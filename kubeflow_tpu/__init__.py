@@ -1,0 +1,19 @@
+"""kubeflow-tpu: a TPU-native ML platform with the capabilities of Kubeflow.
+
+A ground-up rebuild of the Kubeflow platform (reference: cheyang/kubeflow)
+designed TPU-first:
+
+- ``kfctl``-style deployment CLI over a typed :class:`~kubeflow_tpu.config.kfdef.KfDef`
+  config (replaces bootstrap/cmd/kfctl + ksonnet).
+- A typed manifest layer (``kubeflow_tpu.manifests``) stamping out Kubernetes
+  objects (replaces the jsonnet package tree under kubeflow/).
+- CRD training operators (``kubeflow_tpu.operators``) that gang-schedule onto
+  contiguous TPU slices and rendezvous through a JAX coordinator over ICI/DCN
+  (replaces TFJob/PyTorchJob/MPIJob TF_CONFIG/NCCL/MPI wiring).
+- A JAX/XLA compute path (``models``, ``parallel``, ``train``, ``serving``)
+  the reference delegated to external container images.
+"""
+
+from kubeflow_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,3 @@
+from kubeflow_tpu.apis import jobs
+
+__all__ = ["jobs"]

@@ -1,0 +1,81 @@
+"""BenchmarkJob CRD API.
+
+Analogue of kubebench (kubeflow/kubebench/prototypes/kubebench-job.jsonnet:6-23,
+kubebench-operator.jsonnet): a BenchmarkJob wraps a training job template with
+a benchmark config, runs it, scrapes the reported metrics, and records results
+(reporter-csv equivalent) in its status.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+from kubeflow_tpu.k8s import objects as k8s
+from kubeflow_tpu.version import API_GROUP
+
+BENCHMARK_JOB_KIND = "BenchmarkJob"
+BENCHMARK_JOB_PLURAL = "benchmarkjobs"
+BENCHMARK_API_VERSION = f"{API_GROUP}/v1"
+
+
+def benchmark_job_crd() -> dict:
+    schema = {
+        "type": "object",
+        "properties": {
+            "spec": {
+                "type": "object",
+                "properties": {
+                    "jobTemplate": {
+                        "type": "object",
+                        "x-kubernetes-preserve-unknown-fields": True,
+                    },
+                    "metrics": {"type": "array", "items": {"type": "string"}},
+                    "warmupSteps": {"type": "integer", "minimum": 0},
+                    "measureSteps": {"type": "integer", "minimum": 1},
+                    "repetitions": {"type": "integer", "minimum": 1},
+                },
+            },
+            "status": {"type": "object", "x-kubernetes-preserve-unknown-fields": True},
+        },
+    }
+    return k8s.crd(
+        group=API_GROUP,
+        kind=BENCHMARK_JOB_KIND,
+        plural=BENCHMARK_JOB_PLURAL,
+        short_names=["bench"],
+        categories=["all", "kubeflow-tpu"],
+        versions=[
+            k8s.crd_version(
+                "v1",
+                schema=schema,
+                storage=True,
+                printer_columns=[
+                    k8s.printer_column("State", ".status.state"),
+                    k8s.printer_column("Result", ".status.results"),
+                ],
+            )
+        ],
+    )
+
+
+def benchmark_job(
+    name: str,
+    namespace: str,
+    job_template: Mapping[str, Any],
+    metrics: list[str] | None = None,
+    warmup_steps: int = 10,
+    measure_steps: int = 50,
+    repetitions: int = 1,
+) -> dict:
+    return {
+        "apiVersion": BENCHMARK_API_VERSION,
+        "kind": BENCHMARK_JOB_KIND,
+        "metadata": k8s.metadata(name, namespace),
+        "spec": {
+            "jobTemplate": dict(job_template),
+            "metrics": list(metrics or ["samples_per_sec"]),
+            "warmupSteps": warmup_steps,
+            "measureSteps": measure_steps,
+            "repetitions": repetitions,
+        },
+    }

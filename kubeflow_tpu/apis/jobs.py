@@ -1,0 +1,248 @@
+"""Training-job CRD API types.
+
+The platform's job API family — the TPU-native equivalents of the reference's
+five training CRDs (SURVEY.md §2.2):
+
+- ``JaxJob``  — the native kind: SPMD JAX workers gang-scheduled onto a TPU
+  slice, rendezvous via a JAX coordinator (replaces TFJob's PS/Worker +
+  TF_CONFIG model, kubeflow/tf-training/tf-job-operator.libsonnet:10-96).
+- ``TFJob``, ``PyTorchJob``, ``MXNetJob``, ``ChainerJob``, ``MPIJob`` —
+  compatibility kinds with the reference's replica-type surfaces, lowered by
+  their controllers onto the same gang-scheduling core.
+
+All kinds share the replicaSpecs/runPolicy/status-conditions shape the
+reference operators converged on, with a ``tpu`` block replacing
+nvidia.com/gpu counts (e.g. pytorch-job.jsonnet:26-32).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Mapping, Sequence
+
+from kubeflow_tpu.k8s import objects as k8s
+from kubeflow_tpu.version import API_GROUP
+
+JOBS_API_VERSION = f"{API_GROUP}/v1"
+
+# ---------------------------------------------------------------------------
+# Replica types per job kind (reference CRD validation properties, e.g.
+# tf-job-operator.libsonnet:61-96 restricts PS/Worker/Chief/Master/Eval)
+# ---------------------------------------------------------------------------
+
+JAX_JOB_KIND = "JaxJob"
+TF_JOB_KIND = "TFJob"
+PYTORCH_JOB_KIND = "PyTorchJob"
+MXNET_JOB_KIND = "MXNetJob"
+CHAINER_JOB_KIND = "ChainerJob"
+MPI_JOB_KIND = "MPIJob"
+
+REPLICA_TYPES: dict[str, tuple[str, ...]] = {
+    JAX_JOB_KIND: ("Worker",),
+    TF_JOB_KIND: ("Chief", "PS", "Worker", "Evaluator"),
+    PYTORCH_JOB_KIND: ("Master", "Worker"),
+    MXNET_JOB_KIND: ("Scheduler", "Server", "Worker"),
+    CHAINER_JOB_KIND: ("Master", "Worker"),
+    MPI_JOB_KIND: ("Launcher", "Worker"),
+}
+
+# Replica types limited to at most one replica (Chief max 1:
+# tf-job-operator.libsonnet:66-70).
+SINGLETON_REPLICA_TYPES = {"Chief", "Master", "Scheduler", "Launcher"}
+
+PLURALS: dict[str, str] = {
+    JAX_JOB_KIND: "jaxjobs",
+    TF_JOB_KIND: "tfjobs",
+    PYTORCH_JOB_KIND: "pytorchjobs",
+    MXNET_JOB_KIND: "mxnetjobs",
+    CHAINER_JOB_KIND: "chainerjobs",
+    MPI_JOB_KIND: "mpijobs",
+}
+
+ALL_JOB_KINDS = tuple(PLURALS)
+
+# Condition types (mirrors the operator status contract asserted by
+# testing/tf_job_simple_test.py:91 and printed via the CRD printer column
+# tf-job-operator.libsonnet:70-81).
+COND_CREATED = "Created"
+COND_RUNNING = "Running"
+COND_RESTARTING = "Restarting"
+COND_SUCCEEDED = "Succeeded"
+COND_FAILED = "Failed"
+
+RESTART_POLICIES = ("Always", "OnFailure", "Never", "ExitCode")
+CLEAN_POD_POLICIES = ("Running", "All", "None")
+
+# Env vars the controller injects into every worker pod — the TF_CONFIG
+# analogue (launcher.py:69-81) recast for `jax.distributed.initialize`.
+ENV_COORDINATOR_ADDRESS = "JAX_COORDINATOR_ADDRESS"
+ENV_NUM_PROCESSES = "JAX_NUM_PROCESSES"
+ENV_PROCESS_ID = "JAX_PROCESS_ID"
+ENV_SLICE_ID = "MEGASCALE_SLICE_ID"
+ENV_NUM_SLICES = "MEGASCALE_NUM_SLICES"
+ENV_COORDINATOR_PORT = "JAX_COORDINATOR_PORT"
+ENV_TPU_TOPOLOGY = "TPU_TOPOLOGY"
+ENV_TPU_ACCELERATOR = "TPU_ACCELERATOR_TYPE"
+ENV_TPU_WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
+
+DEFAULT_COORDINATOR_PORT = 8476
+
+TPU_RESOURCE = "google.com/tpu"
+
+
+def tpu_resources(chips: int) -> dict | None:
+    """Pod resources block requesting TPU chips; None when chips == 0 (CPU).
+
+    The analogue of the reference's `numGpus` → nvidia.com/gpu limits
+    expansion (kubeflow/pytorch-job/prototypes/pytorch-job.jsonnet:26-32)."""
+    if not chips:
+        return None
+    return {
+        "limits": {TPU_RESOURCE: chips},
+        "requests": {TPU_RESOURCE: chips},
+    }
+
+
+@dataclass(frozen=True)
+class Condition:
+    type: str
+    status: str  # "True" | "False" | "Unknown"
+    reason: str = ""
+    message: str = ""
+    last_transition_time: str = ""
+
+    def to_dict(self) -> dict:
+        return {
+            "type": self.type,
+            "status": self.status,
+            "reason": self.reason,
+            "message": self.message,
+            "lastTransitionTime": self.last_transition_time,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Validation schema shared by all job kinds
+# ---------------------------------------------------------------------------
+
+
+def _replica_spec_schema(replica_types: Sequence[str]) -> dict:
+    props = {}
+    for rt in replica_types:
+        max_replicas = 1 if rt in SINGLETON_REPLICA_TYPES else None
+        replicas: dict = {"type": "integer", "minimum": 0}
+        if max_replicas is not None:
+            replicas["maximum"] = max_replicas
+        props[rt] = {
+            "type": "object",
+            "properties": {
+                "replicas": replicas,
+                "restartPolicy": {"type": "string", "enum": list(RESTART_POLICIES)},
+                "template": {"type": "object", "x-kubernetes-preserve-unknown-fields": True},
+            },
+        }
+    return {"type": "object", "properties": props}
+
+
+def job_schema(kind: str) -> dict:
+    return {
+        "type": "object",
+        "properties": {
+            "spec": {
+                "type": "object",
+                "properties": {
+                    "replicaSpecs": _replica_spec_schema(REPLICA_TYPES[kind]),
+                    "tpu": {
+                        "type": "object",
+                        "properties": {
+                            "accelerator": {"type": "string"},
+                            "topology": {"type": "string"},
+                            "numSlices": {"type": "integer", "minimum": 1},
+                        },
+                    },
+                    "runPolicy": {
+                        "type": "object",
+                        "properties": {
+                            "cleanPodPolicy": {
+                                "type": "string",
+                                "enum": list(CLEAN_POD_POLICIES),
+                            },
+                            "backoffLimit": {"type": "integer", "minimum": 0},
+                            "activeDeadlineSeconds": {"type": "integer", "minimum": 1},
+                            "ttlSecondsAfterFinished": {"type": "integer", "minimum": 0},
+                        },
+                    },
+                },
+                "x-kubernetes-preserve-unknown-fields": True,
+            },
+            "status": {"type": "object", "x-kubernetes-preserve-unknown-fields": True},
+        },
+    }
+
+
+def job_crd(kind: str) -> dict:
+    """CRD for one job kind, with the reference's printer-column surface
+    (tf-job-operator.libsonnet:70-81: State + Age columns)."""
+    return k8s.crd(
+        group=API_GROUP,
+        kind=kind,
+        plural=PLURALS[kind],
+        short_names=[kind.lower().replace("job", "j")],
+        categories=["all", "kubeflow-tpu"],
+        versions=[
+            k8s.crd_version(
+                "v1",
+                schema=job_schema(kind),
+                served=True,
+                storage=True,
+                printer_columns=[
+                    k8s.printer_column("State", ".status.state"),
+                    k8s.printer_column("Age", ".metadata.creationTimestamp", "date"),
+                ],
+            )
+        ],
+    )
+
+
+def all_job_crds() -> list[dict]:
+    return [job_crd(kind) for kind in ALL_JOB_KINDS]
+
+
+# ---------------------------------------------------------------------------
+# Spec validation used by controllers and the webhook
+# ---------------------------------------------------------------------------
+
+
+class JobValidationError(ValueError):
+    pass
+
+
+def validate_job(job: Mapping) -> None:
+    kind = job.get("kind", "")
+    if kind not in REPLICA_TYPES:
+        raise JobValidationError(f"unknown job kind {kind!r}")
+    spec = job.get("spec", {})
+    replica_specs = spec.get("replicaSpecs", {})
+    if not replica_specs:
+        raise JobValidationError(f"{kind} {job['metadata'].get('name')}: spec.replicaSpecs is empty")
+    allowed = REPLICA_TYPES[kind]
+    for rt, rspec in replica_specs.items():
+        if rt not in allowed:
+            raise JobValidationError(
+                f"{kind}: replica type {rt!r} not in {allowed}"
+            )
+        replicas = rspec.get("replicas", 1)
+        if not isinstance(replicas, int) or replicas < 0:
+            raise JobValidationError(f"{kind}/{rt}: invalid replicas {replicas!r}")
+        if rt in SINGLETON_REPLICA_TYPES and replicas > 1:
+            raise JobValidationError(f"{kind}/{rt}: at most 1 replica allowed")
+        rp = rspec.get("restartPolicy")
+        if rp is not None and rp not in RESTART_POLICIES:
+            raise JobValidationError(f"{kind}/{rt}: invalid restartPolicy {rp!r}")
+        tmpl = rspec.get("template", {})
+        if not tmpl.get("spec", {}).get("containers"):
+            raise JobValidationError(f"{kind}/{rt}: template has no containers")
+    rp = spec.get("runPolicy", {})
+    cpp = rp.get("cleanPodPolicy")
+    if cpp is not None and cpp not in CLEAN_POD_POLICIES:
+        raise JobValidationError(f"{kind}: invalid cleanPodPolicy {cpp!r}")

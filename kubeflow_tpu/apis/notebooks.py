@@ -1,0 +1,106 @@
+"""Notebook CRD API.
+
+The analogue of the reference's Notebook CRD, defined both at
+components/notebook-controller/pkg/apis/notebook/v1alpha1/notebook_types.go:28-80
+and kubeflow/jupyter/notebooks.libsonnet:11-20. A Notebook CR describes one
+user notebook server; the controller materialises it as a StatefulSet +
+Service with a gateway route, status mirrored from the pod container state
+(notebook_controller.go:148-263).
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.k8s import objects as k8s
+from kubeflow_tpu.version import API_GROUP
+
+NOTEBOOK_KIND = "Notebook"
+NOTEBOOK_PLURAL = "notebooks"
+NOTEBOOKS_API_VERSION = f"{API_GROUP}/v1"
+
+
+def notebook_crd() -> dict:
+    schema = {
+        "type": "object",
+        "properties": {
+            "spec": {
+                "type": "object",
+                "properties": {
+                    "template": {
+                        "type": "object",
+                        "x-kubernetes-preserve-unknown-fields": True,
+                    },
+                    "tpu": {
+                        "type": "object",
+                        "properties": {
+                            "accelerator": {"type": "string"},
+                            "chips": {"type": "integer", "minimum": 0},
+                        },
+                    },
+                },
+                "x-kubernetes-preserve-unknown-fields": True,
+            },
+            "status": {"type": "object", "x-kubernetes-preserve-unknown-fields": True},
+        },
+    }
+    return k8s.crd(
+        group=API_GROUP,
+        kind=NOTEBOOK_KIND,
+        plural=NOTEBOOK_PLURAL,
+        short_names=["nb"],
+        categories=["all", "kubeflow-tpu"],
+        versions=[
+            k8s.crd_version(
+                "v1",
+                schema=schema,
+                storage=True,
+                printer_columns=[
+                    k8s.printer_column("State", ".status.state"),
+                    k8s.printer_column("Age", ".metadata.creationTimestamp", "date"),
+                ],
+            )
+        ],
+    )
+
+
+def notebook(
+    name: str,
+    namespace: str,
+    image: str,
+    tpu_chips: int = 0,
+    cpu: str = "1",
+    memory: str = "2Gi",
+    workspace_pvc: str | None = None,
+) -> dict:
+    """Build a Notebook CR (what jupyter-web-app POSTs,
+    components/jupyter-web-app/default/routes.py:33-111)."""
+    resources: dict = {"requests": {"cpu": cpu, "memory": memory}}
+    if tpu_chips:
+        resources["limits"] = {"google.com/tpu": tpu_chips}
+    volumes = []
+    mounts = []
+    if workspace_pvc:
+        volumes.append(k8s.pvc_volume("workspace", workspace_pvc))
+        mounts.append(k8s.volume_mount("workspace", "/home/jovyan"))
+    return {
+        "apiVersion": NOTEBOOKS_API_VERSION,
+        "kind": NOTEBOOK_KIND,
+        "metadata": k8s.metadata(name, namespace, {"app": name}),
+        "spec": {
+            "template": {
+                "spec": k8s.pod_spec(
+                    [
+                        k8s.container(
+                            "notebook",
+                            image,
+                            resources=resources,
+                            ports={"notebook": 8888},
+                            volume_mounts=mounts or None,
+                            env={"JUPYTER_ENABLE_LAB": "true"},
+                        )
+                    ],
+                    volumes=volumes or None,
+                )
+            },
+            "tpu": {"chips": tpu_chips},
+        },
+    }

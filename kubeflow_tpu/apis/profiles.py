@@ -1,0 +1,69 @@
+"""Profile CRD API — multi-tenancy.
+
+Analogue of the reference's Profile CRD
+(components/profile-controller/pkg/apis/kubeflow/v1alpha1, reconciled at
+profile_controller.go:108-206): a cluster-scoped CR per user that the
+controller expands into a namespace + namespaced-admin Role + RoleBinding for
+the owner.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.k8s import objects as k8s
+from kubeflow_tpu.version import API_GROUP
+
+PROFILE_KIND = "Profile"
+PROFILE_PLURAL = "profiles"
+PROFILES_API_VERSION = f"{API_GROUP}/v1"
+
+
+def profile_crd() -> dict:
+    schema = {
+        "type": "object",
+        "properties": {
+            "spec": {
+                "type": "object",
+                "properties": {
+                    "owner": {
+                        "type": "object",
+                        "properties": {
+                            "kind": {"type": "string"},
+                            "name": {"type": "string"},
+                        },
+                    },
+                    "resourceQuota": {
+                        "type": "object",
+                        "x-kubernetes-preserve-unknown-fields": True,
+                    },
+                },
+            },
+            "status": {"type": "object", "x-kubernetes-preserve-unknown-fields": True},
+        },
+    }
+    return k8s.crd(
+        group=API_GROUP,
+        kind=PROFILE_KIND,
+        plural=PROFILE_PLURAL,
+        scope="Cluster",
+        categories=["kubeflow-tpu"],
+        versions=[
+            k8s.crd_version(
+                "v1",
+                schema=schema,
+                storage=True,
+                printer_columns=[k8s.printer_column("State", ".status.state")],
+            )
+        ],
+    )
+
+
+def profile(name: str, owner_name: str, owner_kind: str = "User", quota: dict | None = None) -> dict:
+    spec: dict = {"owner": {"kind": owner_kind, "name": owner_name}}
+    if quota:
+        spec["resourceQuota"] = quota
+    return {
+        "apiVersion": PROFILES_API_VERSION,
+        "kind": PROFILE_KIND,
+        "metadata": k8s.metadata(name),
+        "spec": spec,
+    }

@@ -1,0 +1,149 @@
+"""StudyJob CRD API — hyperparameter tuning.
+
+Analogue of Katib's StudyJob CRD (kubeflow/katib/studyjobcontroller.libsonnet:14-38;
+worker/metricsCollector templates :115-147, :351-400). A StudyJob declares an
+objective, a parameter space, a suggestion algorithm, and a trial template
+(a JaxJob); the study controller spawns trial jobs, collects metrics from
+their status, and feeds results back to the suggestion service.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+from kubeflow_tpu.k8s import objects as k8s
+from kubeflow_tpu.version import API_GROUP
+
+STUDY_JOB_KIND = "StudyJob"
+STUDY_JOB_PLURAL = "studyjobs"
+TUNING_API_VERSION = f"{API_GROUP}/v1"
+
+# Suggestion algorithms — parity with suggestion.libsonnet:3-10 (random, grid,
+# hyperband, bayesianoptimization).
+ALGORITHMS = ("random", "grid", "hyperband", "bayesianoptimization")
+
+PARAM_TYPES = ("double", "int", "categorical", "discrete")
+
+OPTIMIZATION_TYPES = ("maximize", "minimize")
+
+
+def study_job_crd() -> dict:
+    schema = {
+        "type": "object",
+        "properties": {
+            "spec": {
+                "type": "object",
+                "properties": {
+                    "objective": {
+                        "type": "object",
+                        "properties": {
+                            "type": {"type": "string", "enum": list(OPTIMIZATION_TYPES)},
+                            "objectiveMetricName": {"type": "string"},
+                            "goal": {"type": "number"},
+                        },
+                    },
+                    "algorithm": {"type": "string", "enum": list(ALGORITHMS)},
+                    "parallelTrialCount": {"type": "integer", "minimum": 1},
+                    "maxTrialCount": {"type": "integer", "minimum": 1},
+                    "maxFailedTrialCount": {"type": "integer", "minimum": 0},
+                    "parameters": {
+                        "type": "array",
+                        "items": {
+                            "type": "object",
+                            "properties": {
+                                "name": {"type": "string"},
+                                "parameterType": {"type": "string", "enum": list(PARAM_TYPES)},
+                                "feasibleSpace": {
+                                    "type": "object",
+                                    "x-kubernetes-preserve-unknown-fields": True,
+                                },
+                            },
+                        },
+                    },
+                    "trialTemplate": {
+                        "type": "object",
+                        "x-kubernetes-preserve-unknown-fields": True,
+                    },
+                },
+            },
+            "status": {"type": "object", "x-kubernetes-preserve-unknown-fields": True},
+        },
+    }
+    return k8s.crd(
+        group=API_GROUP,
+        kind=STUDY_JOB_KIND,
+        plural=STUDY_JOB_PLURAL,
+        short_names=["study"],
+        categories=["all", "kubeflow-tpu"],
+        versions=[
+            k8s.crd_version(
+                "v1",
+                schema=schema,
+                storage=True,
+                printer_columns=[
+                    k8s.printer_column("State", ".status.state"),
+                    k8s.printer_column("Best", ".status.bestObjectiveValue"),
+                    k8s.printer_column("Trials", ".status.completedTrialCount", "integer"),
+                ],
+            )
+        ],
+    )
+
+
+def study_job(
+    name: str,
+    namespace: str,
+    objective_metric: str,
+    parameters: list[dict],
+    trial_template: Mapping[str, Any],
+    algorithm: str = "random",
+    optimization_type: str = "maximize",
+    goal: float | None = None,
+    parallel_trials: int = 2,
+    max_trials: int = 10,
+    max_failed_trials: int = 3,
+) -> dict:
+    objective: dict = {
+        "type": optimization_type,
+        "objectiveMetricName": objective_metric,
+    }
+    if goal is not None:
+        objective["goal"] = goal
+    return {
+        "apiVersion": TUNING_API_VERSION,
+        "kind": STUDY_JOB_KIND,
+        "metadata": k8s.metadata(name, namespace),
+        "spec": {
+            "objective": objective,
+            "algorithm": algorithm,
+            "parallelTrialCount": parallel_trials,
+            "maxTrialCount": max_trials,
+            "maxFailedTrialCount": max_failed_trials,
+            "parameters": list(parameters),
+            "trialTemplate": dict(trial_template),
+        },
+    }
+
+
+def double_param(name: str, min_val: float, max_val: float, log_scale: bool = False) -> dict:
+    return {
+        "name": name,
+        "parameterType": "double",
+        "feasibleSpace": {"min": min_val, "max": max_val, "logScale": log_scale},
+    }
+
+
+def int_param(name: str, min_val: int, max_val: int) -> dict:
+    return {
+        "name": name,
+        "parameterType": "int",
+        "feasibleSpace": {"min": min_val, "max": max_val},
+    }
+
+
+def categorical_param(name: str, choices: list) -> dict:
+    return {
+        "name": name,
+        "parameterType": "categorical",
+        "feasibleSpace": {"list": list(choices)},
+    }

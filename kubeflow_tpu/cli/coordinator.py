@@ -1,0 +1,291 @@
+"""Deployment coordinator: composes a platform driver with the manifest layer.
+
+The analogue of bootstrap/pkg/kfapp/coordinator/coordinator.go — NewKfApp
+(:227), LoadKfApp (:321), Generate (:492), Apply (:385) — plus the ksonnet
+package-manager apply semantics (per-component apply with constant-backoff
+retry, bootstrap/pkg/kfapp/ksonnet/ksonnet.go:132-175).
+
+Lifecycle (4 verbs, KfApp interface analogue, group.go:93-98):
+
+- init:     write app.yaml (KfDef) into a fresh app dir
+- generate: render every component's manifests to <app>/manifests/<name>.yaml
+            (+ platform config, e.g. TPU node-pool specs for gcp-tpu)
+- apply:    platform.apply (provision infra) then apply manifests to the
+            cluster: namespaces/CRDs first, then per-component with retry
+- delete:   reverse: delete components, then optionally cluster-scoped
+            resources + CRDs (the kfctl.sh:511-583 GC flow)
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+import yaml
+
+from kubeflow_tpu.config.kfdef import KfDef, PLATFORM_FAKE
+from kubeflow_tpu.k8s.client import ApiError, K8sClient
+from kubeflow_tpu.manifests.core import generate as generate_prototype
+
+logger = logging.getLogger(__name__)
+
+# Per-component apply retry: 6 attempts, constant 5s backoff
+# (ksonnet.go:147-168 semantics).
+APPLY_RETRIES = 6
+APPLY_BACKOFF_SECONDS = 5.0
+
+# Kinds applied before everything else, in order.
+_PRIORITY_KINDS = ("Namespace", "CustomResourceDefinition")
+# Cluster-scoped kinds garbage-collected on `delete all` (kfctl.sh:529-557
+# deletes clusterrolebindings/clusterroles/crds by label).
+_CLUSTER_SCOPED_GC_KINDS = (
+    "ClusterRoleBinding",
+    "ClusterRole",
+    "MutatingWebhookConfiguration",
+    "ValidatingWebhookConfiguration",
+    "CustomResourceDefinition",
+)
+
+PART_OF_LABEL = "app.kubernetes.io/part-of"
+PLATFORM_LABEL_VALUE = "kubeflow-tpu"
+
+
+@dataclass
+class ApplyReport:
+    applied: list[str] = field(default_factory=list)
+    failed: dict[str, str] = field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return not self.failed
+
+
+class Coordinator:
+    def __init__(
+        self,
+        kfdef: KfDef,
+        client_factory: Callable[[KfDef], K8sClient] | None = None,
+        backoff_seconds: float | None = None,
+    ):
+        self.kfdef = kfdef
+        self._client_factory = client_factory or _default_client_factory
+        self._client: K8sClient | None = None
+        self._backoff = (
+            backoff_seconds
+            if backoff_seconds is not None
+            else (0.0 if kfdef.spec.platform == PLATFORM_FAKE else APPLY_BACKOFF_SECONDS)
+        )
+
+    # ------------------------------------------------------------------
+    # verbs
+    # ------------------------------------------------------------------
+
+    @classmethod
+    def init(cls, kfdef: KfDef, app_dir: str, **kwargs) -> "Coordinator":
+        """Create the app dir and persist app.yaml (NewKfApp analogue)."""
+        os.makedirs(app_dir, exist_ok=True)
+        app_yaml = os.path.join(app_dir, "app.yaml")
+        if os.path.exists(app_yaml):
+            raise FileExistsError(f"{app_yaml} already exists; delete it or use a new dir")
+        kfdef.spec.app_dir = app_dir
+        kfdef.save(app_yaml)
+        return cls(kfdef, **kwargs)
+
+    @classmethod
+    def load(cls, app_dir: str, **kwargs) -> "Coordinator":
+        return cls(KfDef.load_app_dir(app_dir), **kwargs)
+
+    def generate(self, what: str = "all") -> list[str]:
+        """Render component manifests and/or platform config into the app dir.
+
+        ``what`` scopes the verb like the reference CLI
+        (kfctl {generate,apply,delete} {all,k8s,platform}, root.go:23-40):
+        ``k8s`` renders manifests only, ``platform`` writes platform config
+        only, ``all`` does both.
+        """
+        app_dir = self._require_app_dir()
+        written: list[str] = []
+        if what in ("all", "k8s"):
+            mdir = os.path.join(app_dir, "manifests")
+            os.makedirs(mdir, exist_ok=True)
+            for comp in self.kfdef.spec.components:
+                params = dict(comp.params)
+                objs = generate_prototype(comp.prototype_name, self._with_defaults(params))
+                self._label_objects(objs)
+                path = os.path.join(mdir, f"{comp.name}.yaml")
+                with open(path, "w") as f:
+                    yaml.safe_dump_all(objs, f, sort_keys=True)
+                written.append(path)
+        if what in ("all", "platform"):
+            self._generate_platform_config(app_dir)
+        return written
+
+    def apply(self, what: str = "all") -> ApplyReport:
+        """Provision platform (what=all|platform) and apply generated
+        manifests (what=all|k8s)."""
+        if what in ("all", "platform"):
+            self._platform_apply()
+        if what == "platform":
+            return ApplyReport()
+        client = self.client()
+        report = ApplyReport()
+        ns = self.kfdef.spec.namespace
+        # namespace first (ksonnet.go:102-110)
+        try:
+            if client.get_or_none("v1", "Namespace", ns) is None:
+                client.create(
+                    {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": ns}}
+                )
+        except ApiError as e:
+            report.failed["namespace"] = str(e)
+            return report
+
+        components = self._load_generated()
+        # global pass: priority kinds across all components (CRDs must exist
+        # before CRs referencing them)
+        for kind in _PRIORITY_KINDS:
+            for comp_name, objs in components:
+                for obj in objs:
+                    if obj["kind"] == kind:
+                        self._apply_one(client, obj, comp_name, report)
+        for comp_name, objs in components:
+            for obj in objs:
+                if obj["kind"] in _PRIORITY_KINDS:
+                    continue
+                self._apply_one(client, obj, comp_name, report)
+        return report
+
+    def delete(self, what: str = "all", delete_cluster_scoped: bool = True) -> ApplyReport:
+        """Delete deployed components (kfctl.sh:511-583 delete flow).
+
+        ``what=platform`` is a no-op today: cluster deprovisioning is left to
+        the user's infra tooling (parity with `kfctl delete platform`, which
+        the reference also gates behind confirmation)."""
+        if what == "platform":
+            return ApplyReport()
+        client = self.client()
+        report = ApplyReport()
+        components = self._load_generated()
+        for comp_name, objs in components:
+            for obj in objs:
+                if obj["kind"] in _CLUSTER_SCOPED_GC_KINDS:
+                    continue
+                m = obj["metadata"]
+                try:
+                    client.delete_if_exists(
+                        obj["apiVersion"], obj["kind"], m["name"], m.get("namespace")
+                    )
+                    report.applied.append(f"{comp_name}/{obj['kind']}/{m['name']}")
+                except ApiError as e:
+                    report.failed[f"{comp_name}/{obj['kind']}/{m['name']}"] = str(e)
+        if delete_cluster_scoped:
+            for comp_name, objs in components:
+                for kind in _CLUSTER_SCOPED_GC_KINDS:
+                    for obj in objs:
+                        if obj["kind"] != kind:
+                            continue
+                        m = obj["metadata"]
+                        try:
+                            client.delete_if_exists(obj["apiVersion"], kind, m["name"])
+                            report.applied.append(f"{comp_name}/{kind}/{m['name']}")
+                        except ApiError as e:
+                            report.failed[f"{comp_name}/{kind}/{m['name']}"] = str(e)
+        return report
+
+    def show(self) -> list[dict]:
+        """All generated objects (ks show analogue)."""
+        out: list[dict] = []
+        for _, objs in self._load_generated():
+            out.extend(objs)
+        return out
+
+    # ------------------------------------------------------------------
+    # helpers
+    # ------------------------------------------------------------------
+
+    def client(self) -> K8sClient:
+        if self._client is None:
+            self._client = self._client_factory(self.kfdef)
+        return self._client
+
+    def _require_app_dir(self) -> str:
+        if not self.kfdef.spec.app_dir:
+            raise ValueError("KfDef has no app_dir; use Coordinator.init/load")
+        return self.kfdef.spec.app_dir
+
+    def _with_defaults(self, params: dict) -> dict:
+        params.setdefault("namespace", self.kfdef.spec.namespace)
+        return params
+
+    def _label_objects(self, objs: list[dict]) -> None:
+        for obj in objs:
+            labels = obj["metadata"].setdefault("labels", {})
+            labels.setdefault(PART_OF_LABEL, PLATFORM_LABEL_VALUE)
+
+    def _load_generated(self) -> list[tuple[str, list[dict]]]:
+        app_dir = self._require_app_dir()
+        mdir = os.path.join(app_dir, "manifests")
+        if not os.path.isdir(mdir):
+            raise FileNotFoundError(
+                f"{mdir} does not exist; run `kfctl generate` first"
+            )
+        out = []
+        for comp in self.kfdef.spec.components:
+            path = os.path.join(mdir, f"{comp.name}.yaml")
+            if not os.path.exists(path):
+                raise FileNotFoundError(
+                    f"{path} missing; re-run `kfctl generate` (component {comp.name})"
+                )
+            with open(path) as f:
+                objs = [o for o in yaml.safe_load_all(f) if o]
+            out.append((comp.name, objs))
+        return out
+
+    def _apply_one(
+        self, client: K8sClient, obj: dict, comp_name: str, report: ApplyReport
+    ) -> None:
+        m = obj["metadata"]
+        key = f"{comp_name}/{obj['kind']}/{m['name']}"
+        last_err: Exception | None = None
+        for attempt in range(APPLY_RETRIES):
+            try:
+                client.apply(obj)
+                report.applied.append(key)
+                return
+            except ApiError as e:
+                last_err = e
+                # 4xx (other than 409 conflict races) won't heal by retrying
+                if 400 <= e.code < 500 and e.code != 409:
+                    break
+                logger.warning("apply %s attempt %d failed: %s", key, attempt + 1, e)
+                if self._backoff:
+                    time.sleep(self._backoff)
+            except Exception as e:  # network-level errors: retry
+                last_err = e
+                logger.warning("apply %s attempt %d failed: %s", key, attempt + 1, e)
+                if self._backoff:
+                    time.sleep(self._backoff)
+        report.failed[key] = str(last_err)
+
+    # ------------------------------------------------------------------
+    # platform drivers
+    # ------------------------------------------------------------------
+
+    def _platform_apply(self) -> None:
+        from kubeflow_tpu.cli import platforms
+
+        platforms.get_platform(self.kfdef.spec.platform).apply(self.kfdef)
+
+    def _generate_platform_config(self, app_dir: str) -> None:
+        from kubeflow_tpu.cli import platforms
+
+        platforms.get_platform(self.kfdef.spec.platform).generate(self.kfdef, app_dir)
+
+
+def _default_client_factory(kfdef: KfDef) -> K8sClient:
+    from kubeflow_tpu.cli import platforms
+
+    return platforms.get_platform(kfdef.spec.platform).client(kfdef)

@@ -1,0 +1,148 @@
+"""kfctl: the deployment CLI.
+
+The analogue of bootstrap/cmd/kfctl (cobra commands
+init/generate/apply/delete/show/version, root.go:23-40) and scripts/kfctl.sh.
+
+Usage:
+    kfctl init <app-name> --platform gcp-tpu --project p --zone us-central2-b
+    kfctl generate [all|k8s|platform]
+    kfctl apply    [all|k8s|platform]
+    kfctl delete   [all|k8s]
+    kfctl show
+    kfctl version
+
+State lives in <app-dir>/app.yaml (KfDef), like the reference's app.yaml +
+env.sh persistence (kfctl.sh:44-75).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import yaml
+
+from kubeflow_tpu.cli.coordinator import Coordinator
+from kubeflow_tpu.config import defaults
+from kubeflow_tpu.config.kfdef import ALLOWED_PLATFORMS
+from kubeflow_tpu.version import __version__
+
+
+def _add_init(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser("init", help="create a new kubeflow-tpu app dir")
+    p.add_argument("name", help="app name (also the app dir unless --app-dir)")
+    p.add_argument("--app-dir", default=None)
+    p.add_argument("--platform", default="none", choices=ALLOWED_PLATFORMS)
+    p.add_argument("--namespace", default="kubeflow")
+    p.add_argument("--project", default="", help="cloud project (gcp-tpu)")
+    p.add_argument("--zone", default="")
+    p.add_argument("--accelerator", default="v5litepod-8")
+    p.add_argument("--topology", default="2x4")
+    p.add_argument("--num-slices", type=int, default=1)
+    p.add_argument("--use-basic-auth", action="store_true")
+
+
+def _add_verb(sub: argparse._SubParsersAction, verb: str, help_: str) -> None:
+    p = sub.add_parser(verb, help=help_)
+    p.add_argument(
+        "what",
+        nargs="?",
+        default="all",
+        choices=["all", "k8s", "platform"],
+        help="scope (reference kfctl semantics)",
+    )
+    p.add_argument("--app-dir", default=".", help="app dir (default: cwd)")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(prog="kfctl", description=__doc__)
+    ap.add_argument("-v", "--verbose", action="store_true")
+    sub = ap.add_subparsers(dest="command", required=True)
+    _add_init(sub)
+    _add_verb(sub, "generate", "render component manifests into the app dir")
+    _add_verb(sub, "apply", "provision platform and apply manifests")
+    _add_verb(sub, "delete", "delete deployed resources")
+    show = sub.add_parser("show", help="print generated manifests")
+    show.add_argument("--app-dir", default=".")
+    sub.add_parser("version", help="print version")
+    return ap
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(levelname)s %(name)s: %(message)s",
+    )
+    try:
+        return _dispatch(args)
+    except (ValueError, FileNotFoundError, FileExistsError, RuntimeError) as e:
+        print(f"kfctl: error: {e}", file=sys.stderr)
+        return 1
+
+
+def _dispatch(args: argparse.Namespace) -> int:
+    if args.command == "version":
+        print(__version__)
+        return 0
+
+    if args.command == "init":
+        app_dir = args.app_dir or os.path.abspath(args.name)
+        kfdef = defaults.default_kfdef(
+            args.name,
+            platform=args.platform,
+            namespace=args.namespace,
+            project=args.project,
+            zone=args.zone,
+            accelerator=args.accelerator,
+            topology=args.topology,
+            num_slices=args.num_slices,
+            use_basic_auth=args.use_basic_auth,
+        )
+        Coordinator.init(kfdef, app_dir)
+        print(f"initialized app {args.name!r} in {app_dir} (platform={args.platform})")
+        print(f"components: {', '.join(c.name for c in kfdef.spec.components)}")
+        return 0
+
+    coord = Coordinator.load(os.path.abspath(args.app_dir))
+
+    if args.command == "generate":
+        written = coord.generate(args.what)
+        for path in written:
+            print(f"generated {os.path.relpath(path)}")
+        return 0
+
+    if args.command == "apply":
+        if args.what in ("all", "k8s") and not os.path.isdir(
+            os.path.join(coord.kfdef.spec.app_dir, "manifests")
+        ):
+            coord.generate(args.what)
+        report = coord.apply(args.what)
+        print(f"applied {len(report.applied)} objects")
+        if report.failed:
+            for key, err in report.failed.items():
+                print(f"FAILED {key}: {err}", file=sys.stderr)
+            return 1
+        return 0
+
+    if args.command == "delete":
+        report = coord.delete(args.what)
+        print(f"deleted {len(report.applied)} objects")
+        if report.failed:
+            for key, err in report.failed.items():
+                print(f"FAILED {key}: {err}", file=sys.stderr)
+            return 1
+        return 0
+
+    if args.command == "show":
+        objs = coord.show()
+        sys.stdout.write(yaml.safe_dump_all(objs, sort_keys=True))
+        return 0
+
+    raise ValueError(f"unknown command {args.command}")
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,227 @@
+"""Platform drivers: infra provisioning + cluster connection per platform.
+
+The analogue of the platform KfApps — gcp (bootstrap/pkg/kfapp/gcp/gcp.go:
+generateDMConfigs :951, updateDM :480, blockingWait :221), minikube
+(minikube.go:44-138) — recast for TPU:
+
+- ``fake``     : in-process FakeApiServer (tests, dry-run deploys)
+- ``none``     : bring-your-own cluster, connect via kubectl-proxy/KUBECONFIG
+- ``minikube`` : local cluster via kubectl proxy
+- ``gcp-tpu``  : writes TPU cluster provisioning configs (the
+  cluster-kubeflow.yaml/cluster.jinja analogue with TPU slice node pools
+  replacing the GPU pool at cluster.jinja:132-158) and shells out to gcloud
+  when available.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shutil
+import subprocess
+
+import yaml
+
+from kubeflow_tpu.config.kfdef import (
+    KfDef,
+    PLATFORM_FAKE,
+    PLATFORM_GCP_TPU,
+    PLATFORM_MINIKUBE,
+    PLATFORM_NONE,
+)
+from kubeflow_tpu.k8s.client import ClusterConfig, HttpK8sClient, K8sClient
+from kubeflow_tpu.k8s.fake import FakeApiServer
+
+logger = logging.getLogger(__name__)
+
+
+class Platform:
+    """Platform driver interface (KfApp Init/Generate/Apply/Delete analogue
+    restricted to the infra half; manifests are the coordinator's job)."""
+
+    name = "base"
+
+    def generate(self, kfdef: KfDef, app_dir: str) -> None:
+        """Write platform config files into the app dir."""
+
+    def apply(self, kfdef: KfDef) -> None:
+        """Provision/verify infrastructure."""
+
+    def client(self, kfdef: KfDef) -> K8sClient:
+        raise NotImplementedError
+
+
+class FakePlatform(Platform):
+    """In-process cluster. One FakeApiServer per process, shared across
+    coordinator instances so apply/show/delete see the same state."""
+
+    name = PLATFORM_FAKE
+    _shared: FakeApiServer | None = None
+
+    @classmethod
+    def shared_server(cls) -> FakeApiServer:
+        if cls._shared is None:
+            cls._shared = FakeApiServer()
+        return cls._shared
+
+    @classmethod
+    def reset(cls) -> None:
+        cls._shared = None
+
+    def client(self, kfdef: KfDef) -> K8sClient:
+        return self.shared_server()
+
+
+class NonePlatform(Platform):
+    """User brings a cluster; we connect via $KUBEFLOW_TPU_APISERVER or the
+    kubectl-proxy default."""
+
+    name = PLATFORM_NONE
+
+    def client(self, kfdef: KfDef) -> K8sClient:
+        host = os.environ.get("KUBEFLOW_TPU_APISERVER", "http://127.0.0.1:8001")
+        token = os.environ.get("KUBEFLOW_TPU_TOKEN")
+        return HttpK8sClient(ClusterConfig(host=host, token=token))
+
+
+class MinikubePlatform(NonePlatform):
+    name = PLATFORM_MINIKUBE
+
+    def apply(self, kfdef: KfDef) -> None:
+        if shutil.which("minikube") is None:
+            logger.warning("minikube binary not found; assuming cluster is already up")
+            return
+        status = subprocess.run(
+            ["minikube", "status", "--format", "{{.Host}}"],
+            capture_output=True,
+            text=True,
+        )
+        if "Running" not in status.stdout:
+            raise RuntimeError("minikube is not running; `minikube start` first")
+
+
+class GcpTpuPlatform(NonePlatform):
+    """GKE + TPU node pools.
+
+    generate() writes cluster provisioning configs into
+    <app_dir>/gcp_config/ (the generateDMConfigs analogue, gcp.go:951):
+    a cluster spec with a TPU slice node pool per KfDef.spec.tpu — this is
+    the file a user feeds to gcloud/terraform. apply() runs gcloud when
+    installed, else instructs.
+    """
+
+    name = PLATFORM_GCP_TPU
+
+    def generate(self, kfdef: KfDef, app_dir: str) -> None:
+        cfg_dir = os.path.join(app_dir, "gcp_config")
+        os.makedirs(cfg_dir, exist_ok=True)
+        tpu = kfdef.spec.tpu
+        cluster = {
+            "cluster": {
+                "name": kfdef.name,
+                "project": kfdef.spec.project,
+                "zone": kfdef.spec.zone,
+                "releaseChannel": "regular",
+                # CPU pool for platform components (cluster-kubeflow.yaml:47
+                # analogue)
+                "nodePools": [
+                    {
+                        "name": "platform-pool",
+                        "machineType": "n2-standard-8",
+                        "initialNodeCount": 2,
+                        "autoscaling": {"enabled": True, "minNodeCount": 2, "maxNodeCount": 10},
+                    },
+                    # TPU slice pool — replaces the GPU pool
+                    # (cluster.jinja:132-158). One node per TPU VM host;
+                    # gke placement policy keeps slices contiguous.
+                    {
+                        "name": "tpu-pool",
+                        "machineType": _tpu_machine_type(tpu.accelerator),
+                        "initialNodeCount": 0,
+                        "autoscaling": {"enabled": True, "minNodeCount": 0, "maxNodeCount": 32},
+                        "placementPolicy": {"tpuTopology": tpu.topology},
+                        "config": {
+                            "reservationAffinity": (
+                                {"consumeReservationType": "ANY_RESERVATION"}
+                                if tpu.reserved
+                                else {"consumeReservationType": "NO_RESERVATION"}
+                            ),
+                            "labels": {
+                                "kubeflow-tpu.org/accelerator": tpu.accelerator,
+                            },
+                        },
+                        "multislice": {"numSlices": tpu.num_slices},
+                    },
+                ],
+            }
+        }
+        with open(os.path.join(cfg_dir, "cluster.yaml"), "w") as f:
+            yaml.safe_dump(cluster, f, sort_keys=False)
+        iam = {
+            "bindings": [
+                {
+                    "role": "roles/tpu.admin",
+                    "members": [f"serviceAccount:{kfdef.name}-admin@{kfdef.spec.project}.iam.gserviceaccount.com"],
+                },
+                {
+                    "role": "roles/logging.logWriter",
+                    "members": [f"serviceAccount:{kfdef.name}-vm@{kfdef.spec.project}.iam.gserviceaccount.com"],
+                },
+            ]
+        }
+        with open(os.path.join(cfg_dir, "iam_bindings.yaml"), "w") as f:
+            yaml.safe_dump(iam, f, sort_keys=False)
+
+    def apply(self, kfdef: KfDef) -> None:
+        if shutil.which("gcloud") is None:
+            logger.warning(
+                "gcloud not installed; provision the cluster from "
+                "%s/gcp_config/cluster.yaml manually",
+                kfdef.spec.app_dir,
+            )
+            return
+        cfg = os.path.join(kfdef.spec.app_dir, "gcp_config", "cluster.yaml")
+        with open(cfg) as f:
+            cluster = yaml.safe_load(f)["cluster"]
+        existing = subprocess.run(
+            [
+                "gcloud", "container", "clusters", "list",
+                f"--project={cluster['project']}", f"--zone={cluster['zone']}",
+                "--format=json",
+            ],
+            capture_output=True,
+            text=True,
+        )
+        names = [c["name"] for c in json.loads(existing.stdout or "[]")]
+        if cluster["name"] not in names:
+            raise RuntimeError(
+                f"cluster {cluster['name']} not found in project; create it with "
+                f"gcloud container clusters create-auto (see {cfg})"
+            )
+
+
+_PLATFORMS: dict[str, Platform] = {
+    p.name: p()
+    for p in (FakePlatform, NonePlatform, MinikubePlatform, GcpTpuPlatform)
+}
+
+
+def get_platform(name: str) -> Platform:
+    try:
+        return _PLATFORMS[name]
+    except KeyError:
+        raise ValueError(f"unknown platform {name!r}; known: {sorted(_PLATFORMS)}")
+
+
+def _tpu_machine_type(accelerator: str) -> str:
+    """Map TPU accelerator type to the GKE machine type family."""
+    if accelerator.startswith("v5litepod"):
+        return "ct5lp-hightpu-4t"
+    if accelerator.startswith("v5p"):
+        return "ct5p-hightpu-4t"
+    if accelerator.startswith("v4"):
+        return "ct4p-hightpu-4t"
+    if accelerator.startswith("v6e"):
+        return "ct6e-standard-4t"
+    return "ct5lp-hightpu-4t"

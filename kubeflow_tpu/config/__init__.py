@@ -1,0 +1,4 @@
+from kubeflow_tpu.config.kfdef import KfDef, KfDefSpec, Param
+from kubeflow_tpu.config import defaults
+
+__all__ = ["KfDef", "KfDefSpec", "Param", "defaults"]

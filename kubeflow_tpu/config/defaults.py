@@ -1,0 +1,76 @@
+"""Default component sets per platform.
+
+The analogue of bootstrap/config/kfctl_default.yaml:5-40 (and the iap /
+basic_auth variants): which components `kfctl init` puts in a fresh app.yaml
+for each platform.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.config.kfdef import (
+    ComponentConfig,
+    KfDef,
+    KfDefSpec,
+    PLATFORM_FAKE,
+    PLATFORM_GCP_TPU,
+    PLATFORM_MINIKUBE,
+    PLATFORM_NONE,
+    TpuSpec,
+)
+
+# Core components every platform gets (the kfctl_default.yaml core list).
+CORE_COMPONENTS = [
+    "gateway",
+    "centraldashboard",
+    "training-operator",
+    "training-dashboard",
+    "notebook-controller",
+    "jupyter-web-app",
+    "profile-controller",
+    "study-controller",
+    "benchmark-operator",
+    "metric-collector",
+]
+
+# Extra components for cloud deployments.
+GCP_COMPONENTS = [
+    "admission-webhook",
+]
+
+# Deliberately optional (match reference opt-ins: spartakus, echo-server).
+OPTIONAL_COMPONENTS = [
+    "usage-reporter",
+    "echo-server",
+]
+
+
+def default_components(platform: str) -> list[ComponentConfig]:
+    names = list(CORE_COMPONENTS)
+    if platform == PLATFORM_GCP_TPU:
+        names += GCP_COMPONENTS
+    return [ComponentConfig(name=n) for n in names]
+
+
+def default_kfdef(
+    name: str,
+    platform: str = PLATFORM_NONE,
+    namespace: str = "kubeflow",
+    project: str = "",
+    zone: str = "",
+    accelerator: str = "v5litepod-8",
+    topology: str = "2x4",
+    num_slices: int = 1,
+    use_basic_auth: bool = False,
+) -> KfDef:
+    spec = KfDefSpec(
+        platform=platform,
+        namespace=namespace,
+        project=project,
+        zone=zone,
+        use_basic_auth=use_basic_auth,
+        tpu=TpuSpec(
+            accelerator=accelerator, topology=topology, num_slices=num_slices
+        ),
+        components=default_components(platform),
+    )
+    return KfDef(name=name, spec=spec)

@@ -1,0 +1,201 @@
+"""KfDef: the platform's typed application config.
+
+The analogue of the reference's KfDef CRD schema
+(bootstrap/pkg/apis/apps/kfdef/v1alpha1/application_types.go:24-92): an
+app.yaml written by `kfctl init`, read by generate/apply/delete, describing the
+target platform, the set of components to deploy, and per-component parameter
+overrides. Where the reference layers ksonnet concepts (registries, packages,
+prototypes, modules), here a *component* is simply a named entry in the
+manifest package registry plus a param dict.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from dataclasses import dataclass, field
+from typing import Any
+
+import yaml
+
+from kubeflow_tpu.version import API_GROUP, DEFAULT_NAMESPACE, __version__
+
+KFDEF_API_VERSION = f"{API_GROUP}/v1"
+KFDEF_KIND = "KfDef"
+
+# Platforms the deployment engine knows how to drive (the analogue of the
+# AllowedPlatforms list, bootstrap/pkg/apis/apps/group.go:38-56).
+PLATFORM_NONE = "none"          # manifests only; user brings a cluster
+PLATFORM_FAKE = "fake"          # in-process fake apiserver (tests / dry runs)
+PLATFORM_MINIKUBE = "minikube"
+PLATFORM_GCP_TPU = "gcp-tpu"    # GKE + TPU node pools / PodSlices
+ALLOWED_PLATFORMS = (PLATFORM_NONE, PLATFORM_FAKE, PLATFORM_MINIKUBE, PLATFORM_GCP_TPU)
+
+
+@dataclass
+class Param:
+    """One component parameter override (KsParameter analogue,
+    application_types.go:77-81)."""
+
+    name: str
+    value: Any
+
+
+@dataclass
+class ComponentConfig:
+    """A component to deploy: references a prototype in the manifest registry
+    (KsComponent analogue, application_types.go:63-67)."""
+
+    name: str                         # instance name (also default object name prefix)
+    prototype: str | None = None      # registry prototype; defaults to `name`
+    params: dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def prototype_name(self) -> str:
+        return self.prototype or self.name
+
+
+@dataclass
+class TpuSpec:
+    """TPU fleet description used by the gcp-tpu platform and the operators'
+    topology allocator — the analogue of the GPU node-pool block in
+    deployment/gke/deployment_manager_configs/cluster.jinja:132-158, recast
+    for TPU slices."""
+
+    accelerator: str = "v5litepod-8"   # TPU type / slice shape
+    topology: str = "2x4"              # physical chip topology of the slice
+    num_slices: int = 1                # multislice (DCN-connected) count
+    runtime_version: str = "tpu-ubuntu2204-base"
+    reserved: bool = False
+
+
+@dataclass
+class KfDefSpec:
+    platform: str = PLATFORM_NONE
+    namespace: str = DEFAULT_NAMESPACE
+    app_dir: str = ""
+    project: str = ""                  # cloud project (gcp-tpu)
+    zone: str = ""
+    email: str = ""
+    ip_name: str = ""
+    hostname: str = ""
+    use_basic_auth: bool = False
+    use_istio: bool = False
+    enable_applications: bool = True
+    delete_storage: bool = False
+    tpu: TpuSpec = field(default_factory=TpuSpec)
+    components: list[ComponentConfig] = field(default_factory=list)
+    version: str = __version__
+
+    def component(self, name: str) -> ComponentConfig | None:
+        for c in self.components:
+            if c.name == name:
+                return c
+        return None
+
+
+@dataclass
+class KfDef:
+    name: str
+    spec: KfDefSpec = field(default_factory=KfDefSpec)
+
+    # ------------------------------------------------------------------
+    # (de)serialisation
+    # ------------------------------------------------------------------
+
+    def to_dict(self) -> dict:
+        return {
+            "apiVersion": KFDEF_API_VERSION,
+            "kind": KFDEF_KIND,
+            "metadata": {"name": self.name, "namespace": self.spec.namespace},
+            "spec": _spec_to_dict(self.spec),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "KfDef":
+        if d.get("kind") != KFDEF_KIND:
+            raise ValueError(f"not a KfDef: kind={d.get('kind')!r}")
+        spec_d = dict(d.get("spec", {}))
+        tpu_d = {_camel_to_snake(k): v for k, v in spec_d.pop("tpu", {}).items()}
+        tpu_known = {f.name for f in dataclasses.fields(TpuSpec)}
+        tpu_unknown = set(tpu_d) - tpu_known
+        if tpu_unknown:
+            raise ValueError(f"unknown KfDef tpu fields: {sorted(tpu_unknown)}")
+        tpu = TpuSpec(**tpu_d)
+        comps = [
+            ComponentConfig(
+                name=c["name"],
+                prototype=c.get("prototype"),
+                params=dict(c.get("params", {})),
+            )
+            for c in spec_d.pop("components", [])
+        ]
+        known = {f.name for f in dataclasses.fields(KfDefSpec)}
+        fields = {_camel_to_snake(k): v for k, v in spec_d.items()}
+        unknown = set(fields) - known
+        if unknown:
+            raise ValueError(f"unknown KfDef spec fields: {sorted(unknown)}")
+        spec = KfDefSpec(tpu=tpu, components=comps, **fields)
+        if spec.platform not in ALLOWED_PLATFORMS:
+            raise ValueError(
+                f"platform {spec.platform!r} not in {ALLOWED_PLATFORMS}"
+            )
+        return cls(name=d["metadata"]["name"], spec=spec)
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            yaml.safe_dump(self.to_dict(), f, sort_keys=False)
+
+    @classmethod
+    def load(cls, path: str) -> "KfDef":
+        with open(path) as f:
+            return cls.from_dict(yaml.safe_load(f))
+
+    @classmethod
+    def load_app_dir(cls, app_dir: str) -> "KfDef":
+        """Load the app.yaml in an app directory (coordinator.LoadKfApp
+        analogue, bootstrap/pkg/kfapp/coordinator/coordinator.go:321)."""
+        path = os.path.join(app_dir, "app.yaml")
+        if not os.path.exists(path):
+            raise FileNotFoundError(f"no app.yaml in {app_dir}; run `kfctl init` first")
+        kfdef = cls.load(path)
+        kfdef.spec.app_dir = app_dir
+        return kfdef
+
+
+def _camel_to_snake(name: str) -> str:
+    out = []
+    for ch in name:
+        if ch.isupper():
+            out.append("_")
+            out.append(ch.lower())
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+def _snake_to_camel(name: str) -> str:
+    parts = name.split("_")
+    return parts[0] + "".join(p.title() for p in parts[1:])
+
+
+def _spec_to_dict(spec: KfDefSpec) -> dict:
+    out: dict[str, Any] = {}
+    for f in dataclasses.fields(spec):
+        v = getattr(spec, f.name)
+        if f.name == "tpu":
+            out["tpu"] = {
+                _snake_to_camel(k): val for k, val in dataclasses.asdict(v).items()
+            }
+        elif f.name == "components":
+            out["components"] = [
+                {
+                    "name": c.name,
+                    **({"prototype": c.prototype} if c.prototype else {}),
+                    **({"params": c.params} if c.params else {}),
+                }
+                for c in v
+            ]
+        else:
+            out[_snake_to_camel(f.name)] = v
+    return out

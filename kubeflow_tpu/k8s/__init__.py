@@ -1,0 +1,5 @@
+from kubeflow_tpu.k8s import objects
+from kubeflow_tpu.k8s.client import ApiError, K8sClient
+from kubeflow_tpu.k8s.fake import FakeApiServer
+
+__all__ = ["objects", "K8sClient", "ApiError", "FakeApiServer"]

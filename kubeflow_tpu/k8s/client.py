@@ -1,0 +1,400 @@
+"""Kubernetes API client layer.
+
+The reference's Go components all speak to the apiserver through client-go
+(bootstrap/pkg/apis/apps/group.go kube client helpers); its Python components
+use the official kubernetes client (components/openmpi-controller). Neither is
+available here, so the platform ships its own thin client:
+
+- :class:`K8sClient` — the abstract CRUD+watch surface every controller, the
+  CLI apply path, and web apps are written against.
+- :class:`HttpK8sClient` — a real apiserver backend over HTTP (requests),
+  resolving REST paths from a kind→plural registry.
+- :class:`kubeflow_tpu.k8s.fake.FakeApiServer` — an in-process backend with
+  identical semantics, used by unit tests (the envtest analogue, SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import logging
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterator, Mapping, Sequence
+
+
+class ApiError(Exception):
+    """Kubernetes-style API error with an HTTP status code."""
+
+    def __init__(self, code: int, reason: str, message: str = ""):
+        super().__init__(f"{code} {reason}: {message}")
+        self.code = code
+        self.reason = reason
+        self.message = message
+
+    @classmethod
+    def not_found(cls, what: str) -> "ApiError":
+        return cls(404, "NotFound", what)
+
+    @classmethod
+    def conflict(cls, what: str) -> "ApiError":
+        return cls(409, "Conflict", what)
+
+    @classmethod
+    def already_exists(cls, what: str) -> "ApiError":
+        return cls(409, "AlreadyExists", what)
+
+    @classmethod
+    def invalid(cls, what: str) -> "ApiError":
+        return cls(422, "Invalid", what)
+
+
+@dataclass(frozen=True)
+class WatchEvent:
+    type: str  # ADDED | MODIFIED | DELETED
+    object: dict
+
+
+# Built-in kind → (plural, namespaced). CRD kinds are registered at runtime.
+_BUILTIN_KINDS: dict[str, tuple[str, bool]] = {
+    "Pod": ("pods", True),
+    "Service": ("services", True),
+    "ConfigMap": ("configmaps", True),
+    "Secret": ("secrets", True),
+    "Namespace": ("namespaces", False),
+    "PersistentVolumeClaim": ("persistentvolumeclaims", True),
+    "ServiceAccount": ("serviceaccounts", True),
+    "Deployment": ("deployments", True),
+    "StatefulSet": ("statefulsets", True),
+    "Job": ("jobs", True),
+    "CronJob": ("cronjobs", True),
+    "Event": ("events", True),
+    "Role": ("roles", True),
+    "RoleBinding": ("rolebindings", True),
+    "ClusterRole": ("clusterroles", False),
+    "ClusterRoleBinding": ("clusterrolebindings", False),
+    "CustomResourceDefinition": ("customresourcedefinitions", False),
+    "MutatingWebhookConfiguration": ("mutatingwebhookconfigurations", False),
+    "ValidatingWebhookConfiguration": ("validatingwebhookconfigurations", False),
+}
+
+
+class KindRegistry:
+    """Resolves kind → REST plural/scope; extended when CRDs are applied."""
+
+    def __init__(self) -> None:
+        self._kinds = dict(_BUILTIN_KINDS)
+        self._lock = threading.Lock()
+
+    def register_crd(self, crd_obj: Mapping[str, Any]) -> None:
+        spec = crd_obj["spec"]
+        kind = spec["names"]["kind"]
+        plural = spec["names"]["plural"]
+        namespaced = spec.get("scope", "Namespaced") == "Namespaced"
+        with self._lock:
+            self._kinds[kind] = (plural, namespaced)
+
+    def plural(self, kind: str) -> str:
+        try:
+            return self._kinds[kind][0]
+        except KeyError:
+            raise ApiError.not_found(f"no REST mapping for kind {kind}")
+
+    def namespaced(self, kind: str) -> bool:
+        try:
+            return self._kinds[kind][1]
+        except KeyError:
+            raise ApiError.not_found(f"no REST mapping for kind {kind}")
+
+
+class K8sClient:
+    """Abstract CRUD + watch surface.
+
+    Objects are plain dicts with apiVersion/kind/metadata, exactly as built by
+    :mod:`kubeflow_tpu.k8s.objects`.
+    """
+
+    def create(self, obj: dict) -> dict:
+        raise NotImplementedError
+
+    def get(self, api_version: str, kind: str, name: str, namespace: str | None = None) -> dict:
+        raise NotImplementedError
+
+    def list(
+        self,
+        api_version: str,
+        kind: str,
+        namespace: str | None = None,
+        label_selector: Mapping[str, str] | None = None,
+    ) -> list[dict]:
+        raise NotImplementedError
+
+    def update(self, obj: dict) -> dict:
+        raise NotImplementedError
+
+    def update_status(self, obj: dict) -> dict:
+        raise NotImplementedError
+
+    def patch(self, api_version: str, kind: str, name: str, patch: dict, namespace: str | None = None) -> dict:
+        raise NotImplementedError
+
+    def delete(self, api_version: str, kind: str, name: str, namespace: str | None = None) -> None:
+        raise NotImplementedError
+
+    def watch(
+        self, api_version: str, kind: str, namespace: str | None = None
+    ) -> "WatchStream":
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
+    # Conveniences shared by all backends
+    # ------------------------------------------------------------------
+
+    def apply(self, obj: dict) -> dict:
+        """Create-or-update (the `ks apply` / kubectl-apply analogue used by
+        the deployment engine, bootstrap/pkg/kfapp/ksonnet/ksonnet.go:132-175)."""
+        m = obj["metadata"]
+        try:
+            existing = self.get(
+                obj["apiVersion"], obj["kind"], m["name"], m.get("namespace")
+            )
+        except ApiError as e:
+            if e.code != 404:
+                raise
+            return self.create(obj)
+        merged = copy.deepcopy(obj)
+        merged["metadata"]["resourceVersion"] = existing["metadata"].get("resourceVersion")
+        return self.update(merged)
+
+    def get_or_none(self, api_version: str, kind: str, name: str, namespace: str | None = None) -> dict | None:
+        try:
+            return self.get(api_version, kind, name, namespace)
+        except ApiError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    def delete_if_exists(self, api_version: str, kind: str, name: str, namespace: str | None = None) -> bool:
+        try:
+            self.delete(api_version, kind, name, namespace)
+            return True
+        except ApiError as e:
+            if e.code == 404:
+                return False
+            raise
+
+
+class WatchStream:
+    """Iterator of WatchEvents with a stop handle, backed by a queue."""
+
+    def __init__(self, on_stop: Callable[[], None] | None = None):
+        self._q: "queue.Queue[WatchEvent | None]" = queue.Queue()
+        self._on_stop = on_stop
+        self._stopped = threading.Event()
+
+    def push(self, event: WatchEvent) -> None:
+        self._q.put(event)
+
+    def stop(self) -> None:
+        if not self._stopped.is_set():
+            self._stopped.set()
+            if self._on_stop:
+                self._on_stop()
+            self._q.put(None)
+
+    def __iter__(self) -> Iterator[WatchEvent]:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            yield item
+
+    def next(self, timeout: float | None = None) -> WatchEvent | None:
+        """Get the next event, or None on timeout/stop."""
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+
+def match_labels(obj: Mapping[str, Any], selector: Mapping[str, str] | None) -> bool:
+    if not selector:
+        return True
+    labels = obj.get("metadata", {}).get("labels", {}) or {}
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+def merge_patch(base: dict, patch: Mapping[str, Any]) -> dict:
+    """RFC 7386 JSON merge patch (nulls delete keys). Matches kubectl
+    `--type=merge`, which is all the platform's controllers need."""
+    out = copy.deepcopy(base)
+
+    def _merge(dst: dict, src: Mapping[str, Any]) -> None:
+        for k, v in src.items():
+            if v is None:
+                dst.pop(k, None)
+            elif isinstance(v, Mapping) and isinstance(dst.get(k), dict):
+                _merge(dst[k], v)
+            else:
+                dst[k] = copy.deepcopy(v)
+
+    _merge(out, patch)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Real-cluster backend
+# ---------------------------------------------------------------------------
+
+
+def _api_prefix(api_version: str) -> str:
+    return f"/api/{api_version}" if "/" not in api_version else f"/apis/{api_version}"
+
+
+@dataclass
+class ClusterConfig:
+    """Connection parameters for a real apiserver (or our HTTP fake served
+    over a socket). Token/CA handling mirrors the in-cluster convention."""
+
+    host: str = "http://127.0.0.1:8001"  # `kubectl proxy` default
+    token: str | None = None
+    verify: bool | str = True
+
+
+class HttpK8sClient(K8sClient):
+    """Talks to a real apiserver over HTTP.
+
+    Path layout: /api/v1/... for core, /apis/<group>/<version>/... otherwise;
+    namespaced resources under /namespaces/<ns>/. Watches use
+    ?watch=true chunked JSON streams.
+    """
+
+    def __init__(self, config: ClusterConfig | None = None, registry: KindRegistry | None = None):
+        import requests
+
+        self._cfg = config or ClusterConfig()
+        self._registry = registry or KindRegistry()
+        self._session = requests.Session()
+        if self._cfg.token:
+            self._session.headers["Authorization"] = f"Bearer {self._cfg.token}"
+        self._session.verify = self._cfg.verify
+
+    # -- path building ---------------------------------------------------
+
+    def _path(self, api_version: str, kind: str, namespace: str | None, name: str | None = None) -> str:
+        plural = self._registry.plural(kind)
+        parts = [_api_prefix(api_version)]
+        if self._registry.namespaced(kind) and namespace:
+            parts.append(f"/namespaces/{namespace}")
+        parts.append(f"/{plural}")
+        if name:
+            parts.append(f"/{name}")
+        return "".join(parts)
+
+    def _request(self, method: str, path: str, body: dict | None = None, params: dict | None = None, content_type: str = "application/json") -> dict:
+        url = self._cfg.host + path
+        resp = self._session.request(
+            method,
+            url,
+            json=body,
+            params=params,
+            headers={"Content-Type": content_type},
+            timeout=60,
+        )
+        if resp.status_code >= 400:
+            try:
+                status = resp.json()
+                raise ApiError(resp.status_code, status.get("reason", "Error"), status.get("message", resp.text))
+            except ValueError:
+                raise ApiError(resp.status_code, "Error", resp.text)
+        return resp.json() if resp.content else {}
+
+    # -- CRUD ------------------------------------------------------------
+
+    def create(self, obj: dict) -> dict:
+        m = obj["metadata"]
+        path = self._path(obj["apiVersion"], obj["kind"], m.get("namespace"))
+        created = self._request("POST", path, body=obj)
+        if obj["kind"] == "CustomResourceDefinition":
+            self._registry.register_crd(obj)
+        return created
+
+    def get(self, api_version: str, kind: str, name: str, namespace: str | None = None) -> dict:
+        return self._request("GET", self._path(api_version, kind, namespace, name))
+
+    def list(self, api_version: str, kind: str, namespace: str | None = None, label_selector: Mapping[str, str] | None = None) -> list[dict]:
+        params = {}
+        if label_selector:
+            params["labelSelector"] = ",".join(f"{k}={v}" for k, v in label_selector.items())
+        result = self._request("GET", self._path(api_version, kind, namespace), params=params)
+        items = result.get("items", [])
+        for it in items:  # list items omit apiVersion/kind; restore them
+            it.setdefault("apiVersion", api_version)
+            it.setdefault("kind", kind)
+        return items
+
+    def update(self, obj: dict) -> dict:
+        m = obj["metadata"]
+        updated = self._request("PUT", self._path(obj["apiVersion"], obj["kind"], m.get("namespace"), m["name"]), body=obj)
+        if obj["kind"] == "CustomResourceDefinition":
+            self._registry.register_crd(obj)
+        return updated
+
+    def update_status(self, obj: dict) -> dict:
+        m = obj["metadata"]
+        path = self._path(obj["apiVersion"], obj["kind"], m.get("namespace"), m["name"]) + "/status"
+        return self._request("PUT", path, body=obj)
+
+    def patch(self, api_version: str, kind: str, name: str, patch: dict, namespace: str | None = None) -> dict:
+        return self._request(
+            "PATCH",
+            self._path(api_version, kind, namespace, name),
+            body=patch,
+            content_type="application/merge-patch+json",
+        )
+
+    def delete(self, api_version: str, kind: str, name: str, namespace: str | None = None) -> None:
+        self._request("DELETE", self._path(api_version, kind, namespace, name))
+
+    def watch(self, api_version: str, kind: str, namespace: str | None = None) -> WatchStream:
+        path = self._path(api_version, kind, namespace)
+        url = self._cfg.host + path
+        holder: dict = {}
+
+        def _on_stop() -> None:
+            # abort the in-flight chunked read so the thread + connection are
+            # released immediately instead of idling until the 1h timeout
+            resp = holder.get("resp")
+            if resp is not None:
+                try:
+                    resp.close()
+                except Exception:
+                    pass
+
+        stream = WatchStream(on_stop=_on_stop)
+
+        def _run() -> None:
+            try:
+                resp = self._session.get(url, params={"watch": "true"}, stream=True, timeout=3600)
+                holder["resp"] = resp
+                if resp.status_code >= 400:
+                    logging.warning("watch %s failed: HTTP %s %s", path, resp.status_code, resp.text[:200])
+                    return
+                for line in resp.iter_lines():
+                    if not line:
+                        continue
+                    evt = json.loads(line)
+                    stream.push(WatchEvent(evt["type"], evt["object"]))
+            except Exception as e:
+                logging.warning("watch %s aborted: %s", path, e)
+            finally:
+                stream.stop()
+
+        t = threading.Thread(target=_run, daemon=True)
+        t.start()
+        return stream
+
+    @property
+    def registry(self) -> KindRegistry:
+        return self._registry

@@ -1,0 +1,264 @@
+"""In-process fake Kubernetes apiserver.
+
+The envtest analogue (SURVEY.md §4: the reference tests controllers against a
+kubebuilder envtest apiserver, components/profile-controller/
+profile_controller_suite_test.go). This fake implements the same
+:class:`~kubeflow_tpu.k8s.client.K8sClient` surface the real HTTP backend
+does, with faithful-enough semantics for controller correctness tests:
+
+- uid / resourceVersion / creationTimestamp assignment, optimistic-concurrency
+  conflicts on stale resourceVersion
+- status as a subresource (spec updates don't clobber status and vice versa)
+- namespace existence enforcement, label-selector list filtering
+- ownerReference cascade deletion (foreground, synchronous)
+- watch streams with ADDED/MODIFIED/DELETED events
+- CRD registration: applying a CRD makes its kind servable
+"""
+
+from __future__ import annotations
+
+import copy
+import datetime
+import threading
+import uuid
+from typing import Any, Mapping
+
+from kubeflow_tpu.k8s.client import (
+    ApiError,
+    K8sClient,
+    KindRegistry,
+    WatchEvent,
+    WatchStream,
+    match_labels,
+    merge_patch,
+)
+
+
+def _now() -> str:
+    return (
+        datetime.datetime.now(datetime.timezone.utc)
+        .replace(microsecond=0)
+        .isoformat()
+        .replace("+00:00", "Z")
+    )
+
+
+class FakeApiServer(K8sClient):
+    def __init__(self) -> None:
+        self._store: dict[tuple[str, str, str, str], dict] = {}
+        self._registry = KindRegistry()
+        self._lock = threading.RLock()
+        self._rv = 0
+        # (api_version, kind, namespace-or-"") -> list of streams
+        self._watchers: dict[tuple[str, str, str], list[WatchStream]] = {}
+
+    @property
+    def registry(self) -> KindRegistry:
+        return self._registry
+
+    # ------------------------------------------------------------------
+    # internals
+    # ------------------------------------------------------------------
+
+    def _key(self, api_version: str, kind: str, namespace: str | None, name: str) -> tuple[str, str, str, str]:
+        ns = namespace or "" if self._registry.namespaced(kind) else ""
+        return (api_version, kind, ns, name)
+
+    def _next_rv(self) -> str:
+        self._rv += 1
+        return str(self._rv)
+
+    def _notify(self, event_type: str, obj: dict) -> None:
+        api_version, kind = obj["apiVersion"], obj["kind"]
+        ns = obj["metadata"].get("namespace", "")
+        scopes = (ns, "") if ns else ("",)
+        for scope in scopes:
+            for stream in self._watchers.get((api_version, kind, scope), []):
+                stream.push(WatchEvent(event_type, copy.deepcopy(obj)))
+
+    def _check_namespace(self, obj: Mapping[str, Any]) -> None:
+        kind = obj["kind"]
+        if not self._registry.namespaced(kind):
+            return
+        ns = obj["metadata"].get("namespace")
+        if not ns:
+            raise ApiError.invalid(f"{kind} {obj['metadata'].get('name')}: namespace required")
+        if ("v1", "Namespace", "", ns) not in self._store:
+            raise ApiError.not_found(f"namespace {ns} not found")
+
+    # ------------------------------------------------------------------
+    # CRUD
+    # ------------------------------------------------------------------
+
+    def create(self, obj: dict) -> dict:
+        obj = copy.deepcopy(obj)
+        m = obj.setdefault("metadata", {})
+        if "name" not in m and "generateName" in m:
+            m["name"] = m["generateName"] + uuid.uuid4().hex[:6]
+        with self._lock:
+            self._check_namespace(obj)
+            key = self._key(obj["apiVersion"], obj["kind"], m.get("namespace"), m["name"])
+            if key in self._store:
+                raise ApiError.already_exists(
+                    f"{obj['kind']} {m.get('namespace', '')}/{m['name']} already exists"
+                )
+            m["uid"] = str(uuid.uuid4())
+            m["resourceVersion"] = self._next_rv()
+            m["creationTimestamp"] = _now()
+            self._store[key] = obj
+            if obj["kind"] == "CustomResourceDefinition":
+                self._registry.register_crd(obj)
+            self._notify("ADDED", obj)
+            return copy.deepcopy(obj)
+
+    def get(self, api_version: str, kind: str, name: str, namespace: str | None = None) -> dict:
+        with self._lock:
+            key = self._key(api_version, kind, namespace, name)
+            if key not in self._store:
+                raise ApiError.not_found(f"{kind} {namespace or ''}/{name} not found")
+            return copy.deepcopy(self._store[key])
+
+    def list(
+        self,
+        api_version: str,
+        kind: str,
+        namespace: str | None = None,
+        label_selector: Mapping[str, str] | None = None,
+    ) -> list[dict]:
+        with self._lock:
+            out = []
+            for (av, k, ns, _), obj in self._store.items():
+                if av != api_version or k != kind:
+                    continue
+                if namespace and ns != namespace:
+                    continue
+                if match_labels(obj, label_selector):
+                    out.append(copy.deepcopy(obj))
+            out.sort(key=lambda o: (o["metadata"].get("namespace", ""), o["metadata"]["name"]))
+            return out
+
+    def _update(self, obj: dict, subresource: str | None) -> dict:
+        obj = copy.deepcopy(obj)
+        m = obj["metadata"]
+        with self._lock:
+            key = self._key(obj["apiVersion"], obj["kind"], m.get("namespace"), m["name"])
+            existing = self._store.get(key)
+            if existing is None:
+                raise ApiError.not_found(f"{obj['kind']} {m.get('namespace', '')}/{m['name']} not found")
+            sent_rv = m.get("resourceVersion")
+            if sent_rv is not None and sent_rv != existing["metadata"]["resourceVersion"]:
+                raise ApiError.conflict(
+                    f"{obj['kind']} {m['name']}: resourceVersion {sent_rv} is stale"
+                )
+            if subresource == "status":
+                new = copy.deepcopy(existing)
+                new["status"] = copy.deepcopy(obj.get("status", {}))
+            else:
+                new = obj
+                # status is a subresource: a plain update cannot change it
+                if "status" in existing:
+                    new["status"] = copy.deepcopy(existing["status"])
+                else:
+                    new.pop("status", None)
+            for immutable in ("uid", "creationTimestamp"):
+                new["metadata"][immutable] = existing["metadata"][immutable]
+            new["metadata"]["resourceVersion"] = self._next_rv()
+            self._store[key] = new
+            if new["kind"] == "CustomResourceDefinition":
+                self._registry.register_crd(new)
+            self._notify("MODIFIED", new)
+            return copy.deepcopy(new)
+
+    def update(self, obj: dict) -> dict:
+        return self._update(obj, subresource=None)
+
+    def update_status(self, obj: dict) -> dict:
+        return self._update(obj, subresource="status")
+
+    def patch(self, api_version: str, kind: str, name: str, patch: dict, namespace: str | None = None) -> dict:
+        with self._lock:
+            current = self.get(api_version, kind, name, namespace)
+            patched = merge_patch(current, patch)
+            # merge-patching may not change resourceVersion semantics: patch
+            # always applies to latest, so drop any stale rv from the patch
+            patched["metadata"]["resourceVersion"] = current["metadata"]["resourceVersion"]
+            if "status" in patch:
+                with_status = self._update(patched, subresource="status")
+                if set(patch.keys()) - {"status"}:
+                    patched["metadata"]["resourceVersion"] = with_status["metadata"]["resourceVersion"]
+                    return self._update(patched, subresource=None)
+                return with_status
+            return self._update(patched, subresource=None)
+
+    def delete(self, api_version: str, kind: str, name: str, namespace: str | None = None) -> None:
+        with self._lock:
+            key = self._key(api_version, kind, namespace, name)
+            obj = self._store.pop(key, None)
+            if obj is None:
+                raise ApiError.not_found(f"{kind} {namespace or ''}/{name} not found")
+            self._notify("DELETED", obj)
+            self._cascade_delete(obj)
+            if kind == "Namespace":
+                self._delete_namespace_contents(name)
+
+    def _cascade_delete(self, owner: dict) -> None:
+        owner_uid = owner["metadata"]["uid"]
+        ns = owner["metadata"].get("namespace", "")
+        doomed = []
+        for key, obj in self._store.items():
+            if obj["metadata"].get("namespace", "") != ns:
+                continue
+            for ref in obj["metadata"].get("ownerReferences", []):
+                if ref.get("uid") == owner_uid or (
+                    not ref.get("uid")
+                    and ref.get("kind") == owner["kind"]
+                    and ref.get("name") == owner["metadata"]["name"]
+                ):
+                    doomed.append(key)
+                    break
+        for key in doomed:
+            obj = self._store.pop(key, None)
+            if obj is not None:
+                self._notify("DELETED", obj)
+                self._cascade_delete(obj)
+
+    def _delete_namespace_contents(self, ns: str) -> None:
+        doomed = [k for k, o in self._store.items() if o["metadata"].get("namespace") == ns]
+        for key in doomed:
+            obj = self._store.pop(key, None)
+            if obj is not None:
+                self._notify("DELETED", obj)
+
+    # ------------------------------------------------------------------
+    # watch
+    # ------------------------------------------------------------------
+
+    def watch(self, api_version: str, kind: str, namespace: str | None = None) -> WatchStream:
+        scope = namespace or ""
+        key = (api_version, kind, scope)
+
+        def _on_stop() -> None:
+            with self._lock:
+                streams = self._watchers.get(key, [])
+                if stream in streams:
+                    streams.remove(stream)
+
+        stream = WatchStream(on_stop=_on_stop)
+        with self._lock:
+            self._watchers.setdefault(key, []).append(stream)
+            # replay current state as ADDED events (informer initial-list)
+            for obj in self.list(api_version, kind, namespace or None):
+                stream.push(WatchEvent("ADDED", obj))
+        return stream
+
+    # ------------------------------------------------------------------
+    # test helpers
+    # ------------------------------------------------------------------
+
+    def all_objects(self) -> list[dict]:
+        with self._lock:
+            return [copy.deepcopy(o) for o in self._store.values()]
+
+    def ensure_namespace(self, name: str) -> None:
+        if self.get_or_none("v1", "Namespace", name) is None:
+            self.create({"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": name}})

@@ -1,0 +1,500 @@
+"""Typed builders for Kubernetes objects.
+
+This is the foundation of the manifest layer: where the reference composes raw
+JSON through jsonnet functions (e.g. kubeflow/common/ambassador.libsonnet,
+kubeflow/tf-training/tf-job-operator.libsonnet), we compose plain Python dicts
+through small, explicit builder functions. Manifests stay inspectable (dicts in,
+dicts out), diffable, and trivially golden-testable.
+
+Only fields the platform actually uses are modeled; everything is a vanilla
+dict so callers can always reach in and set exotic fields directly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Mapping, Sequence
+
+# ---------------------------------------------------------------------------
+# Small helpers
+# ---------------------------------------------------------------------------
+
+
+def _clean(d: dict) -> dict:
+    """Drop None-valued keys so optional arguments vanish from output."""
+    return {k: v for k, v in d.items() if v is not None}
+
+
+def metadata(
+    name: str,
+    namespace: str | None = None,
+    labels: Mapping[str, str] | None = None,
+    annotations: Mapping[str, str] | None = None,
+) -> dict:
+    return _clean(
+        {
+            "name": name,
+            "namespace": namespace,
+            "labels": dict(labels) if labels else None,
+            "annotations": dict(annotations) if annotations else None,
+        }
+    )
+
+
+def object_ref(obj: Mapping[str, Any]) -> dict:
+    """An ownerReference to `obj` (controller=true, like controller-runtime)."""
+    return {
+        "apiVersion": obj["apiVersion"],
+        "kind": obj["kind"],
+        "name": obj["metadata"]["name"],
+        "uid": obj["metadata"].get("uid", ""),
+        "controller": True,
+        "blockOwnerDeletion": True,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Core workload objects
+# ---------------------------------------------------------------------------
+
+
+def container(
+    name: str,
+    image: str,
+    command: Sequence[str] | None = None,
+    args: Sequence[str] | None = None,
+    env: Mapping[str, str] | None = None,
+    env_from_field: Mapping[str, str] | None = None,
+    ports: Mapping[str, int] | None = None,
+    resources: Mapping[str, Any] | None = None,
+    volume_mounts: Sequence[Mapping[str, str]] | None = None,
+    working_dir: str | None = None,
+    liveness_probe: dict | None = None,
+    readiness_probe: dict | None = None,
+    image_pull_policy: str | None = None,
+) -> dict:
+    """A container spec.
+
+    ``env`` maps name->literal value; ``env_from_field`` maps name->fieldPath
+    (downward API), used e.g. to give each TPU worker its own pod IP/name.
+    ``ports`` maps port-name -> containerPort.
+    """
+    env_list: list[dict] = []
+    for k, v in (env or {}).items():
+        env_list.append({"name": k, "value": str(v)})
+    for k, path in (env_from_field or {}).items():
+        env_list.append({"name": k, "valueFrom": {"fieldRef": {"fieldPath": path}}})
+    return _clean(
+        {
+            "name": name,
+            "image": image,
+            "command": list(command) if command else None,
+            "args": list(args) if args else None,
+            "workingDir": working_dir,
+            "env": env_list or None,
+            "ports": [
+                {"name": n, "containerPort": p} for n, p in (ports or {}).items()
+            ]
+            or None,
+            "resources": dict(resources) if resources else None,
+            "volumeMounts": [dict(v) for v in volume_mounts] if volume_mounts else None,
+            "livenessProbe": liveness_probe,
+            "readinessProbe": readiness_probe,
+            "imagePullPolicy": image_pull_policy,
+        }
+    )
+
+
+def tcp_probe(port: int, initial_delay: int = 15, period: int = 10) -> dict:
+    """TCP liveness probe, mirroring the serving probe at
+    kubeflow/tf-serving/tf-serving-template.libsonnet:70-75."""
+    return {
+        "tcpSocket": {"port": port},
+        "initialDelaySeconds": initial_delay,
+        "periodSeconds": period,
+    }
+
+
+def http_probe(path: str, port: int, initial_delay: int = 10, period: int = 10) -> dict:
+    return {
+        "httpGet": {"path": path, "port": port},
+        "initialDelaySeconds": initial_delay,
+        "periodSeconds": period,
+    }
+
+
+def pod_spec(
+    containers: Sequence[dict],
+    service_account: str | None = None,
+    volumes: Sequence[dict] | None = None,
+    node_selector: Mapping[str, str] | None = None,
+    restart_policy: str | None = None,
+    scheduler_name: str | None = None,
+    host_network: bool | None = None,
+    subdomain: str | None = None,
+    hostname: str | None = None,
+    tolerations: Sequence[dict] | None = None,
+    init_containers: Sequence[dict] | None = None,
+) -> dict:
+    return _clean(
+        {
+            "containers": list(containers),
+            "initContainers": list(init_containers) if init_containers else None,
+            "serviceAccountName": service_account,
+            "volumes": list(volumes) if volumes else None,
+            "nodeSelector": dict(node_selector) if node_selector else None,
+            "restartPolicy": restart_policy,
+            "schedulerName": scheduler_name,
+            "hostNetwork": host_network,
+            "subdomain": subdomain,
+            "hostname": hostname,
+            "tolerations": list(tolerations) if tolerations else None,
+        }
+    )
+
+
+def pod(
+    name: str,
+    namespace: str,
+    spec: dict,
+    labels: Mapping[str, str] | None = None,
+    annotations: Mapping[str, str] | None = None,
+    owner: Mapping[str, Any] | None = None,
+) -> dict:
+    meta = metadata(name, namespace, labels, annotations)
+    if owner is not None:
+        meta["ownerReferences"] = [object_ref(owner)]
+    return {"apiVersion": "v1", "kind": "Pod", "metadata": meta, "spec": spec}
+
+
+def deployment(
+    name: str,
+    namespace: str,
+    containers: Sequence[dict],
+    replicas: int = 1,
+    labels: Mapping[str, str] | None = None,
+    pod_labels: Mapping[str, str] | None = None,
+    pod_annotations: Mapping[str, str] | None = None,
+    service_account: str | None = None,
+    volumes: Sequence[dict] | None = None,
+    node_selector: Mapping[str, str] | None = None,
+) -> dict:
+    pod_labels = dict(pod_labels or labels or {"app": name})
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": metadata(name, namespace, labels),
+        "spec": {
+            "replicas": replicas,
+            "selector": {"matchLabels": pod_labels},
+            "template": {
+                "metadata": _clean(
+                    {
+                        "labels": pod_labels,
+                        "annotations": dict(pod_annotations)
+                        if pod_annotations
+                        else None,
+                    }
+                ),
+                "spec": pod_spec(
+                    containers,
+                    service_account=service_account,
+                    volumes=volumes,
+                    node_selector=node_selector,
+                ),
+            },
+        },
+    }
+
+
+def stateful_set(
+    name: str,
+    namespace: str,
+    containers: Sequence[dict],
+    service_name: str,
+    replicas: int = 1,
+    labels: Mapping[str, str] | None = None,
+    service_account: str | None = None,
+    volumes: Sequence[dict] | None = None,
+    volume_claim_templates: Sequence[dict] | None = None,
+) -> dict:
+    sel = dict(labels or {"app": name})
+    spec: dict = {
+        "serviceName": service_name,
+        "replicas": replicas,
+        "selector": {"matchLabels": sel},
+        "template": {
+            "metadata": {"labels": sel},
+            "spec": pod_spec(containers, service_account=service_account, volumes=volumes),
+        },
+    }
+    if volume_claim_templates:
+        spec["volumeClaimTemplates"] = list(volume_claim_templates)
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "StatefulSet",
+        "metadata": metadata(name, namespace, labels),
+        "spec": spec,
+    }
+
+
+def service(
+    name: str,
+    namespace: str,
+    selector: Mapping[str, str],
+    ports: Sequence[Mapping[str, Any]],
+    labels: Mapping[str, str] | None = None,
+    annotations: Mapping[str, str] | None = None,
+    cluster_ip: str | None = None,
+    service_type: str | None = None,
+) -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": metadata(name, namespace, labels, annotations),
+        "spec": _clean(
+            {
+                "selector": dict(selector),
+                "ports": [dict(p) for p in ports],
+                "clusterIP": cluster_ip,
+                "type": service_type,
+            }
+        ),
+    }
+
+
+def headless_service(
+    name: str,
+    namespace: str,
+    selector: Mapping[str, str],
+    ports: Sequence[Mapping[str, Any]],
+    labels: Mapping[str, str] | None = None,
+) -> dict:
+    """Headless service for stable per-pod DNS — the rendezvous substrate for
+    TPU workers (the analogue of the per-replica services tf-operator creates)."""
+    return service(
+        name, namespace, selector, ports, labels=labels, cluster_ip="None"
+    )
+
+
+def config_map(
+    name: str, namespace: str, data: Mapping[str, str], labels: Mapping[str, str] | None = None
+) -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": metadata(name, namespace, labels),
+        "data": {k: str(v) for k, v in data.items()},
+    }
+
+
+def secret(
+    name: str,
+    namespace: str,
+    string_data: Mapping[str, str],
+    secret_type: str = "Opaque",
+) -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Secret",
+        "metadata": metadata(name, namespace),
+        "type": secret_type,
+        "stringData": {k: str(v) for k, v in string_data.items()},
+    }
+
+
+def namespace_obj(name: str, labels: Mapping[str, str] | None = None) -> dict:
+    return {"apiVersion": "v1", "kind": "Namespace", "metadata": metadata(name, labels=labels)}
+
+
+def pvc(name: str, namespace: str, storage: str, access_modes: Sequence[str] = ("ReadWriteOnce",), storage_class: str | None = None) -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "PersistentVolumeClaim",
+        "metadata": metadata(name, namespace),
+        "spec": _clean(
+            {
+                "accessModes": list(access_modes),
+                "resources": {"requests": {"storage": storage}},
+                "storageClassName": storage_class,
+            }
+        ),
+    }
+
+
+# ---------------------------------------------------------------------------
+# RBAC
+# ---------------------------------------------------------------------------
+
+
+def service_account(name: str, namespace: str, labels: Mapping[str, str] | None = None) -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "ServiceAccount",
+        "metadata": metadata(name, namespace, labels),
+    }
+
+
+def policy_rule(api_groups: Sequence[str], resources: Sequence[str], verbs: Sequence[str]) -> dict:
+    return {
+        "apiGroups": list(api_groups),
+        "resources": list(resources),
+        "verbs": list(verbs),
+    }
+
+
+def cluster_role(name: str, rules: Sequence[dict], labels: Mapping[str, str] | None = None) -> dict:
+    return {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "ClusterRole",
+        "metadata": metadata(name, labels=labels),
+        "rules": list(rules),
+    }
+
+
+def role(name: str, namespace: str, rules: Sequence[dict]) -> dict:
+    return {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "Role",
+        "metadata": metadata(name, namespace),
+        "rules": list(rules),
+    }
+
+
+def cluster_role_binding(name: str, role_name: str, sa_name: str, sa_namespace: str) -> dict:
+    return {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "ClusterRoleBinding",
+        "metadata": metadata(name),
+        "roleRef": {
+            "apiGroup": "rbac.authorization.k8s.io",
+            "kind": "ClusterRole",
+            "name": role_name,
+        },
+        "subjects": [
+            {"kind": "ServiceAccount", "name": sa_name, "namespace": sa_namespace}
+        ],
+    }
+
+
+def role_binding(name: str, namespace: str, role_name: str, subjects: Sequence[dict]) -> dict:
+    return {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "RoleBinding",
+        "metadata": metadata(name, namespace),
+        "roleRef": {
+            "apiGroup": "rbac.authorization.k8s.io",
+            "kind": "Role",
+            "name": role_name,
+        },
+        "subjects": list(subjects),
+    }
+
+
+# ---------------------------------------------------------------------------
+# CRDs
+# ---------------------------------------------------------------------------
+
+
+def crd(
+    group: str,
+    kind: str,
+    plural: str,
+    versions: Sequence[dict],
+    scope: str = "Namespaced",
+    short_names: Sequence[str] | None = None,
+    categories: Sequence[str] | None = None,
+) -> dict:
+    """A CustomResourceDefinition (apiextensions v1).
+
+    The reference defines its CRDs in v1beta1 with a stored + served version
+    pair and printer columns (kubeflow/tf-training/tf-job-operator.libsonnet:52-97);
+    we model the same surface in the v1 schema.
+    """
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": metadata(f"{plural}.{group}"),
+        "spec": _clean(
+            {
+                "group": group,
+                "scope": scope,
+                "names": _clean(
+                    {
+                        "kind": kind,
+                        "plural": plural,
+                        "singular": kind.lower(),
+                        "shortNames": list(short_names) if short_names else None,
+                        "categories": list(categories) if categories else None,
+                    }
+                ),
+                "versions": list(versions),
+            }
+        ),
+    }
+
+
+def crd_version(
+    name: str,
+    schema: dict | None = None,
+    served: bool = True,
+    storage: bool = False,
+    printer_columns: Sequence[dict] | None = None,
+    status_subresource: bool = True,
+) -> dict:
+    v: dict = {"name": name, "served": served, "storage": storage}
+    if status_subresource:
+        v["subresources"] = {"status": {}}
+    if schema is not None:
+        v["schema"] = {"openAPIV3Schema": schema}
+    if printer_columns:
+        v["additionalPrinterColumns"] = list(printer_columns)
+    return v
+
+
+def printer_column(name: str, json_path: str, col_type: str = "string") -> dict:
+    return {"name": name, "type": col_type, "jsonPath": json_path}
+
+
+# ---------------------------------------------------------------------------
+# Volumes
+# ---------------------------------------------------------------------------
+
+
+def config_map_volume(name: str, config_map_name: str) -> dict:
+    return {"name": name, "configMap": {"name": config_map_name}}
+
+
+def secret_volume(name: str, secret_name: str) -> dict:
+    return {"name": name, "secret": {"secretName": secret_name}}
+
+
+def empty_dir_volume(name: str, medium: str | None = None) -> dict:
+    return {"name": name, "emptyDir": _clean({"medium": medium})}
+
+
+def pvc_volume(name: str, claim: str) -> dict:
+    return {"name": name, "persistentVolumeClaim": {"claimName": claim}}
+
+
+def volume_mount(name: str, mount_path: str, read_only: bool | None = None, sub_path: str | None = None) -> dict:
+    return _clean(
+        {"name": name, "mountPath": mount_path, "readOnly": read_only, "subPath": sub_path}
+    )
+
+
+# ---------------------------------------------------------------------------
+# Keys / identity helpers used across client, fake server, and controllers
+# ---------------------------------------------------------------------------
+
+
+def gvk(obj: Mapping[str, Any]) -> tuple[str, str]:
+    """(apiVersion, kind)."""
+    return obj["apiVersion"], obj["kind"]
+
+
+def obj_key(obj: Mapping[str, Any]) -> str:
+    """Stable identity string: apiVersion/kind/namespace/name."""
+    m = obj.get("metadata", {})
+    return "/".join(
+        [obj["apiVersion"], obj["kind"], m.get("namespace", ""), m["name"]]
+    )

@@ -1,0 +1,23 @@
+from kubeflow_tpu.manifests.core import (
+    ParamSpec,
+    Prototype,
+    PrototypeError,
+    REQUIRED,
+    all_prototypes,
+    generate,
+    get_prototype,
+    load_all_packages,
+    prototype,
+)
+
+__all__ = [
+    "ParamSpec",
+    "Prototype",
+    "PrototypeError",
+    "REQUIRED",
+    "all_prototypes",
+    "generate",
+    "get_prototype",
+    "load_all_packages",
+    "prototype",
+]

@@ -1,0 +1,163 @@
+"""Manifest package core: the prototype/param registry.
+
+Replaces the reference's ksonnet machinery — prototypes with
+`@param/@optionalParam` comment headers (e.g.
+kubeflow/tf-training/prototypes/tf-job-operator.jsonnet:1-11) instantiated by
+`ks generate` / `ks param set` (bootstrap/pkg/kfapp/ksonnet/ksonnet.go:322,488).
+
+Here a *prototype* is a registered Python function taking validated params and
+returning a list of Kubernetes objects (plain dicts). Packages live under
+``kubeflow_tpu.manifests.packages`` and self-register on import.
+"""
+
+from __future__ import annotations
+
+import importlib
+import pkgutil
+from dataclasses import dataclass, field
+from typing import Any, Callable, Mapping, Sequence
+
+
+class PrototypeError(Exception):
+    pass
+
+
+class _Required:
+    def __repr__(self) -> str:  # pragma: no cover
+        return "<required>"
+
+
+REQUIRED = _Required()
+
+
+@dataclass(frozen=True)
+class ParamSpec:
+    """One prototype parameter (@param/@optionalParam analogue)."""
+
+    name: str
+    default: Any = REQUIRED
+    description: str = ""
+
+    @property
+    def required(self) -> bool:
+        return self.default is REQUIRED
+
+
+@dataclass
+class Prototype:
+    name: str
+    description: str
+    package: str
+    params: tuple[ParamSpec, ...]
+    fn: Callable[..., list[dict]]
+
+    def resolve_params(self, overrides: Mapping[str, Any]) -> dict[str, Any]:
+        known = {p.name: p for p in self.params}
+        unknown = set(overrides) - set(known)
+        if unknown:
+            raise PrototypeError(
+                f"prototype {self.name}: unknown params {sorted(unknown)}; "
+                f"known: {sorted(known)}"
+            )
+        resolved: dict[str, Any] = {}
+        missing = []
+        for p in self.params:
+            if p.name in overrides:
+                resolved[p.name] = overrides[p.name]
+            elif p.required:
+                missing.append(p.name)
+            else:
+                resolved[p.name] = p.default
+        if missing:
+            raise PrototypeError(
+                f"prototype {self.name}: missing required params {missing}"
+            )
+        return resolved
+
+    def generate(self, overrides: Mapping[str, Any] | None = None) -> list[dict]:
+        objs = self.fn(**self.resolve_params(overrides or {}))
+        for o in objs:
+            if "apiVersion" not in o or "kind" not in o or "metadata" not in o:
+                raise PrototypeError(
+                    f"prototype {self.name} produced a non-k8s object: {o}"
+                )
+        return objs
+
+
+_REGISTRY: dict[str, Prototype] = {}
+_PACKAGES_LOADED = False
+
+
+def prototype(
+    name: str,
+    description: str,
+    params: Sequence[ParamSpec] = (),
+    package: str = "",
+) -> Callable[[Callable[..., list[dict]]], Callable[..., list[dict]]]:
+    """Decorator registering a manifest-generator function as a prototype."""
+
+    def _register(fn: Callable[..., list[dict]]) -> Callable[..., list[dict]]:
+        if name in _REGISTRY:
+            raise PrototypeError(f"duplicate prototype {name}")
+        pkg = package or fn.__module__.rsplit(".", 1)[-1]
+        _REGISTRY[name] = Prototype(
+            name=name,
+            description=description,
+            package=pkg,
+            params=tuple(params),
+            fn=fn,
+        )
+        return fn
+
+    return _register
+
+
+def load_all_packages() -> None:
+    """Import every module in manifests.packages so prototypes register."""
+    global _PACKAGES_LOADED
+    if _PACKAGES_LOADED:
+        return
+    from kubeflow_tpu.manifests import packages as pkgs
+
+    for mod in pkgutil.iter_modules(pkgs.__path__):
+        importlib.import_module(f"{pkgs.__name__}.{mod.name}")
+    _PACKAGES_LOADED = True
+
+
+def get_prototype(name: str) -> Prototype:
+    load_all_packages()
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise PrototypeError(
+            f"unknown prototype {name!r}; available: {sorted(_REGISTRY)}"
+        )
+
+
+def all_prototypes() -> dict[str, Prototype]:
+    load_all_packages()
+    return dict(_REGISTRY)
+
+
+def generate(name: str, params: Mapping[str, Any] | None = None) -> list[dict]:
+    """Instantiate a prototype (the `ks generate` + `ks show` analogue)."""
+    return get_prototype(name).generate(params)
+
+
+GATEWAY_ROUTE_ANNOTATION = "kubeflow-tpu.org/gateway-route"
+
+
+def gateway_route(name: str, prefix: str, service: str, rewrite: str = "/") -> dict:
+    """Gateway route annotation for a Service — the platform-wide analogue of
+    the `getambassador.io/config` annotations the reference attaches to every
+    web-app Service (kubeflow/common/ambassador.libsonnet route pattern). The
+    gateway proxy discovers Services carrying this annotation and routes
+    `prefix` to them."""
+    import yaml
+
+    return {
+        GATEWAY_ROUTE_ANNOTATION: yaml.safe_dump(
+            {"name": name, "prefix": prefix, "service": service, "rewrite": rewrite},
+            sort_keys=True,
+        )
+    }

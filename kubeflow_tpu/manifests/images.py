@@ -1,0 +1,25 @@
+"""Default container images for platform components.
+
+The reference pins external images per component (e.g.
+gcr.io/kubeflow-images-public/tf_operator:v0.5.0 at
+kubeflow/tf-training/prototypes/tf-job-operator.jsonnet:7). Our platform
+components are all served out of one image built from this repo; workloads
+default to a JAX+libtpu image (replacing the CUDA tensorflow images).
+"""
+
+from kubeflow_tpu.version import __version__
+
+# The platform image: contains kubeflow_tpu and runs components via
+# `python -m kubeflow_tpu.<component>`.
+PLATFORM = f"ghcr.io/kubeflow-tpu/platform:{__version__}"
+
+# Default workload image: JAX + libtpu (the analogue of the CUDA-built
+# tensorflow images the reference defaults to, tf-job-operator.libsonnet:192).
+JAX_TPU = "ghcr.io/kubeflow-tpu/jax-tpu:0.9.0"
+
+# Notebook image: JAX + libtpu + jupyter (replaces
+# components/tensorflow-notebook-image CUDA matrix).
+NOTEBOOK = "ghcr.io/kubeflow-tpu/jax-notebook:0.9.0"
+
+# Serving image: the TPU model server (replaces tensorflow/serving).
+SERVING = f"ghcr.io/kubeflow-tpu/serving:{__version__}"

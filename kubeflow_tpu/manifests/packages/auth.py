@@ -1,0 +1,143 @@
+"""Auth package: basic-auth gatekeeper + admission webhook.
+
+Analogues of components/gatekeeper (AuthServer.go:32-210 — login form +
+cookie sessions fronting the gateway), kubeflow/common/basic-auth.libsonnet,
+and components/gcp-admission-webhook (main.go:131-158 — mutating webhook
+injecting cloud credentials into pods labeled for it; here it also injects
+TPU env defaults).
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.k8s import objects as k8s
+from kubeflow_tpu.manifests import images
+from kubeflow_tpu.manifests.core import ParamSpec, prototype
+from kubeflow_tpu.version import DEFAULT_NAMESPACE
+
+
+@prototype(
+    "gatekeeper",
+    "Basic-auth gateway: /login form + cookie sessions "
+    "(components/gatekeeper AuthServer analogue)",
+    params=[
+        ParamSpec("namespace", DEFAULT_NAMESPACE),
+        ParamSpec("image", images.PLATFORM),
+        ParamSpec("username", "admin"),
+        ParamSpec("password_hash", "", "bcrypt/sha256 hash; empty disables login"),
+    ],
+)
+def gatekeeper(namespace: str, image: str, username: str, password_hash: str) -> list[dict]:
+    name = "gatekeeper"
+    labels = {"app": name}
+    return [
+        k8s.secret(
+            f"{name}-login",
+            namespace,
+            {"username": username, "passwordHash": password_hash},
+        ),
+        k8s.service(
+            name,
+            namespace,
+            selector=labels,
+            ports=[{"name": "http", "port": 8085, "targetPort": 8085}],
+            labels=labels,
+        ),
+        k8s.deployment(
+            name,
+            namespace,
+            containers=[
+                k8s.container(
+                    name,
+                    image,
+                    command=["python", "-m", "kubeflow_tpu.auth.gatekeeper"],
+                    args=["--port=8085"],
+                    env={"LOGIN_SECRET_PATH": "/etc/login"},
+                    ports={"http": 8085},
+                    volume_mounts=[k8s.volume_mount("login", "/etc/login", read_only=True)],
+                )
+            ],
+            labels=labels,
+            volumes=[k8s.secret_volume("login", f"{name}-login")],
+        ),
+    ]
+
+
+@prototype(
+    "admission-webhook",
+    "Mutating webhook injecting credentials + TPU env defaults into labeled "
+    "pods (gcp-admission-webhook / credentials-pod-preset analogue)",
+    params=[
+        ParamSpec("namespace", DEFAULT_NAMESPACE),
+        ParamSpec("image", images.PLATFORM),
+        ParamSpec(
+            "ca_bundle",
+            "",
+            "base64 CA for the webhook serving cert; when empty the webhook "
+            "server self-signs at startup and patches this config in-cluster",
+        ),
+    ],
+)
+def admission_webhook(namespace: str, image: str, ca_bundle: str) -> list[dict]:
+    name = "admission-webhook"
+    labels = {"app": name}
+    return [
+        k8s.service_account(name, namespace, labels),
+        k8s.service(
+            name,
+            namespace,
+            selector=labels,
+            ports=[{"name": "https", "port": 443, "targetPort": 8443}],
+            labels=labels,
+        ),
+        k8s.deployment(
+            name,
+            namespace,
+            containers=[
+                k8s.container(
+                    name,
+                    image,
+                    command=["python", "-m", "kubeflow_tpu.auth.webhook"],
+                    args=["--port=8443"],
+                    ports={"https": 8443},
+                )
+            ],
+            labels=labels,
+            service_account=name,
+        ),
+        {
+            "apiVersion": "admissionregistration.k8s.io/v1",
+            "kind": "MutatingWebhookConfiguration",
+            "metadata": k8s.metadata(name, labels=labels),
+            "webhooks": [
+                {
+                    "name": f"{name}.kubeflow-tpu.org",
+                    "admissionReviewVersions": ["v1"],
+                    "sideEffects": "None",
+                    # Ignore so pod creation is never blocked while the
+                    # webhook bootstraps its self-signed cert and patches
+                    # caBundle (the reference's webhook also mutates
+                    # best-effort, gcp-admission-webhook/main.go:131-158).
+                    "failurePolicy": "Ignore",
+                    "clientConfig": {
+                        "service": {
+                            "name": name,
+                            "namespace": namespace,
+                            "path": "/mutate",
+                        },
+                        **({"caBundle": ca_bundle} if ca_bundle else {}),
+                    },
+                    "rules": [
+                        {
+                            "apiGroups": [""],
+                            "apiVersions": ["v1"],
+                            "operations": ["CREATE"],
+                            "resources": ["pods"],
+                        }
+                    ],
+                    "objectSelector": {
+                        "matchLabels": {"kubeflow-tpu.org/inject-credentials": "true"}
+                    },
+                }
+            ],
+        },
+    ]

@@ -1,0 +1,62 @@
+"""Benchmark package: the kubebench-equivalent harness.
+
+Analogue of kubeflow/kubebench (kubebench-operator.jsonnet, kubebench-job
+prototype :6-23): BenchmarkJob CRD + operator that runs a job template under
+measurement, scrapes reported metrics, and records results in status (the
+reporter-csv equivalent).
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.apis.benchmark import benchmark_job_crd
+from kubeflow_tpu.k8s import objects as k8s
+from kubeflow_tpu.manifests import images
+from kubeflow_tpu.manifests.core import ParamSpec, prototype
+from kubeflow_tpu.version import API_GROUP, DEFAULT_NAMESPACE
+
+
+@prototype(
+    "benchmark-operator",
+    "BenchmarkJob CRD + operator (kubebench-operator analogue)",
+    params=[
+        ParamSpec("namespace", DEFAULT_NAMESPACE),
+        ParamSpec("image", images.PLATFORM),
+    ],
+)
+def benchmark_operator(namespace: str, image: str) -> list[dict]:
+    name = "benchmark-operator"
+    labels = {"app": name}
+    return [
+        benchmark_job_crd(),
+        k8s.service_account(name, namespace, labels),
+        k8s.cluster_role(
+            name,
+            [
+                k8s.policy_rule(
+                    [API_GROUP], ["benchmarkjobs", "benchmarkjobs/status"], ["*"]
+                ),
+                k8s.policy_rule(
+                    [API_GROUP],
+                    ["jaxjobs", "jaxjobs/status", "tfjobs", "pytorchjobs", "mpijobs"],
+                    ["*"],
+                ),
+                k8s.policy_rule([""], ["pods", "pods/log", "events"], ["get", "list", "watch", "create", "patch"]),
+            ],
+            labels,
+        ),
+        k8s.cluster_role_binding(name, name, name, namespace),
+        k8s.deployment(
+            name,
+            namespace,
+            containers=[
+                k8s.container(
+                    name,
+                    image,
+                    command=["python", "-m", "kubeflow_tpu.operators.benchmark"],
+                    ports={"metrics": 8443},
+                )
+            ],
+            labels=labels,
+            service_account=name,
+        ),
+    ]

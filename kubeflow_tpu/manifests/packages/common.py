@@ -1,0 +1,205 @@
+"""Common package: API gateway, central dashboard, usage reporting, echo server.
+
+The analogue of kubeflow/common — ambassador gateway
+(ambassador.libsonnet:7-226), centraldashboard (centraldashboard.libsonnet),
+spartakus anonymous usage reporter (spartakus.libsonnet:1-122), echo-server.
+
+The gateway here is our own: a reverse proxy that discovers routes from
+`kubeflow-tpu.org/gateway-route` Service annotations (the getambassador.io/config
+pattern) and fronts every platform web app on one port.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.k8s import objects as k8s
+from kubeflow_tpu.manifests import images
+from kubeflow_tpu.manifests.core import ParamSpec, gateway_route, prototype
+from kubeflow_tpu.version import DEFAULT_NAMESPACE
+
+
+@prototype(
+    "gateway",
+    "API gateway: annotation-discovered reverse proxy fronting all platform "
+    "UIs/APIs (ambassador analogue, kubeflow/common/ambassador.libsonnet:7-226)",
+    params=[
+        ParamSpec("namespace", DEFAULT_NAMESPACE),
+        ParamSpec("image", images.PLATFORM),
+        ParamSpec("replicas", 3, "gateway replicas (ambassador default 3)"),
+        ParamSpec("service_type", "ClusterIP", "ClusterIP | NodePort | LoadBalancer"),
+    ],
+)
+def gateway(namespace: str, image: str, replicas: int, service_type: str) -> list[dict]:
+    name = "gateway"
+    labels = {"app": name, "service": "gateway"}
+    return [
+        k8s.service_account(name, namespace, labels),
+        k8s.cluster_role(
+            name,
+            [k8s.policy_rule([""], ["services"], ["get", "list", "watch"])],
+            labels,
+        ),
+        k8s.cluster_role_binding(name, name, name, namespace),
+        k8s.service(
+            name,
+            namespace,
+            selector=labels,
+            ports=[{"name": "http", "port": 80, "targetPort": 8080}],
+            labels=labels,
+            service_type=service_type,
+        ),
+        k8s.service(
+            f"{name}-admin",
+            namespace,
+            selector=labels,
+            ports=[{"name": "admin", "port": 8877, "targetPort": 8877}],
+            labels=labels,
+        ),
+        k8s.deployment(
+            name,
+            namespace,
+            containers=[
+                k8s.container(
+                    name,
+                    image,
+                    command=["python", "-m", "kubeflow_tpu.gateway"],
+                    args=["--port=8080", "--admin-port=8877", f"--namespace={namespace}"],
+                    ports={"http": 8080, "admin": 8877},
+                    liveness_probe=k8s.http_probe("/healthz", 8877, initial_delay=30),
+                    readiness_probe=k8s.http_probe("/healthz", 8877),
+                )
+            ],
+            replicas=replicas,
+            labels=labels,
+            service_account=name,
+        ),
+    ]
+
+
+@prototype(
+    "centraldashboard",
+    "Central dashboard web app (kubeflow/common/centraldashboard.libsonnet)",
+    params=[
+        ParamSpec("namespace", DEFAULT_NAMESPACE),
+        ParamSpec("image", images.PLATFORM),
+    ],
+)
+def centraldashboard(namespace: str, image: str) -> list[dict]:
+    name = "centraldashboard"
+    labels = {"app": name}
+    return [
+        k8s.service_account(name, namespace, labels),
+        k8s.cluster_role(
+            name,
+            [
+                k8s.policy_rule(
+                    [""], ["pods", "events", "namespaces", "nodes"], ["get", "list", "watch"]
+                ),
+                k8s.policy_rule(
+                    ["kubeflow-tpu.org"], ["*"], ["get", "list", "watch"]
+                ),
+            ],
+            labels,
+        ),
+        k8s.cluster_role_binding(name, name, name, namespace),
+        k8s.service(
+            name,
+            namespace,
+            selector=labels,
+            ports=[{"name": "http", "port": 80, "targetPort": 8082}],
+            labels=labels,
+            annotations=gateway_route(name, "/", f"{name}.{namespace}:80"),
+        ),
+        k8s.deployment(
+            name,
+            namespace,
+            containers=[
+                k8s.container(
+                    name,
+                    image,
+                    command=["python", "-m", "kubeflow_tpu.dashboard"],
+                    ports={"http": 8082},
+                    liveness_probe=k8s.http_probe("/healthz", 8082, initial_delay=30),
+                )
+            ],
+            labels=labels,
+            service_account=name,
+        ),
+    ]
+
+
+@prototype(
+    "usage-reporter",
+    "Anonymous usage reporter, opt-in (spartakus analogue, "
+    "kubeflow/common/spartakus.libsonnet:1-122)",
+    params=[
+        ParamSpec("namespace", DEFAULT_NAMESPACE),
+        ParamSpec("image", images.PLATFORM),
+        ParamSpec("usage_id", "unknown_cluster"),
+        ParamSpec("report_usage", False, "actually send reports (default off)"),
+    ],
+)
+def usage_reporter(
+    namespace: str, image: str, usage_id: str, report_usage: bool
+) -> list[dict]:
+    name = "usage-reporter"
+    labels = {"app": name}
+    return [
+        k8s.service_account(name, namespace, labels),
+        k8s.cluster_role(
+            name, [k8s.policy_rule([""], ["nodes"], ["get", "list"])], labels
+        ),
+        k8s.cluster_role_binding(name, name, name, namespace),
+        k8s.deployment(
+            name,
+            namespace,
+            containers=[
+                k8s.container(
+                    name,
+                    image,
+                    command=["python", "-m", "kubeflow_tpu.utils.usage_reporter"],
+                    args=[
+                        f"--usage-id={usage_id}",
+                        f"--enabled={'true' if report_usage else 'false'}",
+                    ],
+                )
+            ],
+            labels=labels,
+            service_account=name,
+        ),
+    ]
+
+
+@prototype(
+    "echo-server",
+    "Echo server for gateway/auth debugging (components/echo-server)",
+    params=[
+        ParamSpec("namespace", DEFAULT_NAMESPACE),
+        ParamSpec("image", images.PLATFORM),
+    ],
+)
+def echo_server(namespace: str, image: str) -> list[dict]:
+    name = "echo-server"
+    labels = {"app": name}
+    return [
+        k8s.service(
+            name,
+            namespace,
+            selector=labels,
+            ports=[{"name": "http", "port": 80, "targetPort": 8083}],
+            labels=labels,
+            annotations=gateway_route(name, "/echo/", f"{name}.{namespace}:80"),
+        ),
+        k8s.deployment(
+            name,
+            namespace,
+            containers=[
+                k8s.container(
+                    name,
+                    image,
+                    command=["python", "-m", "kubeflow_tpu.utils.echo_server"],
+                    ports={"http": 8083},
+                )
+            ],
+            labels=labels,
+        ),
+    ]

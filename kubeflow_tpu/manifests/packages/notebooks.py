@@ -1,0 +1,121 @@
+"""Notebooks package: notebook-controller + jupyter-web-app.
+
+The analogue of kubeflow/jupyter (JupyterHub StatefulSet, jupyter.libsonnet:128-160,
+spawner config :10-33) and components/{notebook-controller,jupyter-web-app}.
+TPU-native: notebook images ship JAX + libtpu (replacing the CUDA tensorflow
+notebook matrix, components/tensorflow-notebook-image), and notebooks can
+request google.com/tpu chips.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.apis.notebooks import notebook_crd
+from kubeflow_tpu.k8s import objects as k8s
+from kubeflow_tpu.manifests import images
+from kubeflow_tpu.manifests.core import ParamSpec, gateway_route, prototype
+from kubeflow_tpu.version import API_GROUP, DEFAULT_NAMESPACE
+
+
+@prototype(
+    "notebook-controller",
+    "Notebook CRD + controller: materialises Notebook CRs as StatefulSet + "
+    "Service with gateway routes (components/notebook-controller analogue)",
+    params=[
+        ParamSpec("namespace", DEFAULT_NAMESPACE),
+        ParamSpec("image", images.PLATFORM),
+    ],
+)
+def notebook_controller(namespace: str, image: str) -> list[dict]:
+    name = "notebook-controller"
+    labels = {"app": name}
+    return [
+        notebook_crd(),
+        k8s.service_account(name, namespace, labels),
+        k8s.cluster_role(
+            name,
+            [
+                k8s.policy_rule([API_GROUP], ["notebooks", "notebooks/status"], ["*"]),
+                k8s.policy_rule([""], ["services", "events"], ["*"]),
+                k8s.policy_rule(["apps"], ["statefulsets"], ["*"]),
+                k8s.policy_rule([""], ["pods"], ["get", "list", "watch"]),
+            ],
+            labels,
+        ),
+        k8s.cluster_role_binding(name, name, name, namespace),
+        k8s.deployment(
+            name,
+            namespace,
+            containers=[
+                k8s.container(
+                    name,
+                    image,
+                    command=["python", "-m", "kubeflow_tpu.operators.notebook"],
+                    ports={"metrics": 8443},
+                )
+            ],
+            labels=labels,
+            service_account=name,
+        ),
+    ]
+
+
+@prototype(
+    "jupyter-web-app",
+    "Notebook CRUD web UI: lists/creates/deletes Notebook CRs + PVCs "
+    "(components/jupyter-web-app routes.py:33-168 analogue)",
+    params=[
+        ParamSpec("namespace", DEFAULT_NAMESPACE),
+        ParamSpec("image", images.PLATFORM),
+        ParamSpec("default_notebook_image", images.NOTEBOOK),
+    ],
+)
+def jupyter_web_app(namespace: str, image: str, default_notebook_image: str) -> list[dict]:
+    name = "jupyter-web-app"
+    labels = {"app": name}
+    return [
+        k8s.service_account(name, namespace, labels),
+        k8s.cluster_role(
+            name,
+            [
+                k8s.policy_rule([API_GROUP], ["notebooks"], ["*"]),
+                k8s.policy_rule(
+                    [""],
+                    ["persistentvolumeclaims", "namespaces", "pods", "pods/log", "events"],
+                    ["get", "list", "watch", "create", "delete"],
+                ),
+                k8s.policy_rule(["storage.k8s.io"], ["storageclasses"], ["get", "list"]),
+            ],
+            labels,
+        ),
+        k8s.cluster_role_binding(name, name, name, namespace),
+        k8s.config_map(
+            f"{name}-config",
+            namespace,
+            {"defaultNotebookImage": default_notebook_image},
+            labels=labels,
+        ),
+        k8s.service(
+            name,
+            namespace,
+            selector=labels,
+            ports=[{"name": "http", "port": 80, "targetPort": 5000}],
+            labels=labels,
+            annotations=gateway_route(name, "/jupyter/", f"{name}.{namespace}:80"),
+        ),
+        k8s.deployment(
+            name,
+            namespace,
+            containers=[
+                k8s.container(
+                    name,
+                    image,
+                    command=["python", "-m", "kubeflow_tpu.webapps.jupyter"],
+                    args=[f"--default-image={default_notebook_image}"],
+                    ports={"http": 5000},
+                    liveness_probe=k8s.http_probe("/healthz", 5000, initial_delay=30),
+                )
+            ],
+            labels=labels,
+            service_account=name,
+        ),
+    ]

@@ -1,0 +1,205 @@
+"""Observability package: metric-collector + prometheus.
+
+Analogue of metric-collector/ (availability prober exporting the
+`kubeflow_availability` gauge, kubeflow-readiness.py:21-37, deployed by
+kubeflow/gcp/prototypes/metric-collector.jsonnet) and the prometheus deploy
+prototype (kubeflow/gcp/prototypes/prometheus.jsonnet). Extended for TPU:
+the collector also probes TPU device health per node.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.k8s import objects as k8s
+from kubeflow_tpu.manifests import images
+from kubeflow_tpu.manifests.core import ParamSpec, prototype
+from kubeflow_tpu.version import DEFAULT_NAMESPACE
+
+
+@prototype(
+    "metric-collector",
+    "Availability prober: exports kubeflow_availability (+ TPU slice health) "
+    "prometheus gauges on :8000 (metric-collector analogue)",
+    params=[
+        ParamSpec("namespace", DEFAULT_NAMESPACE),
+        ParamSpec("image", images.PLATFORM),
+        ParamSpec("target_url", "http://gateway.kubeflow/healthz", "endpoint to probe"),
+        ParamSpec("interval_seconds", 30),
+    ],
+)
+def metric_collector(
+    namespace: str, image: str, target_url: str, interval_seconds: int
+) -> list[dict]:
+    name = "metric-collector"
+    labels = {"app": name}
+    return [
+        k8s.service_account(name, namespace, labels),
+        k8s.cluster_role(
+            name,
+            [k8s.policy_rule([""], ["nodes", "pods"], ["get", "list"])],
+            labels,
+        ),
+        k8s.cluster_role_binding(name, name, name, namespace),
+        k8s.service(
+            name,
+            namespace,
+            selector=labels,
+            ports=[{"name": "metrics", "port": 8000, "targetPort": 8000}],
+            labels=labels,
+            annotations={
+                "prometheus.io/scrape": "true",
+                "prometheus.io/port": "8000",
+            },
+        ),
+        k8s.deployment(
+            name,
+            namespace,
+            containers=[
+                k8s.container(
+                    name,
+                    image,
+                    command=["python", "-m", "kubeflow_tpu.observability.collector"],
+                    args=[
+                        f"--target-url={target_url}",
+                        f"--interval={interval_seconds}",
+                        "--port=8000",
+                    ],
+                    ports={"metrics": 8000},
+                )
+            ],
+            labels=labels,
+            service_account=name,
+        ),
+    ]
+
+
+@prototype(
+    "prometheus",
+    "Prometheus server scraping annotated pods/services "
+    "(kubeflow/gcp/prototypes/prometheus.jsonnet analogue)",
+    params=[
+        ParamSpec("namespace", DEFAULT_NAMESPACE),
+        ParamSpec("image", "prom/prometheus:v2.45.0"),
+        ParamSpec("retention", "15d"),
+        ParamSpec("storage", "10Gi"),
+    ],
+)
+def prometheus(namespace: str, image: str, retention: str, storage: str) -> list[dict]:
+    name = "prometheus"
+    labels = {"app": name}
+    scrape_config = """\
+global:
+  scrape_interval: 30s
+scrape_configs:
+  - job_name: kubernetes-pods
+    kubernetes_sd_configs: [{role: pod}]
+    relabel_configs:
+      - source_labels: [__meta_kubernetes_pod_annotation_prometheus_io_scrape]
+        action: keep
+        regex: "true"
+      - source_labels: [__address__, __meta_kubernetes_pod_annotation_prometheus_io_port]
+        action: replace
+        target_label: __address__
+        regex: ([^:]+)(?::\\d+)?;(\\d+)
+        replacement: $1:$2
+      - source_labels: [__meta_kubernetes_pod_annotation_prometheus_io_path]
+        action: replace
+        target_label: __metrics_path__
+        regex: (.+)
+  - job_name: kubernetes-services
+    kubernetes_sd_configs: [{role: service}]
+    relabel_configs:
+      - source_labels: [__meta_kubernetes_service_annotation_prometheus_io_scrape]
+        action: keep
+        regex: "true"
+"""
+    return [
+        k8s.service_account(name, namespace, labels),
+        k8s.cluster_role(
+            name,
+            [
+                k8s.policy_rule(
+                    [""],
+                    ["nodes", "services", "endpoints", "pods"],
+                    ["get", "list", "watch"],
+                )
+            ],
+            labels,
+        ),
+        k8s.cluster_role_binding(name, name, name, namespace),
+        k8s.config_map(f"{name}-config", namespace, {"prometheus.yml": scrape_config}, labels),
+        k8s.pvc(f"{name}-data", namespace, storage),
+        k8s.service(
+            name,
+            namespace,
+            selector=labels,
+            ports=[{"name": "http", "port": 9090, "targetPort": 9090}],
+            labels=labels,
+        ),
+        k8s.deployment(
+            name,
+            namespace,
+            containers=[
+                k8s.container(
+                    name,
+                    image,
+                    args=[
+                        "--config.file=/etc/prometheus/prometheus.yml",
+                        f"--storage.tsdb.retention.time={retention}",
+                        "--storage.tsdb.path=/prometheus",
+                    ],
+                    ports={"http": 9090},
+                    volume_mounts=[
+                        k8s.volume_mount("config", "/etc/prometheus", read_only=True),
+                        k8s.volume_mount("data", "/prometheus"),
+                    ],
+                )
+            ],
+            labels=labels,
+            service_account=name,
+            volumes=[
+                k8s.config_map_volume("config", f"{name}-config"),
+                k8s.pvc_volume("data", f"{name}-data"),
+            ],
+        ),
+    ]
+
+
+@prototype(
+    "tensorboard",
+    "TensorBoard deployment reading logs from gs://|pvc path "
+    "(kubeflow/tensorboard analogue; serves JAX profiler traces)",
+    params=[
+        ParamSpec("name", "tensorboard"),
+        ParamSpec("namespace", DEFAULT_NAMESPACE),
+        ParamSpec("log_dir", "", "gs:// or pvc path with event files / xprof traces"),
+        ParamSpec("image", images.JAX_TPU),
+    ],
+)
+def tensorboard(name: str, namespace: str, log_dir: str, image: str) -> list[dict]:
+    labels = {"app": name}
+    from kubeflow_tpu.manifests.core import gateway_route
+
+    return [
+        k8s.service(
+            name,
+            namespace,
+            selector=labels,
+            ports=[{"name": "http", "port": 80, "targetPort": 6006}],
+            labels=labels,
+            annotations=gateway_route(name, f"/{name}/", f"{name}.{namespace}:80"),
+        ),
+        k8s.deployment(
+            name,
+            namespace,
+            containers=[
+                k8s.container(
+                    name,
+                    image,
+                    command=["tensorboard"],
+                    args=[f"--logdir={log_dir}", "--port=6006", "--bind_all"],
+                    ports={"http": 6006},
+                )
+            ],
+            labels=labels,
+        ),
+    ]

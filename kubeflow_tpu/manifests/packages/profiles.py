@@ -1,0 +1,57 @@
+"""Profiles package: multi-tenancy controller.
+
+Analogue of kubeflow/profiles + components/profile-controller
+(Reconcile at profile_controller.go:108-206, generateRole :207).
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.apis.profiles import profile_crd
+from kubeflow_tpu.k8s import objects as k8s
+from kubeflow_tpu.manifests import images
+from kubeflow_tpu.manifests.core import ParamSpec, prototype
+from kubeflow_tpu.version import API_GROUP, DEFAULT_NAMESPACE
+
+
+@prototype(
+    "profile-controller",
+    "Profile CRD + controller: per-user namespace + namespaced-admin "
+    "Role/RoleBinding (+ quota) per Profile CR",
+    params=[
+        ParamSpec("namespace", DEFAULT_NAMESPACE),
+        ParamSpec("image", images.PLATFORM),
+    ],
+)
+def profile_controller(namespace: str, image: str) -> list[dict]:
+    name = "profile-controller"
+    labels = {"app": name}
+    return [
+        profile_crd(),
+        k8s.service_account(name, namespace, labels),
+        k8s.cluster_role(
+            name,
+            [
+                k8s.policy_rule([API_GROUP], ["profiles", "profiles/status"], ["*"]),
+                k8s.policy_rule([""], ["namespaces", "resourcequotas", "events"], ["*"]),
+                k8s.policy_rule(
+                    ["rbac.authorization.k8s.io"], ["roles", "rolebindings"], ["*"]
+                ),
+            ],
+            labels,
+        ),
+        k8s.cluster_role_binding(name, name, name, namespace),
+        k8s.deployment(
+            name,
+            namespace,
+            containers=[
+                k8s.container(
+                    name,
+                    image,
+                    command=["python", "-m", "kubeflow_tpu.operators.profile"],
+                    ports={"metrics": 8443},
+                )
+            ],
+            labels=labels,
+            service_account=name,
+        ),
+    ]

@@ -1,0 +1,170 @@
+"""Serving package: TPU model server deployment + service.
+
+The analogue of kubeflow/tf-serving — model-server Deployment with gRPC :9000
+and REST :8500 (tf-serving-template.libsonnet:29-49), model loaded from
+GCS/S3/PVC (prototypes/tf-serving-gcp.jsonnet:8), TCP liveness probe on the
+gRPC port (:70-75), prometheus monitoring (:127-130), gateway/istio routing
+(tf-serving-service-template.libsonnet) — with tensorflow/serving replaced by
+our TPU inference engine (kubeflow_tpu.serving) and nvidia.com/gpu variants
+replaced by google.com/tpu.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.apis.jobs import TPU_RESOURCE, tpu_resources
+from kubeflow_tpu.k8s import objects as k8s
+from kubeflow_tpu.manifests import images
+from kubeflow_tpu.manifests.core import ParamSpec, gateway_route, prototype
+from kubeflow_tpu.version import DEFAULT_NAMESPACE
+
+GRPC_PORT = 9000
+REST_PORT = 8500
+
+
+@prototype(
+    "tpu-serving",
+    "TPU model server Deployment: gRPC :9000 + REST :8500, model from "
+    "gs://|s3://|pvc path, prometheus metrics, TPU resources",
+    params=[
+        ParamSpec("name"),
+        ParamSpec("namespace", DEFAULT_NAMESPACE),
+        ParamSpec("model_path", "", "gs://, s3://, /pvc/ or local model dir"),
+        ParamSpec("model_name", "", "served model name (defaults to `name`)"),
+        ParamSpec("image", images.SERVING),
+        ParamSpec("replicas", 1),
+        ParamSpec("num_tpu_chips", 1, "google.com/tpu chips per replica (0 = CPU)"),
+        ParamSpec("batch_size", 8, "max server-side batch size"),
+        ParamSpec("batch_timeout_ms", 5, "batching window"),
+        ParamSpec("enable_prometheus", True),
+        ParamSpec("dtype", "bfloat16"),
+    ],
+)
+def tpu_serving(
+    name: str,
+    namespace: str,
+    model_path: str,
+    model_name: str,
+    image: str,
+    replicas: int,
+    num_tpu_chips: int,
+    batch_size: int,
+    batch_timeout_ms: int,
+    enable_prometheus: bool,
+    dtype: str,
+) -> list[dict]:
+    model_name = model_name or name
+    labels = {"app": name, "service": "tpu-serving"}
+    resources = tpu_resources(num_tpu_chips)
+    args = [
+        f"--model-name={model_name}",
+        f"--model-path={model_path}",
+        f"--grpc-port={GRPC_PORT}",
+        f"--rest-port={REST_PORT}",
+        f"--batch-size={batch_size}",
+        f"--batch-timeout-ms={batch_timeout_ms}",
+        f"--dtype={dtype}",
+    ]
+    if enable_prometheus:
+        args.append("--enable-prometheus")
+    pod_annotations = (
+        {
+            "prometheus.io/scrape": "true",
+            "prometheus.io/path": "/monitoring/prometheus/metrics",
+            "prometheus.io/port": str(REST_PORT),
+        }
+        if enable_prometheus
+        else None
+    )
+    return [
+        k8s.deployment(
+            name,
+            namespace,
+            containers=[
+                k8s.container(
+                    name,
+                    image,
+                    command=["python", "-m", "kubeflow_tpu.serving"],
+                    args=args,
+                    ports={"grpc": GRPC_PORT, "rest": REST_PORT},
+                    resources=resources,
+                    liveness_probe=k8s.tcp_probe(GRPC_PORT, initial_delay=30),
+                    readiness_probe=k8s.http_probe(
+                        f"/v1/models/{model_name}", REST_PORT, initial_delay=20
+                    ),
+                )
+            ],
+            replicas=replicas,
+            labels=labels,
+            pod_annotations=pod_annotations,
+        ),
+        k8s.service(
+            name,
+            namespace,
+            selector=labels,
+            ports=[
+                {"name": "grpc", "port": GRPC_PORT, "targetPort": GRPC_PORT},
+                {"name": "rest", "port": REST_PORT, "targetPort": REST_PORT},
+            ],
+            labels=labels,
+            annotations=gateway_route(
+                name, f"/models/{name}/", f"{name}.{namespace}:{REST_PORT}"
+            ),
+        ),
+    ]
+
+
+@prototype(
+    "batch-predict",
+    "Batch prediction Job over a dataset (kubeflow/tf-batch-predict analogue)",
+    params=[
+        ParamSpec("name"),
+        ParamSpec("namespace", DEFAULT_NAMESPACE),
+        ParamSpec("model_path"),
+        ParamSpec("input_path"),
+        ParamSpec("output_path"),
+        ParamSpec("image", images.SERVING),
+        ParamSpec("num_tpu_chips", 1),
+        ParamSpec("batch_size", 64),
+    ],
+)
+def batch_predict(
+    name: str,
+    namespace: str,
+    model_path: str,
+    input_path: str,
+    output_path: str,
+    image: str,
+    num_tpu_chips: int,
+    batch_size: int,
+) -> list[dict]:
+    resources = tpu_resources(num_tpu_chips)
+    return [
+        {
+            "apiVersion": "batch/v1",
+            "kind": "Job",
+            "metadata": k8s.metadata(name, namespace, {"app": name}),
+            "spec": {
+                "backoffLimit": 2,
+                "template": {
+                    "metadata": {"labels": {"app": name}},
+                    "spec": k8s.pod_spec(
+                        [
+                            k8s.container(
+                                name,
+                                image,
+                                command=["python", "-m", "kubeflow_tpu.serving.batch_predict"],
+                                args=[
+                                    f"--model-path={model_path}",
+                                    f"--input-path={input_path}",
+                                    f"--output-path={output_path}",
+                                    f"--batch-size={batch_size}",
+                                ],
+                                resources=resources,
+                            )
+                        ],
+                        restart_policy="Never",
+                    ),
+                },
+            },
+        }
+    ]

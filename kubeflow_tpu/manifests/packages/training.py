@@ -1,0 +1,460 @@
+"""Training package: job CRDs + the training operator deployment.
+
+The equivalent of the reference's five operator packages —
+kubeflow/tf-training/tf-job-operator.libsonnet (CRD :52-97, operator
+Deployment :99-143, ConfigMap :180-196, RBAC :200-350, dashboard :353-488),
+kubeflow/pytorch-job, kubeflow/mxnet-job, kubeflow/chainer-job,
+kubeflow/mpi-job — collapsed into one TPU-native operator that serves all six
+job kinds (JaxJob native + five compatibility kinds).
+
+Job prototypes mirror the reference's example prototypes
+(kubeflow/examples/prototypes/tf-job-simple-v1beta2.jsonnet,
+kubeflow/pytorch-job/prototypes/pytorch-job.jsonnet,
+kubeflow/mpi-job/prototypes/mpi-job-custom.jsonnet) with `numGpus` replaced by
+TPU accelerator/topology params.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.apis import jobs as jobs_api
+from kubeflow_tpu.k8s import objects as k8s
+from kubeflow_tpu.manifests import images
+from kubeflow_tpu.manifests.core import ParamSpec, gateway_route, prototype
+from kubeflow_tpu.version import API_GROUP, DEFAULT_NAMESPACE
+
+
+@prototype(
+    "training-operator",
+    "Job CRDs (JaxJob/TFJob/PyTorchJob/MXNetJob/ChainerJob/MPIJob) + the "
+    "training-operator Deployment, RBAC and config",
+    params=[
+        ParamSpec("namespace", DEFAULT_NAMESPACE),
+        ParamSpec("image", images.PLATFORM),
+        ParamSpec("replicas", 1, "operator replicas (leader-elected)"),
+        ParamSpec("default_workload_image", images.JAX_TPU),
+        ParamSpec("cluster_scoped", True, "watch all namespaces (RBAC scope)"),
+    ],
+)
+def training_operator(
+    namespace: str,
+    image: str,
+    replicas: int,
+    default_workload_image: str,
+    cluster_scoped: bool,
+) -> list[dict]:
+    name = "training-operator"
+    labels = {"app": name, "app.kubernetes.io/part-of": "kubeflow-tpu"}
+    objs: list[dict] = list(jobs_api.all_job_crds())
+
+    # ConfigMap (the grpcServerFilePath/default-image config analogue,
+    # tf-job-operator.libsonnet:180-196), mounted at /etc/config/config.yaml
+    import yaml as _yaml
+
+    objs.append(
+        k8s.config_map(
+            f"{name}-config",
+            namespace,
+            {
+                "config.yaml": _yaml.safe_dump(
+                    {"defaultWorkloadImage": default_workload_image}, sort_keys=True
+                )
+            },
+            labels=labels,
+        )
+    )
+
+    objs.append(k8s.service_account(name, namespace, labels))
+    rules = [
+        k8s.policy_rule(
+            [API_GROUP],
+            [p for p in jobs_api.PLURALS.values()]
+            + [f"{p}/status" for p in jobs_api.PLURALS.values()],
+            ["*"],
+        ),
+        k8s.policy_rule([""], ["pods", "services", "events", "configmaps"], ["*"]),
+        k8s.policy_rule(["apps"], ["deployments", "statefulsets"], ["get", "list", "watch"]),
+    ]
+    if cluster_scoped:
+        objs.append(k8s.cluster_role(name, rules, labels))
+        objs.append(k8s.cluster_role_binding(name, name, name, namespace))
+    else:
+        objs.append(k8s.role(name, namespace, rules))
+        objs.append(
+            k8s.role_binding(
+                name,
+                namespace,
+                name,
+                [{"kind": "ServiceAccount", "name": name, "namespace": namespace}],
+            )
+        )
+
+    objs.append(
+        k8s.deployment(
+            name,
+            namespace,
+            containers=[
+                k8s.container(
+                    name,
+                    image,
+                    command=["python", "-m", "kubeflow_tpu.operators"],
+                    args=["--alsologtostderr", "-v=1"],
+                    env={"OPERATOR_CONFIG": "/etc/config/config.yaml"},
+                    ports={"metrics": 8443},
+                    volume_mounts=[k8s.volume_mount("config", "/etc/config", read_only=True)],
+                )
+            ],
+            replicas=replicas,
+            labels=labels,
+            service_account=name,
+            volumes=[k8s.config_map_volume("config", f"{name}-config")],
+        )
+    )
+    return objs
+
+
+@prototype(
+    "training-dashboard",
+    "Training-job dashboard Service + Deployment with gateway route "
+    "(tf-job-dashboard analogue, tf-job-operator.libsonnet:353-488)",
+    params=[
+        ParamSpec("namespace", DEFAULT_NAMESPACE),
+        ParamSpec("image", images.PLATFORM),
+    ],
+)
+def training_dashboard(namespace: str, image: str) -> list[dict]:
+    name = "training-dashboard"
+    labels = {"app": name}
+    return [
+        k8s.service(
+            name,
+            namespace,
+            selector=labels,
+            ports=[{"name": "http", "port": 80, "targetPort": 8085}],
+            labels=labels,
+            annotations=gateway_route(name, f"/{name}/", f"{name}.{namespace}:80"),
+        ),
+        k8s.deployment(
+            name,
+            namespace,
+            containers=[
+                k8s.container(
+                    name,
+                    image,
+                    command=["python", "-m", "kubeflow_tpu.dashboard.training"],
+                    ports={"http": 8085},
+                )
+            ],
+            labels=labels,
+            service_account="training-operator",
+        ),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Job prototypes
+# ---------------------------------------------------------------------------
+
+
+def _worker_template(image: str, command: list[str], num_tpu_chips: int) -> dict:
+    resources = jobs_api.tpu_resources(num_tpu_chips)
+    return {
+        "spec": {
+            "containers": [
+                k8s.container("worker", image, command=command, resources=resources)
+            ],
+            "restartPolicy": "Never",
+        }
+    }
+
+
+def _job(
+    kind: str,
+    name: str,
+    namespace: str,
+    replica_specs: dict,
+    accelerator: str,
+    topology: str,
+    num_slices: int = 1,
+    clean_pod_policy: str = "Running",
+) -> dict:
+    return {
+        "apiVersion": jobs_api.JOBS_API_VERSION,
+        "kind": kind,
+        "metadata": k8s.metadata(name, namespace),
+        "spec": {
+            "replicaSpecs": replica_specs,
+            "tpu": {
+                "accelerator": accelerator,
+                "topology": topology,
+                "numSlices": num_slices,
+            },
+            "runPolicy": {"cleanPodPolicy": clean_pod_policy},
+        },
+    }
+
+
+_JOB_PARAMS = [
+    ParamSpec("name"),
+    ParamSpec("namespace", DEFAULT_NAMESPACE),
+    ParamSpec("image", images.JAX_TPU),
+    ParamSpec("num_workers", 2, "worker pods (one per TPU VM host)"),
+    ParamSpec("accelerator", "v5litepod-8", "TPU slice type"),
+    ParamSpec("topology", "2x4", "slice chip topology"),
+    ParamSpec("num_slices", 1, "multislice count (DCN-connected)"),
+    ParamSpec("chips_per_worker", 4, "google.com/tpu chips per worker pod"),
+]
+
+
+@prototype(
+    "jax-job-simple",
+    "A simple JaxJob running an allreduce smoke workload "
+    "(tf-job-simple analogue, kubeflow/examples/prototypes/tf-job-simple-v1beta2.jsonnet)",
+    params=_JOB_PARAMS
+    + [ParamSpec("command", None, "override container command (list)")],
+)
+def jax_job_simple(
+    name: str,
+    namespace: str,
+    image: str,
+    num_workers: int,
+    accelerator: str,
+    topology: str,
+    num_slices: int,
+    chips_per_worker: int,
+    command,
+) -> list[dict]:
+    command = command or [
+        "python",
+        "-m",
+        "kubeflow_tpu.workloads.allreduce_smoke",
+    ]
+    return [
+        _job(
+            jobs_api.JAX_JOB_KIND,
+            name,
+            namespace,
+            {
+                "Worker": {
+                    "replicas": num_workers,
+                    "restartPolicy": "OnFailure",
+                    "template": _worker_template(image, command, chips_per_worker),
+                }
+            },
+            accelerator,
+            topology,
+            num_slices,
+        )
+    ]
+
+
+@prototype(
+    "tf-job",
+    "TFJob with Chief/PS/Worker replicas (compat surface of "
+    "kubeflow/tf-training; lowered to SPMD on TPU by the operator)",
+    params=_JOB_PARAMS + [ParamSpec("num_ps", 0), ParamSpec("command", None)],
+)
+def tf_job(
+    name: str,
+    namespace: str,
+    image: str,
+    num_workers: int,
+    accelerator: str,
+    topology: str,
+    num_slices: int,
+    chips_per_worker: int,
+    num_ps: int,
+    command,
+) -> list[dict]:
+    command = command or ["python", "-m", "kubeflow_tpu.workloads.tf_cnn"]
+    specs = {
+        "Worker": {
+            "replicas": num_workers,
+            "restartPolicy": "OnFailure",
+            "template": _worker_template(image, command, chips_per_worker),
+        }
+    }
+    if num_ps:
+        specs["PS"] = {
+            "replicas": num_ps,
+            "restartPolicy": "OnFailure",
+            "template": _worker_template(image, command, 0),
+        }
+    return [
+        _job(jobs_api.TF_JOB_KIND, name, namespace, specs, accelerator, topology, num_slices)
+    ]
+
+
+@prototype(
+    "pytorch-job",
+    "PyTorchJob with Master/Worker replicas on torch-xla "
+    "(kubeflow/pytorch-job/prototypes/pytorch-job.jsonnet:8-32 with "
+    "numGpus→TPU chips)",
+    params=_JOB_PARAMS + [ParamSpec("command", None)],
+)
+def pytorch_job(
+    name: str,
+    namespace: str,
+    image: str,
+    num_workers: int,
+    accelerator: str,
+    topology: str,
+    num_slices: int,
+    chips_per_worker: int,
+    command,
+) -> list[dict]:
+    command = command or ["python", "-m", "kubeflow_tpu.workloads.torch_xla_ddp"]
+    return [
+        _job(
+            jobs_api.PYTORCH_JOB_KIND,
+            name,
+            namespace,
+            {
+                "Master": {
+                    "replicas": 1,
+                    "restartPolicy": "OnFailure",
+                    "template": _worker_template(image, command, chips_per_worker),
+                },
+                "Worker": {
+                    "replicas": num_workers,
+                    "restartPolicy": "OnFailure",
+                    "template": _worker_template(image, command, chips_per_worker),
+                },
+            },
+            accelerator,
+            topology,
+            num_slices,
+        )
+    ]
+
+
+@prototype(
+    "mpi-job",
+    "MPIJob-equivalent: Launcher/Worker allreduce over ICI via JAX collectives "
+    "(kubeflow/mpi-job/prototypes/mpi-job-custom.jsonnet:35-59, no "
+    "kubectl-delivery needed)",
+    params=_JOB_PARAMS + [ParamSpec("command", None)],
+)
+def mpi_job(
+    name: str,
+    namespace: str,
+    image: str,
+    num_workers: int,
+    accelerator: str,
+    topology: str,
+    num_slices: int,
+    chips_per_worker: int,
+    command,
+) -> list[dict]:
+    command = command or ["python", "-m", "kubeflow_tpu.workloads.allreduce_bench"]
+    return [
+        _job(
+            jobs_api.MPI_JOB_KIND,
+            name,
+            namespace,
+            {
+                "Launcher": {
+                    "replicas": 1,
+                    "restartPolicy": "OnFailure",
+                    "template": _worker_template(image, command, 0),
+                },
+                "Worker": {
+                    "replicas": num_workers,
+                    "restartPolicy": "OnFailure",
+                    "template": _worker_template(image, command, chips_per_worker),
+                },
+            },
+            accelerator,
+            topology,
+            num_slices,
+        )
+    ]
+
+
+@prototype(
+    "mxnet-job",
+    "MXNetJob compat surface (kubeflow/mxnet-job/prototypes/mxnet-job.jsonnet:9-12)",
+    params=_JOB_PARAMS
+    + [ParamSpec("num_schedulers", 1), ParamSpec("num_servers", 1), ParamSpec("command", None)],
+)
+def mxnet_job(
+    name: str,
+    namespace: str,
+    image: str,
+    num_workers: int,
+    accelerator: str,
+    topology: str,
+    num_slices: int,
+    chips_per_worker: int,
+    num_schedulers: int,
+    num_servers: int,
+    command,
+) -> list[dict]:
+    command = command or ["python", "-m", "kubeflow_tpu.workloads.allreduce_smoke"]
+    return [
+        _job(
+            jobs_api.MXNET_JOB_KIND,
+            name,
+            namespace,
+            {
+                "Scheduler": {
+                    "replicas": num_schedulers,
+                    "restartPolicy": "Never",
+                    "template": _worker_template(image, command, 0),
+                },
+                "Server": {
+                    "replicas": num_servers,
+                    "restartPolicy": "Never",
+                    "template": _worker_template(image, command, 0),
+                },
+                "Worker": {
+                    "replicas": num_workers,
+                    "restartPolicy": "Never",
+                    "template": _worker_template(image, command, chips_per_worker),
+                },
+            },
+            accelerator,
+            topology,
+            num_slices,
+        )
+    ]
+
+
+@prototype(
+    "chainer-job",
+    "ChainerJob compat surface (kubeflow/chainer-job/prototypes/chainer-job.jsonnet:7-10)",
+    params=_JOB_PARAMS + [ParamSpec("command", None)],
+)
+def chainer_job(
+    name: str,
+    namespace: str,
+    image: str,
+    num_workers: int,
+    accelerator: str,
+    topology: str,
+    num_slices: int,
+    chips_per_worker: int,
+    command,
+) -> list[dict]:
+    command = command or ["python", "-m", "kubeflow_tpu.workloads.allreduce_smoke"]
+    return [
+        _job(
+            jobs_api.CHAINER_JOB_KIND,
+            name,
+            namespace,
+            {
+                "Master": {
+                    "replicas": 1,
+                    "restartPolicy": "OnFailure",
+                    "template": _worker_template(image, command, chips_per_worker),
+                },
+                "Worker": {
+                    "replicas": num_workers,
+                    "restartPolicy": "OnFailure",
+                    "template": _worker_template(image, command, chips_per_worker),
+                },
+            },
+            accelerator,
+            topology,
+            num_slices,
+        )
+    ]

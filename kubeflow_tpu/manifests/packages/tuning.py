@@ -1,0 +1,140 @@
+"""Tuning package: the Katib-equivalent HP search stack.
+
+Analogue of kubeflow/katib (vizier.libsonnet:28-380,
+studyjobcontroller.libsonnet:14-147). Where Katib runs a vizier-core manager +
+MySQL + per-algorithm suggestion Deployments, our stack is leaner and
+TPU-native: one study-controller that embeds the suggestion algorithms
+(random/grid/hyperband/bayesianoptimization — parity with
+suggestion.libsonnet:3-10) and persists study state in the StudyJob status,
+spawning JaxJob trials. An optional standalone suggestion service mirrors the
+reference's pluggable-algorithm deployment shape.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.apis.tuning import study_job_crd
+from kubeflow_tpu.k8s import objects as k8s
+from kubeflow_tpu.manifests import images
+from kubeflow_tpu.manifests.core import ParamSpec, gateway_route, prototype
+from kubeflow_tpu.version import API_GROUP, DEFAULT_NAMESPACE
+
+
+@prototype(
+    "study-controller",
+    "StudyJob CRD + controller with embedded suggestion algorithms "
+    "(random/grid/hyperband/bayesianoptimization)",
+    params=[
+        ParamSpec("namespace", DEFAULT_NAMESPACE),
+        ParamSpec("image", images.PLATFORM),
+    ],
+)
+def study_controller(namespace: str, image: str) -> list[dict]:
+    name = "study-controller"
+    labels = {"app": name}
+    return [
+        study_job_crd(),
+        k8s.service_account(name, namespace, labels),
+        k8s.cluster_role(
+            name,
+            [
+                k8s.policy_rule([API_GROUP], ["studyjobs", "studyjobs/status"], ["*"]),
+                k8s.policy_rule(
+                    [API_GROUP],
+                    ["jaxjobs", "jaxjobs/status", "tfjobs", "pytorchjobs", "mpijobs"],
+                    ["*"],
+                ),
+                k8s.policy_rule([""], ["events"], ["create", "patch"]),
+            ],
+            labels,
+        ),
+        k8s.cluster_role_binding(name, name, name, namespace),
+        k8s.deployment(
+            name,
+            namespace,
+            containers=[
+                k8s.container(
+                    name,
+                    image,
+                    command=["python", "-m", "kubeflow_tpu.operators.study"],
+                    ports={"metrics": 8443},
+                )
+            ],
+            labels=labels,
+            service_account=name,
+        ),
+    ]
+
+
+@prototype(
+    "suggestion-service",
+    "Standalone suggestion service Deployment+Service for one algorithm "
+    "(vizier suggestion-<algo> analogue, kubeflow/katib/suggestion.libsonnet)",
+    params=[
+        ParamSpec("algorithm", "random", "random|grid|hyperband|bayesianoptimization"),
+        ParamSpec("namespace", DEFAULT_NAMESPACE),
+        ParamSpec("image", images.PLATFORM),
+    ],
+)
+def suggestion_service(algorithm: str, namespace: str, image: str) -> list[dict]:
+    name = f"suggestion-{algorithm}"
+    labels = {"app": name, "component": "suggestion"}
+    return [
+        k8s.service(
+            name,
+            namespace,
+            selector=labels,
+            ports=[{"name": "api", "port": 6789, "targetPort": 6789}],
+            labels=labels,
+        ),
+        k8s.deployment(
+            name,
+            namespace,
+            containers=[
+                k8s.container(
+                    name,
+                    image,
+                    command=["python", "-m", "kubeflow_tpu.tuning.service"],
+                    args=[f"--algorithm={algorithm}", "--port=6789"],
+                    ports={"api": 6789},
+                )
+            ],
+            labels=labels,
+        ),
+    ]
+
+
+@prototype(
+    "study-ui",
+    "Study results UI behind the gateway (katib UI analogue)",
+    params=[
+        ParamSpec("namespace", DEFAULT_NAMESPACE),
+        ParamSpec("image", images.PLATFORM),
+    ],
+)
+def study_ui(namespace: str, image: str) -> list[dict]:
+    name = "study-ui"
+    labels = {"app": name}
+    return [
+        k8s.service(
+            name,
+            namespace,
+            selector=labels,
+            ports=[{"name": "http", "port": 80, "targetPort": 8089}],
+            labels=labels,
+            annotations=gateway_route(name, "/study/", f"{name}.{namespace}:80"),
+        ),
+        k8s.deployment(
+            name,
+            namespace,
+            containers=[
+                k8s.container(
+                    name,
+                    image,
+                    command=["python", "-m", "kubeflow_tpu.webapps.study"],
+                    ports={"http": 8089},
+                )
+            ],
+            labels=labels,
+            service_account="study-controller",
+        ),
+    ]

@@ -1,0 +1,74 @@
+"""Golden-snapshot tooling for the manifest layer.
+
+The reference's jsonnet tests compare generated objects to golden literals
+(kubeflow/tf-training/tests/tf-job_test.jsonnet). Here each snapshot case is a
+(prototype, params) pair rendered to canonical YAML; `--update` rewrites
+tests/golden/*.yaml, and tests/test_manifests.py::test_golden_snapshots
+compares byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import yaml
+
+from kubeflow_tpu.manifests.core import generate
+
+# case name -> (prototype, params)
+SNAPSHOT_CASES: dict[str, tuple[str, dict]] = {
+    "training-operator": ("training-operator", {}),
+    "jax-job-simple": (
+        "jax-job-simple",
+        {"name": "smoke", "num_workers": 4, "accelerator": "v5litepod-16", "topology": "4x4"},
+    ),
+    "tf-job": ("tf-job", {"name": "bert", "num_workers": 4, "num_ps": 2}),
+    "pytorch-job": ("pytorch-job", {"name": "llama", "num_workers": 3}),
+    "mpi-job": ("mpi-job", {"name": "allreduce", "num_workers": 2}),
+    "gateway": ("gateway", {}),
+    "centraldashboard": ("centraldashboard", {}),
+    "tpu-serving": (
+        "tpu-serving",
+        {"name": "bert", "model_path": "gs://models/bert", "num_tpu_chips": 4},
+    ),
+}
+
+
+def render_case(case_name: str) -> str:
+    proto, params = SNAPSHOT_CASES[case_name]
+    objs = generate(proto, params)
+    return yaml.safe_dump_all(objs, sort_keys=True, default_flow_style=False)
+
+
+def golden_dir() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(__file__))), "tests", "golden")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--update", action="store_true", help="rewrite golden files")
+    args = ap.parse_args(argv)
+    gdir = golden_dir()
+    os.makedirs(gdir, exist_ok=True)
+    drift = []
+    for case in SNAPSHOT_CASES:
+        rendered = render_case(case)
+        path = os.path.join(gdir, f"{case}.yaml")
+        if args.update:
+            with open(path, "w") as f:
+                f.write(rendered)
+            print(f"wrote {path}")
+        else:
+            existing = open(path).read() if os.path.exists(path) else None
+            if existing != rendered:
+                drift.append(case)
+    if drift:
+        print(f"golden drift: {drift}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,6 @@
+__version__ = "0.1.0"
+
+# API group for all CRDs this platform owns (the analogue of kubeflow.org in
+# the reference, e.g. kubeflow/tf-training/tf-job-operator.libsonnet:55).
+API_GROUP = "kubeflow-tpu.org"
+DEFAULT_NAMESPACE = "kubeflow"

@@ -1,0 +1,152 @@
+"""Fake apiserver semantics tests (envtest-analogue correctness)."""
+
+import pytest
+
+from kubeflow_tpu.apis import jobs as jobs_api
+from kubeflow_tpu.k8s.client import ApiError
+from kubeflow_tpu.k8s import objects as k8s
+
+
+def _pod(name, ns="default", labels=None):
+    return k8s.pod(name, ns, k8s.pod_spec([k8s.container("c", "img")]), labels=labels)
+
+
+def test_create_assigns_metadata(api):
+    created = api.create(_pod("p1"))
+    m = created["metadata"]
+    assert m["uid"] and m["resourceVersion"] and m["creationTimestamp"]
+
+
+def test_create_requires_namespace(api):
+    with pytest.raises(ApiError) as e:
+        api.create(_pod("p1", ns="nope"))
+    assert e.value.code == 404
+
+
+def test_duplicate_create_conflicts(api):
+    api.create(_pod("p1"))
+    with pytest.raises(ApiError) as e:
+        api.create(_pod("p1"))
+    assert e.value.code == 409
+
+
+def test_stale_resource_version_conflicts(api):
+    created = api.create(_pod("p1"))
+    stale = dict(created)
+    api.update(created)  # bumps rv
+    with pytest.raises(ApiError) as e:
+        api.update(stale)
+    assert e.value.code == 409
+
+
+def test_status_subresource_isolation(api):
+    api.create(jobs_api.job_crd("JaxJob"))
+    job = api.create(
+        {
+            "apiVersion": "kubeflow-tpu.org/v1",
+            "kind": "JaxJob",
+            "metadata": {"name": "j", "namespace": "default"},
+            "spec": {"x": 1},
+        }
+    )
+    # update_status sets status without touching spec
+    job["status"] = {"state": "Running"}
+    job["spec"] = {"x": 999}
+    updated = api.update_status(job)
+    assert updated["status"] == {"state": "Running"}
+    assert updated["spec"] == {"x": 1}
+    # plain update cannot clobber status
+    updated["spec"] = {"x": 2}
+    updated["status"] = {"state": "HACKED"}
+    final = api.update(updated)
+    assert final["spec"] == {"x": 2}
+    assert final["status"] == {"state": "Running"}
+
+
+def test_label_selector_list(api):
+    api.create(_pod("a", labels={"job": "x"}))
+    api.create(_pod("b", labels={"job": "y"}))
+    got = api.list("v1", "Pod", "default", label_selector={"job": "x"})
+    assert [o["metadata"]["name"] for o in got] == ["a"]
+
+
+def test_merge_patch(api):
+    api.create(_pod("p", labels={"a": "1", "b": "2"}))
+    patched = api.patch(
+        "v1", "Pod", "p", {"metadata": {"labels": {"b": None, "c": "3"}}}, "default"
+    )
+    assert patched["metadata"]["labels"] == {"a": "1", "c": "3"}
+
+
+def test_owner_reference_cascade_delete(api):
+    api.create(jobs_api.job_crd("JaxJob"))
+    job = api.create(
+        {
+            "apiVersion": "kubeflow-tpu.org/v1",
+            "kind": "JaxJob",
+            "metadata": {"name": "j", "namespace": "default"},
+            "spec": {},
+        }
+    )
+    child = _pod("j-worker-0")
+    child["metadata"]["ownerReferences"] = [k8s.object_ref(job)]
+    api.create(child)
+    api.delete("kubeflow-tpu.org/v1", "JaxJob", "j", "default")
+    assert api.get_or_none("v1", "Pod", "j-worker-0", "default") is None
+
+
+def test_watch_sees_lifecycle(api):
+    stream = api.watch("v1", "Pod", "default")
+    api.create(_pod("w1"))
+    api.delete("v1", "Pod", "w1", "default")
+    events = []
+    for _ in range(2):
+        evt = stream.next(timeout=2)
+        assert evt is not None
+        events.append((evt.type, evt.object["metadata"]["name"]))
+    stream.stop()
+    assert events == [("ADDED", "w1"), ("DELETED", "w1")]
+
+
+def test_watch_initial_replay(api):
+    api.create(_pod("pre"))
+    stream = api.watch("v1", "Pod", "default")
+    evt = stream.next(timeout=2)
+    assert evt.type == "ADDED" and evt.object["metadata"]["name"] == "pre"
+    stream.stop()
+
+
+def test_crd_registration_enables_kind(api):
+    with pytest.raises(ApiError):
+        api.create(
+            {
+                "apiVersion": "kubeflow-tpu.org/v1",
+                "kind": "TFJob",
+                "metadata": {"name": "t", "namespace": "default"},
+            }
+        )
+    api.create(jobs_api.job_crd("TFJob"))
+    created = api.create(
+        {
+            "apiVersion": "kubeflow-tpu.org/v1",
+            "kind": "TFJob",
+            "metadata": {"name": "t", "namespace": "default"},
+            "spec": {},
+        }
+    )
+    assert created["metadata"]["uid"]
+
+
+def test_apply_create_then_update(api):
+    cm = k8s.config_map("c", "default", {"k": "1"})
+    api.apply(cm)
+    cm2 = k8s.config_map("c", "default", {"k": "2"})
+    out = api.apply(cm2)
+    assert out["data"]["k"] == "2"
+
+
+def test_namespace_delete_removes_contents(api):
+    api.ensure_namespace("scratch")
+    api.create(_pod("p", ns="scratch"))
+    api.delete("v1", "Namespace", "scratch")
+    assert api.get_or_none("v1", "Pod", "p", "scratch") is None

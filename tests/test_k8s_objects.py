@@ -1,0 +1,77 @@
+"""Unit tests for the K8s object builders."""
+
+from kubeflow_tpu.k8s import objects as k8s
+
+
+def test_container_env_and_ports():
+    c = k8s.container(
+        "worker",
+        "img:1",
+        command=["python", "-m", "x"],
+        env={"A": "1"},
+        env_from_field={"POD_IP": "status.podIP"},
+        ports={"http": 8080},
+        resources={"limits": {"google.com/tpu": 4}},
+    )
+    assert c["env"] == [
+        {"name": "A", "value": "1"},
+        {"name": "POD_IP", "valueFrom": {"fieldRef": {"fieldPath": "status.podIP"}}},
+    ]
+    assert c["ports"] == [{"name": "http", "containerPort": 8080}]
+    assert c["resources"]["limits"]["google.com/tpu"] == 4
+    assert "args" not in c  # None-valued fields are dropped
+
+
+def test_deployment_selector_matches_pod_labels():
+    d = k8s.deployment(
+        "op", "kubeflow", [k8s.container("c", "img")], labels={"app": "op"}
+    )
+    sel = d["spec"]["selector"]["matchLabels"]
+    assert sel == d["spec"]["template"]["metadata"]["labels"]
+    assert d["metadata"]["namespace"] == "kubeflow"
+
+
+def test_headless_service():
+    s = k8s.headless_service(
+        "job-workers", "ns", {"job": "j"}, [{"name": "coord", "port": 8476}]
+    )
+    assert s["spec"]["clusterIP"] == "None"
+
+
+def test_crd_builder():
+    c = k8s.crd(
+        "kubeflow-tpu.org",
+        "JaxJob",
+        "jaxjobs",
+        versions=[
+            k8s.crd_version(
+                "v1",
+                schema={"type": "object"},
+                storage=True,
+                printer_columns=[k8s.printer_column("State", ".status.state")],
+            )
+        ],
+    )
+    assert c["metadata"]["name"] == "jaxjobs.kubeflow-tpu.org"
+    v = c["spec"]["versions"][0]
+    assert v["storage"] is True
+    assert v["subresources"] == {"status": {}}
+    assert v["additionalPrinterColumns"][0]["jsonPath"] == ".status.state"
+
+
+def test_owner_ref_cascade_fields():
+    parent = {
+        "apiVersion": "kubeflow-tpu.org/v1",
+        "kind": "JaxJob",
+        "metadata": {"name": "j", "namespace": "ns", "uid": "u1"},
+    }
+    p = k8s.pod("p", "ns", k8s.pod_spec([k8s.container("c", "i")]), owner=parent)
+    ref = p["metadata"]["ownerReferences"][0]
+    assert ref["uid"] == "u1" and ref["controller"] is True
+
+
+def test_rbac_builders():
+    r = k8s.cluster_role("r", [k8s.policy_rule([""], ["pods"], ["get"])])
+    b = k8s.cluster_role_binding("b", "r", "sa", "ns")
+    assert r["rules"][0]["resources"] == ["pods"]
+    assert b["subjects"][0]["namespace"] == "ns"

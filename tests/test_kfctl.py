@@ -1,0 +1,162 @@
+"""kfctl lifecycle tests — the analogue of testing/kfctl/kfctl_go_test.py
+(init/generate/apply against a cluster) run against the fake platform."""
+
+import os
+
+import pytest
+import yaml
+
+from kubeflow_tpu.cli import platforms
+from kubeflow_tpu.cli.coordinator import Coordinator
+from kubeflow_tpu.cli.kfctl import main as kfctl_main
+from kubeflow_tpu.config import defaults
+
+
+@pytest.fixture(autouse=True)
+def fresh_fake_platform():
+    platforms.FakePlatform.reset()
+    yield
+    platforms.FakePlatform.reset()
+
+
+def _init_app(tmp_path, platform="fake", name="testapp"):
+    app_dir = str(tmp_path / name)
+    rc = kfctl_main(["init", name, "--app-dir", app_dir, "--platform", platform])
+    assert rc == 0
+    return app_dir
+
+
+def test_init_writes_app_yaml(tmp_path):
+    app_dir = _init_app(tmp_path)
+    data = yaml.safe_load(open(os.path.join(app_dir, "app.yaml")))
+    assert data["kind"] == "KfDef"
+    assert data["spec"]["platform"] == "fake"
+    comp_names = [c["name"] for c in data["spec"]["components"]]
+    assert "training-operator" in comp_names and "gateway" in comp_names
+
+
+def test_init_twice_fails(tmp_path):
+    app_dir = _init_app(tmp_path)
+    rc = kfctl_main(["init", "testapp", "--app-dir", app_dir, "--platform", "fake"])
+    assert rc == 1
+
+
+def test_generate_writes_all_components(tmp_path):
+    app_dir = _init_app(tmp_path)
+    assert kfctl_main(["generate", "--app-dir", app_dir]) == 0
+    mdir = os.path.join(app_dir, "manifests")
+    files = sorted(os.listdir(mdir))
+    kfdef = defaults.default_kfdef("x", platform="fake")
+    assert files == sorted(f"{c.name}.yaml" for c in kfdef.spec.components)
+    # every object carries the part-of label (used by delete GC)
+    for fn in files:
+        for obj in yaml.safe_load_all(open(os.path.join(mdir, fn))):
+            if obj:
+                assert (
+                    obj["metadata"]["labels"]["app.kubernetes.io/part-of"]
+                    == "kubeflow-tpu"
+                )
+
+
+def test_apply_then_delete_full_lifecycle(tmp_path):
+    app_dir = _init_app(tmp_path)
+    assert kfctl_main(["generate", "--app-dir", app_dir]) == 0
+    assert kfctl_main(["apply", "--app-dir", app_dir]) == 0
+
+    server = platforms.FakePlatform.shared_server()
+    # namespace exists, CRDs registered, operator deployment present
+    assert server.get_or_none("v1", "Namespace", "kubeflow") is not None
+    crds = server.list("apiextensions.k8s.io/v1", "CustomResourceDefinition")
+    crd_names = {c["metadata"]["name"] for c in crds}
+    assert "jaxjobs.kubeflow-tpu.org" in crd_names
+    assert "notebooks.kubeflow-tpu.org" in crd_names
+    assert "studyjobs.kubeflow-tpu.org" in crd_names
+    deps = server.list("apps/v1", "Deployment", "kubeflow")
+    dep_names = {d["metadata"]["name"] for d in deps}
+    assert {"training-operator", "gateway", "centraldashboard"} <= dep_names
+
+    # apply is idempotent
+    assert kfctl_main(["apply", "--app-dir", app_dir]) == 0
+
+    assert kfctl_main(["delete", "--app-dir", app_dir]) == 0
+    assert server.list("apps/v1", "Deployment", "kubeflow") == []
+    assert server.list("apiextensions.k8s.io/v1", "CustomResourceDefinition") == []
+
+
+def test_apply_auto_generates(tmp_path):
+    app_dir = _init_app(tmp_path)
+    assert kfctl_main(["apply", "--app-dir", app_dir]) == 0
+    assert os.path.isdir(os.path.join(app_dir, "manifests"))
+
+
+def test_generate_before_init_fails(tmp_path):
+    rc = kfctl_main(["generate", "--app-dir", str(tmp_path)])
+    assert rc == 1
+
+
+def test_show_prints_objects(tmp_path, capsys):
+    app_dir = _init_app(tmp_path)
+    kfctl_main(["generate", "--app-dir", app_dir])
+    capsys.readouterr()  # drop init/generate output
+    assert kfctl_main(["show", "--app-dir", app_dir]) == 0
+    out = capsys.readouterr().out
+    docs = [d for d in yaml.safe_load_all(out) if d]
+    assert len(docs) > 20
+
+
+def test_gcp_tpu_platform_config(tmp_path):
+    app_dir = str(tmp_path / "gcpapp")
+    rc = kfctl_main(
+        [
+            "init", "gcpapp", "--app-dir", app_dir, "--platform", "gcp-tpu",
+            "--project", "my-proj", "--zone", "us-central2-b",
+            "--accelerator", "v5p-16", "--topology", "2x2x4", "--num-slices", "2",
+        ]
+    )
+    assert rc == 0
+    coord = Coordinator.load(app_dir)
+    coord.generate()
+    cluster = yaml.safe_load(open(os.path.join(app_dir, "gcp_config", "cluster.yaml")))
+    pools = {p["name"]: p for p in cluster["cluster"]["nodePools"]}
+    assert pools["tpu-pool"]["machineType"] == "ct5p-hightpu-4t"
+    assert pools["tpu-pool"]["placementPolicy"]["tpuTopology"] == "2x2x4"
+    assert pools["tpu-pool"]["multislice"]["numSlices"] == 2
+    # admission-webhook included for gcp platform
+    assert os.path.exists(os.path.join(app_dir, "manifests", "admission-webhook.yaml"))
+
+
+def test_version(capsys):
+    assert kfctl_main(["version"]) == 0
+    assert capsys.readouterr().out.strip()
+
+
+def test_component_param_overrides_flow(tmp_path):
+    app_dir = str(tmp_path / "app")
+    kfdef = defaults.default_kfdef("app", platform="fake")
+    kfdef.spec.component("gateway").params["replicas"] = 5
+    coord = Coordinator.init(kfdef, app_dir)
+    coord.generate()
+    report = coord.apply()
+    assert report.ok, report.failed
+    server = platforms.FakePlatform.shared_server()
+    dep = server.get("apps/v1", "Deployment", "gateway", "kubeflow")
+    assert dep["spec"]["replicas"] == 5
+
+
+def test_scope_platform_only_skips_manifests(tmp_path):
+    app_dir = _init_app(tmp_path, name="scoped")
+    assert kfctl_main(["apply", "platform", "--app-dir", app_dir]) == 0
+    server = platforms.FakePlatform.shared_server()
+    # no k8s objects were applied
+    assert server.get_or_none("v1", "Namespace", "kubeflow") is None
+
+
+def test_scope_k8s_generate_only_writes_manifests(tmp_path):
+    app_dir = str(tmp_path / "gcpscope")
+    kfctl_main(
+        ["init", "gcpscope", "--app-dir", app_dir, "--platform", "gcp-tpu",
+         "--project", "p", "--zone", "z"]
+    )
+    assert kfctl_main(["generate", "k8s", "--app-dir", app_dir]) == 0
+    assert os.path.isdir(os.path.join(app_dir, "manifests"))
+    assert not os.path.exists(os.path.join(app_dir, "gcp_config"))

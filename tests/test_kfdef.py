@@ -1,0 +1,107 @@
+"""KfDef config schema round-trip and validation tests
+(application_types_test.go analogue)."""
+
+import pytest
+
+from kubeflow_tpu.config import defaults
+from kubeflow_tpu.config.kfdef import ComponentConfig, KfDef, KfDefSpec, TpuSpec
+
+
+def test_round_trip(tmp_path):
+    kfdef = defaults.default_kfdef(
+        "myapp", platform="gcp-tpu", project="proj", zone="us-central2-b",
+        accelerator="v5p-16", topology="2x2x4", num_slices=2,
+    )
+    path = tmp_path / "app.yaml"
+    kfdef.save(str(path))
+    loaded = KfDef.load(str(path))
+    assert loaded.name == "myapp"
+    assert loaded.spec.platform == "gcp-tpu"
+    assert loaded.spec.tpu.accelerator == "v5p-16"
+    assert loaded.spec.tpu.num_slices == 2
+    assert [c.name for c in loaded.spec.components] == [
+        c.name for c in kfdef.spec.components
+    ]
+
+
+def test_unknown_field_rejected():
+    with pytest.raises(ValueError, match="unknown KfDef spec fields"):
+        KfDef.from_dict(
+            {
+                "apiVersion": "kubeflow-tpu.org/v1",
+                "kind": "KfDef",
+                "metadata": {"name": "x"},
+                "spec": {"bogusField": 1},
+            }
+        )
+
+
+def test_bad_platform_rejected():
+    with pytest.raises(ValueError, match="platform"):
+        KfDef.from_dict(
+            {
+                "apiVersion": "kubeflow-tpu.org/v1",
+                "kind": "KfDef",
+                "metadata": {"name": "x"},
+                "spec": {"platform": "aws-trainium"},
+            }
+        )
+
+
+def test_wrong_kind_rejected():
+    with pytest.raises(ValueError, match="not a KfDef"):
+        KfDef.from_dict({"kind": "ConfigMap", "metadata": {"name": "x"}})
+
+
+def test_component_params_preserved(tmp_path):
+    kfdef = KfDef(
+        "app",
+        KfDefSpec(
+            components=[
+                ComponentConfig("serve-bert", prototype="tpu-serving", params={"model_path": "gs://m"})
+            ]
+        ),
+    )
+    path = tmp_path / "app.yaml"
+    kfdef.save(str(path))
+    loaded = KfDef.load(str(path))
+    c = loaded.spec.component("serve-bert")
+    assert c.prototype_name == "tpu-serving"
+    assert c.params == {"model_path": "gs://m"}
+
+
+def test_load_app_dir_missing(tmp_path):
+    with pytest.raises(FileNotFoundError, match="kfctl init"):
+        KfDef.load_app_dir(str(tmp_path))
+
+
+def test_gcp_platform_gets_webhook():
+    comps = [c.name for c in defaults.default_components("gcp-tpu")]
+    assert "admission-webhook" in comps
+    assert "training-operator" in comps
+
+
+def test_tpu_block_camel_case_accepted():
+    kfdef = KfDef.from_dict(
+        {
+            "apiVersion": "kubeflow-tpu.org/v1",
+            "kind": "KfDef",
+            "metadata": {"name": "x"},
+            "spec": {"tpu": {"numSlices": 2, "accelerator": "v5p-16"}},
+        }
+    )
+    assert kfdef.spec.tpu.num_slices == 2
+    # serialisation is camelCase like the rest of spec
+    assert kfdef.to_dict()["spec"]["tpu"]["numSlices"] == 2
+
+
+def test_tpu_block_unknown_field_rejected():
+    with pytest.raises(ValueError, match="unknown KfDef tpu fields"):
+        KfDef.from_dict(
+            {
+                "apiVersion": "kubeflow-tpu.org/v1",
+                "kind": "KfDef",
+                "metadata": {"name": "x"},
+                "spec": {"tpu": {"gpuCount": 8}},
+            }
+        )
