@@ -1,0 +1,52 @@
+"""TPU-native parallelism library.
+
+The reference platform's parallelism is *replica-typed*: PS/Worker processes
+wired by TF_CONFIG (kubeflow/tf-training/tf-job-operator.libsonnet:10-96),
+MPI allreduce (kubeflow/mpi-job/mpi-operator.libsonnet:5-28), NCCL inside
+imported GPU images. This package replaces all of that with the SPMD model
+native to TPUs:
+
+- :mod:`~kubeflow_tpu.parallel.mesh` — device meshes over ICI/DCN with named
+  axes for data / fsdp / tensor / sequence / expert parallelism.
+- :mod:`~kubeflow_tpu.parallel.sharding` — named-rule pytree sharding (the
+  GSPMD analogue of the reference's per-replica resource assignment).
+- :mod:`~kubeflow_tpu.parallel.collectives` — XLA collective wrappers
+  (psum / all_gather / reduce_scatter / ppermute) for use under shard_map.
+- :mod:`~kubeflow_tpu.parallel.distributed` — multi-host rendezvous from the
+  operator-injected coordinator env (the TF_CONFIG analogue, SURVEY.md §2.2).
+- :mod:`~kubeflow_tpu.parallel.ring_attention` — ring attention over the
+  sequence axis for long-context training (absent from the reference,
+  SURVEY.md §5.7).
+"""
+
+from kubeflow_tpu.parallel.mesh import (
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_FSDP,
+    AXIS_SEQUENCE,
+    AXIS_TENSOR,
+    MeshConfig,
+    build_mesh,
+)
+from kubeflow_tpu.parallel.sharding import (
+    PartitionRule,
+    batch_spec,
+    named_sharding,
+    shard_pytree,
+    spec_for_path,
+)
+
+__all__ = [
+    "AXIS_DATA",
+    "AXIS_EXPERT",
+    "AXIS_FSDP",
+    "AXIS_SEQUENCE",
+    "AXIS_TENSOR",
+    "MeshConfig",
+    "build_mesh",
+    "PartitionRule",
+    "batch_spec",
+    "named_sharding",
+    "shard_pytree",
+    "spec_for_path",
+]

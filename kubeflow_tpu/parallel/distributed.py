@@ -1,0 +1,79 @@
+"""Multi-host rendezvous from operator-injected environment.
+
+The reference's operators wire workers together by injecting ``TF_CONFIG``
+(cluster host lists + task index — consumed at
+tf-controller-examples/tf-cnn/launcher.py:69-81) or MPI hostfiles delivered by
+kubectl-delivery (kubeflow/mpi-job/mpi-operator.libsonnet:280). Our JaxJob
+controller injects three env vars instead (kubeflow_tpu/apis/jobs.py) and every
+worker calls :func:`initialize_from_env`, which performs the
+``jax.distributed.initialize`` rendezvous — the single entry point for both
+ICI (intra-slice) and DCN (multi-slice) topologies.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+import jax
+
+from kubeflow_tpu.apis.jobs import (
+    ENV_COORDINATOR_ADDRESS,
+    ENV_NUM_PROCESSES,
+    ENV_PROCESS_ID,
+)
+
+
+@dataclass(frozen=True)
+class ProcessInfo:
+    coordinator_address: str | None
+    num_processes: int
+    process_id: int
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.num_processes > 1
+
+
+def process_info_from_env(environ=None) -> ProcessInfo:
+    env = os.environ if environ is None else environ
+    return ProcessInfo(
+        coordinator_address=env.get(ENV_COORDINATOR_ADDRESS),
+        num_processes=int(env.get(ENV_NUM_PROCESSES, "1")),
+        process_id=int(env.get(ENV_PROCESS_ID, "0")),
+    )
+
+
+def initialize_from_env(environ=None) -> ProcessInfo:
+    """Join the job's collective. No-op for single-process jobs, so the same
+    worker image runs unmodified on one chip or a multi-host slice (the
+    property the reference gets from launcher.py tolerating absent TF_CONFIG).
+    """
+    info = process_info_from_env(environ)
+    if info.is_distributed:
+        if not info.coordinator_address:
+            raise RuntimeError(
+                f"{ENV_NUM_PROCESSES}>1 but {ENV_COORDINATOR_ADDRESS} is unset; "
+                "the JaxJob controller must inject the coordinator service address"
+            )
+        jax.distributed.initialize(
+            coordinator_address=info.coordinator_address,
+            num_processes=info.num_processes,
+            process_id=info.process_id,
+        )
+    return info
+
+
+def barrier(name: str = "barrier") -> None:
+    """Block until every process reaches this point (checkpoint/teardown
+    ordering — the role the openmpi sidecar's file signals play at
+    components/openmpi-controller/controller/controller.py:17-116)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+
+
+def shutdown() -> None:
+    if jax.distributed.is_initialized():
+        jax.distributed.shutdown()

@@ -1,0 +1,116 @@
+"""Device-mesh construction with named parallelism axes.
+
+The reference expresses scale as replica counts on a CRD
+(e.g. numPs/numWorkers, kubeflow/tf-training/tf-job-operator.libsonnet:10-96;
+numGpus, kubeflow/pytorch-job/prototypes/pytorch-job.jsonnet:26-32). The TPU
+equivalent is a :class:`jax.sharding.Mesh` whose named axes carry the
+parallelism strategy; XLA inserts the collectives. Axis order here is chosen
+so the highest-bandwidth-demand axes (tensor, then sequence) land on the
+innermost ICI dimensions, while pure-data axes tolerate DCN.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical axis names, outermost (DCN-tolerant) to innermost (ICI-hungry).
+AXIS_DATA = "data"
+AXIS_FSDP = "fsdp"
+AXIS_EXPERT = "expert"
+AXIS_SEQUENCE = "sequence"
+AXIS_TENSOR = "tensor"
+
+MESH_AXES: tuple[str, ...] = (
+    AXIS_DATA,
+    AXIS_FSDP,
+    AXIS_EXPERT,
+    AXIS_SEQUENCE,
+    AXIS_TENSOR,
+)
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Degrees of each parallelism axis.
+
+    Any axis may be -1 (at most one), meaning "absorb the remaining devices" —
+    the same convenience the reference exposes by letting replica counts
+    default from cluster size.
+    """
+
+    data: int = -1
+    fsdp: int = 1
+    expert: int = 1
+    sequence: int = 1
+    tensor: int = 1
+    # Axes that collectively must map onto a single slice's ICI. Used by the
+    # operator's topology allocator; informational on a single host.
+    ici_axes: tuple[str, ...] = field(
+        default=(AXIS_EXPERT, AXIS_SEQUENCE, AXIS_TENSOR), repr=False
+    )
+
+    def degrees(self) -> dict[str, int]:
+        return {
+            AXIS_DATA: self.data,
+            AXIS_FSDP: self.fsdp,
+            AXIS_EXPERT: self.expert,
+            AXIS_SEQUENCE: self.sequence,
+            AXIS_TENSOR: self.tensor,
+        }
+
+    def resolve(self, n_devices: int) -> dict[str, int]:
+        """Fill in the one -1 axis and validate the product equals n_devices."""
+        degrees = self.degrees()
+        wildcard = [name for name, d in degrees.items() if d == -1]
+        if len(wildcard) > 1:
+            raise ValueError(f"at most one mesh axis may be -1, got {wildcard}")
+        fixed = math.prod(d for d in degrees.values() if d != -1)
+        if wildcard:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {fixed}"
+                )
+            degrees[wildcard[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh {degrees} needs {fixed} devices but {n_devices} are present"
+            )
+        return degrees
+
+
+def build_mesh(
+    config: MeshConfig | None = None,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a Mesh with the canonical axis names.
+
+    On TPU, delegates device placement to ``mesh_utils.create_device_mesh`` so
+    axes map contiguously onto the physical torus; on CPU/virtual devices it
+    reshapes the flat device list (placement is meaningless there).
+    """
+    config = config or MeshConfig()
+    devices = list(devices if devices is not None else jax.devices())
+    degrees = config.resolve(len(devices))
+    shape = tuple(degrees[a] for a in MESH_AXES)
+    if devices[0].platform == "tpu":
+        from jax.experimental import mesh_utils
+
+        mesh_devices = mesh_utils.create_device_mesh(
+            shape, devices=np.asarray(devices)
+        )
+    else:
+        mesh_devices = np.asarray(devices).reshape(shape)
+    return Mesh(mesh_devices, MESH_AXES)
+
+
+def single_device_mesh(device: jax.Device | None = None) -> Mesh:
+    """A 1×1×1×1×1 mesh — lets the same pjit code path run on one chip."""
+    device = device or jax.devices()[0]
+    return build_mesh(MeshConfig(data=1), devices=[device])

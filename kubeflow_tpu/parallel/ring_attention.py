@@ -1,0 +1,122 @@
+"""Ring attention: exact attention over a sequence-sharded mesh axis.
+
+Long-context support is entirely absent from the reference (SURVEY.md §5.7 —
+its only sharding notions are PS sharding and MPI allreduce). Here sequences
+are sharded over the ``sequence`` mesh axis; each device holds one query chunk
+and streams key/value chunks around the ICI ring with ``ppermute``, folding
+each block in with an online-softmax update (flash-attention accumulation).
+Communication overlaps compute naturally: XLA schedules the permute for step
+i+1 concurrently with the block matmuls for step i.
+
+Memory per device is O(seq/ring × seq/ring) instead of O(seq²); the ring makes
+context length scale linearly with the number of devices on the axis.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kubeflow_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_SEQUENCE
+
+_NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, bias, m_prev, num_prev, den_prev, scale):
+    """Fold one K/V block into the running online-softmax state.
+
+    q: [B, H, Tq, D]; k,v: [B, H, Tk, D]; bias: [Tq, Tk] additive mask.
+    State: running max m [B,H,Tq,1], numerator [B,H,Tq,D], denominator
+    [B,H,Tq,1] — all float32 regardless of input dtype.
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * scale + bias[None, None, :, :]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    # Renormalize previous accumulators to the new max.
+    correction = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    num = num_prev * correction + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32)
+    )
+    den = den_prev * correction + jnp.sum(p, axis=-1, keepdims=True)
+    return m_new, num, den
+
+
+def _causal_bias(q_start, k_start, tq, tk):
+    q_pos = q_start + jnp.arange(tq)[:, None]
+    k_pos = k_start + jnp.arange(tk)[None, :]
+    return jnp.where(q_pos >= k_pos, 0.0, _NEG_INF).astype(jnp.float32)
+
+
+def _ring_attention_sharded(q, k, v, *, causal: bool, axis: str):
+    """Per-device body under shard_map. q,k,v: [B, H, T_local, D]."""
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    b, h, t_local, d = q.shape
+    scale = 1.0 / (d**0.5)
+    q32 = q.astype(jnp.float32)
+
+    def step(carry, i):
+        k_blk, v_blk, m, num, den = carry
+        # Block i arrived from device (idx + i) mod n — its global offset.
+        src = (idx + i) % n
+        if causal:
+            bias = _causal_bias(idx * t_local, src * t_local, t_local, t_local)
+        else:
+            bias = jnp.zeros((t_local, t_local), jnp.float32)
+        m, num, den = _block_attn(q32, k_blk, v_blk, bias, m, num, den, scale)
+        # Pull the next block from the right neighbor (ring shift by one).
+        perm = [(j, (j - 1) % n) for j in range(n)]
+        k_nxt = lax.ppermute(k_blk, axis_name=axis, perm=perm)
+        v_nxt = lax.ppermute(v_blk, axis_name=axis, perm=perm)
+        return (k_nxt, v_nxt, m, num, den), None
+
+    m0 = jnp.full((b, h, t_local, 1), _NEG_INF, jnp.float32)
+    num0 = jnp.zeros((b, h, t_local, d), jnp.float32)
+    den0 = jnp.zeros((b, h, t_local, 1), jnp.float32)
+    (_, _, m, num, den), _ = lax.scan(
+        step, (k.astype(jnp.float32), v.astype(jnp.float32), m0, num0, den0),
+        jnp.arange(n),
+    )
+    return (num / den).astype(q.dtype)
+
+
+def ring_attention(
+    q,
+    k,
+    v,
+    mesh: Mesh,
+    *,
+    causal: bool = True,
+    axis: str = AXIS_SEQUENCE,
+    batch_axes=(AXIS_DATA, AXIS_FSDP),
+):
+    """Exact attention with q/k/v laid out [B@batch_axes, H, T@axis, D].
+
+    Inputs are global arrays (or tracers under jit); output keeps the input
+    layout. Batch stays sharded over the data/fsdp axes so each data-parallel
+    group runs the ring only on its own examples; pass ``batch_axes=()`` for
+    replicated-batch use.
+    """
+    spec = P(tuple(batch_axes) or None, None, axis, None)
+    body = functools.partial(_ring_attention_sharded, causal=causal, axis=axis)
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
+
+
+def reference_attention(q, k, v, *, causal: bool = True):
+    """Unsharded O(T²) attention — the correctness oracle for tests."""
+    d = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s / (d**0.5)
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        s = s + _causal_bias(0, 0, tq, tk)[None, None]
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)

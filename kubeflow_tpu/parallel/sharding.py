@@ -1,0 +1,89 @@
+"""Named-rule pytree sharding.
+
+The reference assigns compute by labeling replicas (PS vs Worker) and letting
+the runtime place tensors; the GSPMD analogue is a table of rules mapping
+parameter paths to :class:`~jax.sharding.PartitionSpec`s. Rules are matched by
+regex over the ``/``-joined pytree path, first match wins — the same
+precedence model as the reference's componentParams overrides
+(bootstrap/config/kfctl_default.yaml:5-40).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_SEQUENCE
+
+
+@dataclass(frozen=True)
+class PartitionRule:
+    """Map parameter paths matching ``pattern`` to ``spec``."""
+
+    pattern: str
+    spec: P
+
+    def matches(self, path: str) -> bool:
+        return re.search(self.pattern, path) is not None
+
+
+def path_str(key_path) -> str:
+    """Render a jax key path as 'a/b/0/c' for rule matching."""
+    parts = []
+    for k in key_path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def spec_for_path(path: str, rules: list[PartitionRule], default: P = P()) -> P:
+    for rule in rules:
+        if rule.matches(path):
+            return rule.spec
+    return default
+
+
+def tree_specs(tree, rules: list[PartitionRule], default: P = P()):
+    """PartitionSpec pytree matching ``tree``'s structure."""
+    return jax.tree_util.tree_map_with_path(
+        lambda kp, _: spec_for_path(path_str(kp), rules, default), tree
+    )
+
+
+def named_sharding(mesh: Mesh, spec: P) -> NamedSharding:
+    return NamedSharding(mesh, spec)
+
+
+def tree_shardings(mesh: Mesh, tree, rules: list[PartitionRule], default: P = P()):
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        tree_specs(tree, rules, default),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_pytree(tree, mesh: Mesh, rules: list[PartitionRule], default: P = P()):
+    """Place every leaf of ``tree`` per the first matching rule."""
+    return jax.device_put(tree, tree_shardings(mesh, tree, rules, default))
+
+
+def batch_spec(sequence_sharded: bool = False) -> P:
+    """Spec for [batch, seq, ...] activations: batch over data×fsdp, and the
+    sequence dim over the sequence axis when context parallelism is on."""
+    if sequence_sharded:
+        return P((AXIS_DATA, AXIS_FSDP), AXIS_SEQUENCE)
+    return P((AXIS_DATA, AXIS_FSDP))
+
+
+def constrain(x, mesh: Mesh, spec: P):
+    """with_sharding_constraint pinned to a mesh (safe outside jit too)."""
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))

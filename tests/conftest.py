@@ -7,12 +7,24 @@ must be set before jax is first imported anywhere in the test process.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# Force CPU even when the session points JAX at real TPU hardware. On the
+# TPU-tunnel image a sitecustomize pre-imports jax with JAX_PLATFORMS=axon, so
+# the env var is too late there and the config knob must be flipped
+# post-import; on a plain box the env var suffices and jax stays unimported
+# until a test needs it (XLA_FLAGS applies either way — the CPU backend
+# initializes lazily).
+import sys  # noqa: E402
+
+if "jax" in sys.modules:
+    sys.modules["jax"].config.update("jax_platforms", "cpu")
+else:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,143 @@
+"""Parallelism-library tests on the 8-device virtual CPU mesh (the fake-slice
+harness SURVEY.md §4 calls for — distributed semantics without TPUs)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from kubeflow_tpu.parallel import (
+    AXIS_DATA,
+    AXIS_FSDP,
+    AXIS_SEQUENCE,
+    AXIS_TENSOR,
+    MeshConfig,
+    PartitionRule,
+    build_mesh,
+    shard_pytree,
+)
+from kubeflow_tpu.parallel import collectives, sharding
+from kubeflow_tpu.parallel.distributed import process_info_from_env
+from kubeflow_tpu.parallel.ring_attention import (
+    reference_attention,
+    ring_attention,
+)
+
+
+def test_mesh_resolve_wildcard():
+    cfg = MeshConfig(data=-1, tensor=2)
+    assert cfg.resolve(8)[AXIS_DATA] == 4
+    assert cfg.resolve(8)[AXIS_TENSOR] == 2
+
+
+def test_mesh_resolve_errors():
+    with pytest.raises(ValueError):
+        MeshConfig(data=3, tensor=2).resolve(8)
+    with pytest.raises(ValueError):
+        MeshConfig(data=-1, fsdp=3).resolve(8)
+
+
+def test_build_mesh_axes():
+    mesh = build_mesh(MeshConfig(data=2, fsdp=2, tensor=2))
+    assert mesh.shape[AXIS_DATA] == 2
+    assert mesh.shape[AXIS_FSDP] == 2
+    assert mesh.shape[AXIS_TENSOR] == 2
+    assert mesh.devices.size == 8
+
+
+def test_partition_rules_first_match_wins():
+    rules = [
+        PartitionRule(r"attn/.*kernel", P(AXIS_FSDP, AXIS_TENSOR)),
+        PartitionRule(r"kernel", P(AXIS_FSDP)),
+    ]
+    assert sharding.spec_for_path("layer0/attn/q/kernel", rules) == P(
+        AXIS_FSDP, AXIS_TENSOR
+    )
+    assert sharding.spec_for_path("layer0/mlp/kernel", rules) == P(AXIS_FSDP)
+    assert sharding.spec_for_path("layer0/bias", rules) == P()
+
+
+def test_shard_pytree_places_leaves():
+    mesh = build_mesh(MeshConfig(data=2, tensor=4))
+    tree = {"w": jnp.zeros((8, 16)), "b": jnp.zeros((16,))}
+    rules = [PartitionRule(r"w", P(None, AXIS_TENSOR))]
+    sharded = shard_pytree(tree, mesh, rules)
+    w_shard = sharded["w"].sharding
+    assert w_shard.spec == P(None, AXIS_TENSOR)
+    # Each device holds a 8x4 shard of w.
+    assert sharded["w"].addressable_shards[0].data.shape == (8, 4)
+
+
+def test_allreduce_mean():
+    mesh = build_mesh(MeshConfig(data=8))
+    fn = collectives.allreduce_mean(mesh, AXIS_DATA)
+    x = jnp.arange(16.0)
+    out = fn(x)
+    # Every shard is replaced by the mean over ring members of its own shard
+    # group; with in_specs P(axis) the global result equals mean over shards
+    # broadcast back — check via numpy reference.
+    shards = np.stack(np.split(np.arange(16.0), 8))
+    expected = np.tile(shards.mean(axis=0), 8)
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-6)
+
+
+def test_ring_permute_rotates():
+    mesh = build_mesh(MeshConfig(data=8))
+
+    @jax.jit
+    @jax.shard_map(mesh=mesh, in_specs=P(AXIS_DATA), out_specs=P(AXIS_DATA),
+                   check_vma=False)
+    def rotate(x):
+        return collectives.ring_permute(x, AXIS_DATA, shift=1)
+
+    x = jnp.arange(8.0)
+    out = np.asarray(rotate(x))
+    np.testing.assert_allclose(out, np.roll(np.arange(8.0), 1))
+
+
+def test_process_info_defaults():
+    info = process_info_from_env({})
+    assert not info.is_distributed
+    assert info.process_id == 0
+
+
+def test_process_info_from_operator_env():
+    env = {
+        "JAX_COORDINATOR_ADDRESS": "job-worker-0.jobsvc:1234",
+        "JAX_NUM_PROCESSES": "4",
+        "JAX_PROCESS_ID": "2",
+    }
+    info = process_info_from_env(env)
+    assert info.is_distributed
+    assert info.coordinator_address == "job-worker-0.jobsvc:1234"
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_reference(causal):
+    mesh = build_mesh(MeshConfig(data=2, sequence=4))
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    shape = (2, 2, 32, 8)  # [B, H, T, D], T sharded 4-way
+    q = jax.random.normal(kq, shape, jnp.float32)
+    k = jax.random.normal(kk, shape, jnp.float32)
+    v = jax.random.normal(kv, shape, jnp.float32)
+    out = ring_attention(q, k, v, mesh, causal=causal)
+    ref = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_attention_under_jit_sharded_inputs():
+    mesh = build_mesh(MeshConfig(data=2, sequence=4))
+    spec = P(None, None, AXIS_SEQUENCE, None)
+    key = jax.random.PRNGKey(1)
+    q = jax.random.normal(key, (2, 2, 16, 8))
+    sharded_q = jax.device_put(q, jax.NamedSharding(mesh, spec))
+
+    @jax.jit
+    def f(q):
+        return ring_attention(q, q, q, mesh, causal=True)
+
+    out = f(sharded_q)
+    ref = reference_attention(q, q, q, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
