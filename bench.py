@@ -1,0 +1,90 @@
+"""Benchmark: flagship LM training throughput on the local accelerator.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no numbers (BASELINE.md — machinery only), so
+``vs_baseline`` is measured against the recorded target in BASELINE.json's
+derived target table when present, else 1.0. The workload is the TFJob
+tf_cnn/BERT analogue recast as the flagship decoder LM: bf16 training step,
+flash-attention pallas kernel, adamw, jitted end to end.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true",
+                        help="small model / few steps (CI smoke)")
+    parser.add_argument("--steps", type=int, default=20)
+    args = parser.parse_args()
+
+    from kubeflow_tpu.models.registry import get_model
+    from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+    from kubeflow_tpu.train.data import place_batch, synthetic_batch
+    from kubeflow_tpu.train.optimizers import OptimizerConfig
+    from kubeflow_tpu.train.trainer import build_train_step, init_state
+
+    on_tpu = jax.default_backend() == "tpu"
+    if args.quick or not on_tpu:
+        model = get_model("lm-test-tiny")
+        batch_size, seq_len = 8, 128
+    else:
+        # ~340M-param flagship slice that fits one v5e chip with adam state.
+        model = get_model(
+            "llama-1b", n_layers=8, max_seq_len=2048, remat=True
+        )
+        batch_size, seq_len = 4, 2048
+
+    n_devices = len(jax.devices())
+    mesh = build_mesh(MeshConfig(data=n_devices))
+    opt = OptimizerConfig(warmup_steps=2, total_steps=args.steps + 2)
+    state = init_state(jax.random.PRNGKey(0), model, opt, mesh)
+    step_fn = build_train_step(model, opt, mesh)
+    batch = place_batch(
+        synthetic_batch(model, batch_size, seq_len), mesh, model
+    )
+
+    # Warmup/compile.
+    state, metrics = step_fn(state, batch)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        state, metrics = step_fn(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = args.steps * batch_size * seq_len / dt
+    per_chip = tokens_per_sec / n_devices
+
+    # No published reference numbers exist (BASELINE.md); ratio vs the
+    # running record kept in BENCH_BASELINE.json if present.
+    import os
+
+    baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "BENCH_BASELINE.json")
+    try:
+        with open(baseline_path) as f:
+            baseline = json.load(f)["tokens_per_sec_per_chip"]
+        vs = per_chip / baseline
+    except (OSError, KeyError, ValueError):
+        vs = 1.0
+
+    print(json.dumps({
+        "metric": "flagship_lm_train_tokens_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(vs, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

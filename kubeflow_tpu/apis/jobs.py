@@ -87,6 +87,14 @@ ENV_TPU_WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
 
 DEFAULT_COORDINATOR_PORT = 8476
 
+# Identity of the owning job, injected into every worker so the training
+# loop can publish final metrics to the job status (the path the study/
+# benchmark controllers read — the metricsCollector-CronJob analogue,
+# kubeflow/katib/studyjobcontroller.libsonnet:115-147).
+ENV_JOB_NAME = "KUBEFLOW_TPU_JOB_NAME"
+ENV_JOB_NAMESPACE = "KUBEFLOW_TPU_JOB_NAMESPACE"
+ENV_JOB_KIND = "KUBEFLOW_TPU_JOB_KIND"
+
 TPU_RESOURCE = "google.com/tpu"
 
 

@@ -16,6 +16,7 @@ from kubeflow_tpu.version import API_GROUP
 NOTEBOOK_KIND = "Notebook"
 NOTEBOOK_PLURAL = "notebooks"
 NOTEBOOKS_API_VERSION = f"{API_GROUP}/v1"
+NOTEBOOK_PORT = 8888
 
 
 def notebook_crd() -> dict:

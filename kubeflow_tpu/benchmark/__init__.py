@@ -1,0 +1,12 @@
+"""Benchmark runtime (the kubebench analogue).
+
+kubebench runs benchmark workflows via its operator and records reporter CSVs
+(kubeflow/kubebench/prototypes/kubebench-job.jsonnet:6-23). Here a
+BenchmarkJob CR wraps a job template; the controller runs it (optionally N
+repetitions), harvests the metrics each run publishes into job status, and
+aggregates results in the BenchmarkJob status.
+"""
+
+from kubeflow_tpu.benchmark.controller import BenchmarkJobController
+
+__all__ = ["BenchmarkJobController"]

@@ -1,0 +1,102 @@
+"""BenchmarkJob controller — run a job template N times, aggregate metrics."""
+
+from __future__ import annotations
+
+import copy
+
+from kubeflow_tpu.apis.benchmark import (
+    BENCHMARK_API_VERSION,
+    BENCHMARK_JOB_KIND,
+)
+from kubeflow_tpu.apis.jobs import JOBS_API_VERSION
+from kubeflow_tpu.k8s import objects as k8s
+from kubeflow_tpu.operators.base import Controller
+
+LABEL_BENCHMARK = "kubeflow-tpu.org/benchmark-name"
+
+
+class BenchmarkJobController(Controller):
+    api_version = BENCHMARK_API_VERSION
+    kind = BENCHMARK_JOB_KIND
+    resync_seconds = 10.0
+
+    def watched_kinds(self):
+        return [(JOBS_API_VERSION, "JaxJob")]
+
+    def reconcile(self, bench: dict) -> None:
+        bench = copy.deepcopy(bench)
+        spec = bench["spec"]
+        status = bench.setdefault("status", {})
+        if status.get("state") in ("Succeeded", "Failed"):
+            return
+        status.setdefault("state", "Running")
+        runs = status.setdefault("runs", [])
+        ns = bench["metadata"]["namespace"]
+        reps = spec.get("repetitions", 1)
+        wanted = spec.get("metrics", ["samples_per_sec"])
+
+        # Collect finished runs.
+        for run in runs:
+            if run["state"] in ("Succeeded", "Failed"):
+                continue
+            job = self.client.get_or_none(
+                JOBS_API_VERSION, spec["jobTemplate"].get("kind", "JaxJob"),
+                run["jobName"], ns,
+            )
+            if job is None:
+                continue
+            jstate = job.get("status", {}).get("state")
+            if jstate in ("Succeeded", "Failed"):
+                run["state"] = jstate
+                metrics = job.get("status", {}).get("metrics", {})
+                run["metrics"] = {
+                    m: metrics[m] for m in wanted if m in metrics
+                }
+
+        finished = [r for r in runs if r["state"] in ("Succeeded", "Failed")]
+        if any(r["state"] == "Failed" for r in finished):
+            status["state"] = "Failed"
+        elif len(finished) >= reps:
+            status["state"] = "Succeeded"
+            status["results"] = self._aggregate(finished, wanted)
+        elif len(runs) == len(finished):
+            self._spawn_run(bench, runs)
+        self._push_status(bench)
+
+    def _aggregate(self, runs: list[dict], wanted: list[str]) -> dict:
+        results = {}
+        for m in wanted:
+            values = [r["metrics"][m] for r in runs if m in r.get("metrics", {})]
+            if values:
+                results[m] = {
+                    "mean": sum(values) / len(values),
+                    "min": min(values),
+                    "max": max(values),
+                    "runs": len(values),
+                }
+        return results
+
+    def _spawn_run(self, bench: dict, runs: list[dict]) -> None:
+        index = len(runs)
+        name = f"{bench['metadata']['name']}-run-{index}"
+        job = copy.deepcopy(bench["spec"]["jobTemplate"])
+        job.setdefault("apiVersion", JOBS_API_VERSION)
+        job.setdefault("kind", "JaxJob")
+        meta = job.setdefault("metadata", {})
+        meta["name"] = name
+        meta["namespace"] = bench["metadata"]["namespace"]
+        meta.setdefault("labels", {})[LABEL_BENCHMARK] = (
+            bench["metadata"]["name"]
+        )
+        meta["ownerReferences"] = [k8s.object_ref(bench)]
+        self.client.create(job)
+        runs.append({"index": index, "jobName": name, "state": "Running"})
+
+    def _push_status(self, bench: dict) -> None:
+        current = self.client.get_or_none(
+            self.api_version, self.kind, bench["metadata"]["name"],
+            bench["metadata"]["namespace"],
+        )
+        if current is not None and current.get("status") != bench["status"]:
+            current["status"] = bench["status"]
+            self.client.update_status(current)

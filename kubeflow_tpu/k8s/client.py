@@ -64,6 +64,7 @@ _BUILTIN_KINDS: dict[str, tuple[str, bool]] = {
     "Secret": ("secrets", True),
     "Namespace": ("namespaces", False),
     "PersistentVolumeClaim": ("persistentvolumeclaims", True),
+    "ResourceQuota": ("resourcequotas", True),
     "ServiceAccount": ("serviceaccounts", True),
     "Deployment": ("deployments", True),
     "StatefulSet": ("statefulsets", True),

@@ -1,0 +1,21 @@
+"""Model zoo.
+
+The reference trains models only through container images it doesn't own
+(tf_cnn_benchmarks — tf-controller-examples/tf-cnn/launcher.py; TF-Serving
+model binaries). Here the workloads are first-class: pure-functional JAX
+models with explicit param pytrees so the parallel library's path-rule
+sharding (kubeflow_tpu/parallel/sharding.py) applies uniformly.
+
+- :mod:`~kubeflow_tpu.models.transformer` — decoder-only LM (Llama-3-style:
+  RMSNorm, RoPE, GQA, SwiGLU), the flagship training/serving workload.
+- :mod:`~kubeflow_tpu.models.bert` — BERT encoder (baseline config #2).
+- :mod:`~kubeflow_tpu.models.resnet` — ResNet CNN (the tf_cnn_benchmarks
+  analogue, baseline config #1).
+- :mod:`~kubeflow_tpu.models.registry` — name → (config, init, apply) lookup
+  used by jobs, serving, and the benchmark harness.
+"""
+
+from kubeflow_tpu.models import registry
+from kubeflow_tpu.models.registry import get_model, list_models
+
+__all__ = ["registry", "get_model", "list_models"]

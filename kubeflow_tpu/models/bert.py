@@ -1,0 +1,194 @@
+"""BERT encoder (baseline config #2: BERT-base multi-worker training).
+
+Same TPU-first structure as the flagship LM — stacked layers under
+``lax.scan``, bf16 compute/f32 accumulation, path-rule sharding — with the
+BERT specifics: learned position embeddings, post-norm residuals (original
+architecture), GELU MLP, bidirectional flash attention, MLM + NSP heads.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, replace
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from kubeflow_tpu.ops import flash_attention, layer_norm, softmax_cross_entropy
+from kubeflow_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_TENSOR
+from kubeflow_tpu.parallel.sharding import PartitionRule
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30_522
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    norm_eps: float = 1e-12
+    dtype: jnp.dtype = jnp.bfloat16
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+PRESETS: dict[str, BertConfig] = {
+    "bert-base": BertConfig(),
+    "bert-large": BertConfig(d_model=1024, n_layers=24, n_heads=16, d_ff=4096),
+    "bert-test-tiny": BertConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, d_ff=128,
+        max_seq_len=128,
+    ),
+}
+
+
+def config(name: str, **overrides) -> BertConfig:
+    return replace(PRESETS[name], **overrides)
+
+
+def init(key, cfg: BertConfig):
+    d, f = cfg.d_model, cfg.d_ff
+    keys = jax.random.split(key, 12)
+
+    def dense(k, shape, fan_in):
+        return jax.random.normal(k, shape, jnp.float32) * (fan_in**-0.5)
+
+    def stack(k, shape, fan_in):
+        return dense(k, (cfg.n_layers, *shape), fan_in)
+
+    def ln(shape=(cfg.n_layers, d)):
+        return {"scale": jnp.ones(shape, jnp.float32),
+                "bias": jnp.zeros(shape, jnp.float32)}
+
+    return {
+        "embed": {
+            "word": dense(keys[0], (cfg.vocab_size, d), d),
+            "position": dense(keys[1], (cfg.max_seq_len, d), d),
+            "type": dense(keys[2], (cfg.type_vocab_size, d), d),
+            "ln": {"scale": jnp.ones((d,)), "bias": jnp.zeros((d,))},
+        },
+        "layers": {
+            "attn": {
+                "wq": stack(keys[3], (d, d), d),
+                "wk": stack(keys[4], (d, d), d),
+                "wv": stack(keys[5], (d, d), d),
+                "wo": stack(keys[6], (d, d), d),
+                "bq": jnp.zeros((cfg.n_layers, d)),
+                "bk": jnp.zeros((cfg.n_layers, d)),
+                "bv": jnp.zeros((cfg.n_layers, d)),
+                "bo": jnp.zeros((cfg.n_layers, d)),
+            },
+            "mlp": {
+                "wi": stack(keys[7], (d, f), d),
+                "bi": jnp.zeros((cfg.n_layers, f)),
+                "wo": stack(keys[8], (f, d), f),
+                "bo2": jnp.zeros((cfg.n_layers, d)),
+            },
+            "ln_attn": ln(),
+            "ln_mlp": ln(),
+        },
+        "pooler": {"kernel": dense(keys[9], (d, d), d), "bias": jnp.zeros((d,))},
+        "mlm": {
+            "transform": dense(keys[10], (d, d), d),
+            "transform_bias": jnp.zeros((d,)),
+            "ln": {"scale": jnp.ones((d,)), "bias": jnp.zeros((d,))},
+            "output_bias": jnp.zeros((cfg.vocab_size,)),
+        },
+        "nsp": {"kernel": dense(keys[11], (d, 2), d), "bias": jnp.zeros((2,))},
+    }
+
+
+def partition_rules(cfg: BertConfig) -> list[PartitionRule]:
+    return [
+        PartitionRule(r"embed/word", P(AXIS_TENSOR, AXIS_FSDP)),
+        PartitionRule(r"attn/w[qkv]", P(None, AXIS_FSDP, AXIS_TENSOR)),
+        PartitionRule(r"attn/wo", P(None, AXIS_TENSOR, AXIS_FSDP)),
+        PartitionRule(r"mlp/wi", P(None, AXIS_FSDP, AXIS_TENSOR)),
+        PartitionRule(r"mlp/wo", P(None, AXIS_TENSOR, AXIS_FSDP)),
+    ]
+
+
+def batch_partition_spec(cfg: BertConfig) -> P:
+    return P((AXIS_DATA, AXIS_FSDP), None)
+
+
+def _layer_fn(cfg: BertConfig, mesh, carry, layer):
+    x, pad_mask = carry
+    b, t, d = x.shape
+    a = layer["attn"]
+    q = (x @ a["wq"].astype(cfg.dtype) + a["bq"].astype(cfg.dtype)).reshape(
+        b, t, cfg.n_heads, cfg.head_dim
+    )
+    k = (x @ a["wk"].astype(cfg.dtype) + a["bk"].astype(cfg.dtype)).reshape(
+        b, t, cfg.n_heads, cfg.head_dim
+    )
+    v = (x @ a["wv"].astype(cfg.dtype) + a["bv"].astype(cfg.dtype)).reshape(
+        b, t, cfg.n_heads, cfg.head_dim
+    )
+    attn = flash_attention(q, k, v, causal=False,
+                           kv_mask=pad_mask).reshape(b, t, d)
+    attn = attn @ a["wo"].astype(cfg.dtype) + a["bo"].astype(cfg.dtype)
+    x = layer_norm(x + attn, layer["ln_attn"]["scale"],
+                   layer["ln_attn"]["bias"], eps=cfg.norm_eps)
+
+    m = layer["mlp"]
+    h = jax.nn.gelu(x @ m["wi"].astype(cfg.dtype) + m["bi"].astype(cfg.dtype))
+    h = h @ m["wo"].astype(cfg.dtype) + m["bo2"].astype(cfg.dtype)
+    x = layer_norm(x + h, layer["ln_mlp"]["scale"], layer["ln_mlp"]["bias"],
+                   eps=cfg.norm_eps)
+    if mesh is not None:
+        x = lax.with_sharding_constraint(
+            x, jax.NamedSharding(mesh, P((AXIS_DATA, AXIS_FSDP), None, None))
+        )
+    return (x, pad_mask), None
+
+
+def apply(params, tokens, cfg: BertConfig, *, type_ids=None, pad_mask=None,
+          mesh=None):
+    """tokens [B, T] → (sequence_output [B, T, D], pooled [B, D])."""
+    b, t = tokens.shape
+    if pad_mask is None:
+        pad_mask = jnp.ones((b, t), jnp.float32)
+    if type_ids is None:
+        type_ids = jnp.zeros((b, t), jnp.int32)
+    e = params["embed"]
+    x = (
+        e["word"][tokens] + e["position"][:t][None] + e["type"][type_ids]
+    )
+    x = layer_norm(x, e["ln"]["scale"], e["ln"]["bias"], eps=cfg.norm_eps)
+    x = x.astype(cfg.dtype)
+
+    layer_fn = functools.partial(_layer_fn, cfg, mesh)
+    if cfg.remat:
+        layer_fn = jax.checkpoint(layer_fn)
+    (x, _), _ = lax.scan(layer_fn, (x, pad_mask), params["layers"])
+
+    pooled = jnp.tanh(
+        x[:, 0].astype(jnp.float32) @ params["pooler"]["kernel"]
+        + params["pooler"]["bias"]
+    )
+    return x, pooled
+
+
+def mlm_logits(params, sequence_output, cfg: BertConfig):
+    h = sequence_output.astype(jnp.float32) @ params["mlm"]["transform"]
+    h = jax.nn.gelu(h + params["mlm"]["transform_bias"])
+    h = layer_norm(h, params["mlm"]["ln"]["scale"], params["mlm"]["ln"]["bias"],
+                   eps=cfg.norm_eps)
+    return h @ params["embed"]["word"].T + params["mlm"]["output_bias"]
+
+
+def loss_fn(params, batch, cfg: BertConfig, *, mesh=None):
+    """Masked-LM pretraining loss. batch: tokens [B,T], mlm_labels [B,T]
+    (negative = unmasked position), optional pad_mask."""
+    seq, _ = apply(params, batch["tokens"], cfg,
+                   pad_mask=batch.get("pad_mask"), mesh=mesh)
+    logits = mlm_logits(params, seq, cfg)
+    return softmax_cross_entropy(logits, batch["mlm_labels"])

@@ -1,0 +1,59 @@
+"""Model registry: name → ModelSpec.
+
+The lookup layer jobs, serving, and the benchmark harness share — the
+analogue of the reference's prototype `@param model name` indirection
+(e.g. kubeflow/examples/prototypes/tf-job-simple.jsonnet), but typed.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from kubeflow_tpu.models import bert, resnet, transformer
+
+
+@dataclass(frozen=True)
+class ModelSpec:
+    name: str
+    family: str
+    config: Any
+    init: Callable          # (key, cfg) -> params
+    apply: Callable         # (params, inputs, cfg, *, mesh=None) -> outputs
+    loss_fn: Callable       # (params, batch, cfg, *, mesh=None) -> (loss, metrics)
+    partition_rules: Callable
+    batch_partition_spec: Callable
+
+
+def _spec(name, family, module, cfg) -> ModelSpec:
+    return ModelSpec(
+        name=name,
+        family=family,
+        config=cfg,
+        init=module.init,
+        apply=module.apply,
+        loss_fn=module.loss_fn,
+        partition_rules=module.partition_rules,
+        batch_partition_spec=module.batch_partition_spec,
+    )
+
+
+def get_model(name: str, **overrides) -> ModelSpec:
+    for family, module in (
+        ("transformer", transformer),
+        ("bert", bert),
+        ("resnet", resnet),
+    ):
+        if name in module.PRESETS:
+            return _spec(name, family, module, module.config(name, **overrides))
+    raise KeyError(
+        f"unknown model {name!r}; available: {sorted(list_models())}"
+    )
+
+
+def list_models() -> list[str]:
+    return [
+        *transformer.PRESETS,
+        *bert.PRESETS,
+        *resnet.PRESETS,
+    ]

@@ -1,0 +1,236 @@
+"""Decoder-only transformer LM (the flagship workload).
+
+Llama-3-family architecture — RMSNorm pre-norm, rotary positions, grouped-
+query flash attention, SwiGLU MLP — written TPU-first:
+
+- Layers are *stacked* (one leading L dim per weight) and iterated with
+  ``lax.scan``: compile time stays O(1) in depth and FSDP shards every layer
+  identically.
+- All matmuls run in bfloat16 against float32 master weights held by the
+  optimizer; contractions request float32 accumulation on the MXU.
+- Sharding is declared as path rules (DP×FSDP×TP out of the box); activations
+  get explicit constraints at layer boundaries so GSPMD's decisions stay
+  pinned under compiler drift.
+- Optional context parallelism routes attention through the ring kernel over
+  the ``sequence`` mesh axis (long-context mode, SURVEY.md §5.7).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field, replace
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from kubeflow_tpu.ops import flash_attention, rms_norm
+from kubeflow_tpu.ops.rotary import apply_rotary, rotary_frequencies
+from kubeflow_tpu.parallel.mesh import (
+    AXIS_DATA,
+    AXIS_FSDP,
+    AXIS_SEQUENCE,
+    AXIS_TENSOR,
+)
+from kubeflow_tpu.parallel.ring_attention import ring_attention
+from kubeflow_tpu.parallel.sharding import PartitionRule
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32_000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_ff: int = 14_336
+    max_seq_len: int = 8192
+    rope_theta: float = 500_000.0
+    norm_eps: float = 1e-5
+    dtype: jnp.dtype = jnp.bfloat16
+    tie_embeddings: bool = False
+    # Attention runs through the sequence-axis ring kernel when True.
+    context_parallel: bool = False
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+# Named presets; sizes per the public Llama-3/TinyLlama shapes.
+PRESETS: dict[str, TransformerConfig] = {
+    "llama3-8b": TransformerConfig(
+        vocab_size=128_256, d_model=4096, n_layers=32, n_heads=32,
+        n_kv_heads=8, d_ff=14_336, rope_theta=500_000.0,
+    ),
+    "llama-1b": TransformerConfig(
+        vocab_size=32_000, d_model=2048, n_layers=16, n_heads=16,
+        n_kv_heads=8, d_ff=5632,
+    ),
+    "lm-test-tiny": TransformerConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=128, remat=False,
+    ),
+}
+
+
+def config(name: str, **overrides) -> TransformerConfig:
+    return replace(PRESETS[name], **overrides)
+
+
+# ---------------------------------------------------------------------------
+# Parameters
+# ---------------------------------------------------------------------------
+
+
+def init(key, cfg: TransformerConfig):
+    """Parameter pytree; weights float32 (cast to cfg.dtype at apply time)."""
+    d, f = cfg.d_model, cfg.d_ff
+    hd = cfg.head_dim
+    keys = jax.random.split(key, 8)
+
+    def dense(k, shape, fan_in):
+        return jax.random.normal(k, shape, jnp.float32) * (fan_in**-0.5)
+
+    def stack(k, shape, fan_in):
+        return dense(k, (cfg.n_layers, *shape), fan_in)
+
+    params = {
+        "embed": {"kernel": dense(keys[0], (cfg.vocab_size, d), d)},
+        "layers": {
+            "attn": {
+                "wq": stack(keys[1], (d, cfg.n_heads * hd), d),
+                "wk": stack(keys[2], (d, cfg.n_kv_heads * hd), d),
+                "wv": stack(keys[3], (d, cfg.n_kv_heads * hd), d),
+                "wo": stack(keys[4], (cfg.n_heads * hd, d), cfg.n_heads * hd),
+            },
+            "mlp": {
+                "gate": stack(keys[5], (d, f), d),
+                "up": stack(keys[6], (d, f), d),
+                "down": stack(keys[7], (f, d), f),
+            },
+            "ln_attn": jnp.ones((cfg.n_layers, d), jnp.float32),
+            "ln_mlp": jnp.ones((cfg.n_layers, d), jnp.float32),
+        },
+        "final_norm": jnp.ones((d,), jnp.float32),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {
+            "kernel": dense(jax.random.fold_in(key, 99), (d, cfg.vocab_size), d)
+        }
+    return params
+
+
+def partition_rules(cfg: TransformerConfig) -> list[PartitionRule]:
+    """DP×FSDP×TP layout. Stacked layer weights carry a leading L dim (never
+    sharded). Megatron pairing: column-parallel in (wq/wk/wv/gate/up), row-
+    parallel out (wo/down) so each block needs one reduce per residual add."""
+    return [
+        PartitionRule(r"embed/kernel", P(AXIS_TENSOR, AXIS_FSDP)),
+        PartitionRule(r"attn/w[qkv]", P(None, AXIS_FSDP, AXIS_TENSOR)),
+        PartitionRule(r"attn/wo", P(None, AXIS_TENSOR, AXIS_FSDP)),
+        PartitionRule(r"mlp/(gate|up)", P(None, AXIS_FSDP, AXIS_TENSOR)),
+        PartitionRule(r"mlp/down", P(None, AXIS_TENSOR, AXIS_FSDP)),
+        PartitionRule(r"lm_head/kernel", P(AXIS_FSDP, AXIS_TENSOR)),
+        # norms replicated (fall through to default P()).
+    ]
+
+
+def batch_partition_spec(cfg: TransformerConfig) -> P:
+    if cfg.context_parallel:
+        return P((AXIS_DATA, AXIS_FSDP), AXIS_SEQUENCE)
+    return P((AXIS_DATA, AXIS_FSDP), None)
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _constrain(x, mesh, spec):
+    if mesh is not None:
+        x = lax.with_sharding_constraint(x, jax.NamedSharding(mesh, spec))
+    return x
+
+
+def _attention(x, layer, cfg: TransformerConfig, rope, mesh):
+    b, t, d = x.shape
+    hd = cfg.head_dim
+    cos, sin = rope
+    q = (x @ layer["wq"].astype(cfg.dtype)).reshape(b, t, cfg.n_heads, hd)
+    k = (x @ layer["wk"].astype(cfg.dtype)).reshape(b, t, cfg.n_kv_heads, hd)
+    v = (x @ layer["wv"].astype(cfg.dtype)).reshape(b, t, cfg.n_kv_heads, hd)
+    q = apply_rotary(q, cos, sin)
+    k = apply_rotary(k, cos, sin)
+    if cfg.context_parallel:
+        # Ring over the sequence axis; GQA folded by repeating KV heads
+        # (ring kernel is MHA). [B,T,H,D] -> [B,H,T,D].
+        reps = cfg.n_heads // cfg.n_kv_heads
+        k = jnp.repeat(k, reps, axis=2)
+        v = jnp.repeat(v, reps, axis=2)
+        out = ring_attention(
+            q.transpose(0, 2, 1, 3),
+            k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3),
+            mesh,
+            causal=True,
+        ).transpose(0, 2, 1, 3)
+    else:
+        out = flash_attention(q, k, v, causal=True)
+    out = out.reshape(b, t, cfg.n_heads * hd)
+    return out @ layer["wo"].astype(cfg.dtype)
+
+
+def _mlp(x, layer, cfg: TransformerConfig):
+    gate = x @ layer["gate"].astype(cfg.dtype)
+    up = x @ layer["up"].astype(cfg.dtype)
+    return (jax.nn.silu(gate) * up) @ layer["down"].astype(cfg.dtype)
+
+
+def _layer_fn(cfg: TransformerConfig, mesh, rope, x, layer):
+    act_spec = batch_partition_spec(cfg) + (None,)
+    h = rms_norm(x, layer["ln_attn"], eps=cfg.norm_eps)
+    x = x + _attention(h, layer["attn"], cfg, rope, mesh)
+    x = _constrain(x, mesh, P(*act_spec))
+    h = rms_norm(x, layer["ln_mlp"], eps=cfg.norm_eps)
+    x = x + _mlp(h, layer["mlp"], cfg)
+    x = _constrain(x, mesh, P(*act_spec))
+    return x, None
+
+
+def apply(params, tokens, cfg: TransformerConfig, *, mesh=None):
+    """tokens [B, T] int32 → logits [B, T, V] (cfg.dtype)."""
+    t = tokens.shape[1]
+    rope = rotary_frequencies(cfg.head_dim, t, theta=cfg.rope_theta)
+    x = params["embed"]["kernel"].astype(cfg.dtype)[tokens]
+    x = _constrain(x, mesh, P(*(batch_partition_spec(cfg) + (None,))))
+
+    layer_fn = functools.partial(_layer_fn, cfg, mesh, rope)
+    if cfg.remat:
+        layer_fn = jax.checkpoint(
+            layer_fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        )
+    x, _ = lax.scan(layer_fn, x, params["layers"])
+
+    x = rms_norm(x, params["final_norm"], eps=cfg.norm_eps)
+    if cfg.tie_embeddings:
+        head = params["embed"]["kernel"].T
+    else:
+        head = params["lm_head"]["kernel"]
+    logits = x @ head.astype(cfg.dtype)
+    return logits
+
+
+def loss_fn(params, batch, cfg: TransformerConfig, *, mesh=None):
+    """Next-token LM loss. batch: {"tokens": [B, T+1] int32} (or separate
+    "inputs"/"targets"); negative targets are ignored."""
+    from kubeflow_tpu.ops import softmax_cross_entropy
+
+    if "inputs" in batch:
+        inputs, targets = batch["inputs"], batch["targets"]
+    else:
+        inputs, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
+    logits = apply(params, inputs, cfg, mesh=mesh)
+    return softmax_cross_entropy(logits, targets, z_loss=1e-4)

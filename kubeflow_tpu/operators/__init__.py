@@ -1,0 +1,30 @@
+"""CRD controllers (the reference's operator layer, in-repo).
+
+The reference imports its training-operator binaries as container images and
+ships only their CRDs/RBAC/deployments (SURVEY.md §2.2); the controllers
+themselves live here instead:
+
+- :mod:`~kubeflow_tpu.operators.base` — watch+resync reconciler runtime (the
+  controller-runtime analogue).
+- :mod:`~kubeflow_tpu.operators.jobs` — the training-job controller covering
+  JaxJob and the five compatibility kinds (TFJob, PyTorchJob, MXNetJob,
+  ChainerJob, MPIJob): gang-scheduled pods, per-framework rendezvous env
+  injection, status conditions, restart/backoff/clean-pod policies.
+- :mod:`~kubeflow_tpu.operators.notebooks` — Notebook → StatefulSet+Service
+  (components/notebook-controller port).
+- :mod:`~kubeflow_tpu.operators.profiles` — Profile → namespace+RBAC
+  (components/profile-controller port).
+"""
+
+from kubeflow_tpu.operators.base import Controller, run_controllers
+from kubeflow_tpu.operators.jobs import JobController
+from kubeflow_tpu.operators.notebooks import NotebookController
+from kubeflow_tpu.operators.profiles import ProfileController
+
+__all__ = [
+    "Controller",
+    "run_controllers",
+    "JobController",
+    "NotebookController",
+    "ProfileController",
+]

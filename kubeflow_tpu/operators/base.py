@@ -1,0 +1,113 @@
+"""Reconciler runtime — the controller-runtime analogue.
+
+The reference's controllers are kubebuilder managers (notebook-controller
+Reconcile at components/notebook-controller/…/notebook_controller.go:148).
+Same model here: a Controller watches its primary kind, queues object keys on
+events and on a periodic resync, and calls ``reconcile(obj)`` until the
+observed state matches spec. Level-triggered: reconcile reads current state
+from the client and must be idempotent.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Iterable
+
+from kubeflow_tpu.k8s.client import ApiError, K8sClient
+
+log = logging.getLogger(__name__)
+
+
+class Controller:
+    """Base reconciler for one (apiVersion, kind)."""
+
+    api_version: str = ""
+    kind: str = ""
+    resync_seconds: float = 30.0
+
+    def __init__(self, client: K8sClient):
+        self.client = client
+        self._stop = threading.Event()
+
+    # -- to implement -------------------------------------------------------
+
+    def reconcile(self, obj: dict) -> None:
+        raise NotImplementedError
+
+    def watched_kinds(self) -> list[tuple[str, str]]:
+        """Secondary kinds whose events requeue the owning primary object."""
+        return []
+
+    # -- runtime ------------------------------------------------------------
+
+    def reconcile_all(self) -> int:
+        """One pass over every primary object (sync resyncs + tests)."""
+        n = 0
+        for obj in self.client.list(self.api_version, self.kind):
+            self._safe_reconcile(obj)
+            n += 1
+        return n
+
+    def _safe_reconcile(self, obj: dict) -> None:
+        name = obj.get("metadata", {}).get("name", "?")
+        try:
+            self.reconcile(obj)
+        except ApiError as e:
+            if e.code == 409:
+                # Optimistic-concurrency loss: next resync retries.
+                log.debug("%s/%s conflict, will retry", self.kind, name)
+            else:
+                log.exception("%s/%s reconcile failed", self.kind, name)
+        except Exception:
+            log.exception("%s/%s reconcile failed", self.kind, name)
+
+    def run(self) -> None:
+        """Blocking watch loop with periodic resync (run in a thread)."""
+        streams = [self.client.watch(self.api_version, self.kind)]
+        for api_version, kind in self.watched_kinds():
+            streams.append(self.client.watch(api_version, kind))
+        next_resync = 0.0
+        try:
+            while not self._stop.is_set():
+                now = time.monotonic()
+                if now >= next_resync:
+                    self.reconcile_all()
+                    next_resync = now + self.resync_seconds
+                for stream in streams:
+                    event = stream.next(timeout=0.05)
+                    if event is None:
+                        continue
+                    obj = event.object
+                    if obj.get("kind") == self.kind:
+                        if event.type != "DELETED":
+                            self._safe_reconcile(obj)
+                    else:
+                        self._requeue_owner(obj)
+        finally:
+            for stream in streams:
+                stream.stop()
+
+    def _requeue_owner(self, obj: dict) -> None:
+        for ref in obj.get("metadata", {}).get("ownerReferences", []):
+            if ref.get("kind") == self.kind:
+                owner = self.client.get_or_none(
+                    self.api_version, self.kind, ref["name"],
+                    obj["metadata"].get("namespace"),
+                )
+                if owner is not None:
+                    self._safe_reconcile(owner)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def run_controllers(controllers: Iterable[Controller]) -> list[threading.Thread]:
+    """Start each controller's run() loop in a daemon thread."""
+    threads = []
+    for c in controllers:
+        t = threading.Thread(target=c.run, name=f"ctrl-{c.kind}", daemon=True)
+        t.start()
+        threads.append(t)
+    return threads

@@ -1,0 +1,133 @@
+"""Notebook controller.
+
+Port of components/notebook-controller: Notebook CR → StatefulSet + Service,
+status mirrored from the pod's container state
+(notebook_controller.go:148-263, generateStatefulSet :265, generateService
+:313). TPU-native twist: a notebook may request TPU chips, which adds the
+`google.com/tpu` resource and the GKE TPU node selector instead of
+nvidia.com/gpu.
+"""
+
+from __future__ import annotations
+
+import copy
+
+from kubeflow_tpu.apis.notebooks import (
+    NOTEBOOKS_API_VERSION,
+    NOTEBOOK_KIND,
+    NOTEBOOK_PORT,
+)
+from kubeflow_tpu.manifests.images import NOTEBOOK as DEFAULT_NOTEBOOK_IMAGE
+from kubeflow_tpu.apis.jobs import TPU_RESOURCE
+from kubeflow_tpu.k8s import objects as k8s
+from kubeflow_tpu.operators.base import Controller
+from kubeflow_tpu.operators.jobs import GKE_TPU_ACCEL_SELECTOR
+
+LABEL_NOTEBOOK = "kubeflow-tpu.org/notebook-name"
+
+
+class NotebookController(Controller):
+    api_version = NOTEBOOKS_API_VERSION
+    kind = NOTEBOOK_KIND
+
+    def watched_kinds(self):
+        return [("apps/v1", "StatefulSet"), ("v1", "Pod")]
+
+    def reconcile(self, nb: dict) -> None:
+        nb = copy.deepcopy(nb)
+        name = nb["metadata"]["name"]
+        ns = nb["metadata"]["namespace"]
+
+        sts = self._desired_statefulset(nb)
+        existing = self.client.get_or_none("apps/v1", "StatefulSet", name, ns)
+        if existing is None:
+            self.client.create(sts)
+        elif (
+            existing.get("spec", {}).get("template") != sts["spec"]["template"]
+            or existing.get("spec", {}).get("replicas") != sts["spec"]["replicas"]
+        ):
+            existing["spec"] = sts["spec"]
+            self.client.update(existing)
+
+        if self.client.get_or_none("v1", "Service", name, ns) is None:
+            svc = k8s.service(
+                name=name, namespace=ns,
+                selector={LABEL_NOTEBOOK: name},
+                ports=[{"name": "notebook", "port": NOTEBOOK_PORT,
+                        "targetPort": NOTEBOOK_PORT}],
+                labels={LABEL_NOTEBOOK: name},
+            )
+            svc["metadata"]["ownerReferences"] = [k8s.object_ref(nb)]
+            self.client.create(svc)
+
+        self._update_status(nb)
+
+    def _desired_statefulset(self, nb: dict) -> dict:
+        """Wrap the CR's pod template in a 1-replica StatefulSet, filling in
+        a default jupyter container when the template is empty and expanding
+        the tpu block into resources + node selector (the numGpus analogue)."""
+        name = nb["metadata"]["name"]
+        ns = nb["metadata"]["namespace"]
+        spec = nb.get("spec", {})
+        template = copy.deepcopy(spec.get("template", {})) or {}
+        pod_spec = template.setdefault("spec", {})
+        if not pod_spec.get("containers"):
+            pod_spec["containers"] = [
+                k8s.container(
+                    "notebook",
+                    DEFAULT_NOTEBOOK_IMAGE,
+                    args=[
+                        "jupyter", "lab", "--ip=0.0.0.0",
+                        f"--port={NOTEBOOK_PORT}", "--no-browser",
+                        "--allow-root",
+                        f"--NotebookApp.base_url=/notebook/{ns}/{name}",
+                    ],
+                    ports={"notebook": NOTEBOOK_PORT},
+                )
+            ]
+        tpu = spec.get("tpu", {})
+        if tpu.get("chips"):
+            main = pod_spec["containers"][0]
+            resources = main.setdefault("resources", {})
+            resources.setdefault("limits", {})[TPU_RESOURCE] = tpu["chips"]
+            if tpu.get("accelerator"):
+                pod_spec.setdefault("nodeSelector", {})[
+                    GKE_TPU_ACCEL_SELECTOR
+                ] = tpu["accelerator"]
+        tmeta = template.setdefault("metadata", {})
+        tmeta.setdefault("labels", {})[LABEL_NOTEBOOK] = name
+
+        sts = {
+            "apiVersion": "apps/v1",
+            "kind": "StatefulSet",
+            "metadata": k8s.metadata(name, ns, {LABEL_NOTEBOOK: name}),
+            "spec": {
+                "serviceName": name,
+                "replicas": 0 if spec.get("suspend") else 1,
+                "selector": {"matchLabels": {LABEL_NOTEBOOK: name}},
+                "template": template,
+            },
+        }
+        sts["metadata"]["ownerReferences"] = [k8s.object_ref(nb)]
+        return sts
+
+    def _update_status(self, nb: dict) -> None:
+        """Mirror pod container state into status (the reference copies the
+        first container state verbatim, notebook_controller.go:232-256)."""
+        name = nb["metadata"]["name"]
+        ns = nb["metadata"]["namespace"]
+        pods = self.client.list(
+            "v1", "Pod", ns, label_selector={LABEL_NOTEBOOK: name}
+        )
+        status: dict = {"readyReplicas": 0, "containerState": {}}
+        for pod in pods:
+            phase = pod.get("status", {}).get("phase")
+            if phase == "Running":
+                status["readyReplicas"] += 1
+            cstates = pod.get("status", {}).get("containerStatuses", [])
+            if cstates:
+                status["containerState"] = cstates[0].get("state", {})
+        current = self.client.get_or_none(self.api_version, self.kind, name, ns)
+        if current is not None and current.get("status") != status:
+            current["status"] = status
+            self.client.update_status(current)

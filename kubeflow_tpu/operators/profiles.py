@@ -1,0 +1,71 @@
+"""Profile controller — multi-tenancy.
+
+Port of components/profile-controller (Reconcile at
+profile_controller.go:108-206, generateRole :207): each cluster-scoped
+Profile expands into the user's namespace, a namespaced-admin Role, a
+RoleBinding to the owner subject, and an optional ResourceQuota (the hook
+where per-team TPU chip quotas land: `requests.google.com/tpu`).
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.apis.profiles import PROFILE_KIND, PROFILES_API_VERSION
+from kubeflow_tpu.k8s import objects as k8s
+from kubeflow_tpu.operators.base import Controller
+
+ADMIN_ROLE = "namespace-admin"
+
+
+class ProfileController(Controller):
+    api_version = PROFILES_API_VERSION
+    kind = PROFILE_KIND
+
+    def reconcile(self, profile: dict) -> None:
+        name = profile["metadata"]["name"]
+        owner = profile.get("spec", {}).get("owner", {})
+
+        if self.client.get_or_none("v1", "Namespace", name) is None:
+            ns = k8s.namespace_obj(
+                name, labels={"kubeflow-tpu.org/profile": name}
+            )
+            ns["metadata"]["ownerReferences"] = [k8s.object_ref(profile)]
+            self.client.create(ns)
+
+        if self.client.get_or_none(
+            "rbac.authorization.k8s.io/v1", "Role", ADMIN_ROLE, name
+        ) is None:
+            role = k8s.role(
+                ADMIN_ROLE, name,
+                rules=[k8s.policy_rule(["*"], ["*"], ["*"])],
+            )
+            self.client.create(role)
+
+        binding_name = f"{ADMIN_ROLE}-binding"
+        if owner and self.client.get_or_none(
+            "rbac.authorization.k8s.io/v1", "RoleBinding", binding_name, name
+        ) is None:
+            binding = k8s.role_binding(
+                binding_name, name, ADMIN_ROLE,
+                subjects=[{
+                    "kind": owner.get("kind", "User"),
+                    "name": owner.get("name", ""),
+                    "apiGroup": "rbac.authorization.k8s.io",
+                }],
+            )
+            self.client.create(binding)
+
+        quota = profile.get("spec", {}).get("resourceQuota")
+        if quota and self.client.get_or_none(
+            "v1", "ResourceQuota", "profile-quota", name
+        ) is None:
+            self.client.create({
+                "apiVersion": "v1",
+                "kind": "ResourceQuota",
+                "metadata": k8s.metadata("profile-quota", name),
+                "spec": quota,
+            })
+
+        current = self.client.get_or_none(self.api_version, self.kind, name)
+        if current is not None and current.get("status", {}).get("state") != "Ready":
+            current["status"] = {"state": "Ready"}
+            self.client.update_status(current)

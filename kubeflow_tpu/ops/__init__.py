@@ -1,0 +1,28 @@
+"""TPU compute kernels.
+
+The reference platform ships no kernels at all — its compute lives inside
+imported container images (tf_cnn_benchmarks, TF ModelServer; SURVEY.md §2.2).
+This package is the compute path those images provided, built TPU-first:
+pallas kernels for the ops XLA won't fuse optimally on its own, pure-jax
+fallbacks everywhere so the same model code runs on the CPU fake slice.
+
+- :mod:`~kubeflow_tpu.ops.attention` — flash attention (pallas MXU kernel,
+  online softmax, causal/GQA), blockwise custom-VJP backward.
+- :mod:`~kubeflow_tpu.ops.norms` — RMSNorm / LayerNorm (fused pallas RMSNorm).
+- :mod:`~kubeflow_tpu.ops.rotary` — rotary position embeddings.
+- :mod:`~kubeflow_tpu.ops.losses` — stable cross entropy with z-loss.
+"""
+
+from kubeflow_tpu.ops.attention import flash_attention
+from kubeflow_tpu.ops.losses import softmax_cross_entropy
+from kubeflow_tpu.ops.norms import layer_norm, rms_norm
+from kubeflow_tpu.ops.rotary import apply_rotary, rotary_frequencies
+
+__all__ = [
+    "flash_attention",
+    "softmax_cross_entropy",
+    "layer_norm",
+    "rms_norm",
+    "apply_rotary",
+    "rotary_frequencies",
+]

@@ -1,0 +1,47 @@
+"""Loss functions.
+
+Cross entropy is computed in float32 from bf16 logits with the max-subtracted
+logsumexp, plus the z-loss regularizer that keeps logits from drifting when
+training in low precision. Masked positions (label < 0) contribute zero and
+are excluded from the normalizer — the convention the data pipeline's padding
+relies on.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy(
+    logits, labels, *, z_loss: float = 0.0, where=None
+):
+    """Mean token cross entropy.
+
+    logits: [..., V]; labels: [...] int32, negative = ignore. Returns
+    (loss, metrics dict with "loss", "z_loss", "tokens").
+    """
+    logits32 = logits.astype(jnp.float32)
+    # The subtracted max must be the SAME stop-gradient value when added
+    # back, else grad(lse) gains a spurious one_hot(argmax) term.
+    m = jax.lax.stop_gradient(jnp.max(logits32, axis=-1, keepdims=True))
+    shifted = logits32 - m
+    lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1)) + m[..., 0]
+    label_logit = jnp.take_along_axis(
+        logits32, jnp.maximum(labels, 0)[..., None], axis=-1
+    )[..., 0]
+    nll = lse - label_logit
+
+    mask = labels >= 0
+    if where is not None:
+        mask = mask & where
+    maskf = mask.astype(jnp.float32)
+    tokens = jnp.maximum(jnp.sum(maskf), 1.0)
+    loss = jnp.sum(nll * maskf) / tokens
+
+    metrics = {"loss": loss, "tokens": tokens}
+    if z_loss:
+        zl = z_loss * jnp.sum(jnp.square(lse) * maskf) / tokens
+        metrics["z_loss"] = zl
+        loss = loss + zl
+    return loss, metrics

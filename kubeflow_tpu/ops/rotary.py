@@ -1,0 +1,37 @@
+"""Rotary position embeddings (RoPE).
+
+Pure jax: two multiplies and an add per element — XLA fuses this into the
+surrounding projection matmuls, so a pallas kernel would buy nothing here.
+Frequencies are precomputed once per model and closed over by the jitted step.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rotary_frequencies(head_dim: int, max_len: int, *, theta: float = 10000.0):
+    """cos/sin tables [max_len, head_dim//2], float32."""
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    angles = jnp.outer(jnp.arange(max_len, dtype=jnp.float32), inv_freq)
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rotary(x, cos, sin, *, positions=None):
+    """Rotate pairs (x[..., :D/2], x[..., D/2:]). x: [B, T, H, D].
+
+    ``positions`` ([B, T] int) selects rows of the tables; defaults to
+    0..T-1 (training); decoding passes the absolute positions.
+    """
+    t = x.shape[1]
+    if positions is None:
+        c = cos[:t][None, :, None, :]
+        s = sin[:t][None, :, None, :]
+    else:
+        c = cos[positions][:, :, None, :]
+        s = sin[positions][:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    rotated = jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
+    return rotated.astype(x.dtype)

@@ -1,0 +1,15 @@
+"""TPU model serving runtime.
+
+Replaces the reference's serving stack — the TF ModelServer deployment
+(kubeflow/tf-serving/tf-serving-template.libsonnet:29-49) plus the tornado
+REST→gRPC http-proxy (components/k8s-model-server/http-proxy/server.py) —
+with one process: a jitted JAX inference engine with server-side dynamic
+batching (the TPU needs full batches to keep the MXU busy) behind the same
+REST surface the proxy exposed (/v1/models/<m>:predict, metadata, health,
+prometheus metrics).
+"""
+
+from kubeflow_tpu.serving.engine import InferenceEngine
+from kubeflow_tpu.serving.server import ModelServer
+
+__all__ = ["InferenceEngine", "ModelServer"]

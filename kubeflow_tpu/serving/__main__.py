@@ -1,0 +1,42 @@
+"""CLI: `python -m kubeflow_tpu.serving --model-name ... --rest-port 8500`.
+
+The container entrypoint the tpu-serving manifest runs
+(kubeflow_tpu/manifests/packages/serving.py args)."""
+
+from __future__ import annotations
+
+import argparse
+
+from kubeflow_tpu.serving.engine import EngineConfig
+from kubeflow_tpu.serving.server import ModelServer
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--model-name", required=True,
+                   help="registry model name (kubeflow_tpu.models)")
+    p.add_argument("--model-path", default="",
+                   help="checkpoint dir (empty = fresh init, benchmarking)")
+    p.add_argument("--rest-port", type=int, default=8500)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--batch-timeout-ms", type=float, default=5.0)
+    p.add_argument("--max-seq-len", type=int, default=128)
+    args = p.parse_args(argv)
+
+    server = ModelServer(
+        EngineConfig(
+            model=args.model_name,
+            checkpoint_dir=args.model_path or None,
+            batch_size=args.batch_size,
+            max_seq_len=args.max_seq_len,
+        ),
+        port=args.rest_port,
+        batch_timeout_ms=args.batch_timeout_ms,
+    )
+    print(f"serving {args.model_name} on :{args.rest_port}")
+    server.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

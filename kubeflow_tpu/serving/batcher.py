@@ -1,0 +1,73 @@
+"""Dynamic batcher.
+
+Requests queue up to ``batch_timeout_ms`` or until the server batch fills,
+then run as one TPU call — the role TF-Serving's batching config plays in the
+reference (enable via the prototype param, tf-serving-template.libsonnet).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+
+@dataclass
+class _Pending:
+    instance: dict
+    event: threading.Event = field(default_factory=threading.Event)
+    result: Any = None
+    error: Exception | None = None
+
+
+class DynamicBatcher:
+    def __init__(
+        self,
+        predict_batch: Callable[[list[dict]], list[dict]],
+        batch_size: int,
+        batch_timeout_ms: float = 5.0,
+    ):
+        self._predict = predict_batch
+        self._batch_size = batch_size
+        self._timeout = batch_timeout_ms / 1000.0
+        self._queue: queue.Queue[_Pending] = queue.Queue()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                first = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            batch = [first]
+            deadline = self._timeout
+            while len(batch) < self._batch_size:
+                try:
+                    batch.append(self._queue.get(timeout=deadline))
+                except queue.Empty:
+                    break
+            try:
+                results = self._predict([p.instance for p in batch])
+                for p, r in zip(batch, results):
+                    p.result = r
+            except Exception as e:  # surfaced to every waiter in the batch
+                for p in batch:
+                    p.error = e
+            for p in batch:
+                p.event.set()
+
+    def submit(self, instance: dict, timeout: float = 30.0) -> dict:
+        p = _Pending(instance)
+        self._queue.put(p)
+        if not p.event.wait(timeout):
+            raise TimeoutError("predict timed out")
+        if p.error is not None:
+            raise p.error
+        return p.result
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2)

@@ -1,0 +1,159 @@
+"""Inference engine: registry model + checkpoint → jitted predict.
+
+TPU-first: one compiled function per (padded) batch shape, inputs padded to
+the fixed server batch so every request rides the same executable; bf16
+activations; optional greedy decode loop for LMs via ``lax.scan`` (static
+length, compiled once).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.models.registry import ModelSpec, get_model
+
+
+@dataclass
+class EngineConfig:
+    model: str = "lm-test-tiny"
+    checkpoint_dir: str | None = None
+    batch_size: int = 8
+    max_seq_len: int = 128
+
+
+class InferenceEngine:
+    """Thread-safe predict over a fixed-shape compiled function."""
+
+    def __init__(self, cfg: EngineConfig):
+        self.cfg = cfg
+        self.model: ModelSpec = get_model(cfg.model)
+        self._lock = threading.Lock()
+        self.params = self._load_params()
+        self._predict = jax.jit(self._predict_fn)
+        self._warm = False
+
+    def _load_params(self):
+        params = self.model.init(jax.random.PRNGKey(0), self.model.config)
+        if self.cfg.checkpoint_dir:
+            from kubeflow_tpu.train import checkpoint as ckpt_lib
+            from kubeflow_tpu.train.optimizers import OptimizerConfig
+            from kubeflow_tpu.train.trainer import init_state
+
+            state = init_state(
+                jax.random.PRNGKey(0), self.model, OptimizerConfig()
+            )
+            abstract = jax.eval_shape(lambda: state)
+            restored = ckpt_lib.restore_latest(self.cfg.checkpoint_dir,
+                                               abstract)
+            if restored is None:
+                raise FileNotFoundError(
+                    f"no checkpoint under {self.cfg.checkpoint_dir}"
+                )
+            params = restored[0].params
+        return params
+
+    # ------------------------------------------------------------------
+
+    def _predict_fn(self, params, inputs):
+        cfg = self.model.config
+        if self.model.family == "transformer":
+            logits = self.model.apply(params, inputs["tokens"], cfg)
+            # Causality makes position len-1 exact regardless of padding
+            # after it — gather each request's last real position.
+            last = jnp.take_along_axis(
+                logits, inputs["last_index"][:, None, None], axis=1
+            )[:, 0]
+            return {
+                "logits": last.astype(jnp.float32),
+                "next_token": jnp.argmax(last, axis=-1),
+            }
+        if self.model.family == "bert":
+            seq, pooled = self.model.apply(
+                params, inputs["tokens"], cfg,
+                pad_mask=inputs.get("pad_mask"),
+            )
+            return {"pooled": pooled.astype(jnp.float32)}
+        if self.model.family == "resnet":
+            logits = self.model.apply(params, inputs["images"], cfg)
+            return {
+                "probabilities": jax.nn.softmax(logits, axis=-1),
+                "classes": jnp.argmax(logits, axis=-1),
+            }
+        raise ValueError(self.model.family)
+
+    def warmup(self) -> None:
+        self.predict_batch(self._example_instances(1))
+        self._warm = True
+
+    @property
+    def ready(self) -> bool:
+        return self._warm
+
+    def _example_instances(self, n: int) -> list[dict]:
+        cfg = self.model.config
+        if self.model.family in ("transformer", "bert"):
+            return [{"tokens": [0] * 8}] * n
+        return [{"images": np.zeros(
+            (cfg.image_size, cfg.image_size, 3)).tolist()}] * n
+
+    # ------------------------------------------------------------------
+
+    def _pad_tokens(self, instances: list[dict]) -> dict:
+        b = self.cfg.batch_size
+        t = self.cfg.max_seq_len
+        tokens = np.zeros((b, t), np.int32)
+        mask = np.zeros((b, t), np.float32)
+        for i, inst in enumerate(instances):
+            seq = np.asarray(inst["tokens"], np.int32)[:t]
+            tokens[i, : len(seq)] = seq
+            mask[i, : len(seq)] = 1.0
+        return {"tokens": tokens, "pad_mask": mask}
+
+    def predict_batch(self, instances: list[dict]) -> list[dict]:
+        """Pad instances to the server batch, run, slice real results."""
+        if len(instances) > self.cfg.batch_size:
+            raise ValueError(
+                f"batch {len(instances)} exceeds limit {self.cfg.batch_size}"
+            )
+        n = len(instances)
+        if self.model.family in ("transformer", "bert"):
+            batch = self._pad_tokens(instances)
+            if self.model.family == "transformer":
+                batch.pop("pad_mask")
+                lengths = [
+                    min(len(inst["tokens"]), self.cfg.max_seq_len)
+                    for inst in instances
+                ] + [1] * (self.cfg.batch_size - n)
+                batch["last_index"] = np.asarray(lengths, np.int32) - 1
+        else:
+            cfg = self.model.config
+            images = np.zeros(
+                (self.cfg.batch_size, cfg.image_size, cfg.image_size, 3),
+                np.float32,
+            )
+            for i, inst in enumerate(instances):
+                images[i] = np.asarray(inst["images"], np.float32)
+            batch = {"images": images}
+
+        with self._lock:
+            out = self._predict(self.params, batch)
+        out = jax.tree.map(lambda x: np.asarray(x)[:n], out)
+        return [
+            {k: v[i].tolist() for k, v in out.items()} for i in range(n)
+        ]
+
+    def metadata(self) -> dict:
+        cfg = self.model.config
+        return {
+            "name": self.cfg.model,
+            "family": self.model.family,
+            "batch_size": self.cfg.batch_size,
+            "config": {
+                k: str(v) for k, v in vars(cfg).items()
+            },
+        }

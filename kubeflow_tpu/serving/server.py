@@ -1,0 +1,166 @@
+"""REST model server.
+
+The http-proxy surface (components/k8s-model-server/http-proxy/server.py:
+PredictHandler :251, metadata :154) served directly from the TPU process:
+
+- ``POST /v1/models/<name>:predict``  {"instances": [...]} → {"predictions": [...]}
+- ``GET  /v1/models/<name>``          model metadata + availability
+- ``GET  /healthz`` ``GET /readyz``   liveness/readiness (probe target,
+  tf-serving-template.libsonnet:70-75)
+- ``GET  /monitoring/prometheus/metrics`` request counters/latency
+  (tf-serving-template.libsonnet:127-130)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from kubeflow_tpu.serving.batcher import DynamicBatcher
+from kubeflow_tpu.serving.engine import EngineConfig, InferenceEngine
+
+
+class _Metrics:
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.requests = 0
+        self.errors = 0
+        self.latency_sum = 0.0
+        self.latency_count = 0
+
+    def observe(self, seconds: float, error: bool) -> None:
+        with self.lock:
+            self.requests += 1
+            self.errors += int(error)
+            self.latency_sum += seconds
+            self.latency_count += 1
+
+    def render(self) -> str:
+        with self.lock:
+            return (
+                "# TYPE serving_requests_total counter\n"
+                f"serving_requests_total {self.requests}\n"
+                "# TYPE serving_errors_total counter\n"
+                f"serving_errors_total {self.errors}\n"
+                "# TYPE serving_latency_seconds summary\n"
+                f"serving_latency_seconds_sum {self.latency_sum:.6f}\n"
+                f"serving_latency_seconds_count {self.latency_count}\n"
+            )
+
+
+class ModelServer:
+    def __init__(self, engine_cfg: EngineConfig, *, port: int = 8500,
+                 batch_timeout_ms: float = 5.0):
+        self.engine = InferenceEngine(engine_cfg)
+        self.batcher = DynamicBatcher(
+            self.engine.predict_batch, engine_cfg.batch_size, batch_timeout_ms
+        )
+        self.metrics = _Metrics()
+        self.port = port
+        self._httpd: ThreadingHTTPServer | None = None
+
+    # ------------------------------------------------------------------
+
+    def handle_predict(self, name: str, body: dict) -> dict:
+        if name != self.engine.cfg.model:
+            raise KeyError(f"model {name!r} not served")
+        instances = body.get("instances")
+        if not isinstance(instances, list) or not instances:
+            raise ValueError("body must contain non-empty 'instances'")
+        # Over-batch-size requests split into chunks through the batcher.
+        preds = [self.batcher.submit(inst) for inst in instances]
+        return {"predictions": preds}
+
+    def handle_metadata(self, name: str) -> dict:
+        if name != self.engine.cfg.model:
+            raise KeyError(f"model {name!r} not served")
+        meta = self.engine.metadata()
+        meta["state"] = "AVAILABLE" if self.engine.ready else "LOADING"
+        return meta
+
+    # ------------------------------------------------------------------
+
+    def _make_handler(server: "ModelServer"):
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _send(self, code: int, payload: dict | str,
+                      content_type="application/json") -> None:
+                body = (
+                    payload if isinstance(payload, str)
+                    else json.dumps(payload)
+                ).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path in ("/healthz", "/livez"):
+                    self._send(200, {"status": "ok"})
+                elif self.path == "/readyz":
+                    code = 200 if server.engine.ready else 503
+                    self._send(code, {"ready": server.engine.ready})
+                elif self.path == "/monitoring/prometheus/metrics":
+                    self._send(200, server.metrics.render(),
+                               content_type="text/plain")
+                elif self.path.startswith("/v1/models/"):
+                    name = self.path[len("/v1/models/"):]
+                    try:
+                        self._send(200, server.handle_metadata(name))
+                    except KeyError as e:
+                        self._send(404, {"error": str(e)})
+                else:
+                    self._send(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                t0 = time.perf_counter()
+                error = False
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                    if self.path.startswith("/v1/models/") and \
+                            self.path.endswith(":predict"):
+                        name = self.path[len("/v1/models/"):-len(":predict")]
+                        self._send(200, server.handle_predict(name, body))
+                    else:
+                        error = True
+                        self._send(404, {"error": f"no route {self.path}"})
+                except KeyError as e:
+                    error = True
+                    self._send(404, {"error": str(e)})
+                except (ValueError, TimeoutError) as e:
+                    error = True
+                    self._send(400, {"error": str(e)})
+                except Exception as e:
+                    error = True
+                    self._send(500, {"error": str(e)})
+                finally:
+                    server.metrics.observe(time.perf_counter() - t0, error)
+
+        return Handler
+
+    def start(self) -> None:
+        self.engine.warmup()
+        self._httpd = ThreadingHTTPServer(
+            ("0.0.0.0", self.port), self._make_handler()
+        )
+        thread = threading.Thread(target=self._httpd.serve_forever,
+                                  daemon=True)
+        thread.start()
+
+    def serve_forever(self) -> None:
+        self.engine.warmup()
+        self._httpd = ThreadingHTTPServer(
+            ("0.0.0.0", self.port), self._make_handler()
+        )
+        self._httpd.serve_forever()
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+        self.batcher.stop()

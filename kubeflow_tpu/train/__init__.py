@@ -1,0 +1,18 @@
+"""Training runtime: the workload the reference's TFJob pods ran, TPU-native.
+
+In the reference, training is a container image the operator launches
+(tf_cnn_benchmarks via launcher.py); checkpoint/resume is delegated to the
+workload (SURVEY.md §5.4). Here it's part of the framework:
+
+- :mod:`~kubeflow_tpu.train.trainer` — SPMD train step factory: one jitted
+  function over the mesh, donated state, grad clipping, metrics.
+- :mod:`~kubeflow_tpu.train.optimizers` — optax optimizer + schedule presets.
+- :mod:`~kubeflow_tpu.train.checkpoint` — orbax save/restore (restart-from-
+  checkpoint, which the reference lacks entirely).
+- :mod:`~kubeflow_tpu.train.data` — synthetic + host-sharded batch pipelines.
+- :mod:`~kubeflow_tpu.train.loop` — the worker entrypoint JaxJob pods run.
+"""
+
+from kubeflow_tpu.train.trainer import TrainState, build_train_step, init_state
+
+__all__ = ["TrainState", "build_train_step", "init_state"]

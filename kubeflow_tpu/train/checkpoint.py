@@ -1,0 +1,57 @@
+"""Checkpoint / resume via orbax.
+
+The reference delegates checkpointing entirely to workloads and cloud storage
+(models read from GCS/S3/PVC — SURVEY.md §5.4); job restart just reruns the
+container. Here restart-from-checkpoint is a framework capability: the train
+loop saves sharded TrainState periodically and on preemption, and resumes from
+the latest step found. Multi-host safe — every process participates in the
+save (orbax handles the per-shard writes + atomic commit)."""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import orbax.checkpoint as ocp
+
+
+def _manager(ckpt_dir: str, max_to_keep: int = 3) -> ocp.CheckpointManager:
+    return ocp.CheckpointManager(
+        os.path.abspath(ckpt_dir),
+        options=ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep, create=True
+        ),
+    )
+
+
+def save(ckpt_dir: str, step: int, state: Any, *, force: bool = False) -> None:
+    mgr = _manager(ckpt_dir)
+    mgr.save(step, args=ocp.args.StandardSave(state), force=force)
+    mgr.wait_until_finished()
+    mgr.close()
+
+
+def latest_step(ckpt_dir: str) -> int | None:
+    if not os.path.isdir(ckpt_dir):
+        return None
+    mgr = _manager(ckpt_dir)
+    step = mgr.latest_step()
+    mgr.close()
+    return step
+
+
+def restore(ckpt_dir: str, step: int, abstract_state: Any) -> Any:
+    """Restore into the structure/shardings of ``abstract_state`` (build it
+    with jax.eval_shape + shardings so restoring places shards directly on
+    device)."""
+    mgr = _manager(ckpt_dir)
+    state = mgr.restore(step, args=ocp.args.StandardRestore(abstract_state))
+    mgr.close()
+    return state
+
+
+def restore_latest(ckpt_dir: str, abstract_state: Any) -> tuple[Any, int] | None:
+    step = latest_step(ckpt_dir)
+    if step is None:
+        return None
+    return restore(ckpt_dir, step, abstract_state), step

@@ -1,0 +1,72 @@
+"""Data pipelines.
+
+Synthetic generators per model family (the benchmark default — the role
+tf_cnn_benchmarks' synthetic data plays in the reference's perf harness,
+tf-controller-examples/tf-cnn/README.md), plus the host→mesh placement helper
+for real multi-host input: each process feeds its local shard and
+``jax.make_array_from_process_local_data`` assembles the global batch.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+
+from kubeflow_tpu.models.registry import ModelSpec
+
+
+def synthetic_batch(model: ModelSpec, batch_size: int, seq_len: int = 512,
+                    seed: int = 0) -> dict:
+    """One host-resident numpy batch matching the model family's loss_fn."""
+    rng = np.random.default_rng(seed)
+    cfg = model.config
+    if model.family in ("transformer",):
+        tokens = rng.integers(0, cfg.vocab_size, (batch_size, seq_len + 1),
+                              dtype=np.int32)
+        if getattr(cfg, "context_parallel", False):
+            # Sequence-sharded batches need seq divisible by the mesh axis;
+            # ship the shifted pair instead of the odd-length token array.
+            return {"inputs": tokens[:, :-1], "targets": tokens[:, 1:]}
+        return {"tokens": tokens}
+    if model.family == "bert":
+        tokens = rng.integers(0, cfg.vocab_size, (batch_size, seq_len),
+                              dtype=np.int32)
+        mask = rng.random((batch_size, seq_len)) < 0.15
+        labels = np.where(mask, tokens, -1).astype(np.int32)
+        return {"tokens": tokens, "mlm_labels": labels}
+    if model.family == "resnet":
+        images = rng.standard_normal(
+            (batch_size, cfg.image_size, cfg.image_size, 3), np.float32
+        )
+        labels = rng.integers(0, cfg.num_classes, (batch_size,), np.int32)
+        return {"images": images, "labels": labels}
+    raise ValueError(f"unknown model family {model.family}")
+
+
+def synthetic_stream(model: ModelSpec, batch_size: int, seq_len: int = 512,
+                     seed: int = 0) -> Iterator[dict]:
+    step = 0
+    while True:
+        yield synthetic_batch(model, batch_size, seq_len, seed=seed + step)
+        step += 1
+
+
+def place_batch(batch: dict, mesh: Mesh, model: ModelSpec) -> dict:
+    """Place a (per-process) host batch onto the mesh with the model's batch
+    sharding. Single-process: device_put; multi-host: assemble the global
+    array from each process's local shard."""
+    spec = model.batch_partition_spec(model.config)
+
+    def place(x):
+        x = np.asarray(x)
+        ndim_spec = tuple(spec)[: x.ndim] + (None,) * max(0, x.ndim - len(spec))
+        sharding = NamedSharding(mesh, jax.sharding.PartitionSpec(*ndim_spec))
+        if jax.process_count() == 1:
+            return jax.device_put(x, sharding)
+        return jax.make_array_from_process_local_data(sharding, x)
+
+    return jax.tree.map(place, batch)

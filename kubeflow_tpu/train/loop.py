@@ -1,0 +1,156 @@
+"""Training loop — the entrypoint JaxJob worker pods run.
+
+The TPU-native analogue of the reference's launcher.py (tf-controller-
+examples/tf-cnn/launcher.py): read the operator-injected rendezvous env, join
+the collective, build the mesh, train with periodic checkpoint, report
+throughput. Runs identically on one chip, the CPU fake slice, or a multi-host
+TPU slice.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from dataclasses import asdict, dataclass, field
+
+import jax
+
+from kubeflow_tpu.models.registry import get_model
+from kubeflow_tpu.parallel.distributed import initialize_from_env
+from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+from kubeflow_tpu.train import checkpoint as ckpt_lib
+from kubeflow_tpu.train.data import place_batch, synthetic_stream
+from kubeflow_tpu.train.optimizers import OptimizerConfig
+from kubeflow_tpu.train.trainer import (
+    build_train_step,
+    init_state,
+    state_shardings,
+)
+
+
+@dataclass
+class RunConfig:
+    model: str = "lm-test-tiny"
+    model_overrides: dict = field(default_factory=dict)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
+    batch_size: int = 8
+    seq_len: int = 128
+    steps: int = 100
+    log_every: int = 10
+    checkpoint_dir: str | None = None
+    checkpoint_every: int = 500
+    seed: int = 0
+
+
+def run(cfg: RunConfig, *, log=print) -> dict:
+    """Train; returns final metrics {step, loss, samples_per_sec, ...}."""
+    info = initialize_from_env()
+    model = get_model(cfg.model, **cfg.model_overrides)
+    mesh = build_mesh(cfg.mesh)
+    opt_cfg = cfg.optimizer
+
+    state = init_state(jax.random.PRNGKey(cfg.seed), model, opt_cfg, mesh)
+    start_step = 0
+    if cfg.checkpoint_dir:
+        abstract = jax.eval_shape(lambda: state)
+        abstract = jax.tree.map(
+            lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+            abstract, state_shardings(abstract, mesh, model),
+        )
+        restored = ckpt_lib.restore_latest(cfg.checkpoint_dir, abstract)
+        if restored is not None:
+            state, start_step = restored
+            log(f"resumed from checkpoint step {start_step}")
+
+    step_fn = build_train_step(model, opt_cfg, mesh)
+    stream = synthetic_stream(model, cfg.batch_size, cfg.seq_len,
+                              seed=cfg.seed)
+
+    metrics = {}
+    t_last = time.perf_counter()
+    samples_since = 0
+    throughput = 0.0
+    for step in range(start_step, cfg.steps):
+        batch = place_batch(next(stream), mesh, model)
+        state, metrics = step_fn(state, batch)
+        samples_since += cfg.batch_size
+        if (step + 1) % cfg.log_every == 0 or step + 1 == cfg.steps:
+            loss = float(metrics["loss"])  # sync point
+            now = time.perf_counter()
+            throughput = samples_since / (now - t_last)
+            t_last, samples_since = now, 0
+            log(
+                f"step={step + 1} loss={loss:.4f} "
+                f"samples/sec={throughput:.1f}"
+            )
+        if (
+            cfg.checkpoint_dir
+            and (step + 1) % cfg.checkpoint_every == 0
+        ):
+            ckpt_lib.save(cfg.checkpoint_dir, step + 1, state)
+    if cfg.checkpoint_dir and ckpt_lib.latest_step(cfg.checkpoint_dir) != cfg.steps:
+        ckpt_lib.save(cfg.checkpoint_dir, cfg.steps, state, force=True)
+
+    result = {
+        "step": cfg.steps,
+        "loss": float(metrics["loss"]) if metrics else None,
+        "samples_per_sec": throughput,
+        "process_id": info.process_id,
+    }
+    if info.process_id == 0:
+        publish_metrics(result, log=log)
+    return result
+
+
+def publish_metrics(result: dict, *, client=None, environ=None, log=print):
+    """Publish final metrics into the owning job's status.metrics — the path
+    the study/benchmark controllers read (the reference scrapes worker logs
+    with a metricsCollector CronJob instead,
+    kubeflow/katib/studyjobcontroller.libsonnet:115-147). Also emits the
+    log-line form for log-scraping collectors."""
+    import os
+
+    from kubeflow_tpu.apis.jobs import (
+        ENV_JOB_KIND,
+        ENV_JOB_NAME,
+        ENV_JOB_NAMESPACE,
+        JOBS_API_VERSION,
+    )
+
+    env = os.environ if environ is None else environ
+    metrics = {k: v for k, v in result.items()
+               if isinstance(v, (int, float)) and v is not None}
+    log(f"kubeflow-tpu-metrics: {json.dumps(metrics)}")
+    name = env.get(ENV_JOB_NAME)
+    if not name:
+        return
+    ns = env.get(ENV_JOB_NAMESPACE, "default")
+    kind = env.get(ENV_JOB_KIND, "JaxJob")
+    if client is None:
+        from kubeflow_tpu.k8s.client import HttpK8sClient
+
+        client = HttpK8sClient()
+    try:
+        job = client.get(JOBS_API_VERSION, kind, name, ns)
+        job.setdefault("status", {})["metrics"] = metrics
+        client.update_status(job)
+    except Exception as e:  # metrics publishing must never kill training
+        log(f"metrics publish failed: {e}")
+
+
+def main(argv=None) -> int:
+    """`python -m kubeflow_tpu.train.loop '<json run config>'`"""
+    argv = sys.argv[1:] if argv is None else argv
+    overrides = json.loads(argv[0]) if argv else {}
+    mesh_cfg = MeshConfig(**overrides.pop("mesh", {}))
+    opt_cfg = OptimizerConfig(**overrides.pop("optimizer", {}))
+    cfg = RunConfig(mesh=mesh_cfg, optimizer=opt_cfg, **overrides)
+    result = run(cfg)
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

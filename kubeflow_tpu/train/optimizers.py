@@ -1,0 +1,52 @@
+"""Optimizer and schedule presets (optax)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import optax
+
+
+@dataclass(frozen=True)
+class OptimizerConfig:
+    name: str = "adamw"
+    learning_rate: float = 3e-4
+    warmup_steps: int = 100
+    total_steps: int = 10_000
+    weight_decay: float = 0.1
+    b1: float = 0.9
+    b2: float = 0.95
+    eps: float = 1e-8
+    grad_clip_norm: float = 1.0
+    min_lr_ratio: float = 0.1
+    momentum: float = 0.9  # sgd only
+
+
+def schedule(cfg: OptimizerConfig):
+    """Linear warmup → cosine decay to min_lr_ratio·peak (the LLM-training
+    default)."""
+    return optax.warmup_cosine_decay_schedule(
+        init_value=0.0,
+        peak_value=cfg.learning_rate,
+        warmup_steps=cfg.warmup_steps,
+        decay_steps=max(cfg.total_steps, cfg.warmup_steps + 1),
+        end_value=cfg.learning_rate * cfg.min_lr_ratio,
+    )
+
+
+def build(cfg: OptimizerConfig) -> optax.GradientTransformation:
+    lr = schedule(cfg)
+    if cfg.name == "adamw":
+        opt = optax.adamw(
+            lr, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps,
+            weight_decay=cfg.weight_decay,
+        )
+    elif cfg.name == "adam":
+        opt = optax.adam(lr, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps)
+    elif cfg.name == "sgd":
+        opt = optax.sgd(lr, momentum=cfg.momentum)
+    else:
+        raise ValueError(f"unknown optimizer {cfg.name!r}")
+    if cfg.grad_clip_norm:
+        opt = optax.chain(optax.clip_by_global_norm(cfg.grad_clip_norm), opt)
+    return opt

@@ -1,0 +1,14 @@
+"""Hyperparameter tuning runtime (the Katib/Vizier analogue).
+
+The reference deploys vizier-core + per-algorithm suggestion services
+(kubeflow/katib/suggestion.libsonnet:3-10: random, grid, hyperband,
+bayesianoptimization) and a StudyJobController whose metricsCollector CronJob
+scrapes worker logs (studyjobcontroller.libsonnet:115-147). Here the same
+pieces are in-process: suggestion algorithms as a library, the study
+controller spawning trial JaxJobs, metrics flowing through job status.
+"""
+
+from kubeflow_tpu.tuning.suggestions import get_algorithm
+from kubeflow_tpu.tuning.controller import StudyJobController
+
+__all__ = ["get_algorithm", "StudyJobController"]

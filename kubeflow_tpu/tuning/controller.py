@@ -1,0 +1,196 @@
+"""StudyJob controller.
+
+The studyjobcontroller analogue (kubeflow/katib/studyjobcontroller.libsonnet):
+reconcile a StudyJob by spawning trial jobs from the trial template with
+``${trialParameters.<name>}`` substituted, reading each finished trial's
+objective from its job status (the metricsCollector path — trials publish
+final metrics into ``.status.metrics``, see kubeflow_tpu/train/loop.py), and
+asking the suggestion algorithm for the next assignments.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import re
+
+from kubeflow_tpu.apis.jobs import JOBS_API_VERSION
+from kubeflow_tpu.apis.tuning import STUDY_JOB_KIND, TUNING_API_VERSION
+from kubeflow_tpu.k8s import objects as k8s
+from kubeflow_tpu.operators.base import Controller
+from kubeflow_tpu.tuning.suggestions import (
+    Observation,
+    domains_from_spec,
+    get_algorithm,
+)
+
+LABEL_STUDY = "kubeflow-tpu.org/study-name"
+LABEL_TRIAL = "kubeflow-tpu.org/trial-index"
+
+_PARAM_RE = re.compile(r"\$\{trialParameters\.([A-Za-z0-9_]+)\}")
+
+
+def substitute_parameters(template, assignments: dict):
+    """Replace ${trialParameters.x} through the whole object tree; a string
+    that is exactly one placeholder takes the raw typed value."""
+
+    def sub(node):
+        if isinstance(node, dict):
+            return {key: sub(value) for key, value in node.items()}
+        if isinstance(node, list):
+            return [sub(item) for item in node]
+        if isinstance(node, str):
+            m = _PARAM_RE.fullmatch(node)
+            if m:
+                return assignments[m.group(1)]
+            return _PARAM_RE.sub(
+                lambda m: str(assignments[m.group(1)]), node
+            )
+        return node
+
+    return sub(copy.deepcopy(template))
+
+
+class StudyJobController(Controller):
+    api_version = TUNING_API_VERSION
+    kind = STUDY_JOB_KIND
+    resync_seconds = 10.0
+
+    def watched_kinds(self):
+        return [(JOBS_API_VERSION, "JaxJob")]
+
+    def reconcile(self, study: dict) -> None:
+        study = copy.deepcopy(study)
+        spec = study["spec"]
+        status = study.setdefault("status", {})
+        if status.get("state") in ("Succeeded", "Failed"):
+            return
+        status.setdefault("state", "Running")
+        trials = status.setdefault("trials", [])
+
+        self._collect_finished(study, trials)
+
+        objective = spec.get("objective", {})
+        maximize = objective.get("type", "maximize") == "maximize"
+        finished = [t for t in trials if t["state"] in ("Succeeded", "Failed")]
+        succeeded = [t for t in finished if t["state"] == "Succeeded"
+                     and t.get("objectiveValue") is not None]
+        failed = [t for t in finished if t["state"] == "Failed"]
+
+        self._update_best(status, succeeded, maximize)
+
+        goal = objective.get("goal")
+        best = status.get("bestObjectiveValue")
+        goal_met = (
+            goal is not None and best is not None
+            and (best >= goal if maximize else best <= goal)
+        )
+        if len(failed) > spec.get("maxFailedTrialCount", 3):
+            status["state"] = "Failed"
+        elif goal_met or len(finished) >= spec.get("maxTrialCount", 10):
+            status["state"] = "Succeeded"
+        else:
+            self._spawn_trials(study, trials, maximize)
+
+        status["completedTrialCount"] = len(finished)
+        self._push_status(study)
+
+    # ------------------------------------------------------------------
+
+    def _trial_job_name(self, study: dict, index: int) -> str:
+        return f"{study['metadata']['name']}-trial-{index}"
+
+    def _collect_finished(self, study: dict, trials: list[dict]) -> None:
+        ns = study["metadata"]["namespace"]
+        metric = study["spec"].get("objective", {}).get(
+            "objectiveMetricName", "loss"
+        )
+        for trial in trials:
+            if trial["state"] in ("Succeeded", "Failed"):
+                continue
+            job = self.client.get_or_none(
+                JOBS_API_VERSION, "JaxJob",
+                self._trial_job_name(study, trial["index"]), ns,
+            )
+            if job is None:
+                continue
+            jstate = job.get("status", {}).get("state")
+            if jstate == "Succeeded":
+                trial["state"] = "Succeeded"
+                metrics = job.get("status", {}).get("metrics", {})
+                if metric in metrics:
+                    trial["objectiveValue"] = float(metrics[metric])
+            elif jstate == "Failed":
+                trial["state"] = "Failed"
+
+    def _update_best(self, status: dict, succeeded: list[dict],
+                     maximize: bool) -> None:
+        if not succeeded:
+            return
+        best = (max if maximize else min)(
+            succeeded, key=lambda t: t["objectiveValue"]
+        )
+        status["bestObjectiveValue"] = best["objectiveValue"]
+        status["bestTrialIndex"] = best["index"]
+        status["bestAssignments"] = best["assignments"]
+
+    def _spawn_trials(self, study: dict, trials: list[dict],
+                      maximize: bool) -> None:
+        spec = study["spec"]
+        ns = study["metadata"]["namespace"]
+        active = [t for t in trials
+                  if t["state"] not in ("Succeeded", "Failed")]
+        budget = min(
+            spec.get("parallelTrialCount", 2) - len(active),
+            spec.get("maxTrialCount", 10) - len(trials),
+        )
+        if budget <= 0:
+            return
+
+        domains = domains_from_spec(spec.get("parameters", []))
+        algo = get_algorithm(
+            spec.get("algorithm", "random"), domains,
+            seed=len(trials),
+        )
+        observations = [
+            Observation(
+                t["assignments"],
+                t["objectiveValue"] if maximize else -t["objectiveValue"],
+            )
+            for t in trials
+            if t["state"] == "Succeeded" and t.get("objectiveValue") is not None
+        ]
+        for _ in range(budget):
+            assignments = algo.next(observations)
+            if assignments is None:  # space exhausted (grid)
+                if not active:
+                    study["status"]["state"] = "Succeeded"
+                return
+            index = len(trials)
+            job = substitute_parameters(spec["trialTemplate"], assignments)
+            job.setdefault("apiVersion", JOBS_API_VERSION)
+            job.setdefault("kind", "JaxJob")
+            meta = job.setdefault("metadata", {})
+            meta["name"] = self._trial_job_name(study, index)
+            meta["namespace"] = ns
+            meta.setdefault("labels", {}).update({
+                LABEL_STUDY: study["metadata"]["name"],
+                LABEL_TRIAL: str(index),
+            })
+            meta["ownerReferences"] = [k8s.object_ref(study)]
+            self.client.create(job)
+            trials.append({
+                "index": index,
+                "assignments": assignments,
+                "state": "Running",
+                "jobName": meta["name"],
+            })
+
+    def _push_status(self, study: dict) -> None:
+        current = self.client.get_or_none(
+            self.api_version, self.kind, study["metadata"]["name"],
+            study["metadata"]["namespace"],
+        )
+        if current is not None and current.get("status") != study["status"]:
+            current["status"] = study["status"]
+            self.client.update_status(current)

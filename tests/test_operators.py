@@ -1,0 +1,299 @@
+"""Controller tests against the fake apiserver (the envtest tier,
+SURVEY.md §4). Pod phase transitions are simulated the way envtest does —
+by writing pod status directly."""
+
+import json
+
+import pytest
+
+from kubeflow_tpu.apis import jobs as jobs_api
+from kubeflow_tpu.apis.notebooks import notebook, notebook_crd
+from kubeflow_tpu.apis.profiles import profile, profile_crd
+from kubeflow_tpu.k8s import objects as k8s
+from kubeflow_tpu.operators.jobs import JobController
+from kubeflow_tpu.operators.notebooks import NotebookController
+from kubeflow_tpu.operators.profiles import ProfileController
+
+
+def make_job(kind="JaxJob", name="train", replicas=4, **spec_extra):
+    replica_types = {
+        "JaxJob": {"Worker": replicas},
+        "TFJob": {"Chief": 1, "PS": 2, "Worker": replicas},
+        "PyTorchJob": {"Master": 1, "Worker": replicas},
+        "MXNetJob": {"Scheduler": 1, "Server": 1, "Worker": replicas},
+        "ChainerJob": {"Master": 1, "Worker": replicas},
+        "MPIJob": {"Launcher": 1, "Worker": replicas},
+    }[kind]
+    return {
+        "apiVersion": jobs_api.JOBS_API_VERSION,
+        "kind": kind,
+        "metadata": {"name": name, "namespace": "kubeflow"},
+        "spec": {
+            "replicaSpecs": {
+                rt: {
+                    "replicas": n,
+                    "restartPolicy": "OnFailure",
+                    "template": {"spec": {"containers": [
+                        {"name": "main", "image": "train:latest"}
+                    ]}},
+                }
+                for rt, n in replica_types.items()
+            },
+            **spec_extra,
+        },
+    }
+
+
+def set_pod_phase(api, pod_name, phase, exit_code=None):
+    pod = api.get("v1", "Pod", pod_name, "kubeflow")
+    status = {"phase": phase}
+    if exit_code is not None:
+        status["containerStatuses"] = [
+            {"name": "main", "state": {"terminated": {"exitCode": exit_code}}}
+        ]
+    pod["status"] = status
+    api.update_status(pod)
+
+
+@pytest.fixture()
+def jaxjob_env(api):
+    for crd in jobs_api.all_job_crds():
+        api.apply(crd)
+    ctrl = JobController(api, "JaxJob")
+    return api, ctrl
+
+
+def test_jaxjob_creates_gang_and_env(jaxjob_env):
+    api, ctrl = jaxjob_env
+    api.create(make_job(tpu={"accelerator": "v5e", "topology": "2x4"}))
+    ctrl.reconcile_all()
+
+    pods = api.list("v1", "Pod", "kubeflow")
+    assert len(pods) == 4
+    svc = api.get("v1", "Service", "train", "kubeflow")
+    assert svc["spec"]["clusterIP"] == "None"
+
+    pod0 = api.get("v1", "Pod", "train-worker-0", "kubeflow")
+    env = {e["name"]: e["value"] for e in pod0["spec"]["containers"][0]["env"]}
+    assert env["JAX_COORDINATOR_ADDRESS"] == (
+        "train-worker-0.train.kubeflow:8476"
+    )
+    assert env["JAX_NUM_PROCESSES"] == "4"
+    assert env["JAX_PROCESS_ID"] == "0"
+    assert pod0["spec"]["nodeSelector"][
+        "cloud.google.com/gke-tpu-accelerator"] == "v5e"
+    assert pod0["spec"]["subdomain"] == "train"
+
+    job = api.get(jobs_api.JOBS_API_VERSION, "JaxJob", "train", "kubeflow")
+    assert job["status"]["state"] == "Created"
+    assert job["status"]["replicaStatuses"]["worker"]["pending"] == 4
+
+
+def test_jaxjob_running_then_succeeded_cleans_pods(jaxjob_env):
+    api, ctrl = jaxjob_env
+    api.create(make_job(replicas=2))
+    ctrl.reconcile_all()
+    for i in range(2):
+        set_pod_phase(api, f"train-worker-{i}", "Running")
+    ctrl.reconcile_all()
+    job = api.get(jobs_api.JOBS_API_VERSION, "JaxJob", "train", "kubeflow")
+    assert job["status"]["state"] == "Running"
+
+    for i in range(2):
+        set_pod_phase(api, f"train-worker-{i}", "Succeeded")
+    ctrl.reconcile_all()
+    job = api.get(jobs_api.JOBS_API_VERSION, "JaxJob", "train", "kubeflow")
+    assert job["status"]["state"] == "Succeeded"
+    conds = {c["type"]: c["status"] for c in job["status"]["conditions"]}
+    assert conds["Succeeded"] == "True"
+    # cleanPodPolicy default Running: succeeded pods stay.
+    assert len(api.list("v1", "Pod", "kubeflow")) == 2
+
+
+def test_jaxjob_restart_on_failure_and_backoff(jaxjob_env):
+    api, ctrl = jaxjob_env
+    api.create(make_job(replicas=2, runPolicy={"backoffLimit": 1}))
+    ctrl.reconcile_all()
+    set_pod_phase(api, "train-worker-0", "Failed")
+    ctrl.reconcile_all()
+    job = api.get(jobs_api.JOBS_API_VERSION, "JaxJob", "train", "kubeflow")
+    assert job["status"]["restartCount"] == 1
+    assert job["status"]["state"] == "Restarting"
+    # Pod was recreated fresh (Pending).
+    pod = api.get("v1", "Pod", "train-worker-0", "kubeflow")
+    assert pod.get("status", {}).get("phase") is None
+
+    # Second failure exceeds backoffLimit=1.
+    set_pod_phase(api, "train-worker-0", "Failed")
+    ctrl.reconcile_all()
+    job = api.get(jobs_api.JOBS_API_VERSION, "JaxJob", "train", "kubeflow")
+    assert job["status"]["state"] == "Failed"
+    reasons = [c["reason"] for c in job["status"]["conditions"]
+               if c["status"] == "True"]
+    assert "BackoffLimitExceeded" in reasons
+
+
+def test_jaxjob_never_restart_fails_job(jaxjob_env):
+    api, ctrl = jaxjob_env
+    job = make_job(replicas=2)
+    for rs in job["spec"]["replicaSpecs"].values():
+        rs["restartPolicy"] = "Never"
+    api.create(job)
+    ctrl.reconcile_all()
+    set_pod_phase(api, "train-worker-1", "Failed")
+    ctrl.reconcile_all()
+    got = api.get(jobs_api.JOBS_API_VERSION, "JaxJob", "train", "kubeflow")
+    assert got["status"]["state"] == "Failed"
+
+
+def test_jaxjob_exitcode_policy(jaxjob_env):
+    api, ctrl = jaxjob_env
+    job = make_job(replicas=1)
+    job["spec"]["replicaSpecs"]["Worker"]["restartPolicy"] = "ExitCode"
+    api.create(job)
+    ctrl.reconcile_all()
+    # Exit 1 = permanent failure.
+    set_pod_phase(api, "train-worker-0", "Failed", exit_code=1)
+    ctrl.reconcile_all()
+    got = api.get(jobs_api.JOBS_API_VERSION, "JaxJob", "train", "kubeflow")
+    assert got["status"]["state"] == "Failed"
+
+
+def test_jaxjob_exitcode_sigkill_restarts(jaxjob_env):
+    api, ctrl = jaxjob_env
+    job = make_job(replicas=1)
+    job["spec"]["replicaSpecs"]["Worker"]["restartPolicy"] = "ExitCode"
+    api.create(job)
+    ctrl.reconcile_all()
+    set_pod_phase(api, "train-worker-0", "Failed", exit_code=137)
+    ctrl.reconcile_all()
+    got = api.get(jobs_api.JOBS_API_VERSION, "JaxJob", "train", "kubeflow")
+    assert got["status"]["state"] == "Restarting"
+
+
+def test_jaxjob_invalid_spec_fails(jaxjob_env):
+    api, ctrl = jaxjob_env
+    bad = make_job()
+    bad["spec"]["replicaSpecs"]["Worker"]["template"] = {"spec": {}}
+    api.create(bad)
+    ctrl.reconcile_all()
+    got = api.get(jobs_api.JOBS_API_VERSION, "JaxJob", "train", "kubeflow")
+    assert got["status"]["state"] == "Failed"
+    assert any(c["reason"] == "InvalidSpec"
+               for c in got["status"]["conditions"])
+
+
+def test_jaxjob_multislice_env(jaxjob_env):
+    api, ctrl = jaxjob_env
+    api.create(make_job(replicas=4, tpu={"accelerator": "v5e",
+                                         "numSlices": 2}))
+    ctrl.reconcile_all()
+    pod3 = api.get("v1", "Pod", "train-worker-3", "kubeflow")
+    env = {e["name"]: e["value"] for e in pod3["spec"]["containers"][0]["env"]}
+    assert env["MEGASCALE_NUM_SLICES"] == "2"
+    assert env["MEGASCALE_SLICE_ID"] == "1"
+    assert env["TPU_WORKER_ID"] == "1"
+
+
+def test_tfjob_tf_config(api):
+    for crd in jobs_api.all_job_crds():
+        api.apply(crd)
+    ctrl = JobController(api, "TFJob")
+    api.create(make_job("TFJob", replicas=2))
+    ctrl.reconcile_all()
+    pod = api.get("v1", "Pod", "train-worker-1", "kubeflow")
+    env = {e["name"]: e["value"] for e in pod["spec"]["containers"][0]["env"]}
+    tf_config = json.loads(env["TF_CONFIG"])
+    assert tf_config["task"] == {"type": "worker", "index": 1}
+    assert len(tf_config["cluster"]["ps"]) == 2
+    assert tf_config["cluster"]["chief"][0].endswith(":8476")
+    # Chief completion defines success.
+    set_pod_phase(api, "train-chief-0", "Succeeded")
+    ctrl.reconcile_all()
+    got = api.get(jobs_api.JOBS_API_VERSION, "TFJob", "train", "kubeflow")
+    assert got["status"]["state"] == "Succeeded"
+
+
+def test_pytorchjob_master_env(api):
+    for crd in jobs_api.all_job_crds():
+        api.apply(crd)
+    ctrl = JobController(api, "PyTorchJob")
+    api.create(make_job("PyTorchJob", replicas=3))
+    ctrl.reconcile_all()
+    pod = api.get("v1", "Pod", "train-worker-2", "kubeflow")
+    env = {e["name"]: e["value"] for e in pod["spec"]["containers"][0]["env"]}
+    assert env["MASTER_ADDR"] == "train-master-0.train.kubeflow"
+    assert env["WORLD_SIZE"] == "4"
+    assert env["RANK"] == "3"
+
+
+def test_mpijob_hostfile(api):
+    for crd in jobs_api.all_job_crds():
+        api.apply(crd)
+    ctrl = JobController(api, "MPIJob")
+    api.create(make_job("MPIJob", replicas=2))
+    ctrl.reconcile_all()
+    pod = api.get("v1", "Pod", "train-launcher-0", "kubeflow")
+    env = {e["name"]: e["value"] for e in pod["spec"]["containers"][0]["env"]}
+    assert "train-worker-0.train.kubeflow slots=1" in env["MPI_HOSTFILE_CONTENT"]
+
+
+def test_notebook_controller_creates_statefulset_and_status(api):
+    api.apply(notebook_crd())
+    ctrl = NotebookController(api)
+    api.create(notebook("nb1", "kubeflow", "jax-notebook:latest",
+                        tpu_chips=4, workspace_pvc="ws"))
+    ctrl.reconcile_all()
+    sts = api.get("apps/v1", "StatefulSet", "nb1", "kubeflow")
+    assert sts["spec"]["replicas"] == 1
+    main = sts["spec"]["template"]["spec"]["containers"][0]
+    assert main["resources"]["limits"]["google.com/tpu"] == 4
+    assert api.get("v1", "Service", "nb1", "kubeflow")
+
+    # Simulate the pod coming up; status mirrors container state.
+    pod_tmpl = sts["spec"]["template"]
+    pod = {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "nb1-0", "namespace": "kubeflow",
+                     "labels": pod_tmpl["metadata"]["labels"]},
+        "spec": pod_tmpl["spec"],
+    }
+    api.create(pod)
+    set_pod_phase(api, "nb1-0", "Running")
+    ctrl.reconcile_all()
+    nb = api.get("kubeflow-tpu.org/v1", "Notebook", "nb1", "kubeflow")
+    assert nb["status"]["readyReplicas"] == 1
+
+
+def test_notebook_suspend_scales_statefulset(api):
+    api.apply(notebook_crd())
+    ctrl = NotebookController(api)
+    api.create(notebook("nb2", "kubeflow", "jax-notebook:latest"))
+    ctrl.reconcile_all()
+    assert api.get("apps/v1", "StatefulSet", "nb2", "kubeflow")["spec"][
+        "replicas"] == 1
+    nb = api.get("kubeflow-tpu.org/v1", "Notebook", "nb2", "kubeflow")
+    nb["spec"]["suspend"] = True
+    api.update(nb)
+    ctrl.reconcile_all()
+    assert api.get("apps/v1", "StatefulSet", "nb2", "kubeflow")["spec"][
+        "replicas"] == 0
+
+
+def test_profile_controller_provisions_namespace_rbac_quota(api):
+    api.apply(profile_crd())
+    ctrl = ProfileController(api)
+    api.create(profile("alice", "alice@example.com",
+                       quota={"hard": {"requests.google.com/tpu": "8"}}))
+    ctrl.reconcile_all()
+    assert api.get("v1", "Namespace", "alice")
+    role = api.get("rbac.authorization.k8s.io/v1", "Role",
+                   "namespace-admin", "alice")
+    assert role["rules"][0]["verbs"] == ["*"]
+    binding = api.get("rbac.authorization.k8s.io/v1", "RoleBinding",
+                      "namespace-admin-binding", "alice")
+    assert binding["subjects"][0]["name"] == "alice@example.com"
+    quota = api.get("v1", "ResourceQuota", "profile-quota", "alice")
+    assert quota["spec"]["hard"]["requests.google.com/tpu"] == "8"
+    prof = api.get("kubeflow-tpu.org/v1", "Profile", "alice")
+    assert prof["status"]["state"] == "Ready"

@@ -1,0 +1,113 @@
+"""Training runtime tests on the fake slice: sharded step, checkpoint/resume,
+the full loop entrypoint."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.models.registry import get_model
+from kubeflow_tpu.parallel import MeshConfig, build_mesh
+from kubeflow_tpu.train import checkpoint as ckpt_lib
+from kubeflow_tpu.train.data import place_batch, synthetic_batch
+from kubeflow_tpu.train.loop import RunConfig, run
+from kubeflow_tpu.train.optimizers import OptimizerConfig
+from kubeflow_tpu.train.trainer import (
+    build_train_step,
+    init_state,
+    state_shardings,
+)
+
+OPT = OptimizerConfig(learning_rate=1e-2, warmup_steps=2, total_steps=50)
+
+
+def test_sharded_train_step_reduces_loss():
+    model = get_model("lm-test-tiny")
+    mesh = build_mesh(MeshConfig(data=2, fsdp=2, tensor=2))
+    state = init_state(jax.random.PRNGKey(0), model, OPT, mesh)
+    # Params actually sharded per rules.
+    wq = state.params["layers"]["attn"]["wq"]
+    assert wq.sharding.spec == jax.sharding.PartitionSpec(None, "fsdp", "tensor")
+    step_fn = build_train_step(model, OPT, mesh)
+    batch = place_batch(synthetic_batch(model, 8, 32), mesh, model)
+    losses = []
+    for _ in range(8):
+        state, metrics = step_fn(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0]
+    assert int(state.step) == 8
+
+
+def test_optimizer_state_sharding_follows_params():
+    model = get_model("lm-test-tiny")
+    mesh = build_mesh(MeshConfig(data=2, fsdp=2, tensor=2))
+    state = init_state(jax.random.PRNGKey(0), model, OPT, mesh)
+    # Find the adam mu pytree inside opt_state and check a leaf's sharding.
+    flat = jax.tree_util.tree_flatten_with_path(state)[0]
+    mu_wq = [
+        leaf for path, leaf in flat
+        if "mu" in str(path) and "wq" in str(path)
+    ]
+    assert mu_wq, "no adam mu state found"
+    assert mu_wq[0].sharding.spec == jax.sharding.PartitionSpec(
+        None, "fsdp", "tensor"
+    )
+
+
+def test_checkpoint_roundtrip_and_resume(tmp_path):
+    model = get_model("lm-test-tiny")
+    mesh = build_mesh(MeshConfig(data=4, fsdp=2))
+    state = init_state(jax.random.PRNGKey(0), model, OPT, mesh)
+    step_fn = build_train_step(model, OPT, mesh)
+    batch = place_batch(synthetic_batch(model, 8, 16), mesh, model)
+    state, _ = step_fn(state, batch)
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    ckpt_lib.save(ckpt_dir, 1, state)
+    assert ckpt_lib.latest_step(ckpt_dir) == 1
+
+    abstract = jax.eval_shape(lambda: state)
+    abstract = jax.tree.map(
+        lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+        abstract, state_shardings(abstract, mesh, model),
+    )
+    restored, step = ckpt_lib.restore_latest(ckpt_dir, abstract)
+    assert step == 1
+    np.testing.assert_allclose(
+        np.asarray(restored.params["final_norm"]),
+        np.asarray(state.params["final_norm"]),
+    )
+    # Restored state is usable for further steps.
+    restored, metrics = step_fn(restored, batch)
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_run_loop_end_to_end(tmp_path, capsys):
+    cfg = RunConfig(
+        model="lm-test-tiny",
+        mesh=MeshConfig(data=4, fsdp=2),
+        optimizer=OPT,
+        batch_size=8,
+        seq_len=32,
+        steps=6,
+        log_every=3,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        checkpoint_every=1000,
+    )
+    result = run(cfg)
+    assert result["step"] == 6
+    assert np.isfinite(result["loss"])
+    assert result["samples_per_sec"] > 0
+    # Final checkpoint written; rerun resumes and exits immediately.
+    assert ckpt_lib.latest_step(cfg.checkpoint_dir) == 6
+    result2 = run(cfg)
+    assert result2["step"] == 6
+
+
+def test_place_batch_shards_batch_dim():
+    model = get_model("lm-test-tiny")
+    mesh = build_mesh(MeshConfig(data=4, fsdp=2))
+    batch = place_batch(synthetic_batch(model, 8, 16), mesh, model)
+    arr = batch["tokens"]
+    assert arr.shape == (8, 17)
+    # batch dim sharded over data×fsdp = 8 ways.
+    assert arr.addressable_shards[0].data.shape == (1, 17)

@@ -1,0 +1,206 @@
+"""Suggestion algorithms + study/benchmark controller tests (the
+katib_studyjob_test.py analogue, driven on the fake apiserver)."""
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.apis import jobs as jobs_api
+from kubeflow_tpu.apis.benchmark import benchmark_job, benchmark_job_crd
+from kubeflow_tpu.apis.tuning import (
+    double_param,
+    int_param,
+    categorical_param,
+    study_job,
+    study_job_crd,
+)
+from kubeflow_tpu.benchmark import BenchmarkJobController
+from kubeflow_tpu.tuning import StudyJobController
+from kubeflow_tpu.tuning.controller import substitute_parameters
+from kubeflow_tpu.tuning.suggestions import (
+    Observation,
+    domains_from_spec,
+    get_algorithm,
+)
+
+PARAMS = [
+    double_param("lr", 1e-4, 1e-1, log_scale=True),
+    int_param("layers", 1, 4),
+    categorical_param("opt", ["adam", "sgd"]),
+]
+DOMAINS = domains_from_spec(PARAMS)
+
+
+def test_random_suggestion_in_bounds():
+    algo = get_algorithm("random", DOMAINS, seed=1)
+    for _ in range(20):
+        a = algo.next([])
+        assert 1e-4 <= a["lr"] <= 1e-1
+        assert 1 <= a["layers"] <= 4
+        assert a["opt"] in ("adam", "sgd")
+
+
+def test_grid_suggestion_exhausts():
+    algo = get_algorithm("grid", domains_from_spec([int_param("n", 1, 2),
+                                                    categorical_param("c", ["a", "b"])]))
+    seen = []
+    obs = []
+    while True:
+        a = algo.next(obs)
+        if a is None:
+            break
+        seen.append(tuple(a.values()))
+        obs.append(Observation(a, 0.0))
+    assert sorted(seen) == [(1, "a"), (1, "b"), (2, "a"), (2, "b")]
+
+
+def test_hyperband_promotes_best():
+    algo = get_algorithm("hyperband", domains_from_spec([double_param("lr", 0.1, 1.0)]))
+    obs = []
+    # Base rung capacity = max_budget/min_budget/eta = 3 random configs.
+    budgets = []
+    for _ in range(3):
+        a = algo.next(obs)
+        budgets.append(a["trainingSteps"])
+        obs.append(Observation(a, a["lr"]))  # higher lr = better
+    assert set(budgets) == {algo.min_budget}
+    promoted = algo.next(obs)
+    assert promoted["trainingSteps"] == algo.min_budget * algo.eta
+    # Promoted config is the best from the base rung.
+    assert promoted["lr"] == max(o.assignments["lr"] for o in obs)
+
+
+def test_bayesian_improves_over_random():
+    # Maximize -(x-0.7)^2 over x in [0,1].
+    dom = domains_from_spec([double_param("x", 0.0, 1.0)])
+    algo = get_algorithm("bayesianoptimization", dom, seed=0)
+    obs = []
+    for _ in range(15):
+        a = algo.next(obs)
+        obs.append(Observation(a, -(a["x"] - 0.7) ** 2))
+    best = max(o.assignments["x"] for o in obs
+               if o.objective == max(ob.objective for ob in obs))
+    assert abs(best - 0.7) < 0.15
+
+
+def test_substitute_parameters_typed_and_string():
+    tmpl = {
+        "spec": {
+            "lr": "${trialParameters.lr}",
+            "args": ["--lr=${trialParameters.lr}", "--n=${trialParameters.n}"],
+        }
+    }
+    out = substitute_parameters(tmpl, {"lr": 0.01, "n": 3})
+    assert out["spec"]["lr"] == 0.01  # typed passthrough
+    assert out["spec"]["args"] == ["--lr=0.01", "--n=3"]
+
+
+def _trial_template():
+    return {
+        "spec": {
+            "replicaSpecs": {
+                "Worker": {
+                    "replicas": 1,
+                    "template": {"spec": {"containers": [{
+                        "name": "main", "image": "train:latest",
+                        "args": ["--lr=${trialParameters.lr}"],
+                    }]}},
+                }
+            }
+        }
+    }
+
+
+def finish_trial(api, ctrl_jobs, name, value):
+    job = api.get(jobs_api.JOBS_API_VERSION, "JaxJob", name, "kubeflow")
+    job["status"] = {"state": "Succeeded", "metrics": {"accuracy": value}}
+    api.update_status(job)
+
+
+def test_study_controller_full_lifecycle(api):
+    api.apply(study_job_crd())
+    for crd in jobs_api.all_job_crds():
+        api.apply(crd)
+    study = study_job(
+        "hp", "kubeflow", "accuracy",
+        parameters=[double_param("lr", 0.001, 0.1)],
+        trial_template=_trial_template(),
+        algorithm="random",
+        parallel_trials=2, max_trials=4,
+    )
+    api.create(study)
+    ctrl = StudyJobController(api)
+    ctrl.reconcile_all()
+
+    # Two parallel trials spawned, parameters substituted.
+    trials = api.list(jobs_api.JOBS_API_VERSION, "JaxJob", "kubeflow")
+    assert len(trials) == 2
+    arg = trials[0]["spec"]["replicaSpecs"]["Worker"]["template"]["spec"][
+        "containers"][0]["args"][0]
+    assert arg.startswith("--lr=0.")
+
+    # Finish them with objective values; next reconcile spawns the rest.
+    for i, t in enumerate(trials):
+        finish_trial(api, ctrl, t["metadata"]["name"], 0.5 + 0.1 * i)
+    ctrl.reconcile_all()
+    trials = api.list(jobs_api.JOBS_API_VERSION, "JaxJob", "kubeflow")
+    assert len(trials) == 4
+    for i, t in enumerate(trials):
+        if not t.get("status"):
+            finish_trial(api, ctrl, t["metadata"]["name"], 0.3 + 0.05 * i)
+    ctrl.reconcile_all()
+
+    got = api.get("kubeflow-tpu.org/v1", "StudyJob", "hp", "kubeflow")
+    assert got["status"]["state"] == "Succeeded"
+    assert got["status"]["completedTrialCount"] == 4
+    assert got["status"]["bestObjectiveValue"] == 0.6
+    assert "lr" in got["status"]["bestAssignments"]
+
+
+def test_study_goal_stops_early(api):
+    api.apply(study_job_crd())
+    for crd in jobs_api.all_job_crds():
+        api.apply(crd)
+    study = study_job(
+        "goal", "kubeflow", "accuracy",
+        parameters=[double_param("lr", 0.001, 0.1)],
+        trial_template=_trial_template(),
+        goal=0.9, parallel_trials=1, max_trials=10,
+    )
+    api.create(study)
+    ctrl = StudyJobController(api)
+    ctrl.reconcile_all()
+    trial = api.list(jobs_api.JOBS_API_VERSION, "JaxJob", "kubeflow")[0]
+    finish_trial(api, ctrl, trial["metadata"]["name"], 0.95)
+    ctrl.reconcile_all()
+    got = api.get("kubeflow-tpu.org/v1", "StudyJob", "goal", "kubeflow")
+    assert got["status"]["state"] == "Succeeded"
+    assert got["status"]["completedTrialCount"] == 1
+
+
+def test_benchmark_controller_aggregates(api):
+    api.apply(benchmark_job_crd())
+    for crd in jobs_api.all_job_crds():
+        api.apply(crd)
+    bench = benchmark_job(
+        "b1", "kubeflow", _trial_template() | {"kind": "JaxJob"},
+        metrics=["samples_per_sec"], repetitions=2,
+    )
+    # Template needs real replicaSpecs, reuse the trial template spec.
+    bench["spec"]["jobTemplate"] = {
+        "kind": "JaxJob", **_trial_template(),
+    }
+    api.create(bench)
+    ctrl = BenchmarkJobController(api)
+    for value in (100.0, 120.0):
+        ctrl.reconcile_all()
+        jobs = [j for j in api.list(jobs_api.JOBS_API_VERSION, "JaxJob",
+                                    "kubeflow") if not j.get("status")]
+        job = jobs[0]
+        job["status"] = {"state": "Succeeded",
+                         "metrics": {"samples_per_sec": value}}
+        api.update_status(job)
+    ctrl.reconcile_all()
+    got = api.get("kubeflow-tpu.org/v1", "BenchmarkJob", "b1", "kubeflow")
+    assert got["status"]["state"] == "Succeeded"
+    agg = got["status"]["results"]["samples_per_sec"]
+    assert agg == {"mean": 110.0, "min": 100.0, "max": 120.0, "runs": 2}
