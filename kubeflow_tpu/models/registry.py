@@ -23,6 +23,10 @@ class ModelSpec:
     loss_fn: Callable       # (params, batch, cfg, *, mesh=None) -> (loss, metrics)
     partition_rules: Callable
     batch_partition_spec: Callable
+    # Optional non-gradient state channel: (params, metrics["_state_updates"])
+    # -> params, applied by the trainer after the optimizer step (BN running
+    # stats and the like).
+    update_state: Callable | None = None
 
 
 def _spec(name, family, module, cfg) -> ModelSpec:
@@ -35,6 +39,7 @@ def _spec(name, family, module, cfg) -> ModelSpec:
         loss_fn=module.loss_fn,
         partition_rules=module.partition_rules,
         batch_partition_spec=module.batch_partition_spec,
+        update_state=getattr(module, "update_state", None),
     )
 
 

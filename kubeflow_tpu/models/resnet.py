@@ -105,12 +105,31 @@ def batch_partition_spec(cfg: ResNetConfig) -> P:
     return P((AXIS_DATA, AXIS_FSDP), None, None, None)
 
 
-def _bn(x, p, eps=1e-5):
-    # Inference-style BN with stored statistics; training uses the batch
-    # statistics path in loss_fn (simplified: statistics computed per step,
-    # running stats updated outside the grad).
-    inv = lax.rsqrt(p["var"] + eps) * p["scale"]
-    return x * inv.astype(x.dtype) + (p["bias"] - p["mean"] * inv).astype(x.dtype)
+BN_MOMENTUM = 0.9
+
+
+def _bn(x, p, eps=1e-5, *, stats=None, path=""):
+    # Training mode (stats is a collector dict): normalize with this batch's
+    # statistics — under a sharded jit the mean/var reductions run globally
+    # across the data axis, i.e. sync-BN for free — and record momentum-merged
+    # running stats (stop_gradient) for the trainer to fold back into params.
+    # Eval mode (stats is None): stored running statistics.
+    if stats is not None:
+        x32 = x.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=(0, 1, 2))
+        var = jnp.var(x32, axis=(0, 1, 2))
+        stats[path] = {
+            "mean": lax.stop_gradient(
+                BN_MOMENTUM * p["mean"] + (1 - BN_MOMENTUM) * mean
+            ),
+            "var": lax.stop_gradient(
+                BN_MOMENTUM * p["var"] + (1 - BN_MOMENTUM) * var
+            ),
+        }
+    else:
+        mean, var = p["mean"], p["var"]
+    inv = lax.rsqrt(var + eps) * p["scale"]
+    return x * inv.astype(x.dtype) + (p["bias"] - mean * inv).astype(x.dtype)
 
 
 def _conv(x, w, stride=1, padding="SAME"):
@@ -122,38 +141,68 @@ def _conv(x, w, stride=1, padding="SAME"):
     )
 
 
-def _block(x, p, stride):
-    h = jax.nn.relu(_bn(_conv(x, p["conv1"]), p["bn1"]))
-    h = jax.nn.relu(_bn(_conv(h, p["conv2"], stride=stride), p["bn2"]))
-    h = _bn(_conv(h, p["conv3"]), p["bn3"])
+def _block(x, p, stride, stats, path):
+    h = jax.nn.relu(_bn(_conv(x, p["conv1"]), p["bn1"],
+                        stats=stats, path=f"{path}/bn1"))
+    h = jax.nn.relu(_bn(_conv(h, p["conv2"], stride=stride), p["bn2"],
+                        stats=stats, path=f"{path}/bn2"))
+    h = _bn(_conv(h, p["conv3"]), p["bn3"], stats=stats, path=f"{path}/bn3")
     if "proj" in p:
-        x = _bn(_conv(x, p["proj"], stride=stride), p["bn_proj"])
+        x = _bn(_conv(x, p["proj"], stride=stride), p["bn_proj"],
+                stats=stats, path=f"{path}/bn_proj")
     return jax.nn.relu(x + h)
 
 
-def apply(params, images, cfg: ResNetConfig, *, mesh=None):
-    """images [B, H, W, 3] float → logits [B, num_classes]."""
+def apply(params, images, cfg: ResNetConfig, *, mesh=None, train=False):
+    """images [B, H, W, 3] float → logits [B, num_classes].
+
+    ``train=True`` normalizes with batch statistics and returns
+    ``(logits, stats)`` where stats maps BN path → new running statistics
+    (consumed by :func:`update_state`)."""
+    stats: dict | None = {} if train else None
     x = images.astype(cfg.dtype)
     if mesh is not None:
         x = lax.with_sharding_constraint(
             x, jax.NamedSharding(mesh, batch_partition_spec(cfg))
         )
     x = jax.nn.relu(_bn(_conv(x, params["stem"]["conv"], stride=2),
-                        params["stem"]["bn"]))
+                        params["stem"]["bn"], stats=stats, path="stem/bn"))
     x = lax.reduce_window(
         x, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME"
     )
     for stage_idx, stage in enumerate(params["stages"]):
         for block_idx, block in enumerate(stage):
             stride = 2 if (stage_idx > 0 and block_idx == 0) else 1
-            x = _block(x, block, stride)
+            x = _block(x, block, stride, stats,
+                       f"stages/{stage_idx}/{block_idx}")
     x = jnp.mean(x, axis=(1, 2)).astype(jnp.float32)
-    return x @ params["head"]["kernel"] + params["head"]["bias"]
+    logits = x @ params["head"]["kernel"] + params["head"]["bias"]
+    return (logits, stats) if train else logits
+
+
+def update_state(params, stats):
+    """Fold the running BN statistics recorded by a ``train=True`` forward
+    back into a fresh params pytree (the non-gradient state channel — the
+    trainer calls this after the optimizer step, overwriting whatever the
+    optimizer did to the stat leaves)."""
+    params = jax.tree.map(lambda x: x, params)  # rebuild containers
+    for path, value in stats.items():
+        node = params
+        parts = path.split("/")
+        for part in parts[:-1]:
+            node = node[int(part)] if part.isdigit() else node[part]
+        bn = dict(node[parts[-1]])
+        bn["mean"], bn["var"] = value["mean"], value["var"]
+        node[parts[-1]] = bn
+    return params
 
 
 def loss_fn(params, batch, cfg: ResNetConfig, *, mesh=None):
     """batch: {"images": [B,H,W,3], "labels": [B]}."""
     from kubeflow_tpu.ops import softmax_cross_entropy
 
-    logits = apply(params, batch["images"], cfg, mesh=mesh)
-    return softmax_cross_entropy(logits, batch["labels"])
+    logits, stats = apply(params, batch["images"], cfg, mesh=mesh, train=True)
+    loss, metrics = softmax_cross_entropy(logits, batch["labels"])
+    metrics = dict(metrics)
+    metrics["_state_updates"] = stats
+    return loss, metrics

@@ -127,11 +127,47 @@ class JobController(Controller):
             label_selector={LABEL_JOB: job["metadata"]["name"]},
         )
 
+    def _rspec_for_pod(self, job: dict, pod: dict) -> dict:
+        rt_label = pod["metadata"]["labels"].get(LABEL_REPLICA_TYPE, "")
+        return next(
+            (rs for rt, rs in job["spec"]["replicaSpecs"].items()
+             if rt.lower() == rt_label), {},
+        )
+
+    def _gang_restart_if_needed(self, job: dict, existing: dict) -> dict:
+        """JaxJob restart is all-or-nothing: a lone restarted process cannot
+        rejoin a completed jax.distributed.initialize rendezvous, so a
+        retryable worker failure restarts the whole gang."""
+        failed = [p for p in existing.values()
+                  if p.get("status", {}).get("phase") == "Failed"]
+        retryable = [
+            self._should_restart(
+                p, self._rspec_for_pod(job, p).get("restartPolicy",
+                                                   "OnFailure"))
+            for p in failed
+        ]
+        # A permanently-failed replica must surface as ReplicaFailed in
+        # _update_status, not be swallowed by a gang recreate.
+        if not failed or not all(retryable):
+            return existing
+        ns = job["metadata"]["namespace"]
+        for pod_name in existing:
+            self.client.delete_if_exists(POD_API, "Pod", pod_name, ns)
+        self._bump_restarts(job)
+        self._set_condition(
+            job, api.COND_RESTARTING, "GangRestarting",
+            "worker failed; restarting the whole gang (collective "
+            "rendezvous is all-or-nothing)",
+        )
+        return {}
+
     def _ensure_pods(self, job: dict) -> list[dict]:
         """Create missing pods (gang: all in one pass); handle restarts."""
         name = job["metadata"]["name"]
         ns = job["metadata"]["namespace"]
         existing = {p["metadata"]["name"]: p for p in self._list_pods(job)}
+        if self.kind == api.JAX_JOB_KIND:
+            existing = self._gang_restart_if_needed(job, existing)
         desired = []
         for rt, rspec in job["spec"]["replicaSpecs"].items():
             for i in range(rspec.get("replicas", 1)):
@@ -144,7 +180,11 @@ class JobController(Controller):
             if pod is not None:
                 phase = pod.get("status", {}).get("phase", "Pending")
                 restart = rspec.get("restartPolicy", "OnFailure")
-                if phase == "Failed" and self._should_restart(pod, restart):
+                # JaxJob restarts only as a whole gang (handled above): a
+                # solo-recreated worker can't rejoin the collective, and a
+                # declined gang restart must not churn pods or restartCount.
+                if (phase == "Failed" and self.kind != api.JAX_JOB_KIND
+                        and self._should_restart(pod, restart)):
                     self.client.delete(POD_API, "Pod", pod_name, ns)
                     self._bump_restarts(job)
                     self._set_condition(
@@ -371,11 +411,7 @@ class JobController(Controller):
         for pod in pods:
             if pod.get("status", {}).get("phase") != "Failed":
                 continue
-            rt_label = pod["metadata"]["labels"][LABEL_REPLICA_TYPE]
-            rspec = next(
-                (rs for rt, rs in job["spec"]["replicaSpecs"].items()
-                 if rt.lower() == rt_label), {},
-            )
+            rspec = self._rspec_for_pod(job, pod)
             if not self._should_restart(
                 pod, rspec.get("restartPolicy", "OnFailure")
             ):

@@ -102,10 +102,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, kvm_ref, o_ref, lse_ref, m_scr, l_scr,
     @pl.when(j == nk - 1)
     def _finish():
         l = l_scr[:]
-        o_ref[0, 0] = (
-            acc_scr[:] / _lanes(l, acc_scr.shape[-1])
+        valid = m_scr[:] > _NEG_INF / 2  # all-masked rows → zeros
+        d_out = acc_scr.shape[-1]
+        o_ref[0, 0] = jnp.where(
+            _lanes(valid, d_out),
+            acc_scr[:] / _lanes(l, d_out),
+            0.0,
         ).astype(o_ref.dtype)
-        lse_ref[0, 0] = (m_scr[:, :1] + jnp.log(l[:, :1]))
+        lse_ref[0, 0] = jnp.where(
+            valid[:, :1], m_scr[:, :1] + jnp.log(l[:, :1]), _NEG_INF
+        )
 
 
 def _flash_fwd_pallas(q, k, v, kvm, *, causal, scale, block_q, block_k,
@@ -217,8 +223,11 @@ def _flash_fwd_xla(q, k, v, kvm, *, causal, scale, block_k):
          _kv_blocks(kvm, nk, block_k),
          jnp.arange(nk)),
     )
-    out = (acc / l).astype(q.dtype)
-    lse = m + jnp.log(l)
+    # Rows with every key masked never saw a finite score (m stayed at
+    # _NEG_INF, p degenerated to exp(0)=1 per key): return zeros, not mean(V).
+    valid = m > _NEG_INF / 2
+    out = jnp.where(valid, acc / l, 0.0).astype(q.dtype)
+    lse = jnp.where(valid, m + jnp.log(l), _NEG_INF)
     return out, lse
 
 
@@ -241,7 +250,9 @@ def _flash_bwd_xla(q, k, v, kvm, out, lse, g_out, *, causal, scale, block_k):
             mask = _causal_mask(0, j * block_k, t, block_k)
             s = jnp.where(mask[None, None], s, _NEG_INF)
         s = jnp.where(kvm_b[..., 0][:, None, None, :] > 0, s, _NEG_INF)
-        p = jnp.exp(s - lse)
+        # All-masked rows carry lse=_NEG_INF; exp(s-lse) would degenerate to
+        # 1 per key — their p (and so dk/dv/dq contributions) must be zero.
+        p = jnp.where(lse > _NEG_INF / 2, jnp.exp(s - lse), 0.0)
         dp = jnp.einsum("bgqd,bkd->bgqk", g32, v_b)
         ds = p * (dp - delta) * scale
         dq = dq + jnp.einsum("bgqk,bkd->bgqd", ds, k_b)

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
@@ -43,10 +44,13 @@ class DynamicBatcher:
             except queue.Empty:
                 continue
             batch = [first]
-            deadline = self._timeout
+            deadline = time.monotonic() + self._timeout
             while len(batch) < self._batch_size:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
                 try:
-                    batch.append(self._queue.get(timeout=deadline))
+                    batch.append(self._queue.get(timeout=remaining))
                 except queue.Empty:
                     break
             try:
@@ -59,14 +63,23 @@ class DynamicBatcher:
             for p in batch:
                 p.event.set()
 
-    def submit(self, instance: dict, timeout: float = 30.0) -> dict:
+    def submit_async(self, instance: dict) -> _Pending:
+        """Enqueue without waiting — lets a caller enqueue a whole request's
+        instances first so they coalesce into full batches, then collect."""
         p = _Pending(instance)
         self._queue.put(p)
+        return p
+
+    @staticmethod
+    def collect(p: _Pending, timeout: float = 30.0) -> dict:
         if not p.event.wait(timeout):
             raise TimeoutError("predict timed out")
         if p.error is not None:
             raise p.error
         return p.result
+
+    def submit(self, instance: dict, timeout: float = 30.0) -> dict:
+        return self.collect(self.submit_async(instance), timeout)
 
     def stop(self) -> None:
         self._stop.set()

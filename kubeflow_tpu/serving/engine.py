@@ -94,6 +94,35 @@ class InferenceEngine:
     def ready(self) -> bool:
         return self._warm
 
+    def validate_instance(self, inst: dict) -> None:
+        """Reject malformed instances before they reach a batch (an empty
+        'tokens' list would wrap last_index to -1 and return garbage logits
+        with 200 OK)."""
+        if not isinstance(inst, dict):
+            raise ValueError("each instance must be an object")
+        if self.model.family in ("transformer", "bert"):
+            toks = inst.get("tokens")
+            if not isinstance(toks, list) or not toks:
+                raise ValueError(
+                    "each instance needs a non-empty 'tokens' list"
+                )
+            if not all(isinstance(t, int) and not isinstance(t, bool)
+                       for t in toks):
+                raise ValueError("'tokens' must be a flat list of ints")
+        elif self.model.family == "resnet":
+            if "images" not in inst:
+                raise ValueError("each instance needs 'images'")
+            cfg = self.model.config
+            try:
+                arr = np.asarray(inst["images"], np.float32)
+            except (TypeError, ValueError) as e:
+                raise ValueError(f"'images' not numeric: {e}") from None
+            want = (cfg.image_size, cfg.image_size, 3)
+            if arr.shape != want:
+                raise ValueError(
+                    f"'images' shape {arr.shape} != expected {want}"
+                )
+
     def _example_instances(self, n: int) -> list[dict]:
         cfg = self.model.config
         if self.model.family in ("transformer", "bert"):

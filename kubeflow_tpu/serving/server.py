@@ -69,8 +69,12 @@ class ModelServer:
         instances = body.get("instances")
         if not isinstance(instances, list) or not instances:
             raise ValueError("body must contain non-empty 'instances'")
-        # Over-batch-size requests split into chunks through the batcher.
-        preds = [self.batcher.submit(inst) for inst in instances]
+        for inst in instances:
+            self.engine.validate_instance(inst)
+        # Enqueue every instance first so the batcher can coalesce a
+        # multi-instance request into full batches, then collect.
+        pending = [self.batcher.submit_async(inst) for inst in instances]
+        preds = [self.batcher.collect(p) for p in pending]
         return {"predictions": preds}
 
     def handle_metadata(self, name: str) -> dict:

@@ -84,6 +84,9 @@ def build_train_step(model: ModelSpec, opt_cfg: OptimizerConfig,
         updates, opt_state = opt.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         metrics = dict(metrics)
+        state_updates = metrics.pop("_state_updates", None)
+        if state_updates is not None and model.update_state is not None:
+            params = model.update_state(params, state_updates)
         metrics["grad_norm"] = optax.global_norm(grads)
         metrics["step"] = state.step
         return (

@@ -135,3 +135,38 @@ def test_resnet_forward_and_train_step():
     )(params)
     assert np.isfinite(float(loss))
     assert np.isfinite(float(jnp.sum(grads["stem"]["conv"])))
+
+
+def test_resnet_bn_trains_with_batch_stats_and_updates_running_stats():
+    from kubeflow_tpu.models.registry import get_model
+    from kubeflow_tpu.train.optimizers import OptimizerConfig
+    from kubeflow_tpu.train.trainer import build_train_step, init_state
+
+    model = get_model("resnet-test-tiny")
+    opt = OptimizerConfig(warmup_steps=1, total_steps=4)
+    state = init_state(jax.random.PRNGKey(0), model, opt)
+    step = build_train_step(model, opt)
+    images = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3)) * 3 + 1
+    batch = {"images": images, "labels": jnp.array([0, 1, 2, 3])}
+    state, metrics = step(state, batch)
+    state, metrics = step(state, batch)  # step 2: lr past warmup zero
+    assert np.isfinite(float(metrics["loss"]))
+    assert "_state_updates" not in metrics
+    # Running stats moved off their init (mean 0 / var 1) toward the batch
+    # statistics of a shifted/scaled input.
+    bn = state.params["stem"]["bn"]
+    assert np.abs(np.asarray(bn["mean"])).max() > 1e-3
+    assert np.abs(np.asarray(bn["var"]) - 1.0).max() > 1e-3
+    # Scale/bias still optimized normally (not clobbered by update_state).
+    assert np.abs(np.asarray(bn["scale"]) - 1.0).max() > 0
+
+
+def test_resnet_train_vs_eval_modes_differ():
+    cfg = resnet.config("resnet-test-tiny")
+    params = resnet.init(jax.random.PRNGKey(0), cfg)
+    images = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 32, 3)) + 2.0
+    eval_logits = resnet.apply(params, images, cfg)
+    train_logits, stats = resnet.apply(params, images, cfg, train=True)
+    assert stats  # collector populated for every BN layer
+    assert not np.allclose(np.asarray(eval_logits),
+                           np.asarray(train_logits))

@@ -297,3 +297,64 @@ def test_profile_controller_provisions_namespace_rbac_quota(api):
     assert quota["spec"]["hard"]["requests.google.com/tpu"] == "8"
     prof = api.get("kubeflow-tpu.org/v1", "Profile", "alice")
     assert prof["status"]["state"] == "Ready"
+
+
+def test_jaxjob_gang_restart_restarts_all_workers(jaxjob_env):
+    api, ctrl = jaxjob_env
+    api.create(make_job(replicas=3))
+    ctrl.reconcile_all()
+    for i in range(3):
+        set_pod_phase(api, f"train-worker-{i}", "Running")
+    ctrl.reconcile_all()
+    # One worker fails retryably: surviving peers hold a dead rendezvous, so
+    # the WHOLE gang must be recreated.
+    set_pod_phase(api, "train-worker-1", "Failed")
+    ctrl.reconcile_all()
+    job = api.get(jobs_api.JOBS_API_VERSION, "JaxJob", "train", "kubeflow")
+    assert job["status"]["restartCount"] == 1
+    for i in range(3):
+        pod = api.get("v1", "Pod", f"train-worker-{i}", "kubeflow")
+        assert pod.get("status", {}).get("phase") is None, i
+    reasons = [c["reason"] for c in job["status"]["conditions"]
+               if c["status"] == "True"]
+    assert "GangRestarting" in reasons
+
+
+def test_jaxjob_gang_restart_does_not_mask_permanent_failure(jaxjob_env):
+    api, ctrl = jaxjob_env
+    job = make_job(replicas=2)
+    for rs in job["spec"]["replicaSpecs"].values():
+        rs["restartPolicy"] = "ExitCode"
+    api.create(job)
+    ctrl.reconcile_all()
+    # worker-0 permanent (exit 1), worker-1 retryable (SIGKILL 137): the job
+    # must fail, not gang-restart forever.
+    set_pod_phase(api, "train-worker-0", "Failed", exit_code=1)
+    set_pod_phase(api, "train-worker-1", "Failed", exit_code=137)
+    ctrl.reconcile_all()
+    got = api.get(jobs_api.JOBS_API_VERSION, "JaxJob", "train", "kubeflow")
+    assert got["status"]["state"] == "Failed"
+    reasons = [c["reason"] for c in got["status"]["conditions"]
+               if c["status"] == "True"]
+    assert "ReplicaFailed" in reasons
+
+
+def test_jaxjob_declined_gang_restart_does_not_churn(jaxjob_env):
+    api, ctrl = jaxjob_env
+    job = make_job(replicas=2)
+    for rs in job["spec"]["replicaSpecs"].values():
+        rs["restartPolicy"] = "ExitCode"
+    job["spec"]["runPolicy"] = {"backoffLimit": 0}
+    api.create(job)
+    ctrl.reconcile_all()
+    set_pod_phase(api, "train-worker-0", "Failed", exit_code=1)    # permanent
+    set_pod_phase(api, "train-worker-1", "Failed", exit_code=137)  # retryable
+    ctrl.reconcile_all()
+    got = api.get(jobs_api.JOBS_API_VERSION, "JaxJob", "train", "kubeflow")
+    assert got["status"]["state"] == "Failed"
+    reasons = [c["reason"] for c in got["status"]["conditions"]
+               if c["status"] == "True"]
+    # Declined gang restart: no solo pod churn, no spurious restartCount, so
+    # the failure reason is ReplicaFailed (not BackoffLimitExceeded).
+    assert "ReplicaFailed" in reasons
+    assert got["status"].get("restartCount", 0) == 0

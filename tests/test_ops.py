@@ -168,3 +168,28 @@ def test_cross_entropy_z_loss_positive():
     loss_z, metrics = softmax_cross_entropy(logits, labels, z_loss=1e-2)
     assert float(loss_z) > float(loss_plain)
     assert float(metrics["z_loss"]) > 0
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_flash_attention_all_masked_rows_are_zero(impl):
+    # A batch element whose kv_mask is all-zero must return zeros (not the
+    # mean of V) and contribute zero gradient.
+    key = jax.random.PRNGKey(3)
+    q, k, v = (
+        jax.random.normal(kk, (2, 16, 2, 32), jnp.float32)
+        for kk in jax.random.split(key, 3)
+    )
+    kv_mask = jnp.stack([jnp.zeros((16,)), jnp.ones((16,))])
+    out = flash_attention(q, k, v, causal=False, kv_mask=kv_mask,
+                          implementation=impl)
+    np.testing.assert_allclose(np.asarray(out[0]), 0.0, atol=1e-6)
+    assert np.abs(np.asarray(out[1])).max() > 0
+
+    def loss(v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal=False, kv_mask=kv_mask,
+                            implementation=impl) ** 2
+        )
+
+    dv = jax.grad(loss)(v)
+    np.testing.assert_allclose(np.asarray(dv[0]), 0.0, atol=1e-6)

@@ -106,3 +106,37 @@ def test_rest_server_predict_metadata_health_metrics():
         assert "serving_requests_total" in text
     finally:
         server.stop()
+
+
+def test_engine_rejects_empty_tokens(engine):
+    with pytest.raises(ValueError):
+        engine.validate_instance({"tokens": []})
+    with pytest.raises(ValueError):
+        engine.validate_instance({})
+    engine.validate_instance({"tokens": [1, 2]})
+
+
+def test_batcher_deadline_is_absolute():
+    import time
+
+    calls = []
+
+    def predict(instances):
+        calls.append(len(instances))
+        return [{} for _ in instances]
+
+    b = DynamicBatcher(predict, batch_size=64, batch_timeout_ms=120)
+    # Feed items slower than the per-item gap but inside one window: an
+    # absolute deadline closes the batch ~120ms after the first item rather
+    # than extending it per arrival.
+    t0 = time.monotonic()
+    pending = []
+    for _ in range(3):
+        pending.append(b.submit_async({}))
+        time.sleep(0.05)
+    for p in pending:
+        b.collect(p, timeout=5)
+    elapsed = time.monotonic() - t0
+    b.stop()
+    assert elapsed < 1.0  # per-item reset would approach 3*120ms+sleeps
+    assert sum(calls) == 3
