@@ -1,0 +1,3 @@
+"""Auth runtimes: gatekeeper login/session server and the mutating
+admission webhook (components/gatekeeper/auth/AuthServer.go,
+components/gcp-admission-webhook/main.go analogues)."""

@@ -1,0 +1,201 @@
+"""Gatekeeper: `python -m kubeflow_tpu.auth.gatekeeper --port=8085`.
+
+The basic-auth gateway (components/gatekeeper/auth/AuthServer.go:32-210):
+a login form POSTs credentials checked against the mounted login secret; on
+success an HMAC-signed session cookie is set. The gateway forward-auths every
+request against ``/auth`` (200 = session valid). Routes:
+
+- ``GET  /login``   login form
+- ``POST /login``   form {username, password} → Set-Cookie + redirect
+- ``GET  /auth``    forward-auth check: 200 if the session cookie verifies
+- ``GET  /logout``  clears the session
+- ``GET  /healthz``
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import hmac
+import json
+import os
+import secrets
+import sys
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from kubeflow_tpu.runtime import strip_glog_args
+
+COOKIE_NAME = "kubeflow-tpu-auth"
+DEFAULT_SECRET_PATH = os.environ.get("LOGIN_SECRET_PATH", "/etc/login")
+
+_LOGIN_FORM = """<!doctype html>
+<html><head><title>kubeflow-tpu login</title></head>
+<body><h2>Sign in to kubeflow-tpu</h2>
+<form method="post" action="/login">
+  <label>Username <input name="username" autocomplete="username"></label><br>
+  <label>Password <input name="password" type="password"
+         autocomplete="current-password"></label><br>
+  <button type="submit">Sign in</button>
+</form>{message}</body></html>
+"""
+
+
+class AuthService:
+    """Credential check + HMAC cookie sessions."""
+
+    def __init__(self, username: str, password_hash: str,
+                 *, session_seconds: float = 24 * 3600.0,
+                 signing_key: bytes | None = None):
+        self.username = username
+        self.password_hash = password_hash  # sha256 hexdigest
+        self.session_seconds = session_seconds
+        self._key = signing_key or secrets.token_bytes(32)
+
+    @classmethod
+    def from_secret_dir(cls, path: str) -> "AuthService":
+        """Load the mounted login Secret: files `username` and either
+        `passwordhash` (sha256 hex) or `password` (plaintext, hashed here)."""
+        def read(name: str) -> str | None:
+            fp = os.path.join(path, name)
+            if os.path.exists(fp):
+                with open(fp) as f:
+                    return f.read().strip()
+            return None
+
+        username = read("username") or "admin"
+        pwhash = read("passwordhash")
+        if pwhash is None:
+            pw = read("password")
+            if pw is None:
+                raise FileNotFoundError(
+                    f"no password/passwordhash under {path}"
+                )
+            pwhash = hashlib.sha256(pw.encode()).hexdigest()
+        return cls(username, pwhash)
+
+    def check_login(self, username: str, password: str) -> bool:
+        got = hashlib.sha256(password.encode()).hexdigest()
+        return (hmac.compare_digest(username, self.username)
+                and hmac.compare_digest(got, self.password_hash))
+
+    def issue_cookie(self, now: float | None = None) -> str:
+        expires = int((now or time.time()) + self.session_seconds)
+        payload = f"{self.username}|{expires}"
+        sig = hmac.new(self._key, payload.encode(),
+                       hashlib.sha256).hexdigest()
+        return f"{payload}|{sig}"
+
+    def verify_cookie(self, token: str, now: float | None = None) -> bool:
+        parts = token.split("|")
+        if len(parts) != 3:
+            return False
+        payload = f"{parts[0]}|{parts[1]}"
+        want = hmac.new(self._key, payload.encode(),
+                        hashlib.sha256).hexdigest()
+        if not hmac.compare_digest(want, parts[2]):
+            return False
+        try:
+            return (now or time.time()) < int(parts[1])
+        except ValueError:
+            return False
+
+
+def _cookie_from_header(header: str | None) -> str | None:
+    for part in (header or "").split(";"):
+        name, _, value = part.strip().partition("=")
+        if name == COOKIE_NAME:
+            return value
+    return None
+
+
+def make_server(auth: AuthService, port: int) -> ThreadingHTTPServer:
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _send(self, code: int, body: bytes, ctype="text/html",
+                  extra: dict | None = None) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (extra or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path in ("/healthz", "/readyz"):
+                self._send(200, b'{"status":"ok"}', "application/json")
+            elif self.path.startswith("/login"):
+                self._send(200, _LOGIN_FORM.format(message="").encode())
+            elif self.path == "/auth":
+                token = _cookie_from_header(self.headers.get("Cookie"))
+                if token and auth.verify_cookie(token):
+                    self._send(200, b'{"authorized":true}',
+                               "application/json")
+                else:
+                    self._send(401, b'{"authorized":false}',
+                               "application/json")
+            elif self.path == "/logout":
+                self._send(
+                    302, b"", extra={
+                        "Location": "/login",
+                        "Set-Cookie": f"{COOKIE_NAME}=; Path=/; Max-Age=0",
+                    },
+                )
+            else:
+                self._send(404, b"not found", "text/plain")
+
+        def do_POST(self):
+            if self.path != "/login":
+                self._send(404, b"not found", "text/plain")
+                return
+            length = int(self.headers.get("Content-Length", 0))
+            form = urllib.parse.parse_qs(
+                self.rfile.read(length).decode("utf-8", "replace")
+            )
+            username = (form.get("username") or [""])[0]
+            password = (form.get("password") or [""])[0]
+            if auth.check_login(username, password):
+                cookie = auth.issue_cookie()
+                self._send(
+                    302, b"", extra={
+                        "Location": "/",
+                        "Set-Cookie": (
+                            f"{COOKIE_NAME}={cookie}; Path=/; HttpOnly"
+                        ),
+                    },
+                )
+            else:
+                self._send(
+                    401,
+                    _LOGIN_FORM.format(
+                        message="<p>Invalid credentials.</p>"
+                    ).encode(),
+                )
+
+    return ThreadingHTTPServer(("0.0.0.0", port), Handler)
+
+
+def main(argv=None) -> int:
+    argv = strip_glog_args(list(sys.argv[1:] if argv is None else argv))
+    p = argparse.ArgumentParser(description="gatekeeper auth server")
+    p.add_argument("--port", type=int, default=8085)
+    p.add_argument("--secret-path", default=DEFAULT_SECRET_PATH)
+    args = p.parse_args(argv)
+
+    auth = AuthService.from_secret_dir(args.secret_path)
+    httpd = make_server(auth, args.port)
+    print(json.dumps({"msg": "gatekeeper up", "port": args.port,
+                      "user": auth.username}))
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,167 @@
+"""Mutating admission webhook: `python -m kubeflow_tpu.auth.webhook`.
+
+The gcp-admission-webhook analogue (components/gcp-admission-webhook/
+main.go:131-158, patch ops :51-53): pods labeled
+`kubeflow-tpu.org/cred-secret=<name>` get that Secret mounted plus
+GOOGLE_APPLICATION_CREDENTIALS pointed at it (the credentials-pod-preset
+surface); TPU-requesting containers get safe env defaults. Speaks the
+AdmissionReview v1 protocol on POST /mutate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import ssl
+import sys
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from kubeflow_tpu.runtime import strip_glog_args
+
+CRED_LABEL = "kubeflow-tpu.org/cred-secret"
+CRED_MOUNT_PATH = "/var/secrets/platform"
+CRED_VOLUME = "platform-creds"
+TPU_RESOURCE = "google.com/tpu"
+
+
+def _env_patch(container: dict, idx: int, name: str, value: str) -> list[dict]:
+    existing = container.get("env")
+    entry = {"name": name, "value": value}
+    if existing is None:
+        return [{"op": "add", "path": f"/spec/containers/{idx}/env",
+                 "value": [entry]}]
+    if any(e.get("name") == name for e in existing):
+        return []
+    return [{"op": "add", "path": f"/spec/containers/{idx}/env/-",
+             "value": entry}]
+
+
+def mutate_pod(pod: dict) -> list[dict]:
+    """JSONPatch ops for one pod (empty = no mutation)."""
+    patches: list[dict] = []
+    spec = pod.get("spec", {})
+    containers = spec.get("containers", [])
+    secret = pod.get("metadata", {}).get("labels", {}).get(CRED_LABEL)
+
+    if secret:
+        volumes = spec.get("volumes")
+        vol = {"name": CRED_VOLUME, "secret": {"secretName": secret}}
+        if volumes is None:
+            patches.append({"op": "add", "path": "/spec/volumes",
+                            "value": [vol]})
+        elif not any(v.get("name") == CRED_VOLUME for v in volumes):
+            patches.append({"op": "add", "path": "/spec/volumes/-",
+                            "value": vol})
+        for i, c in enumerate(containers):
+            mounts = c.get("volumeMounts")
+            mount = {"name": CRED_VOLUME, "mountPath": CRED_MOUNT_PATH,
+                     "readOnly": True}
+            if mounts is None:
+                patches.append({
+                    "op": "add",
+                    "path": f"/spec/containers/{i}/volumeMounts",
+                    "value": [mount],
+                })
+            elif not any(m.get("name") == CRED_VOLUME for m in mounts):
+                patches.append({
+                    "op": "add",
+                    "path": f"/spec/containers/{i}/volumeMounts/-",
+                    "value": mount,
+                })
+            patches.extend(_env_patch(
+                c, i, "GOOGLE_APPLICATION_CREDENTIALS",
+                f"{CRED_MOUNT_PATH}/key.json",
+            ))
+
+    # TPU env defaults for containers requesting chips.
+    for i, c in enumerate(containers):
+        limits = c.get("resources", {}).get("limits", {})
+        if TPU_RESOURCE in limits:
+            patches.extend(_env_patch(c, i, "TPU_MIN_LOG_LEVEL", "1"))
+            patches.extend(_env_patch(c, i, "JAX_PLATFORMS", "tpu,cpu"))
+    return patches
+
+
+def review_response(review: dict) -> dict:
+    """AdmissionReview request → AdmissionReview response."""
+    request = review.get("request", {})
+    uid = request.get("uid", "")
+    obj = request.get("object", {}) or {}
+    response: dict = {"uid": uid, "allowed": True}
+    if obj.get("kind", "Pod") == "Pod":
+        patches = mutate_pod(obj)
+        if patches:
+            response["patchType"] = "JSONPatch"
+            response["patch"] = base64.b64encode(
+                json.dumps(patches).encode()
+            ).decode()
+    return {
+        "apiVersion": review.get("apiVersion",
+                                 "admission.k8s.io/v1"),
+        "kind": "AdmissionReview",
+        "response": response,
+    }
+
+
+def make_server(port: int, *, certfile: str = "",
+                keyfile: str = "") -> ThreadingHTTPServer:
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _send(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path in ("/healthz", "/readyz"):
+                self._send(200, {"status": "ok"})
+            else:
+                self._send(404, {"error": "not found"})
+
+        def do_POST(self):
+            if self.path != "/mutate":
+                self._send(404, {"error": "not found"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                review = json.loads(self.rfile.read(length) or b"{}")
+                self._send(200, review_response(review))
+            except (ValueError, KeyError) as e:
+                self._send(400, {"error": str(e)})
+
+    httpd = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    if certfile and keyfile:
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(certfile, keyfile)
+        httpd.socket = ctx.wrap_socket(httpd.socket, server_side=True)
+    return httpd
+
+
+def main(argv=None) -> int:
+    argv = strip_glog_args(list(sys.argv[1:] if argv is None else argv))
+    p = argparse.ArgumentParser(description="mutating admission webhook")
+    p.add_argument("--port", type=int, default=8443)
+    p.add_argument("--tls-cert", default="",
+                   help="TLS cert path (with --tls-key; plain HTTP if unset)")
+    p.add_argument("--tls-key", default="")
+    args = p.parse_args(argv)
+
+    httpd = make_server(args.port, certfile=args.tls_cert,
+                        keyfile=args.tls_key)
+    print(json.dumps({"msg": "admission webhook up", "port": args.port,
+                      "tls": bool(args.tls_cert)}))
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

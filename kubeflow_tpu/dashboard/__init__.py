@@ -1,0 +1,130 @@
+"""Central dashboard: platform overview UI + JSON API.
+
+The centraldashboard analogue (components/centraldashboard/app/server.ts +
+k8s_service.ts): aggregates component links (Services carrying gateway-route
+annotations), training jobs, notebooks, and studies into one landing page.
+"""
+
+from __future__ import annotations
+
+import html
+from http.server import ThreadingHTTPServer
+
+from kubeflow_tpu.apis.jobs import ALL_JOB_KINDS, JOBS_API_VERSION
+from kubeflow_tpu.apis.notebooks import NOTEBOOK_KIND, NOTEBOOKS_API_VERSION
+from kubeflow_tpu.apis.tuning import STUDY_JOB_KIND, TUNING_API_VERSION
+from kubeflow_tpu.gateway import routes_from_service
+from kubeflow_tpu.k8s.client import ApiError, K8sClient
+from kubeflow_tpu.webapps import JsonHandler
+
+_PAGE = """<!doctype html>
+<html><head><title>kubeflow-tpu</title>
+<style>body{{font-family:sans-serif;margin:2rem}}table{{border-collapse:collapse}}
+td,th{{border:1px solid #ccc;padding:4px 10px}}</style></head>
+<body><h1>kubeflow-tpu</h1>
+<h2>Components</h2><ul>{components}</ul>
+<h2>Jobs</h2><table><tr><th>Kind</th><th>Name</th><th>Namespace</th>
+<th>State</th></tr>{jobs}</table>
+<h2>Notebooks</h2><table><tr><th>Name</th><th>Namespace</th><th>State</th>
+</tr>{notebooks}</table>
+<h2>Studies</h2><table><tr><th>Name</th><th>Namespace</th><th>State</th>
+<th>Best</th></tr>{studies}</table>
+</body></html>
+"""
+
+
+class Dashboard:
+    def __init__(self, client: K8sClient, namespace: str | None = None):
+        self.client = client
+        self.namespace = namespace
+
+    def _safe_list(self, api_version: str, kind: str) -> list[dict]:
+        try:
+            return self.client.list(api_version, kind, self.namespace)
+        except ApiError:
+            return []
+
+    def components(self) -> list[dict]:
+        out = []
+        for svc in self._safe_list("v1", "Service"):
+            for route in routes_from_service(svc):
+                out.append({"name": route.name, "prefix": route.prefix,
+                            "service": route.service})
+        return out
+
+    def jobs(self) -> list[dict]:
+        out = []
+        for kind in ALL_JOB_KINDS:
+            for job in self._safe_list(JOBS_API_VERSION, kind):
+                out.append({
+                    "kind": kind,
+                    "name": job["metadata"]["name"],
+                    "namespace": job["metadata"]["namespace"],
+                    "state": job.get("status", {}).get("state", "Unknown"),
+                })
+        return out
+
+    def notebooks(self) -> list[dict]:
+        return [{
+            "name": nb["metadata"]["name"],
+            "namespace": nb["metadata"]["namespace"],
+            "state": nb.get("status", {}).get("state", "Unknown"),
+        } for nb in self._safe_list(NOTEBOOKS_API_VERSION, NOTEBOOK_KIND)]
+
+    def studies(self) -> list[dict]:
+        return [{
+            "name": s["metadata"]["name"],
+            "namespace": s["metadata"]["namespace"],
+            "state": s.get("status", {}).get("state", "Unknown"),
+            "bestObjective": s.get("status", {}).get("bestObjective"),
+        } for s in self._safe_list(TUNING_API_VERSION, STUDY_JOB_KIND)]
+
+    def overview(self) -> dict:
+        return {
+            "components": self.components(),
+            "jobs": self.jobs(),
+            "notebooks": self.notebooks(),
+            "studies": self.studies(),
+        }
+
+    def render_html(self) -> str:
+        ov = self.overview()
+
+        def esc(v) -> str:
+            return html.escape(str(v))
+
+        components = "".join(
+            f"<li><a href=\"{esc(c['prefix'])}\">{esc(c['name'])}</a> "
+            f"→ {esc(c['service'])}</li>" for c in ov["components"]
+        ) or "<li>(none)</li>"
+        jobs = "".join(
+            f"<tr><td>{esc(j['kind'])}</td><td>{esc(j['name'])}</td>"
+            f"<td>{esc(j['namespace'])}</td><td>{esc(j['state'])}</td></tr>"
+            for j in ov["jobs"]
+        )
+        notebooks = "".join(
+            f"<tr><td>{esc(n['name'])}</td><td>{esc(n['namespace'])}</td>"
+            f"<td>{esc(n['state'])}</td></tr>" for n in ov["notebooks"]
+        )
+        studies = "".join(
+            f"<tr><td>{esc(s['name'])}</td><td>{esc(s['namespace'])}</td>"
+            f"<td>{esc(s['state'])}</td><td>{esc(s['bestObjective'])}</td>"
+            "</tr>" for s in ov["studies"]
+        )
+        return _PAGE.format(components=components, jobs=jobs,
+                            notebooks=notebooks, studies=studies)
+
+
+def make_server(dash: Dashboard, port: int) -> ThreadingHTTPServer:
+    class Handler(JsonHandler):
+        def do_GET(self):
+            if self.path in ("/healthz", "/readyz"):
+                self.send_json(200, {"status": "ok"})
+            elif self.path == "/api/overview":
+                self.send_json(200, dash.overview())
+            elif self.path in ("/", "/index.html"):
+                self.send_html(200, dash.render_html())
+            else:
+                self.send_json(404, {"error": f"no route {self.path}"})
+
+    return ThreadingHTTPServer(("0.0.0.0", port), Handler)

@@ -1,0 +1,281 @@
+"""API gateway: annotation-discovered reverse proxy.
+
+The ambassador analogue (kubeflow/common/ambassador.libsonnet:7-226): every
+platform Service that wants routing carries a
+`kubeflow-tpu.org/gateway-route` annotation (the `getambassador.io/config`
+pattern — route spec {name, prefix, service, rewrite}); the gateway watches
+Services, keeps a longest-prefix route table, and proxies requests to the
+backing service. Optional forward-auth: every request is checked against the
+gatekeeper's /auth endpoint first (the IAP/basic-auth ingress role,
+kubeflow/common/basic-auth.libsonnet).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import urllib.error
+import urllib.request
+from dataclasses import dataclass
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
+
+import yaml
+
+from kubeflow_tpu.k8s.client import K8sClient
+from kubeflow_tpu.manifests.core import GATEWAY_ROUTE_ANNOTATION
+
+log = logging.getLogger(__name__)
+
+# Hop-by-hop headers never forwarded (RFC 7230 §6.1).
+_HOP_HEADERS = {
+    "connection", "keep-alive", "proxy-authenticate",
+    "proxy-authorization", "te", "trailers", "transfer-encoding", "upgrade",
+    "host", "content-length",
+}
+
+
+@dataclass(frozen=True)
+class Route:
+    name: str
+    prefix: str
+    service: str  # host:port
+    rewrite: str = "/"
+
+    def target_for(self, path: str) -> str:
+        """Rewrite `path` (which startswith prefix) onto the backend."""
+        rest = path[len(self.prefix):]
+        base = self.rewrite if self.rewrite.endswith("/") else self.rewrite + "/"
+        return "http://" + self.service + base + rest.lstrip("/")
+
+
+def routes_from_service(svc: dict) -> list[Route]:
+    raw = svc.get("metadata", {}).get("annotations", {}).get(
+        GATEWAY_ROUTE_ANNOTATION
+    )
+    if not raw:
+        return []
+    try:
+        specs = yaml.safe_load(raw)
+    except yaml.YAMLError:
+        log.warning("bad route annotation on %s",
+                    svc["metadata"].get("name"))
+        return []
+    if isinstance(specs, dict):
+        specs = [specs]
+    routes = []
+    for spec in specs or []:
+        try:
+            routes.append(Route(
+                name=spec["name"], prefix=spec["prefix"],
+                service=spec["service"], rewrite=spec.get("rewrite", "/"),
+            ))
+        except (KeyError, TypeError):
+            log.warning("incomplete route spec in %s",
+                        svc["metadata"].get("name"))
+    return routes
+
+
+class RouteTable:
+    """Longest-prefix route lookup, refreshed from Service annotations."""
+
+    def __init__(self) -> None:
+        self._routes: list[Route] = []
+        self._lock = threading.Lock()
+
+    def set_routes(self, routes: list[Route]) -> None:
+        with self._lock:
+            self._routes = sorted(routes, key=lambda r: -len(r.prefix))
+
+    def refresh(self, client: K8sClient, namespace: str | None = None) -> int:
+        routes = []
+        for svc in client.list("v1", "Service", namespace):
+            routes.extend(routes_from_service(svc))
+        self.set_routes(routes)
+        return len(routes)
+
+    def match(self, path: str) -> Route | None:
+        with self._lock:
+            for r in self._routes:
+                if path.startswith(r.prefix):
+                    return r
+        return None
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            return [vars(r) for r in self._routes]
+
+
+class Gateway:
+    """The proxy + admin servers.
+
+    ``resolve`` maps a route's `host:port` service address to the address to
+    actually dial — identity in-cluster, overridden in tests to point at
+    local fixture backends.
+    """
+
+    def __init__(
+        self,
+        table: RouteTable,
+        *,
+        port: int = 8080,
+        admin_port: int = 8877,
+        auth_url: str = "",
+        resolve: Callable[[str], str] | None = None,
+    ):
+        self.table = table
+        self.port = port
+        self.admin_port = admin_port
+        self.auth_url = auth_url
+        self.resolve = resolve or (lambda addr: addr)
+        self.requests_total = 0
+        self.errors_total = 0
+        self._proxy: ThreadingHTTPServer | None = None
+        self._admin: ThreadingHTTPServer | None = None
+
+    # -- auth ---------------------------------------------------------------
+
+    def _authorized(self, handler: BaseHTTPRequestHandler) -> bool:
+        if not self.auth_url:
+            return True
+        req = urllib.request.Request(self.auth_url, method="GET")
+        cookie = handler.headers.get("Cookie")
+        if cookie:
+            req.add_header("Cookie", cookie)
+        auth = handler.headers.get("Authorization")
+        if auth:
+            req.add_header("Authorization", auth)
+        try:
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                return 200 <= resp.status < 300
+        except urllib.error.HTTPError:
+            return False
+        except OSError:
+            return False
+
+    # -- proxy --------------------------------------------------------------
+
+    def _make_proxy_handler(gw: "Gateway"):
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _respond(self, code: int, body: bytes,
+                         headers: dict | None = None) -> None:
+                self.send_response(code)
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                if headers is None or "Content-Type" not in headers:
+                    self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _handle(self):
+                gw.requests_total += 1
+                if self.path == "/healthz":
+                    self._respond(200, b'{"status":"ok"}')
+                    return
+                route = gw.table.match(self.path)
+                if route is None:
+                    gw.errors_total += 1
+                    self._respond(
+                        404,
+                        json.dumps({"error": f"no route for {self.path}"})
+                        .encode(),
+                    )
+                    return
+                if not gw._authorized(self):
+                    self._respond(
+                        401, json.dumps({"error": "unauthorized",
+                                         "login": "/login"}).encode(),
+                    )
+                    return
+                target = route.target_for(self.path)
+                # Re-point at the resolved backend address.
+                target = target.replace(route.service,
+                                        gw.resolve(route.service), 1)
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length) if length else None
+                req = urllib.request.Request(
+                    target, data=body, method=self.command,
+                )
+                for k, v in self.headers.items():
+                    if k.lower() not in _HOP_HEADERS:
+                        req.add_header(k, v)
+                req.add_header("X-Forwarded-Prefix", route.prefix)
+                try:
+                    with urllib.request.urlopen(req, timeout=60) as resp:
+                        payload = resp.read()
+                        headers = {
+                            k: v for k, v in resp.headers.items()
+                            if k.lower() not in _HOP_HEADERS
+                        }
+                        self._respond(resp.status, payload, headers)
+                except urllib.error.HTTPError as e:
+                    self._respond(e.code, e.read(),
+                                  {"Content-Type": e.headers.get(
+                                      "Content-Type", "application/json")})
+                except OSError as e:
+                    gw.errors_total += 1
+                    self._respond(
+                        502,
+                        json.dumps({"error": f"upstream {route.service}: {e}"})
+                        .encode(),
+                    )
+
+            do_GET = do_POST = do_PUT = do_PATCH = do_DELETE = _handle
+
+        return Handler
+
+    def _make_admin_handler(gw: "Gateway"):
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path == "/routes":
+                    body = json.dumps(gw.table.snapshot()).encode()
+                    ctype = "application/json"
+                elif self.path == "/metrics":
+                    body = (
+                        "# TYPE gateway_requests_total counter\n"
+                        f"gateway_requests_total {gw.requests_total}\n"
+                        "# TYPE gateway_errors_total counter\n"
+                        f"gateway_errors_total {gw.errors_total}\n"
+                    ).encode()
+                    ctype = "text/plain"
+                elif self.path in ("/healthz", "/readyz"):
+                    body, ctype = b'{"status":"ok"}', "application/json"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        return Handler
+
+    def start(self) -> None:
+        self._proxy = ThreadingHTTPServer(
+            ("0.0.0.0", self.port), self._make_proxy_handler()
+        )
+        threading.Thread(target=self._proxy.serve_forever,
+                         daemon=True).start()
+        if self.admin_port:
+            self._admin = ThreadingHTTPServer(
+                ("0.0.0.0", self.admin_port), self._make_admin_handler()
+            )
+            threading.Thread(target=self._admin.serve_forever,
+                             daemon=True).start()
+
+    def stop(self) -> None:
+        for httpd in (self._proxy, self._admin):
+            if httpd:
+                httpd.shutdown()

@@ -1,0 +1,51 @@
+"""Gateway entrypoint: `python -m kubeflow_tpu.gateway --port=8080
+--admin-port=8877 --namespace=kubeflow` (the ambassador Deployment command,
+kubeflow/common/ambassador.libsonnet)."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+import time
+
+from kubeflow_tpu.gateway import Gateway, RouteTable
+from kubeflow_tpu.runtime import add_client_args, client_from_args, strip_glog_args
+
+log = logging.getLogger(__name__)
+
+
+def main(argv=None) -> int:
+    argv = strip_glog_args(list(sys.argv[1:] if argv is None else argv))
+    p = argparse.ArgumentParser(description="kubeflow-tpu API gateway")
+    add_client_args(p)
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--admin-port", type=int, default=8877)
+    p.add_argument("--auth-url", default="",
+                   help="forward-auth check endpoint (gatekeeper /auth); "
+                        "empty = no auth")
+    p.add_argument("--refresh-seconds", type=float, default=15.0)
+    args = p.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    client = client_from_args(args)
+    table = RouteTable()
+    gw = Gateway(table, port=args.port, admin_port=args.admin_port,
+                 auth_url=args.auth_url)
+    gw.start()
+    log.info("gateway on :%d (admin :%d)", args.port, args.admin_port)
+    try:
+        while True:
+            try:
+                n = table.refresh(client, args.namespace)
+                log.debug("route table refreshed: %d routes", n)
+            except Exception as e:  # keep serving on apiserver blips
+                log.warning("route refresh failed: %s", e)
+            time.sleep(args.refresh_seconds)
+    except KeyboardInterrupt:
+        gw.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

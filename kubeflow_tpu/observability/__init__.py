@@ -1,0 +1,2 @@
+"""Observability runtimes: availability prober (metric-collector analogue,
+metric-collector/service-readiness/kubeflow-readiness.py)."""

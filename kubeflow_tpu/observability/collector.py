@@ -1,0 +1,122 @@
+"""Availability prober: `python -m kubeflow_tpu.observability.collector`.
+
+Probes a platform endpoint on an interval and exports the
+`kubeflow_availability` prometheus gauge on :8000 — the metric-collector
+contract (metric-collector/service-readiness/kubeflow-readiness.py:21-37,
+deployed by kubeflow/gcp/prototypes/metric-collector.jsonnet).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from kubeflow_tpu.runtime import strip_glog_args
+
+log = logging.getLogger(__name__)
+
+
+class AvailabilityProber:
+    def __init__(self, target_url: str, interval: float = 30.0,
+                 timeout: float = 10.0):
+        self.target_url = target_url
+        self.interval = interval
+        self.timeout = timeout
+        self.available = 0
+        self.probes_total = 0
+        self.failures_total = 0
+        self._stop = threading.Event()
+
+    def probe_once(self) -> bool:
+        self.probes_total += 1
+        try:
+            with urllib.request.urlopen(self.target_url,
+                                        timeout=self.timeout) as resp:
+                ok = 200 <= resp.status < 400
+        except (urllib.error.URLError, OSError, ValueError):
+            ok = False
+        self.available = int(ok)
+        if not ok:
+            self.failures_total += 1
+        return ok
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            ok = self.probe_once()
+            log.info("probe %s: %s", self.target_url,
+                     "up" if ok else "DOWN")
+            self._stop.wait(self.interval)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def render_metrics(self) -> str:
+        return (
+            "# TYPE kubeflow_availability gauge\n"
+            f"kubeflow_availability {self.available}\n"
+            "# TYPE kubeflow_availability_probes_total counter\n"
+            f"kubeflow_availability_probes_total {self.probes_total}\n"
+            "# TYPE kubeflow_availability_failures_total counter\n"
+            f"kubeflow_availability_failures_total {self.failures_total}\n"
+        )
+
+
+def make_server(prober: AvailabilityProber, port: int) -> ThreadingHTTPServer:
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            if self.path == "/metrics":
+                body = prober.render_metrics().encode()
+            elif self.path in ("/healthz", "/readyz"):
+                body = b'{"status":"ok"}'
+            else:
+                self.send_response(404)
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    return ThreadingHTTPServer(("0.0.0.0", port), Handler)
+
+
+def main(argv=None) -> int:
+    argv = strip_glog_args(list(sys.argv[1:] if argv is None else argv))
+    p = argparse.ArgumentParser(description="kubeflow availability prober")
+    p.add_argument("--target-url", required=True)
+    p.add_argument("--interval", type=float, default=30.0)
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--once", action="store_true",
+                   help="probe once, print the gauge, exit 0/1")
+    args = p.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    prober = AvailabilityProber(args.target_url, args.interval)
+    if args.once:
+        ok = prober.probe_once()
+        print(prober.render_metrics(), end="")
+        return 0 if ok else 1
+    httpd = make_server(prober, args.port)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        prober.run()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        prober.stop()
+        httpd.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,39 @@
+"""Training-operator manager: `python -m kubeflow_tpu.operators`.
+
+The binary the training-operator Deployment runs (the
+`/opt/kubeflow/tf-operator.v1beta2` analogue,
+kubeflow/tf-training/tf-job-operator.libsonnet:99-143). Runs the job
+controllers for all six kinds plus the notebook/profile/study/benchmark
+controllers in one manager process, watching the in-cluster apiserver.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.runtime import controller_main
+
+
+def make_all_controllers(client):
+    from kubeflow_tpu.benchmark.controller import BenchmarkJobController
+    from kubeflow_tpu.operators.jobs import make_job_controllers
+    from kubeflow_tpu.operators.notebooks import NotebookController
+    from kubeflow_tpu.operators.profiles import ProfileController
+    from kubeflow_tpu.tuning.controller import StudyJobController
+
+    return [
+        *make_job_controllers(client),
+        NotebookController(client),
+        ProfileController(client),
+        StudyJobController(client),
+        BenchmarkJobController(client),
+    ]
+
+
+def main(argv=None) -> int:
+    return controller_main(
+        argv, make_all_controllers,
+        "kubeflow-tpu training-operator manager (all controllers)",
+    )
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,20 @@
+"""BenchmarkJob-controller entrypoint:
+`python -m kubeflow_tpu.operators.benchmark` (the kubebench-operator,
+kubeflow/kubebench/prototypes/kubebench-operator.jsonnet)."""
+
+from __future__ import annotations
+
+from kubeflow_tpu.runtime import controller_main
+
+
+def main(argv=None) -> int:
+    from kubeflow_tpu.benchmark.controller import BenchmarkJobController
+
+    return controller_main(
+        argv, lambda client: [BenchmarkJobController(client)],
+        "kubeflow-tpu benchmark controller",
+    )
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

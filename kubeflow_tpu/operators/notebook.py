@@ -1,0 +1,20 @@
+"""Notebook-controller entrypoint: `python -m kubeflow_tpu.operators.notebook`
+(the notebook-controller manager binary,
+components/notebook-controller/cmd/manager)."""
+
+from __future__ import annotations
+
+from kubeflow_tpu.runtime import controller_main
+
+
+def main(argv=None) -> int:
+    from kubeflow_tpu.operators.notebooks import NotebookController
+
+    return controller_main(
+        argv, lambda client: [NotebookController(client)],
+        "kubeflow-tpu notebook controller",
+    )
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

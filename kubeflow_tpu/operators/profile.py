@@ -1,0 +1,19 @@
+"""Profile-controller entrypoint: `python -m kubeflow_tpu.operators.profile`
+(the profile-controller manager binary, components/profile-controller)."""
+
+from __future__ import annotations
+
+from kubeflow_tpu.runtime import controller_main
+
+
+def main(argv=None) -> int:
+    from kubeflow_tpu.operators.profiles import ProfileController
+
+    return controller_main(
+        argv, lambda client: [ProfileController(client)],
+        "kubeflow-tpu profile controller",
+    )
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

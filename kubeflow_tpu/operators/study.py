@@ -1,0 +1,20 @@
+"""StudyJob-controller entrypoint: `python -m kubeflow_tpu.operators.study`
+(the studyjob-controller Deployment,
+kubeflow/katib/studyjobcontroller.libsonnet:14-147)."""
+
+from __future__ import annotations
+
+from kubeflow_tpu.runtime import controller_main
+
+
+def main(argv=None) -> int:
+    from kubeflow_tpu.tuning.controller import StudyJobController
+
+    return controller_main(
+        argv, lambda client: [StudyJobController(client)],
+        "kubeflow-tpu studyjob controller",
+    )
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,200 @@
+"""Shared container-entrypoint runtime.
+
+Every `python -m kubeflow_tpu.*` binary the manifests reference (controller
+managers, web apps, the gateway) builds its apiserver client and serves its
+health/metrics port through here — the role cobra/viper + controller-runtime
+manager setup plays for the reference's Go binaries
+(bootstrap/cmd/kfctl/cmd/root.go:23-40; operator manager flags at
+kubeflow/tf-training/tf-job-operator.libsonnet:99-143).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Iterable
+
+from kubeflow_tpu.k8s.client import (
+    ClusterConfig,
+    HttpK8sClient,
+    K8sClient,
+    KindRegistry,
+)
+
+log = logging.getLogger(__name__)
+
+IN_CLUSTER_TOKEN = "/var/run/secrets/kubernetes.io/serviceaccount/token"
+IN_CLUSTER_CA = "/var/run/secrets/kubernetes.io/serviceaccount/ca.crt"
+
+
+def strip_glog_args(argv: list[str]) -> list[str]:
+    """Drop glog-style flags the reference's operator deployments pass
+    (`--alsologtostderr -v=1`, tf-job-operator.libsonnet:101-103) so argparse
+    entrypoints accept the same manifest args."""
+    out = []
+    for a in argv:
+        if a == "--alsologtostderr" or a.startswith(("-v=", "--v=")):
+            continue
+        out.append(a)
+    return out
+
+
+def add_client_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "--apiserver",
+        default=os.environ.get("KUBEFLOW_TPU_APISERVER", ""),
+        help="apiserver URL; empty = in-cluster config, falling back to "
+             "the kubectl-proxy default http://127.0.0.1:8001",
+    )
+    p.add_argument("--token-path", default="",
+                   help="bearer token file (default: in-cluster SA token)")
+    p.add_argument("--namespace", default=os.environ.get(
+        "KUBEFLOW_TPU_NAMESPACE", "kubeflow"))
+
+
+def cluster_config_from_args(args) -> ClusterConfig:
+    host = args.apiserver
+    token = None
+    verify: bool | str = True
+    token_path = args.token_path or (
+        IN_CLUSTER_TOKEN if os.path.exists(IN_CLUSTER_TOKEN) else ""
+    )
+    if token_path and os.path.exists(token_path):
+        with open(token_path) as f:
+            token = f.read().strip()
+    if not host:
+        k8s_host = os.environ.get("KUBERNETES_SERVICE_HOST")
+        if k8s_host:
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            host = f"https://{k8s_host}:{port}"
+            if os.path.exists(IN_CLUSTER_CA):
+                verify = IN_CLUSTER_CA
+        else:
+            host = "http://127.0.0.1:8001"
+    return ClusterConfig(host=host, token=token, verify=verify)
+
+
+def platform_registry() -> KindRegistry:
+    """KindRegistry pre-loaded with every platform CRD kind, so entrypoints
+    can resolve REST paths without a discovery round-trip."""
+    from kubeflow_tpu.apis.benchmark import benchmark_job_crd
+    from kubeflow_tpu.apis.jobs import all_job_crds
+    from kubeflow_tpu.apis.notebooks import notebook_crd
+    from kubeflow_tpu.apis.profiles import profile_crd
+    from kubeflow_tpu.apis.tuning import study_job_crd
+
+    registry = KindRegistry()
+    for crd in [*all_job_crds(), notebook_crd(), profile_crd(),
+                study_job_crd(), benchmark_job_crd()]:
+        registry.register_crd(crd)
+    return registry
+
+
+def client_from_args(args) -> K8sClient:
+    return HttpK8sClient(cluster_config_from_args(args),
+                         registry=platform_registry())
+
+
+class HealthServer:
+    """`/healthz` + `/metrics` sidecar port every manager binary exposes (the
+    promhttp `/metrics` contract, bootstrap/cmd/bootstrap/app/ksServer.go:1460).
+    """
+
+    def __init__(self, port: int, metrics_fn: Callable[[], dict] | None = None):
+        self.port = port
+        self._metrics_fn = metrics_fn or (lambda: {})
+        self._httpd: ThreadingHTTPServer | None = None
+
+    def start(self) -> None:
+        metrics_fn = self._metrics_fn
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path in ("/healthz", "/readyz", "/livez"):
+                    body, ctype = b'{"status":"ok"}', "application/json"
+                elif self.path == "/metrics":
+                    lines = []
+                    for k, v in metrics_fn().items():
+                        lines.append(f"# TYPE {k} counter")
+                        lines.append(f"{k} {v}")
+                    body = ("\n".join(lines) + "\n").encode()
+                    ctype = "text/plain"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", self.port), Handler)
+        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+
+
+def controller_main(
+    argv,
+    make_controllers: Callable[[K8sClient], Iterable],
+    description: str,
+    *,
+    default_metrics_port: int = 8443,
+) -> int:
+    """Shared main for every controller-manager entrypoint: build the client,
+    construct controllers, run watch loops until signalled (or one pass with
+    ``--once``, the mode tests and one-shot reconcile jobs use)."""
+    import sys
+
+    argv = strip_glog_args(list(sys.argv[1:] if argv is None else argv))
+    p = argparse.ArgumentParser(description=description)
+    add_client_args(p)
+    p.add_argument("--once", action="store_true",
+                   help="single reconcile pass over all objects, then exit")
+    p.add_argument("--metrics-port", type=int, default=default_metrics_port,
+                   help="health/metrics port (0 = disabled)")
+    args = p.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    client = client_from_args(args)
+    controllers = list(make_controllers(client))
+
+    if args.once:
+        total = sum(c.reconcile_all() for c in controllers)
+        print(json.dumps({"reconciled": total,
+                          "controllers": len(controllers)}))
+        return 0
+
+    from kubeflow_tpu.operators.base import run_controllers
+
+    health = None
+    if args.metrics_port:
+        counts = {"kubeflow_tpu_controllers_running": len(controllers)}
+        health = HealthServer(args.metrics_port, lambda: counts)
+        health.start()
+    threads = run_controllers(controllers)
+    log.info("running %d controllers: %s", len(controllers),
+             ", ".join(c.kind for c in controllers))
+    try:
+        for t in threads:
+            t.join()
+    except KeyboardInterrupt:
+        for c in controllers:
+            c.stop()
+    finally:
+        if health:
+            health.stop()
+    return 0

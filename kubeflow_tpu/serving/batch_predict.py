@@ -1,0 +1,97 @@
+"""Batch prediction job: `python -m kubeflow_tpu.serving.batch_predict`.
+
+The tf-batch-predict analogue (kubeflow/tf-batch-predict/
+tf-batch-predict.libsonnet): read JSONL instances, run them through the
+inference engine in server-batch-size chunks, write JSONL predictions.
+Runs as a K8s Job (restartPolicy Never, backoffLimit in the manifest).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from kubeflow_tpu.runtime import strip_glog_args
+
+
+def run_batch_predict(engine, input_path: str, output_path: str,
+                      batch_size: int, *, log=print) -> dict:
+    total = errors = 0
+    with open(input_path) as fin, open(output_path, "w") as fout:
+        chunk: list[dict] = []
+        lines: list[int] = []
+
+        def flush():
+            nonlocal total, errors
+            if not chunk:
+                return
+            try:
+                for inst in chunk:
+                    engine.validate_instance(inst)
+                preds = engine.predict_batch(chunk)
+            except ValueError:
+                # Fall back to per-instance so one bad row doesn't kill the
+                # whole chunk.
+                preds = []
+                for inst in chunk:
+                    try:
+                        engine.validate_instance(inst)
+                        preds.extend(engine.predict_batch([inst]))
+                    except ValueError as e_one:
+                        preds.append({"error": str(e_one)})
+                        errors += 1
+            for line_no, pred in zip(lines, preds):
+                fout.write(json.dumps({"line": line_no, **pred}) + "\n")
+            total += len(chunk)
+            chunk.clear()
+            lines.clear()
+
+        for i, line in enumerate(fin):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                chunk.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                fout.write(json.dumps({"line": i, "error": str(e)}) + "\n")
+                errors += 1
+                continue
+            lines.append(i)
+            if len(chunk) >= batch_size:
+                flush()
+        flush()
+    summary = {"instances": total, "errors": errors,
+               "output_path": output_path}
+    log(f"batch predict done: {json.dumps(summary)}")
+    return summary
+
+
+def main(argv=None) -> int:
+    argv = strip_glog_args(list(sys.argv[1:] if argv is None else argv))
+    p = argparse.ArgumentParser(description="batch prediction job")
+    p.add_argument("--model-name", default="lm-test-tiny",
+                   help="registry model name")
+    p.add_argument("--model-path", default="",
+                   help="checkpoint dir (empty = fresh init)")
+    p.add_argument("--input-path", required=True, help="JSONL instances")
+    p.add_argument("--output-path", required=True, help="JSONL predictions")
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--max-seq-len", type=int, default=128)
+    args = p.parse_args(argv)
+
+    from kubeflow_tpu.serving.engine import EngineConfig, InferenceEngine
+
+    engine = InferenceEngine(EngineConfig(
+        model=args.model_name,
+        checkpoint_dir=args.model_path or None,
+        batch_size=args.batch_size,
+        max_seq_len=args.max_seq_len,
+    ))
+    run_batch_predict(engine, args.input_path, args.output_path,
+                      args.batch_size)
+    return 0  # bad rows are recorded in the output, not fatal
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,104 @@
+"""Suggestion service: `python -m kubeflow_tpu.tuning.service`.
+
+The vizier-core analogue (manager Service on :6789,
+kubeflow/katib/vizier.libsonnet:28-380) as a REST JSON service over the
+in-repo suggestion algorithms (random/grid/hyperband/bayesianoptimization,
+suggestion.libsonnet:3-10 surface):
+
+- ``POST /api/suggestions``  {"algorithm": ..., "parameters": [...],
+  "observations": [{"assignments": {...}, "objective": ...}], "count": N}
+  → {"suggestions": [{...}, ...]}
+- ``GET /api/algorithms``    available algorithm names
+- ``GET /healthz``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from kubeflow_tpu.runtime import strip_glog_args
+from kubeflow_tpu.tuning.suggestions import (
+    _ALGORITHMS,
+    Observation,
+    domains_from_spec,
+    get_algorithm,
+)
+
+
+def suggest(body: dict, default_algorithm: str = "random") -> dict:
+    algorithm = body.get("algorithm", default_algorithm)
+    parameters = body.get("parameters", [])
+    if not parameters:
+        raise ValueError("'parameters' must be a non-empty list")
+    count = int(body.get("count", 1))
+    domains = domains_from_spec(parameters)
+    algo = get_algorithm(algorithm, domains, seed=int(body.get("seed", 0)))
+    observations = [
+        Observation(o["assignments"], float(o["objective"]))
+        for o in body.get("observations", [])
+    ]
+    suggestions = []
+    for _ in range(count):
+        nxt = algo.next(observations)
+        if nxt is None:
+            break
+        suggestions.append(nxt)
+    return {"algorithm": algorithm, "suggestions": suggestions}
+
+
+def make_server(port: int, default_algorithm: str) -> ThreadingHTTPServer:
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _send(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path in ("/healthz", "/readyz"):
+                self._send(200, {"status": "ok"})
+            elif self.path == "/api/algorithms":
+                self._send(200, {"algorithms": sorted(_ALGORITHMS)})
+            else:
+                self._send(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            if self.path != "/api/suggestions":
+                self._send(404, {"error": f"no route {self.path}"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                self._send(200, suggest(body, default_algorithm))
+            except (ValueError, KeyError, TypeError) as e:
+                self._send(400, {"error": str(e)})
+
+    return ThreadingHTTPServer(("0.0.0.0", port), Handler)
+
+
+def main(argv=None) -> int:
+    argv = strip_glog_args(list(sys.argv[1:] if argv is None else argv))
+    p = argparse.ArgumentParser(description="HP suggestion service")
+    p.add_argument("--algorithm", default="random",
+                   help="default algorithm when a request names none")
+    p.add_argument("--port", type=int, default=6789)
+    args = p.parse_args(argv)
+    httpd = make_server(args.port, args.algorithm)
+    print(f"suggestion service ({args.algorithm}) on :{args.port}")
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,2 @@
+"""Small infra runtimes: echo server, usage reporter
+(components/echo-server, kubeflow/common/spartakus.libsonnet analogues)."""

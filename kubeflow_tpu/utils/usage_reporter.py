@@ -1,0 +1,82 @@
+"""Anonymous usage reporter: `python -m kubeflow_tpu.utils.usage_reporter`.
+
+The spartakus analogue (kubeflow/common/spartakus.libsonnet:1-122,
+opt-out warning at coordinator.usageReportWarn, coordinator.go:201). Reports
+an anonymous cluster id + platform version on an interval. Disabled reporting
+(`--enabled=false`) still runs the loop but only logs locally — the container
+stays healthy either way, and nothing is ever sent unless a report URL is
+explicitly configured.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+import time
+import urllib.request
+
+from kubeflow_tpu.runtime import strip_glog_args
+from kubeflow_tpu.version import __version__
+
+log = logging.getLogger(__name__)
+
+
+def build_report(usage_id: str) -> dict:
+    return {
+        "usage_id": usage_id,
+        "platform": "kubeflow-tpu",
+        "version": __version__,
+        "timestamp": int(time.time()),
+    }
+
+
+def report_once(usage_id: str, enabled: bool, report_url: str,
+                *, log_fn=log.info) -> bool:
+    report = build_report(usage_id)
+    if not enabled or not report_url:
+        log_fn("usage reporting disabled; report (not sent): %s",
+               json.dumps(report))
+        return False
+    try:
+        req = urllib.request.Request(
+            report_url, json.dumps(report).encode(),
+            {"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            ok = 200 <= resp.status < 300
+    except OSError as e:
+        log_fn("usage report failed: %s", e)
+        return False
+    return ok
+
+
+def main(argv=None) -> int:
+    argv = strip_glog_args(list(sys.argv[1:] if argv is None else argv))
+    p = argparse.ArgumentParser(description="anonymous usage reporter")
+    p.add_argument("--usage-id", default="unknown")
+    p.add_argument("--enabled", default="false",
+                   help="true/false — off by default (opt-in)")
+    p.add_argument("--report-url", default="",
+                   help="endpoint to POST reports to (empty = log only)")
+    p.add_argument("--interval", type=float, default=3600.0)
+    p.add_argument("--once", action="store_true")
+    args = p.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    enabled = str(args.enabled).lower() in ("true", "1", "yes")
+    if args.once:
+        report_once(args.usage_id, enabled, args.report_url)
+        return 0
+    try:
+        while True:
+            report_once(args.usage_id, enabled, args.report_url)
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,37 @@
+"""Web apps: CRUD UIs over the platform CRDs (jupyter-web-app and the study
+UI — components/jupyter-web-app/default/routes.py:33-168,
+kubeflow/katib UI analogues). Served from the same http.server runtime as the
+rest of the platform; each app exposes a JSON API plus a minimal HTML shell.
+"""
+
+from __future__ import annotations
+
+import json
+from http.server import BaseHTTPRequestHandler
+
+
+class JsonHandler(BaseHTTPRequestHandler):
+    """Shared helpers for JSON web-app handlers."""
+
+    def log_message(self, *a):
+        pass
+
+    def send_json(self, code: int, payload) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def send_html(self, code: int, html: str) -> None:
+        body = html.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "text/html; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def read_json(self) -> dict:
+        length = int(self.headers.get("Content-Length", 0))
+        return json.loads(self.rfile.read(length) or b"{}")

@@ -1,0 +1,148 @@
+"""Study web app: `python -m kubeflow_tpu.webapps.study`.
+
+The Katib UI analogue (kubeflow/katib vizier UI surface): list studies with
+trial progress and best objective, inspect one study's trials, create/delete
+studies.
+
+- ``GET    /api/namespaces/<ns>/studies``          list with summary
+- ``POST   /api/namespaces/<ns>/studies``          create a StudyJob CR
+- ``GET    /api/namespaces/<ns>/studies/<name>``   detail incl. trials
+- ``DELETE /api/namespaces/<ns>/studies/<name>``   delete
+- ``GET    /healthz``
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+from http.server import ThreadingHTTPServer
+
+from kubeflow_tpu.apis.tuning import STUDY_JOB_KIND, TUNING_API_VERSION
+from kubeflow_tpu.k8s.client import ApiError, K8sClient
+from kubeflow_tpu.runtime import add_client_args, client_from_args, strip_glog_args
+from kubeflow_tpu.webapps import JsonHandler
+
+_RE_LIST = re.compile(r"^/api/namespaces/([^/]+)/studies/?$")
+_RE_ITEM = re.compile(r"^/api/namespaces/([^/]+)/studies/([^/]+)$")
+
+
+class StudyApp:
+    def __init__(self, client: K8sClient):
+        self.client = client
+
+    def list_studies(self, namespace: str) -> list[dict]:
+        return [self._summary(s) for s in self.client.list(
+            TUNING_API_VERSION, STUDY_JOB_KIND, namespace)]
+
+    @staticmethod
+    def _summary(study: dict) -> dict:
+        status = study.get("status", {})
+        return {
+            "name": study["metadata"]["name"],
+            "namespace": study["metadata"]["namespace"],
+            "algorithm": study["spec"].get("algorithm", "random"),
+            "state": status.get("state", "Unknown"),
+            "trials": len(status.get("trials", [])),
+            "bestObjective": status.get("bestObjective"),
+            "bestAssignments": status.get("bestAssignments"),
+        }
+
+    def get_study(self, namespace: str, name: str) -> dict:
+        study = self.client.get(TUNING_API_VERSION, STUDY_JOB_KIND, name,
+                                namespace)
+        detail = self._summary(study)
+        detail["parameters"] = study["spec"].get("parameters", [])
+        detail["trialList"] = study.get("status", {}).get("trials", [])
+        return detail
+
+    def create_study(self, namespace: str, body: dict) -> dict:
+        name = body.get("name") or body.get("metadata", {}).get("name")
+        if not name:
+            raise ValueError("study needs a name")
+        spec = body.get("spec") or {
+            k: v for k, v in body.items() if k != "name"
+        }
+        if "parameters" not in spec or "trialTemplate" not in spec:
+            raise ValueError("spec needs 'parameters' and 'trialTemplate'")
+        return self.client.create({
+            "apiVersion": TUNING_API_VERSION,
+            "kind": STUDY_JOB_KIND,
+            "metadata": {"name": name, "namespace": namespace},
+            "spec": spec,
+        })
+
+    def delete_study(self, namespace: str, name: str) -> None:
+        self.client.delete(TUNING_API_VERSION, STUDY_JOB_KIND, name,
+                           namespace)
+
+
+def make_server(app: StudyApp, port: int) -> ThreadingHTTPServer:
+    class Handler(JsonHandler):
+        def do_GET(self):
+            if self.path in ("/healthz", "/readyz"):
+                self.send_json(200, {"status": "ok"})
+                return
+            m = _RE_ITEM.match(self.path)
+            if m:
+                try:
+                    self.send_json(200, app.get_study(m.group(1),
+                                                      m.group(2)))
+                except ApiError as e:
+                    self.send_json(e.code, {"error": str(e)})
+                return
+            m = _RE_LIST.match(self.path)
+            if m:
+                try:
+                    self.send_json(200,
+                                   {"studies": app.list_studies(m.group(1))})
+                except ApiError as e:
+                    self.send_json(e.code, {"error": str(e)})
+                return
+            self.send_json(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            m = _RE_LIST.match(self.path)
+            if not m:
+                self.send_json(404, {"error": f"no route {self.path}"})
+                return
+            try:
+                created = app.create_study(m.group(1), self.read_json())
+                self.send_json(201, {"name": created["metadata"]["name"]})
+            except ValueError as e:
+                self.send_json(400, {"error": str(e)})
+            except ApiError as e:
+                self.send_json(e.code, {"error": str(e)})
+
+        def do_DELETE(self):
+            m = _RE_ITEM.match(self.path)
+            if not m:
+                self.send_json(404, {"error": f"no route {self.path}"})
+                return
+            try:
+                app.delete_study(m.group(1), m.group(2))
+                self.send_json(200, {"deleted": m.group(2)})
+            except ApiError as e:
+                self.send_json(e.code, {"error": str(e)})
+
+    return ThreadingHTTPServer(("0.0.0.0", port), Handler)
+
+
+def main(argv=None) -> int:
+    argv = strip_glog_args(list(sys.argv[1:] if argv is None else argv))
+    p = argparse.ArgumentParser(description="study web app")
+    add_client_args(p)
+    p.add_argument("--port", type=int, default=8089)
+    args = p.parse_args(argv)
+
+    httpd = make_server(StudyApp(client_from_args(args)), args.port)
+    print(f"study web app on :{args.port}")
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,7 @@
+"""Canned workload entrypoints job prototypes run in worker containers —
+the tf-controller-examples analogue (tf-controller-examples/tf-cnn/launcher.py).
+
+Every workload reads the operator-injected rendezvous env, joins the
+collective, runs, and exits 0 on success (job completion is pod exit status,
+the contract the reference's operators share).
+"""

@@ -1,0 +1,81 @@
+"""Allreduce bandwidth benchmark over ICI — the MPIJob/Horovod-benchmark
+analogue (BASELINE config #4; reference surface:
+kubeflow/mpi-job/prototypes/mpi-job-custom.jsonnet:35-59).
+
+Sweeps buffer sizes, psums each over every device, reports per-size wall
+time and algorithmic bus bandwidth as JSON lines. The MPIJob prototype's
+default command.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from kubeflow_tpu.runtime import strip_glog_args
+
+
+def _bench_one(n_elems: int, iters: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    n_local = jax.local_device_count()
+    n_global = jax.device_count()
+    allreduce = jax.pmap(lambda x: jax.lax.psum(x, "d"), axis_name="d")
+    x = jnp.ones((n_local, n_elems), jnp.float32)
+    allreduce(x)[0].block_until_ready()  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = allreduce(x)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    bytes_ = n_elems * 4
+    # Ring-allreduce algorithmic bandwidth: 2(n-1)/n × payload / time.
+    algo_bw = (2 * (n_global - 1) / max(n_global, 1)) * bytes_ / dt
+    return {
+        "elements": n_elems,
+        "bytes": bytes_,
+        "devices": n_global,
+        "seconds_per_allreduce": dt,
+        "algo_bandwidth_gbps": algo_bw / 1e9,
+    }
+
+
+def main(argv=None) -> int:
+    argv = strip_glog_args(list(sys.argv[1:] if argv is None else argv))
+    p = argparse.ArgumentParser(description="allreduce bandwidth benchmark")
+    p.add_argument("--min-elems", type=int, default=1 << 10)
+    p.add_argument("--max-elems", type=int, default=1 << 24)
+    p.add_argument("--iters", type=int, default=10)
+    args = p.parse_args(argv)
+
+    from kubeflow_tpu.parallel.distributed import (
+        initialize_from_env,
+        shutdown,
+    )
+
+    info = initialize_from_env()
+    results = []
+    n = args.min_elems
+    while n <= args.max_elems:
+        r = _bench_one(n, args.iters)
+        results.append(r)
+        if info.process_id == 0:
+            print(json.dumps(r))
+        n *= 4
+    if info.process_id == 0:
+        best = max(r["algo_bandwidth_gbps"] for r in results)
+        summary = {"metric": "allreduce_peak_bandwidth", "value": best,
+                   "unit": "GB/s", "devices": results[0]["devices"]}
+        print(json.dumps(summary))
+        from kubeflow_tpu.train.loop import publish_metrics
+
+        publish_metrics({"allreduce_peak_gbps": best})
+    shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,57 @@
+"""Allreduce smoke test — the distributed-rendezvous E2E workload.
+
+Default command for the MXNet/Chainer compat job prototypes and the
+fake-slice E2E test: join the collective via the operator-injected env, psum
+a known value over every device, assert the result, exit 0. This is the
+smallest job that proves rendezvous + collectives work end to end (the role
+tf-job-simple plays in CI, testing/tf_job_simple_test.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from kubeflow_tpu.runtime import strip_glog_args
+
+
+def main(argv=None) -> int:
+    argv = strip_glog_args(list(sys.argv[1:] if argv is None else argv))
+    p = argparse.ArgumentParser(description="collective allreduce smoke test")
+    p.add_argument("--value", type=float, default=1.0)
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.parallel.distributed import (
+        initialize_from_env,
+        shutdown,
+    )
+
+    info = initialize_from_env()
+    n_local = jax.local_device_count()
+    n_global = jax.device_count()
+
+    allreduce = jax.pmap(lambda x: jax.lax.psum(x, "d"), axis_name="d")
+
+    out = allreduce(jnp.full((n_local,), args.value, jnp.float32))
+    got = float(out[0])
+    want = args.value * n_global
+    result = {
+        "process_id": info.process_id,
+        "num_processes": info.num_processes,
+        "local_devices": n_local,
+        "global_devices": n_global,
+        "psum": got,
+        "expected": want,
+        "ok": abs(got - want) < 1e-4,
+    }
+    print(json.dumps(result))
+    shutdown()
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

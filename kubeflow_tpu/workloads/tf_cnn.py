@@ -1,0 +1,48 @@
+"""ResNet training workload — the tf_cnn_benchmarks analogue
+(tf-controller-examples/tf-cnn/launcher.py:18, BASELINE config #1).
+
+The TFJob-kind default command. Where the reference's launcher parses
+TF_CONFIG and execs tf_cnn_benchmarks into a gRPC PS cluster, this joins the
+JAX collective (the controller injects both TF_CONFIG for compat and the JAX
+coordinator env) and trains data-parallel ResNet on synthetic images.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from kubeflow_tpu.runtime import strip_glog_args
+
+
+def main(argv=None) -> int:
+    argv = strip_glog_args(list(sys.argv[1:] if argv is None else argv))
+    p = argparse.ArgumentParser(description="ResNet training workload")
+    p.add_argument("--model", default="resnet50",
+                   help="resnet50 | resnet18 | resnet-test-tiny")
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--data", type=int, default=-1,
+                   help="data-parallel mesh size (-1 = all devices)")
+    args = p.parse_args(argv)
+
+    from kubeflow_tpu.parallel.mesh import MeshConfig
+    from kubeflow_tpu.train.loop import RunConfig, run
+
+    result = run(RunConfig(
+        model=args.model,
+        mesh=MeshConfig(data=args.data),
+        batch_size=args.batch_size,
+        steps=args.steps,
+        log_every=args.log_every,
+        checkpoint_dir=args.checkpoint_dir,
+    ))
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,91 @@
+"""PyTorchJob workload: DDP-style training from the operator-injected env
+(MASTER_ADDR/MASTER_PORT/WORLD_SIZE/RANK — the same contract the reference's
+pytorch-operator injects, kubeflow/pytorch-job/prototypes/pytorch-job.jsonnet).
+
+On TPU VMs with torch_xla installed this runs the torch-xla SPMD path; on
+CPU-only images (and CI) it falls back to torch.distributed gloo DDP, so the
+PyTorchJob kind is exercised end to end either way.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from kubeflow_tpu.runtime import strip_glog_args
+
+
+def _train_torch(args) -> dict:
+    import torch
+    import torch.nn as nn
+
+    world = int(os.environ.get("WORLD_SIZE", "1"))
+    rank = int(os.environ.get("RANK", "0"))
+    distributed = world > 1
+    if distributed:
+        import torch.distributed as dist
+
+        dist.init_process_group(
+            backend="gloo", init_method="env://",
+            world_size=world, rank=rank,
+        )
+
+    try:
+        import torch_xla.core.xla_model as xm  # type: ignore
+
+        device = xm.xla_device()
+    except Exception:
+        device = torch.device("cpu")
+
+    torch.manual_seed(args.seed + rank)
+    model = nn.Sequential(
+        nn.Linear(args.dim, args.hidden), nn.ReLU(),
+        nn.Linear(args.hidden, 10),
+    ).to(device)
+    if distributed:
+        from torch.nn.parallel import DistributedDataParallel
+
+        model = DistributedDataParallel(model)
+    opt = torch.optim.AdamW(model.parameters(), lr=1e-3)
+    loss_fn = nn.CrossEntropyLoss()
+
+    loss = None
+    for step in range(args.steps):
+        x = torch.randn(args.batch_size, args.dim, device=device)
+        y = torch.randint(0, 10, (args.batch_size,), device=device)
+        opt.zero_grad()
+        loss = loss_fn(model(x), y)
+        loss.backward()  # DDP allreduces grads here
+        opt.step()
+        if (step + 1) % args.log_every == 0 and rank == 0:
+            print(f"step={step + 1} loss={loss.item():.4f}")
+
+    if distributed:
+        import torch.distributed as dist
+
+        dist.barrier()
+        dist.destroy_process_group()
+    return {"rank": rank, "world_size": world, "steps": args.steps,
+            "loss": float(loss.item()) if loss is not None else None}
+
+
+def main(argv=None) -> int:
+    argv = strip_glog_args(list(sys.argv[1:] if argv is None else argv))
+    p = argparse.ArgumentParser(description="PyTorchJob DDP workload")
+    p.add_argument("--steps", type=int, default=50)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--dim", type=int, default=64)
+    p.add_argument("--hidden", type=int, default=128)
+    p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+
+    result = _train_torch(args)
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
