@@ -14,11 +14,11 @@ from concurrent.futures import ThreadPoolExecutor
 
 import pytest
 
-from kubeflow_tpu.manifests.core import REQUIRED, list_prototypes
+from kubeflow_tpu.manifests.core import REQUIRED, all_prototypes
 
 
 def _dummy_value(spec):
-    if spec.default is not REQUIRED and spec.default is not None:
+    if spec.default is not REQUIRED:
         return spec.default
     by_name = {
         "name": "x", "namespace": "kubeflow", "model_path": "/m",
@@ -43,7 +43,7 @@ def _all_rendered_commands() -> set[tuple[str, ...]]:
             for v in node:
                 walk(v)
 
-    for name, proto in list_prototypes().items():
+    for name, proto in all_prototypes().items():
         params = {p.name: _dummy_value(p) for p in proto.params}
         for obj in proto.generate(params):
             walk(obj)
