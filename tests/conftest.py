@@ -23,6 +23,13 @@ import sys  # noqa: E402
 
 if "jax" in sys.modules:
     sys.modules["jax"].config.update("jax_platforms", "cpu")
+    # XLA_FLAGS is parsed once per process; if a backend already came up the
+    # flag above is a no-op and the device count must go through the config
+    # knob (jax>=0.5), mirroring __graft_entry__._force_cpu_mesh.
+    try:
+        sys.modules["jax"].config.update("jax_num_cpu_devices", 8)
+    except (AttributeError, RuntimeError):
+        pass  # older jax, or a backend is already live with 8 devices
 else:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
 

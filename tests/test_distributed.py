@@ -1,0 +1,126 @@
+"""Multi-process distributed rendezvous tests (SURVEY.md §4's mandate).
+
+Tier "fake slice": N real OS processes each perform the
+``jax.distributed.initialize`` rendezvous through the exact code path a
+JaxJob worker runs in production (`initialize_from_env` with the
+operator-injected env), form a global device mesh over per-process virtual
+CPU devices, and run a psum — the capability the reference can only test by
+provisioning a real cluster (testing/install_minikube.sh,
+testing/deploy_kubeflow.py:49).
+
+The E2E test goes one layer up: a JaxJob submitted to the fake apiserver,
+reconciled by the real JobController, executed by the FakeKubelet as real
+subprocesses, completing through to the job's Succeeded condition — the
+in-process analogue of testing/tf_job_simple_test.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+from kubeflow_tpu.apis import jobs as jobs_api
+from kubeflow_tpu.k8s.kubelet import FakeKubelet
+from kubeflow_tpu.operators.jobs import JobController
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def worker_env(port: int, num: int, pid: int, devices: int) -> dict:
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # no real-TPU plumbing in workers
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": f"--xla_force_host_platform_device_count={devices}",
+        jobs_api.ENV_COORDINATOR_ADDRESS: f"127.0.0.1:{port}",
+        jobs_api.ENV_NUM_PROCESSES: str(num),
+        jobs_api.ENV_PROCESS_ID: str(pid),
+        "PYTHONPATH": REPO,
+    })
+    return env
+
+
+@pytest.mark.slow
+def test_two_process_rendezvous_psum():
+    """2 processes × 2 CPU devices rendezvous and psum over all 4 devices."""
+    port = free_port()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "kubeflow_tpu.workloads.allreduce_smoke",
+             "--value", "1.5"],
+            env=worker_env(port, 2, pid, devices=2),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            cwd=REPO,
+        )
+        for pid in range(2)
+    ]
+    outs = [p.communicate(timeout=180)[0] for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out
+    # Every process saw the global slice and the full-reduction value.
+    reports = [json.loads(out.strip().splitlines()[-1]) for out in outs]
+    for rep in reports:
+        assert rep["global_devices"] == 4, rep
+        assert rep["local_devices"] == 2, rep
+        assert rep["psum"] == pytest.approx(1.5 * 4), rep
+    assert sorted(r["process_id"] for r in reports) == [0, 1]
+
+
+@pytest.mark.slow
+def test_jaxjob_e2e_fake_slice(api):
+    """JaxJob → controller gang → FakeKubelet subprocesses → Succeeded."""
+    for crd in jobs_api.all_job_crds():
+        api.apply(crd)
+    ctrl = JobController(api, "JaxJob")
+    job = {
+        "apiVersion": jobs_api.JOBS_API_VERSION,
+        "kind": "JaxJob",
+        "metadata": {"name": "smoke", "namespace": "kubeflow"},
+        "spec": {
+            "replicaSpecs": {
+                "Worker": {
+                    "replicas": 2,
+                    "restartPolicy": "Never",
+                    "template": {"spec": {"containers": [{
+                        "name": "main",
+                        "image": "kubeflow-tpu/worker:latest",
+                        "command": [
+                            "python", "-m",
+                            "kubeflow_tpu.workloads.allreduce_smoke",
+                        ],
+                    }]}},
+                },
+            },
+        },
+    }
+    api.create(job)
+    kubelet = FakeKubelet(api, cpu_devices_per_pod=2)
+    try:
+        ctrl.reconcile_all()
+        pods = api.list("v1", "Pod", namespace="kubeflow")
+        assert len(pods) == 2
+        # The controller injected the rendezvous env the workers consume.
+        env0 = {e["name"]: e["value"]
+                for e in pods[0]["spec"]["containers"][0]["env"]}
+        assert env0[jobs_api.ENV_NUM_PROCESSES] == "2"
+        kubelet.run_until_idle(reconcile=ctrl.reconcile_all)
+    finally:
+        kubelet.shutdown()
+    ctrl.reconcile_all()
+    got = api.get(jobs_api.JOBS_API_VERSION, "JaxJob", "smoke", "kubeflow")
+    conds = {c["type"]: c["status"] for c in got["status"]["conditions"]}
+    assert conds.get(jobs_api.COND_SUCCEEDED) == "True", got["status"]
+    # Worker logs made it into pod status (the kubectl-logs analogue).
+    pod = api.get("v1", "Pod", pods[0]["metadata"]["name"], "kubeflow")
+    assert '"ok": true' in pod["status"]["log"]
