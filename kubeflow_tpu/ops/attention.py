@@ -1,20 +1,20 @@
-"""Flash attention for TPU.
+"""Flash attention for TPU, as blockwise XLA (online softmax over kv blocks).
 
-Forward is a pallas kernel tiled for the MXU: grid over (batch×kv-head×group,
-q-blocks, kv-blocks), online-softmax state carried in VMEM scratch across the
-innermost (sequential) grid dimension, causal blocks above the diagonal
-skipped. GQA is native: the grid's leading dim enumerates query heads while
-the K/V BlockSpec index maps fold the group dim away (``b // group``), so
-grouped keys/values are never materialized at H_q — and never vmapped, which
-would multiply VMEM residency by the group size.
+Forward accumulates the online softmax over kv blocks with ``lax.scan``;
+backward is the flash recomputation from the saved logsumexp, also blockwise,
+so activation memory stays O(T·block) at any sequence length. GQA is native:
+inputs are folded to [B·H_kv, group, T, D] so grouped keys/values are never
+materialized at H_q width.
 
-Backward is the flash recomputation, expressed blockwise with ``lax.scan`` so
-activation memory stays O(T·block) and XLA tiles the matmuls onto the MXU
-itself.
+Why no hand-written kernel: a pallas MXU kernel of this op was benchmarked
+against this path inside the full flagship train step on v5e and lost
+catastrophically through this toolchain (1.2k vs 27.3k tok/s end-to-end;
+git history has the kernel). XLA tiles the scan's matmuls onto the MXU
+itself, and at ``block_k == T`` the scan collapses to a single fused block —
+the measured-fastest configuration (27.3k vs 23.8k tok/s at block_k=128).
 
-The pure-jax path (`implementation="xla"`) runs the same blockwise math and is
-the fallback for the CPU fake slice, for head dims off the 128-lane grid, and
-for short/odd sequence lengths.
+``implementation="plain"`` materializes the [T, S] scores — the fastest
+choice for short sequences where O(T·S) memory is cheap.
 """
 
 from __future__ import annotations
@@ -24,153 +24,19 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
-# 128×128 blocks map exactly onto the MXU tile and keep Mosaic's register
-# allocator happy — 512-wide score blocks spill hundreds of MB (measured:
-# 208M spill slots at block 512, seq 2048, v5e).
+# Default kv block width for the blockwise paths. Callers with known-static
+# sequence lengths should pass block_k == seq_len (single block — measured
+# fastest on v5e); the default keeps memory O(T·2048) for long sequences.
 DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
-
-
-NUM_LANES = 128
+DEFAULT_BLOCK_K = 2048
 
 
 def _causal_mask(q_start, k_start, bq, bk):
     q_pos = q_start + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     k_pos = k_start + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
     return q_pos >= k_pos
-
-
-def _lanes(x, width):
-    """Widen a lane-replicated [rows, NUM_LANES] stat to [rows, width]."""
-    if width == x.shape[-1]:
-        return x
-    if width < x.shape[-1]:
-        return x[:, :width]
-    return pltpu.repeat(x, width // x.shape[-1], axis=1)
-
-
-# ---------------------------------------------------------------------------
-# Pallas forward kernel
-# ---------------------------------------------------------------------------
-
-
-def _fwd_kernel(q_ref, k_ref, v_ref, kvm_ref, o_ref, lse_ref, m_scr, l_scr,
-                acc_scr, *, causal: bool, scale: float, block_q: int,
-                block_k: int):
-    i = pl.program_id(2)  # q block
-    j = pl.program_id(3)  # kv block
-    nk = pl.num_programs(3)
-
-    @pl.when(j == 0)
-    def _init():
-        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
-        l_scr[:] = jnp.zeros_like(l_scr)
-        acc_scr[:] = jnp.zeros_like(acc_scr)
-
-    # NOTE: no @pl.when around the compute — predicating the main body makes
-    # Mosaic stack-allocate the full operands (55MB scoped-vmem blowups) and
-    # fall off the pipelined path. Causality is enforced by the mask alone;
-    # above-diagonal blocks contribute exp(-inf)=0.
-    q = q_ref[0, 0].astype(jnp.float32)
-    k = k_ref[0].astype(jnp.float32)
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale
-    if causal:
-        mask = _causal_mask(i * block_q, j * block_k, block_q, block_k)
-        s = jnp.where(mask, s, _NEG_INF)
-    # Key-padding mask: kvm is [block_k, 1] with 1.0 = valid.
-    s = jnp.where(kvm_ref[0][:, 0][None, :] > 0, s, _NEG_INF)
-    # Row stats kept lane-replicated [block_q, NUM_LANES]: single-lane
-    # vectors are pathological for the VPU.
-    m_prev = m_scr[:]
-    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-    p = jnp.exp(s - _lanes(m_new, block_k))
-    corr = jnp.exp(m_prev - m_new)
-    l_scr[:] = l_scr[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
-    d = acc_scr.shape[-1]
-    acc_scr[:] = acc_scr[:] * _lanes(corr, d) + jax.lax.dot_general(
-        p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    m_scr[:] = m_new
-
-    @pl.when(j == nk - 1)
-    def _finish():
-        l = l_scr[:]
-        valid = m_scr[:] > _NEG_INF / 2  # all-masked rows → zeros
-        d_out = acc_scr.shape[-1]
-        o_ref[0, 0] = jnp.where(
-            _lanes(valid, d_out),
-            acc_scr[:] / _lanes(l, d_out),
-            0.0,
-        ).astype(o_ref.dtype)
-        lse_ref[0, 0] = jnp.where(
-            valid[:, :1], m_scr[:, :1] + jnp.log(l[:, :1]), _NEG_INF
-        )
-
-
-def _flash_fwd_pallas(q, k, v, kvm, *, causal, scale, block_q, block_k,
-                      interpret):
-    """q: [BKV, G, T, D]; k,v: [BKV, S, D]; kvm: [BKV, S, 1]
-    → (out [BKV, G, T, D], lse [BKV, G, T, 1])."""
-    bkv, g, t, d = q.shape
-    s_len = k.shape[1]
-    block_q = min(block_q, t)
-    block_k = min(block_k, s_len)
-    # 4D grid with affine index maps (a folded bh dim with div/mod maps
-    # defeats Mosaic's block-reuse analysis — measured 34x slower).
-    grid = (bkv, g, pl.cdiv(t, block_q), pl.cdiv(s_len, block_k))
-    kernel = functools.partial(
-        _fwd_kernel, causal=causal, scale=scale, block_q=block_q,
-        block_k=block_k,
-    )
-    out, lse = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d),
-                         lambda b, h, i, j: (b, h, i, 0),
-                         memory_space=pltpu.VMEM),
-            # K/V shared across the group dim h.
-            pl.BlockSpec((1, block_k, d), lambda b, h, i, j: (b, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda b, h, i, j: (b, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, 1), lambda b, h, i, j: (b, j, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, 1, block_q, d),
-                         lambda b, h, i, j: (b, h, i, 0),
-                         memory_space=pltpu.VMEM),
-            # lse carried with a trailing singleton: TPU lowering needs the
-            # last two block dims (8,128)-aligned or equal to the array dims.
-            pl.BlockSpec((1, 1, block_q, 1),
-                         lambda b, h, i, j: (b, h, i, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((bkv, g, t, d), q.dtype),
-            jax.ShapeDtypeStruct((bkv, g, t, 1), jnp.float32),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((block_q, NUM_LANES), jnp.float32),
-            pltpu.VMEM((block_q, NUM_LANES), jnp.float32),
-            pltpu.VMEM((block_q, d), jnp.float32),
-        ],
-        # Only the kv dim carries state (online-softmax scratch).
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "parallel",
-                                 "arbitrary"),
-        ),
-        interpret=interpret,
-    )(q, k, v, kvm)
-    return out, lse
 
 
 # ---------------------------------------------------------------------------
@@ -277,42 +143,43 @@ def _flash_bwd_xla(q, k, v, kvm, out, lse, g_out, *, causal, scale, block_k):
 # ---------------------------------------------------------------------------
 
 
-def _use_pallas(t: int, s: int, d: int, block_q: int, block_k: int,
-                implementation: str | None) -> bool:
-    if implementation == "pallas":
-        return True
-    # auto currently = XLA blockwise: measured on v5e (B4 T2048 H16 D128,
-    # causal) it runs at 9.0ms vs 10.2ms for the hand-written reference
-    # pallas kernel — XLA's fusion of the scan already saturates the MXU.
-    # The in-repo pallas kernel is opt-in until it beats the XLA path.
-    return False
+def _plain_attention(q, k, v, kvm, *, causal, scale):
+    """Reference path: materialize the [G,T,S] score matrix. On TPU this is
+    often the fastest choice at moderate T — one fused softmax over a single
+    large MXU matmul pair beats a sequential scan of small blocks — at the
+    cost of O(T·S) activation memory. q: [BKV, G, T, D]; k,v: [BKV, S, D]."""
+    s = jnp.einsum("bgqd,bkd->bgqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * scale
+    t, s_len = q.shape[2], k.shape[1]
+    if causal:
+        mask = _causal_mask(0, 0, t, s_len)
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    s = jnp.where(kvm[..., 0][:, None, None, :] > 0, s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    valid = m > _NEG_INF / 2  # all-masked rows → zeros, matching flash
+    p = jnp.exp(s - jnp.where(valid, m, 0.0))
+    p = jnp.where(valid, p, 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    acc = jnp.einsum("bgqk,bkd->bgqd", p, v.astype(jnp.float32))
+    out = jnp.where(valid, acc / jnp.where(l == 0, 1.0, l), 0.0)
+    return out.astype(q.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash(q, k, v, kvm, causal, scale, block_q, block_k, impl):
-    out, _ = _flash_fwd(q, k, v, kvm, causal, scale, block_q, block_k, impl)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, kvm, causal, scale, block_q, block_k):
+    out, _ = _flash_fwd_xla(q, k, v, kvm, causal=causal, scale=scale,
+                            block_k=block_k)
     return out
 
 
-def _flash_fwd(q, k, v, kvm, causal, scale, block_q, block_k, impl):
-    t, s = q.shape[2], k.shape[1]
-    if _use_pallas(t, s, q.shape[-1], min(block_q, t), min(block_k, s), impl):
-        out, lse = _flash_fwd_pallas(
-            q, k, v, kvm, causal=causal, scale=scale, block_q=block_q,
-            block_k=block_k, interpret=jax.default_backend() != "tpu",
-        )
-    else:
-        out, lse = _flash_fwd_xla(q, k, v, kvm, causal=causal, scale=scale,
-                                  block_k=block_k)
-    return out, lse
-
-
-def _flash_vjp_fwd(q, k, v, kvm, causal, scale, block_q, block_k, impl):
-    out, lse = _flash_fwd(q, k, v, kvm, causal, scale, block_q, block_k, impl)
+def _flash_vjp_fwd(q, k, v, kvm, causal, scale, block_q, block_k):
+    out, lse = _flash_fwd_xla(q, k, v, kvm, causal=causal, scale=scale,
+                              block_k=block_k)
     return out, (q, k, v, kvm, out, lse)
 
 
-def _flash_vjp_bwd(causal, scale, block_q, block_k, impl, res, g):
+def _flash_vjp_bwd(causal, scale, block_q, block_k, res, g):
     q, k, v, kvm, out, lse = res
     dq, dk, dv = _flash_bwd_xla(q, k, v, kvm, out, lse, g, causal=causal,
                                 scale=scale, block_k=block_k)
@@ -339,7 +206,7 @@ def flash_attention(
     q: [B, T, H_q, D]; k, v: [B, S, H_kv, D] with H_q a multiple of H_kv.
     ``kv_mask``: optional [B, S], truthy = attend (padding mask for BERT /
     batched serving). Returns [B, T, H_q, D]. ``implementation``: None
-    (auto), "pallas", "xla".
+    (auto = blockwise flash), "xla" (same), "plain" (materialized scores).
     """
     b, t, hq, d = q.shape
     s_len, hkv = k.shape[1], k.shape[2]
@@ -363,8 +230,12 @@ def flash_attention(
     kf = k.transpose(0, 2, 1, 3).reshape(b * hkv, s_len, d)
     vf = v.transpose(0, 2, 1, 3).reshape(b * hkv, s_len, d)
 
-    out = _flash(qf, kf, vf, kvm, causal, scale, block_q, block_k,
-                 implementation)
+    if implementation == "plain":
+        # Materialized scores; plain autodiff (no flash recompute) — the
+        # short-sequence fast path where O(T·S) memory is cheap.
+        out = _plain_attention(qf, kf, vf, kvm, causal=causal, scale=scale)
+    else:
+        out = _flash(qf, kf, vf, kvm, causal, scale, block_q, block_k)
     return (
         out.reshape(b, hkv, group, t, d)
         .reshape(b, hq, t, d)

@@ -17,12 +17,14 @@ from jax.experimental.pallas import tpu as pltpu
 
 
 def rms_norm(x, weight, *, eps: float = 1e-6, implementation: str | None = None):
-    """y = x / rms(x) * weight over the last dim. x: [..., D], weight: [D]."""
-    if implementation == "pallas" or (
-        implementation is None
-        and jax.default_backend() == "tpu"
-        and x.shape[-1] % 128 == 0
-    ):
+    """y = x / rms(x) * weight over the last dim. x: [..., D], weight: [D].
+
+    Auto is the pure-XLA path: measured inside the full flagship train step
+    on v5e, XLA's fused norm edges out the pallas kernel (27.5k vs 27.0k
+    tok/s end-to-end) — XLA already fuses the norm into its neighbors, and
+    the kernel boundary blocks that. The kernel stays opt-in
+    (``implementation="pallas"``) for standalone-norm workloads."""
+    if implementation == "pallas":
         return _rms_norm_fused(x, weight, eps)
     return _rms_norm_xla(x, weight, eps)
 

@@ -53,10 +53,19 @@ def spec_for_path(path: str, rules: list[PartitionRule], default: P = P()) -> P:
 
 
 def tree_specs(tree, rules: list[PartitionRule], default: P = P()):
-    """PartitionSpec pytree matching ``tree``'s structure."""
-    return jax.tree_util.tree_map_with_path(
-        lambda kp, _: spec_for_path(path_str(kp), rules, default), tree
-    )
+    """PartitionSpec pytree matching ``tree``'s structure. A rule whose spec
+    names more dims than the leaf has falls back to ``default`` — optimizer
+    slots with factored/reduced shapes (adafactor's v_row/v_col vectors)
+    live under the same paths as the params their rules target."""
+
+    def spec_of(kp, leaf):
+        spec = spec_for_path(path_str(kp), rules, default)
+        ndim = getattr(leaf, "ndim", None)
+        if ndim is not None and len(spec) > ndim:
+            return default
+        return spec
+
+    return jax.tree_util.tree_map_with_path(spec_of, tree)
 
 
 def named_sharding(mesh: Mesh, spec: P) -> NamedSharding:

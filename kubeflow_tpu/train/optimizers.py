@@ -20,6 +20,9 @@ class OptimizerConfig:
     grad_clip_norm: float = 1.0
     min_lr_ratio: float = 0.1
     momentum: float = 0.9  # sgd only
+    # adamw/adam first-moment dtype; "bfloat16" halves that slot's HBM
+    # (the second moment stays float32 for update accuracy).
+    mu_dtype: str | None = None
 
 
 def schedule(cfg: OptimizerConfig):
@@ -39,10 +42,15 @@ def build(cfg: OptimizerConfig) -> optax.GradientTransformation:
     if cfg.name == "adamw":
         opt = optax.adamw(
             lr, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps,
-            weight_decay=cfg.weight_decay,
+            weight_decay=cfg.weight_decay, mu_dtype=cfg.mu_dtype,
         )
     elif cfg.name == "adam":
-        opt = optax.adam(lr, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps)
+        opt = optax.adam(lr, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps,
+                         mu_dtype=cfg.mu_dtype)
+    elif cfg.name == "adafactor":
+        # Factored second moment — O(d) optimizer state instead of O(d²),
+        # the standard memory-bound choice for big models on one chip.
+        opt = optax.adafactor(lr, min_dim_size_to_factor=128)
     elif cfg.name == "sgd":
         opt = optax.sgd(lr, momentum=cfg.momentum)
     else:

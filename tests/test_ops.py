@@ -35,7 +35,7 @@ def dense_attention(q, k, v, causal):
 
 
 @pytest.mark.parametrize("causal", [True, False])
-@pytest.mark.parametrize("impl", ["xla", "pallas"])
+@pytest.mark.parametrize("impl", ["xla", "plain"])
 def test_flash_attention_forward(causal, impl):
     key = jax.random.PRNGKey(0)
     kq, kk, kv = jax.random.split(key, 3)
@@ -170,7 +170,7 @@ def test_cross_entropy_z_loss_positive():
     assert float(metrics["z_loss"]) > 0
 
 
-@pytest.mark.parametrize("impl", ["xla", "pallas"])
+@pytest.mark.parametrize("impl", ["xla", "plain"])
 def test_flash_attention_all_masked_rows_are_zero(impl):
     # A batch element whose kv_mask is all-zero must return zeros (not the
     # mean of V) and contribute zero gradient.

@@ -111,3 +111,47 @@ def test_place_batch_shards_batch_dim():
     assert arr.shape == (8, 17)
     # batch dim sharded over data×fsdp = 8 ways.
     assert arr.addressable_shards[0].data.shape == (1, 17)
+
+
+def test_adafactor_and_bf16_mu_train_step():
+    """Memory-lean optimizer paths: adafactor's factored slots (reduced-rank
+    leaves under param paths — exercises the tree_specs rank fallback) and
+    adamw with bfloat16 first moment, each driving a sharded step."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models.registry import get_model
+    from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+    from kubeflow_tpu.train.data import place_batch, synthetic_batch
+    from kubeflow_tpu.train.optimizers import OptimizerConfig
+    from kubeflow_tpu.train.trainer import build_train_step, init_state
+
+    model = get_model("lm-test-tiny")
+    mesh = build_mesh(MeshConfig(data=2, fsdp=2, tensor=2))
+    for cfg in (
+        OptimizerConfig(name="adafactor", warmup_steps=1, total_steps=4),
+        OptimizerConfig(name="adamw", mu_dtype="bfloat16",
+                        warmup_steps=1, total_steps=4),
+    ):
+        state = init_state(jax.random.PRNGKey(0), model, cfg, mesh)
+        step = build_train_step(model, cfg, mesh)
+        batch = place_batch(synthetic_batch(model, 4, 64), mesh, model)
+        state, metrics = step(state, batch)
+        state, metrics = step(state, batch)
+        assert jnp.isfinite(metrics["loss"]), (cfg.name, metrics)
+
+
+def test_tree_specs_rank_fallback():
+    """A rule naming more dims than a leaf has falls back to replicated —
+    factored optimizer slots share param paths but not param ranks."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from kubeflow_tpu.parallel.sharding import PartitionRule, tree_specs
+
+    tree = {"embed": {"kernel": jnp.zeros((8, 4)),
+                      "v_row": jnp.zeros((8,))}}
+    rules = [PartitionRule(r"embed", P("tensor", "fsdp"))]
+    specs = tree_specs(tree, rules)
+    assert specs["embed"]["kernel"] == P("tensor", "fsdp")
+    assert specs["embed"]["v_row"] == P()
