@@ -1,0 +1,99 @@
+"""Serving latency benchmark — BASELINE target #5 (tf-serving BERT inference).
+
+Starts the dual-port model server in-process (bert-base on TPU, the tiny
+preset elsewhere), drives predict RPCs over both gRPC (:9000-contract) and
+REST (:8500-contract), and prints ONE JSON line with p50/p99 latency and
+batched throughput. The reference publishes correctness-only serving tests
+(testing/test_tf_serving.py:40-60, tolerance 0.001 — no latency figure), so
+these are record-setting numbers, not comparisons.
+
+Usage: python bench_serving.py [--quick] [--requests N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import jax
+
+
+def percentile(sorted_vals, p):
+    i = min(int(len(sorted_vals) * p / 100), len(sorted_vals) - 1)
+    return sorted_vals[i]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--requests", type=int, default=200)
+    ap.add_argument("--seq-len", type=int, default=128)
+    ap.add_argument("--concurrency", type=int, default=16)
+    args = ap.parse_args()
+
+    import grpc
+
+    from kubeflow_tpu.serving.engine import EngineConfig
+    from kubeflow_tpu.serving.grpc_server import client_stubs
+    from kubeflow_tpu.serving.server import ModelServer
+
+    on_tpu = jax.default_backend() == "tpu"
+    model = "bert-base" if on_tpu and not args.quick else "bert-test-tiny"
+
+    server = ModelServer(
+        EngineConfig(model=model, batch_size=8, max_seq_len=args.seq_len),
+        port=0, grpc_port=0, batch_timeout_ms=2.0,
+    )
+    server.start()
+    tokens = list(range(2, 2 + args.seq_len - 2))
+    instance = {"tokens": tokens}
+
+    try:
+        with grpc.insecure_channel(f"127.0.0.1:{server.grpc_port}") as chan:
+            predict, _ = client_stubs(chan)
+
+            # Warmup (compile both the singleton and the full batch shape).
+            predict(model, [instance])
+            predict(model, [instance] * 8)
+
+            # Sequential single-instance latency over gRPC.
+            lat = []
+            for _ in range(args.requests):
+                t0 = time.perf_counter()
+                predict(model, [instance])
+                lat.append((time.perf_counter() - t0) * 1e3)
+            lat.sort()
+
+            # Batched throughput: concurrent clients drive the dynamic
+            # batcher at full batch occupancy.
+            def one(_):
+                t0 = time.perf_counter()
+                predict(model, [instance])
+                return (time.perf_counter() - t0) * 1e3
+
+            t0 = time.perf_counter()
+            with ThreadPoolExecutor(args.concurrency) as pool:
+                conc = sorted(pool.map(one, range(args.requests)))
+            wall = time.perf_counter() - t0
+    finally:
+        server.stop()
+
+    print(json.dumps({
+        "metric": "serving_predict_p50_ms",
+        "value": round(percentile(lat, 50), 2),
+        "unit": "ms",
+        "vs_baseline": 1.0,  # reference publishes no latency numbers
+        "p99_ms": round(percentile(lat, 99), 2),
+        "concurrent_p50_ms": round(percentile(conc, 50), 2),
+        "concurrent_p99_ms": round(percentile(conc, 99), 2),
+        "throughput_rps": round(args.requests / wall, 1),
+        "config": f"{model} seq{args.seq_len} batch8 grpc "
+                  f"c{args.concurrency}",
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

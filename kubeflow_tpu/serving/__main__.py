@@ -18,6 +18,9 @@ def main(argv=None) -> int:
     p.add_argument("--model-path", default="",
                    help="checkpoint dir (empty = fresh init, benchmarking)")
     p.add_argument("--rest-port", type=int, default=8500)
+    p.add_argument("--grpc-port", type=int, default=9000,
+                   help="gRPC predict port (tf-serving :9000 contract); "
+                        "-1 disables")
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--batch-timeout-ms", type=float, default=5.0)
     p.add_argument("--max-seq-len", type=int, default=128)
@@ -31,9 +34,11 @@ def main(argv=None) -> int:
             max_seq_len=args.max_seq_len,
         ),
         port=args.rest_port,
+        grpc_port=None if args.grpc_port < 0 else args.grpc_port,
         batch_timeout_ms=args.batch_timeout_ms,
     )
-    print(f"serving {args.model_name} on :{args.rest_port}")
+    print(f"serving {args.model_name} on REST :{args.rest_port} "
+          f"gRPC :{args.grpc_port}")
     server.serve_forever()
     return 0
 

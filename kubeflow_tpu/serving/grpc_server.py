@@ -1,0 +1,146 @@
+"""gRPC prediction service — the :9000 half of the dual-port serving
+contract (reference: TF ModelServer exposes gRPC :9000 next to REST :8500,
+kubeflow/tf-serving/tf-serving-template.libsonnet:43-49, liveness probe TCP
+:9000 at :70-75).
+
+The service is defined with grpc's generic handlers over UTF-8 JSON message
+bodies rather than compiled protos — one wire format (the REST predict
+schema) across both ports, no generated-stub toolchain in the serving image:
+
+    service kubeflow.tpu.serving.PredictionService {
+      rpc Predict (bytes json)          returns (bytes json);
+      rpc GetModelMetadata (bytes json) returns (bytes json);
+    }
+
+Predict request: ``{"model": "<name>", "instances": [...]}`` →
+``{"predictions": [...]}`` — the same payloads the REST
+``/v1/models/<m>:predict`` route exchanges (http-proxy PredictHandler
+analogue, components/k8s-model-server/http-proxy/server.py:251-307).
+"""
+
+from __future__ import annotations
+
+import json
+from concurrent import futures
+
+import grpc
+
+SERVICE = "kubeflow.tpu.serving.PredictionService"
+DEFAULT_GRPC_PORT = 9000
+
+
+def _json_bytes(obj) -> bytes:
+    return json.dumps(obj).encode("utf-8")
+
+
+class GrpcPredictionService:
+    """Serves a :class:`~kubeflow_tpu.serving.server.ModelServer`'s engine
+    over gRPC. Shares the server's batcher, so REST and gRPC requests
+    coalesce into the same TPU batches."""
+
+    def __init__(self, model_server, *, port: int = DEFAULT_GRPC_PORT,
+                 max_workers: int = 16):
+        self.model_server = model_server
+        self.port = port
+        self._grpc_server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers)
+        )
+        self._grpc_server.add_generic_rpc_handlers(
+            (_Handler(self.model_server),)
+        )
+        self.bound_port = self._grpc_server.add_insecure_port(
+            f"0.0.0.0:{port}"
+        )
+
+    def start(self) -> None:
+        self._grpc_server.start()
+
+    def stop(self, grace: float | None = 1.0) -> None:
+        self._grpc_server.stop(grace)
+
+
+class _Handler(grpc.GenericRpcHandler):
+    def __init__(self, model_server):
+        self.model_server = model_server
+
+    def service(self, handler_call_details):
+        method = handler_call_details.method
+        if method == f"/{SERVICE}/Predict":
+            return grpc.unary_unary_rpc_method_handler(
+                self._predict,
+                request_deserializer=bytes,
+                response_serializer=bytes,
+            )
+        if method == f"/{SERVICE}/GetModelMetadata":
+            return grpc.unary_unary_rpc_method_handler(
+                self._metadata,
+                request_deserializer=bytes,
+                response_serializer=bytes,
+            )
+        return None
+
+    # ------------------------------------------------------------------
+
+    def _parse(self, request: bytes, context) -> dict:
+        try:
+            body = json.loads(request or b"{}")
+        except ValueError:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          "request body is not valid JSON")
+        if not isinstance(body, dict):
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          "request body must be a JSON object")
+        return body
+
+    def _predict(self, request: bytes, context) -> bytes:
+        server = self.model_server
+        body = self._parse(request, context)
+        name = body.get("model") or server.engine.cfg.model
+        try:
+            result = server.handle_predict(name, body)
+        except KeyError as e:
+            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        except (ValueError, TimeoutError) as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        return _json_bytes(result)
+
+    def _metadata(self, request: bytes, context) -> bytes:
+        server = self.model_server
+        body = self._parse(request, context)
+        name = body.get("model") or server.engine.cfg.model
+        try:
+            return _json_bytes(server.handle_metadata(name))
+        except KeyError as e:
+            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+
+
+# ---------------------------------------------------------------------------
+# Client helpers (tests, benchmarks, the gateway)
+# ---------------------------------------------------------------------------
+
+
+def client_stubs(channel: grpc.Channel):
+    """Returns (predict, metadata) callables over an open channel."""
+    predict = channel.unary_unary(
+        f"/{SERVICE}/Predict",
+        request_serializer=bytes,
+        response_deserializer=bytes,
+    )
+    metadata = channel.unary_unary(
+        f"/{SERVICE}/GetModelMetadata",
+        request_serializer=bytes,
+        response_deserializer=bytes,
+    )
+
+    def do_predict(model: str, instances: list, timeout: float = 30.0):
+        resp = predict(
+            _json_bytes({"model": model, "instances": instances}),
+            timeout=timeout,
+        )
+        return json.loads(resp)
+
+    def do_metadata(model: str, timeout: float = 10.0):
+        resp = metadata(_json_bytes({"model": model}), timeout=timeout)
+        return json.loads(resp)
+
+    return do_predict, do_metadata

@@ -51,7 +51,14 @@ class _Metrics:
 
 
 class ModelServer:
+    """Dual-port model server: REST on ``port`` (:8500 by convention), gRPC
+    on ``grpc_port`` (:9000; 0 disables) — the tf-serving port contract
+    (tf-serving-template.libsonnet:43-49). Both ports share one engine and
+    one dynamic batcher, so mixed-protocol traffic coalesces into the same
+    TPU batches."""
+
     def __init__(self, engine_cfg: EngineConfig, *, port: int = 8500,
+                 grpc_port: int | None = None,
                  batch_timeout_ms: float = 5.0):
         self.engine = InferenceEngine(engine_cfg)
         self.batcher = DynamicBatcher(
@@ -59,7 +66,9 @@ class ModelServer:
         )
         self.metrics = _Metrics()
         self.port = port
+        self.grpc_port = grpc_port
         self._httpd: ThreadingHTTPServer | None = None
+        self._grpc = None
 
     # ------------------------------------------------------------------
 
@@ -148,17 +157,29 @@ class ModelServer:
 
         return Handler
 
+    def _start_grpc(self) -> None:
+        if self.grpc_port is None:
+            return
+        from kubeflow_tpu.serving.grpc_server import GrpcPredictionService
+
+        self._grpc = GrpcPredictionService(self, port=self.grpc_port)
+        self.grpc_port = self._grpc.bound_port  # resolve port 0 → real port
+        self._grpc.start()
+
     def start(self) -> None:
         self.engine.warmup()
+        self._start_grpc()
         self._httpd = ThreadingHTTPServer(
             ("0.0.0.0", self.port), self._make_handler()
         )
+        self.port = self._httpd.server_address[1]
         thread = threading.Thread(target=self._httpd.serve_forever,
                                   daemon=True)
         thread.start()
 
     def serve_forever(self) -> None:
         self.engine.warmup()
+        self._start_grpc()
         self._httpd = ThreadingHTTPServer(
             ("0.0.0.0", self.port), self._make_handler()
         )
@@ -167,4 +188,6 @@ class ModelServer:
     def stop(self) -> None:
         if self._httpd:
             self._httpd.shutdown()
+        if self._grpc is not None:
+            self._grpc.stop()
         self.batcher.stop()

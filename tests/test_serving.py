@@ -140,3 +140,50 @@ def test_batcher_deadline_is_absolute():
     b.stop()
     assert elapsed < 1.0  # per-item reset would approach 3*120ms+sleeps
     assert sum(calls) == 3
+
+
+def test_grpc_predict_matches_rest():
+    """Dual-port contract: the gRPC :9000 surface serves the same engine and
+    payload schema as REST (tf-serving-template.libsonnet:43-49 analogue)."""
+    import grpc
+
+    from kubeflow_tpu.serving.grpc_server import client_stubs
+
+    server = ModelServer(
+        EngineConfig(model="lm-test-tiny", batch_size=4, max_seq_len=32),
+        port=0, grpc_port=0, batch_timeout_ms=2,
+    )
+    server.start()
+    try:
+        with grpc.insecure_channel(f"127.0.0.1:{server.grpc_port}") as chan:
+            predict, metadata = client_stubs(chan)
+            meta = metadata("lm-test-tiny")
+            assert meta["state"] == "AVAILABLE"
+
+            out = predict("lm-test-tiny",
+                          [{"tokens": [1, 2, 3]}, {"tokens": [4, 5]}])
+            assert len(out["predictions"]) == 2
+
+            # Same instance over REST gives the same next_token.
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}"
+                "/v1/models/lm-test-tiny:predict",
+                data=json.dumps(
+                    {"instances": [{"tokens": [1, 2, 3]}]}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req) as r:
+                rest_out = json.loads(r.read())
+            assert (out["predictions"][0]["next_token"]
+                    == rest_out["predictions"][0]["next_token"])
+
+            # Unknown model → NOT_FOUND.
+            with pytest.raises(grpc.RpcError) as e:
+                predict("nope", [{"tokens": [1]}])
+            assert e.value.code() == grpc.StatusCode.NOT_FOUND
+            # Bad payload → INVALID_ARGUMENT.
+            with pytest.raises(grpc.RpcError) as e:
+                predict("lm-test-tiny", [])
+            assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    finally:
+        server.stop()
