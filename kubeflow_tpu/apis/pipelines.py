@@ -1,0 +1,199 @@
+"""Pipeline/workflow and Application CRD API types.
+
+The workflow layer the reference gets from Argo + KFP (kubeflow/argo/
+argo.libsonnet:89-165; kubeflow/pipeline/*.libsonnet) recast as one
+TPU-native CRD: a ``Workflow`` is a DAG of tasks, each task creating one
+Kubernetes object (typically a training-job CR or a serving Deployment) once
+its dependencies have succeeded. The ``Application`` CR is the deployed-
+platform aggregation object (kubeflow/application/application.libsonnet:
+14-60): a label selector plus component-kind list whose status mirrors the
+readiness of everything it matches.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.k8s import objects as k8s
+from kubeflow_tpu.version import API_GROUP
+
+PIPELINES_API_VERSION = f"{API_GROUP}/v1"
+
+WORKFLOW_KIND = "Workflow"
+WORKFLOW_PLURAL = "workflows"
+APPLICATION_KIND = "Application"
+APPLICATION_PLURAL = "applications"
+
+# Workflow/task phases (argo's workflow phase surface).
+PHASE_PENDING = "Pending"
+PHASE_RUNNING = "Running"
+PHASE_SUCCEEDED = "Succeeded"
+PHASE_FAILED = "Failed"
+
+
+def workflow_schema() -> dict:
+    task = {
+        "type": "object",
+        "required": ["name", "resource"],
+        "properties": {
+            "name": {"type": "string", "minLength": 1},
+            "dependencies": {
+                "type": "array", "items": {"type": "string"},
+            },
+            # The object this task creates, verbatim (a job CR, a
+            # Deployment, ...). Ownership and completion tracking are the
+            # controller's job; kind/apiVersion are required here so a
+            # malformed resource is rejected at admission, not discovered
+            # as a wedged Running workflow.
+            "resource": {
+                "type": "object",
+                "required": ["apiVersion", "kind"],
+                "properties": {
+                    "apiVersion": {"type": "string", "minLength": 1},
+                    "kind": {"type": "string", "minLength": 1},
+                },
+                "x-kubernetes-preserve-unknown-fields": True,
+            },
+        },
+    }
+    return {
+        "type": "object",
+        "properties": {
+            "spec": {
+                "type": "object",
+                "required": ["tasks"],
+                "properties": {
+                    "tasks": {"type": "array", "items": task, "minItems": 1},
+                },
+            },
+            "status": {
+                "type": "object",
+                "x-kubernetes-preserve-unknown-fields": True,
+            },
+        },
+    }
+
+
+def workflow_crd() -> dict:
+    return k8s.crd(
+        group=API_GROUP,
+        kind=WORKFLOW_KIND,
+        plural=WORKFLOW_PLURAL,
+        short_names=["wf"],
+        categories=["all", "kubeflow-tpu"],
+        versions=[
+            k8s.crd_version(
+                "v1",
+                schema=workflow_schema(),
+                served=True,
+                storage=True,
+                printer_columns=[
+                    k8s.printer_column("Phase", ".status.phase"),
+                    k8s.printer_column(
+                        "Age", ".metadata.creationTimestamp", "date"
+                    ),
+                ],
+            )
+        ],
+    )
+
+
+def application_schema() -> dict:
+    return {
+        "type": "object",
+        "properties": {
+            "spec": {
+                "type": "object",
+                "properties": {
+                    "selector": {
+                        "type": "object",
+                        "properties": {
+                            "matchLabels": {
+                                "type": "object",
+                                "x-kubernetes-preserve-unknown-fields": True,
+                            },
+                        },
+                    },
+                    "componentKinds": {
+                        "type": "array",
+                        "items": {
+                            "type": "object",
+                            "required": ["kind"],
+                            "properties": {
+                                "group": {"type": "string"},
+                                "kind": {"type": "string"},
+                            },
+                        },
+                    },
+                    "descriptor": {
+                        "type": "object",
+                        "x-kubernetes-preserve-unknown-fields": True,
+                    },
+                },
+            },
+            "status": {
+                "type": "object",
+                "x-kubernetes-preserve-unknown-fields": True,
+            },
+        },
+    }
+
+
+def application_crd() -> dict:
+    return k8s.crd(
+        group=API_GROUP,
+        kind=APPLICATION_KIND,
+        plural=APPLICATION_PLURAL,
+        short_names=["app"],
+        categories=["all", "kubeflow-tpu"],
+        versions=[
+            k8s.crd_version(
+                "v1",
+                schema=application_schema(),
+                served=True,
+                storage=True,
+                printer_columns=[
+                    k8s.printer_column(
+                        "Assembly", ".status.assemblyPhase"
+                    ),
+                    k8s.printer_column("Ready", ".status.componentsReady"),
+                ],
+            )
+        ],
+    )
+
+
+# ---------------------------------------------------------------------------
+# Workflow DAG validation
+# ---------------------------------------------------------------------------
+
+
+def toposort_tasks(tasks: list[dict]) -> list[str]:
+    """Task names in dependency order. Raises ValueError on duplicate names,
+    unknown dependencies, or cycles — checked at admission and again by the
+    controller (the CRD schema can't express graph invariants)."""
+    names = [t["name"] for t in tasks]
+    if len(set(names)) != len(names):
+        dupes = sorted({n for n in names if names.count(n) > 1})
+        raise ValueError(f"duplicate task names: {dupes}")
+    deps = {t["name"]: list(t.get("dependencies", [])) for t in tasks}
+    for name, ds in deps.items():
+        unknown = [d for d in ds if d not in deps]
+        if unknown:
+            raise ValueError(f"task {name!r} depends on unknown {unknown}")
+    order: list[str] = []
+    state: dict[str, int] = {}  # 0 visiting, 1 done
+
+    def visit(name: str, chain: tuple) -> None:
+        if state.get(name) == 1:
+            return
+        if state.get(name) == 0:
+            cycle = chain[chain.index(name):] + (name,)
+            raise ValueError(f"dependency cycle: {' -> '.join(cycle)}")
+        state[name] = 0
+        for d in deps[name]:
+            visit(d, chain + (name,))
+        state[name] = 1
+        order.append(name)
+
+    for name in deps:
+        visit(name, ())
+    return order

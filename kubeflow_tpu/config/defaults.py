@@ -30,6 +30,8 @@ CORE_COMPONENTS = [
     "study-controller",
     "benchmark-operator",
     "metric-collector",
+    "pipeline-operator",
+    "application",
 ]
 
 # Extra components for cloud deployments.

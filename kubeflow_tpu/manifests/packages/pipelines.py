@@ -1,0 +1,100 @@
+"""Pipelines package: workflow DAGs + Application aggregation.
+
+Analogue of the reference's argo + application packages
+(kubeflow/argo/argo.libsonnet:89-165 deploys the workflow-controller;
+kubeflow/application/application.libsonnet:14-60 defines the Application CR
+the final `kfctl apply` step instantiates, scripts/kfctl.sh:498-508).
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.apis.pipelines import application_crd, workflow_crd
+from kubeflow_tpu.k8s import objects as k8s
+from kubeflow_tpu.manifests import images
+from kubeflow_tpu.manifests.core import ParamSpec, prototype
+from kubeflow_tpu.version import API_GROUP, DEFAULT_NAMESPACE
+
+
+@prototype(
+    "pipeline-operator",
+    "Workflow + Application CRDs and their controller "
+    "(argo workflow-controller analogue)",
+    params=[
+        ParamSpec("namespace", DEFAULT_NAMESPACE),
+        ParamSpec("image", images.PLATFORM),
+    ],
+)
+def pipeline_operator(namespace: str, image: str) -> list[dict]:
+    name = "pipeline-operator"
+    labels = {"app": name}
+    return [
+        workflow_crd(),
+        application_crd(),
+        k8s.service_account(name, namespace, labels),
+        k8s.cluster_role(
+            name,
+            [
+                k8s.policy_rule(
+                    [API_GROUP],
+                    ["workflows", "workflows/status",
+                     "applications", "applications/status"],
+                    ["*"],
+                ),
+                # Tasks create job CRs / Deployments / Services on behalf
+                # of the workflow.
+                k8s.policy_rule(
+                    [API_GROUP],
+                    ["jaxjobs", "jaxjobs/status", "tfjobs", "pytorchjobs",
+                     "mxnetjobs", "chainerjobs", "mpijobs"],
+                    ["*"],
+                ),
+                k8s.policy_rule(
+                    ["apps"], ["deployments", "statefulsets"], ["*"]
+                ),
+                k8s.policy_rule(
+                    [""], ["services", "events"],
+                    ["get", "list", "watch", "create", "patch"],
+                ),
+            ],
+            labels,
+        ),
+        k8s.cluster_role_binding(name, name, name, namespace),
+        k8s.deployment(
+            name,
+            namespace,
+            containers=[
+                k8s.container(
+                    name,
+                    image,
+                    command=["python", "-m", "kubeflow_tpu.operators.pipeline"],
+                    ports={"metrics": 8443},
+                )
+            ],
+            labels=labels,
+            service_account=name,
+        ),
+    ]
+
+
+@prototype(
+    "application",
+    "Application CR aggregating the deployed platform "
+    "(application.libsonnet:14-60; applied last, kfctl.sh:498-508)",
+    params=[
+        ParamSpec("namespace", DEFAULT_NAMESPACE),
+        ParamSpec("name", "kubeflow-tpu"),
+    ],
+)
+def application(namespace: str, name: str) -> list[dict]:
+    return [{
+        "apiVersion": f"{API_GROUP}/v1",
+        "kind": "Application",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {
+            "selector": {"matchLabels": {}},
+            "descriptor": {
+                "type": "kubeflow-tpu",
+                "description": "TPU-native ML platform deployment",
+            },
+        },
+    }]

@@ -33,6 +33,8 @@ SNAPSHOT_CASES: dict[str, tuple[str, dict]] = {
         "tpu-serving",
         {"name": "bert", "model_path": "gs://models/bert", "num_tpu_chips": 4},
     ),
+    "pipeline-operator": ("pipeline-operator", {}),
+    "application": ("application", {}),
 }
 
 

@@ -16,6 +16,10 @@ def make_all_controllers(client):
     from kubeflow_tpu.benchmark.controller import BenchmarkJobController
     from kubeflow_tpu.operators.jobs import make_job_controllers
     from kubeflow_tpu.operators.notebooks import NotebookController
+    from kubeflow_tpu.operators.pipelines import (
+        ApplicationController,
+        WorkflowController,
+    )
     from kubeflow_tpu.operators.profiles import ProfileController
     from kubeflow_tpu.tuning.controller import StudyJobController
 
@@ -25,6 +29,8 @@ def make_all_controllers(client):
         ProfileController(client),
         StudyJobController(client),
         BenchmarkJobController(client),
+        WorkflowController(client),
+        ApplicationController(client),
     ]
 
 

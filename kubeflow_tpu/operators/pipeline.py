@@ -1,0 +1,26 @@
+"""Pipeline-operator entrypoint: `python -m kubeflow_tpu.operators.pipeline`
+(the argo workflow-controller + application sync Deployment analogue,
+kubeflow/argo/argo.libsonnet:89-165, kubeflow/application/
+application.libsonnet:14-60)."""
+
+from __future__ import annotations
+
+from kubeflow_tpu.runtime import controller_main
+
+
+def main(argv=None) -> int:
+    from kubeflow_tpu.operators.pipelines import (
+        ApplicationController,
+        WorkflowController,
+    )
+
+    return controller_main(
+        argv,
+        lambda client: [WorkflowController(client),
+                        ApplicationController(client)],
+        "kubeflow-tpu pipeline (workflow DAG + application) controller",
+    )
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

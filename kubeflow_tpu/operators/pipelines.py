@@ -1,0 +1,243 @@
+"""Workflow (pipeline DAG) and Application controllers.
+
+WorkflowController is the argo workflow-controller analogue
+(kubeflow/argo/argo.libsonnet:89-165) specialized to the platform's needs:
+tasks create Kubernetes objects (training-job CRs, serving Deployments) in
+dependency order, with completion read from the created object's own status
+— no sidecar executors or artifact store, because on this platform jobs
+already publish results through their status and checkpoints through storage.
+
+ApplicationController is the sync-application metacontroller hook analogue
+(kubeflow/application/application.libsonnet:14-60): it aggregates the
+readiness of everything matching the Application's selector into one status.
+"""
+
+from __future__ import annotations
+
+import copy
+
+from kubeflow_tpu.apis import jobs as jobs_api
+from kubeflow_tpu.apis.pipelines import (
+    APPLICATION_KIND,
+    PHASE_FAILED,
+    PHASE_PENDING,
+    PHASE_RUNNING,
+    PHASE_SUCCEEDED,
+    PIPELINES_API_VERSION,
+    WORKFLOW_KIND,
+    toposort_tasks,
+)
+from kubeflow_tpu.k8s import objects as k8s
+from kubeflow_tpu.k8s.client import ApiError
+from kubeflow_tpu.operators.base import Controller
+
+LABEL_WORKFLOW = "kubeflow-tpu.org/workflow"
+LABEL_TASK = "kubeflow-tpu.org/workflow-task"
+
+_TERMINAL = (PHASE_SUCCEEDED, PHASE_FAILED)
+
+
+def _resource_phase(obj: dict) -> tuple[str, str]:
+    """(phase, message) of a task's created object, by kind:
+
+    - platform job kinds + Workflow: their controllers write
+      ``status.state`` / ``status.phase`` (Succeeded/Failed are terminal);
+    - Deployment/StatefulSet: Succeeded when fully ready (a serving task is
+      "done" when it's up — the argo resource-template convention);
+    - Pod: phase verbatim;
+    - anything else: Succeeded once it exists (create-and-forget).
+    """
+    kind = obj.get("kind", "")
+    status = obj.get("status", {})
+    if kind in jobs_api.ALL_JOB_KINDS or "state" in status:
+        state = status.get("state", PHASE_RUNNING)
+        if state in _TERMINAL:
+            return state, status.get("message", "")
+        return PHASE_RUNNING, f"state={state}"
+    if kind == WORKFLOW_KIND:
+        phase = status.get("phase", PHASE_RUNNING)
+        return (phase if phase in _TERMINAL else PHASE_RUNNING), ""
+    if kind in ("Deployment", "StatefulSet"):
+        want = obj.get("spec", {}).get("replicas", 1)
+        ready = status.get("readyReplicas", 0)
+        if ready >= want:
+            return PHASE_SUCCEEDED, f"{ready}/{want} ready"
+        return PHASE_RUNNING, f"{ready}/{want} ready"
+    if kind == "Pod":
+        phase = status.get("phase", PHASE_PENDING)
+        if phase in _TERMINAL:
+            return phase, ""
+        return PHASE_RUNNING, f"phase={phase}"
+    return PHASE_SUCCEEDED, "created"
+
+
+class WorkflowController(Controller):
+    api_version = PIPELINES_API_VERSION
+    kind = WORKFLOW_KIND
+    resync_seconds = 5.0
+
+    def watched_kinds(self):
+        # Tasks may create any kind; job CRs and Deployments cover the
+        # train→serve hot path for event-driven wakeups, the resync loop
+        # covers the rest.
+        return [
+            *((jobs_api.JOBS_API_VERSION, kind)
+              for kind in jobs_api.ALL_JOB_KINDS),
+            ("apps/v1", "Deployment"),
+        ]
+
+    def reconcile(self, wf: dict) -> None:
+        wf = copy.deepcopy(wf)
+        before = copy.deepcopy(wf.get("status", {}))
+        status = wf.setdefault("status", {})
+        if status.get("phase") in _TERMINAL:
+            return
+        tasks = wf["spec"]["tasks"]
+        try:
+            toposort_tasks(tasks)
+        except ValueError as e:
+            status.update(phase=PHASE_FAILED, message=f"invalid DAG: {e}")
+            self.client.update_status(wf)
+            return
+
+        status.setdefault("phase", PHASE_RUNNING)
+        task_status = status.setdefault("tasks", {})
+        for t in tasks:
+            task_status.setdefault(
+                t["name"], {"phase": PHASE_PENDING, "message": ""}
+            )
+
+        failed = [n for n, s in task_status.items()
+                  if s["phase"] == PHASE_FAILED]
+        for t in tasks:
+            ts = task_status[t["name"]]
+            if ts["phase"] in _TERMINAL:
+                continue
+            deps = t.get("dependencies", [])
+            if any(task_status[d]["phase"] == PHASE_FAILED for d in deps):
+                ts.update(phase=PHASE_FAILED, message="dependency failed")
+                continue
+            if not all(task_status[d]["phase"] == PHASE_SUCCEEDED
+                       for d in deps):
+                continue  # stays Pending
+            if failed:
+                continue  # stop launching new work once anything failed
+            try:
+                live = self._ensure_resource(wf, t)
+            except ApiError as e:
+                # Malformed task resource (bad kind, schema reject): fail
+                # the task visibly instead of log-and-retry forever.
+                if 400 <= e.code < 500 and e.code != 409:
+                    ts.update(phase=PHASE_FAILED,
+                              message=f"create failed: {e}")
+                    continue
+                raise
+            phase, message = _resource_phase(live)
+            ts.update(phase=phase, message=message,
+                      resourceName=live["metadata"]["name"],
+                      resourceKind=live.get("kind", ""))
+
+        phases = [task_status[t["name"]]["phase"] for t in tasks]
+        if any(p == PHASE_FAILED for p in phases):
+            # Fail only once nothing is still in flight (running tasks get
+            # to finish; nothing new starts).
+            if all(p in (*_TERMINAL, PHASE_PENDING) for p in phases):
+                status["phase"] = PHASE_FAILED
+                status["message"] = "task failed: " + ", ".join(
+                    n for n, s in task_status.items()
+                    if s["phase"] == PHASE_FAILED
+                )
+        elif all(p == PHASE_SUCCEEDED for p in phases):
+            status["phase"] = PHASE_SUCCEEDED
+            status["message"] = f"{len(tasks)} tasks completed"
+        # Only write on change: an unconditional PUT emits MODIFIED, which
+        # requeues this object — a self-triggering hot loop under run().
+        if status != before:
+            self.client.update_status(wf)
+
+    # ------------------------------------------------------------------
+
+    def _ensure_resource(self, wf: dict, task: dict) -> dict:
+        """Create the task's object if absent; return the live object."""
+        ns = wf["metadata"]["namespace"]
+        resource = copy.deepcopy(task["resource"])
+        meta = resource.setdefault("metadata", {})
+        meta.setdefault("name", f"{wf['metadata']['name']}-{task['name']}")
+        meta.setdefault("namespace", ns)
+        labels = meta.setdefault("labels", {})
+        labels[LABEL_WORKFLOW] = wf["metadata"]["name"]
+        labels[LABEL_TASK] = task["name"]
+        meta["ownerReferences"] = [k8s.object_ref(wf)]
+        live = self.client.get_or_none(
+            resource.get("apiVersion", "v1"), resource.get("kind", ""),
+            meta["name"], meta["namespace"],
+        )
+        if live is not None:
+            return live
+        try:
+            return self.client.create(resource)
+        except ApiError as e:
+            if e.code == 409:  # lost a create race with ourselves
+                return self.client.get(
+                    resource.get("apiVersion", "v1"),
+                    resource.get("kind", ""), meta["name"], meta["namespace"],
+                )
+            raise
+
+
+class ApplicationController(Controller):
+    api_version = PIPELINES_API_VERSION
+    kind = APPLICATION_KIND
+    resync_seconds = 15.0
+
+    # Kinds aggregated when spec.componentKinds is not given — the resource
+    # families the platform deploys (application.libsonnet computes this
+    # from deployed component manifests; declaring it keeps the controller
+    # list-scoped instead of cluster-scanning).
+    DEFAULT_KINDS = (
+        ("apps/v1", "Deployment"),
+        ("apps/v1", "StatefulSet"),
+        ("v1", "Service"),
+        *((jobs_api.JOBS_API_VERSION, kind)
+          for kind in jobs_api.ALL_JOB_KINDS),
+    )
+
+    def reconcile(self, app: dict) -> None:
+        app = copy.deepcopy(app)
+        ns = app["metadata"]["namespace"]
+        spec = app.get("spec", {})
+        selector = spec.get("selector", {}).get("matchLabels", {})
+        kinds = [
+            (f"{ck['group']}/v1" if ck.get("group") else "v1", ck["kind"])
+            for ck in spec.get("componentKinds", [])
+        ] or list(self.DEFAULT_KINDS)
+
+        components, ready = [], 0
+        for api_version, kind in kinds:
+            try:
+                objs = self.client.list(
+                    api_version, kind, namespace=ns,
+                    label_selector=selector or None,
+                )
+            except ApiError:
+                continue  # kind not installed on this cluster
+            for obj in objs:
+                phase, _ = _resource_phase(obj)
+                is_ready = phase == PHASE_SUCCEEDED
+                ready += int(is_ready)
+                components.append({
+                    "kind": kind,
+                    "name": obj["metadata"]["name"],
+                    "status": "Ready" if is_ready else phase,
+                })
+
+        before = copy.deepcopy(app.get("status", {}))
+        status = app.setdefault("status", {})
+        status["components"] = components
+        status["componentsReady"] = f"{ready}/{len(components)}"
+        status["assemblyPhase"] = (
+            PHASE_SUCCEEDED if components and ready == len(components)
+            else PHASE_PENDING
+        )
+        if status != before:  # avoid the self-triggering MODIFIED loop
+            self.client.update_status(app)

@@ -51,6 +51,11 @@ class GrpcPredictionService:
         self.bound_port = self._grpc_server.add_insecure_port(
             f"0.0.0.0:{port}"
         )
+        if self.bound_port == 0 and port != 0:
+            # grpc reports bind failure by returning port 0 instead of
+            # raising (unlike the REST side's OSError) — surface it, or the
+            # :9000 liveness probe restart-loops with no explanation.
+            raise OSError(f"could not bind gRPC port {port}")
 
     def start(self) -> None:
         self._grpc_server.start()
@@ -93,16 +98,26 @@ class _Handler(grpc.GenericRpcHandler):
         return body
 
     def _predict(self, request: bytes, context) -> bytes:
+        import time
+
         server = self.model_server
-        body = self._parse(request, context)
-        name = body.get("model") or server.engine.cfg.model
+        t0 = time.perf_counter()
+        error = True  # aborts raise out of the try
         try:
-            result = server.handle_predict(name, body)
-        except KeyError as e:
-            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
-        except (ValueError, TimeoutError) as e:
-            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
-        return _json_bytes(result)
+            body = self._parse(request, context)
+            name = body.get("model") or server.engine.cfg.model
+            try:
+                result = server.handle_predict(name, body)
+            except KeyError as e:
+                context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+            except (ValueError, TimeoutError) as e:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            error = False
+            return _json_bytes(result)
+        finally:
+            # Same counters as REST, so /monitoring/prometheus/metrics sees
+            # :9000 traffic too.
+            server.metrics.observe(time.perf_counter() - t0, error)
 
     def _metadata(self, request: bytes, context) -> bytes:
         server = self.model_server

@@ -52,7 +52,8 @@ class _Metrics:
 
 class ModelServer:
     """Dual-port model server: REST on ``port`` (:8500 by convention), gRPC
-    on ``grpc_port`` (:9000; 0 disables) — the tf-serving port contract
+    on ``grpc_port`` (:9000; None disables, 0 binds an ephemeral port for
+    tests) — the tf-serving port contract
     (tf-serving-template.libsonnet:43-49). Both ports share one engine and
     one dynamic batcher, so mixed-protocol traffic coalesces into the same
     TPU batches."""

@@ -1,0 +1,252 @@
+"""Workflow DAG + Application controller tests (the argo/application tier:
+workflow semantics the reference exercises via testing/workflows/
+components/workflows.libsonnet DAGs, run here against the fake apiserver)."""
+
+import pytest
+
+from kubeflow_tpu.apis import jobs as jobs_api
+from kubeflow_tpu.apis.pipelines import (
+    PIPELINES_API_VERSION,
+    application_crd,
+    toposort_tasks,
+    workflow_crd,
+)
+from kubeflow_tpu.operators.jobs import JobController
+from kubeflow_tpu.operators.pipelines import (
+    ApplicationController,
+    WorkflowController,
+)
+
+
+def test_toposort_orders_and_rejects():
+    tasks = [
+        {"name": "c", "dependencies": ["a", "b"]},
+        {"name": "a"},
+        {"name": "b", "dependencies": ["a"]},
+    ]
+    order = toposort_tasks(tasks)
+    assert order.index("a") < order.index("b") < order.index("c")
+    with pytest.raises(ValueError, match="duplicate"):
+        toposort_tasks([{"name": "x"}, {"name": "x"}])
+    with pytest.raises(ValueError, match="unknown"):
+        toposort_tasks([{"name": "x", "dependencies": ["nope"]}])
+    with pytest.raises(ValueError, match="cycle"):
+        toposort_tasks([
+            {"name": "a", "dependencies": ["b"]},
+            {"name": "b", "dependencies": ["a"]},
+        ])
+
+
+def job_task(name, deps=()):
+    return {
+        "name": name,
+        "dependencies": list(deps),
+        "resource": {
+            "apiVersion": jobs_api.JOBS_API_VERSION,
+            "kind": "JaxJob",
+            "spec": {"replicaSpecs": {"Worker": {
+                "replicas": 1,
+                "template": {"spec": {"containers": [
+                    {"name": "main", "image": "train:latest"}
+                ]}},
+            }}},
+        },
+    }
+
+
+def make_workflow(tasks, name="wf"):
+    return {
+        "apiVersion": PIPELINES_API_VERSION,
+        "kind": "Workflow",
+        "metadata": {"name": name, "namespace": "kubeflow"},
+        "spec": {"tasks": tasks},
+    }
+
+
+@pytest.fixture()
+def env(api):
+    api.apply(workflow_crd())
+    api.apply(application_crd())
+    for crd in jobs_api.all_job_crds():
+        api.apply(crd)
+    return api, WorkflowController(api)
+
+
+def set_job_state(api, name, state):
+    job = api.get(jobs_api.JOBS_API_VERSION, "JaxJob", name, "kubeflow")
+    job.setdefault("status", {})["state"] = state
+    api.update_status(job)
+
+
+def test_workflow_train_then_serve(env):
+    """The 2-step train→serve pipeline: serving Deployment only created
+    after the training job succeeds; workflow succeeds once serving is up."""
+    api, ctrl = env
+    serve_task = {
+        "name": "serve",
+        "dependencies": ["train"],
+        "resource": {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "spec": {
+                "replicas": 1,
+                "selector": {"matchLabels": {"app": "serve"}},
+                "template": {"metadata": {"labels": {"app": "serve"}},
+                             "spec": {"containers": [
+                                 {"name": "s", "image": "serve:latest"}
+                             ]}},
+            },
+        },
+    }
+    api.create(make_workflow([job_task("train"), serve_task]))
+    ctrl.reconcile_all()
+
+    # Train job created, serve not yet.
+    assert api.get(jobs_api.JOBS_API_VERSION, "JaxJob", "wf-train",
+                   "kubeflow")
+    assert api.get_or_none("apps/v1", "Deployment", "wf-serve",
+                           "kubeflow") is None
+    wf = api.get(PIPELINES_API_VERSION, "Workflow", "wf", "kubeflow")
+    assert wf["status"]["phase"] == "Running"
+    assert wf["status"]["tasks"]["train"]["phase"] == "Running"
+    assert wf["status"]["tasks"]["serve"]["phase"] == "Pending"
+
+    set_job_state(api, "wf-train", "Succeeded")
+    ctrl.reconcile_all()
+    dep = api.get("apps/v1", "Deployment", "wf-serve", "kubeflow")
+    assert dep["metadata"]["ownerReferences"][0]["kind"] == "Workflow"
+
+    # Deployment becomes ready → workflow Succeeded.
+    dep.setdefault("status", {})["readyReplicas"] = 1
+    api.update_status(dep)
+    ctrl.reconcile_all()
+    wf = api.get(PIPELINES_API_VERSION, "Workflow", "wf", "kubeflow")
+    assert wf["status"]["phase"] == "Succeeded"
+
+
+def test_workflow_failure_propagates(env):
+    api, ctrl = env
+    api.create(make_workflow([
+        job_task("train"),
+        job_task("eval", deps=["train"]),
+    ]))
+    ctrl.reconcile_all()
+    set_job_state(api, "wf-train", "Failed")
+    ctrl.reconcile_all()
+    wf = api.get(PIPELINES_API_VERSION, "Workflow", "wf", "kubeflow")
+    assert wf["status"]["phase"] == "Failed"
+    assert wf["status"]["tasks"]["eval"]["phase"] == "Failed"
+    # Downstream job never created.
+    assert api.get_or_none(jobs_api.JOBS_API_VERSION, "JaxJob", "wf-eval",
+                           "kubeflow") is None
+
+
+def test_workflow_diamond_parallel_branches(env):
+    api, ctrl = env
+    api.create(make_workflow([
+        job_task("prep"),
+        job_task("left", deps=["prep"]),
+        job_task("right", deps=["prep"]),
+        job_task("merge", deps=["left", "right"]),
+    ]))
+    ctrl.reconcile_all()
+    set_job_state(api, "wf-prep", "Succeeded")
+    ctrl.reconcile_all()
+    # Both branches launch concurrently once prep is done.
+    assert api.get(jobs_api.JOBS_API_VERSION, "JaxJob", "wf-left", "kubeflow")
+    assert api.get(jobs_api.JOBS_API_VERSION, "JaxJob", "wf-right", "kubeflow")
+    assert api.get_or_none(jobs_api.JOBS_API_VERSION, "JaxJob", "wf-merge",
+                           "kubeflow") is None
+    set_job_state(api, "wf-left", "Succeeded")
+    set_job_state(api, "wf-right", "Succeeded")
+    ctrl.reconcile_all()
+    set_job_state(api, "wf-merge", "Succeeded")
+    ctrl.reconcile_all()
+    wf = api.get(PIPELINES_API_VERSION, "Workflow", "wf", "kubeflow")
+    assert wf["status"]["phase"] == "Succeeded"
+
+
+def test_workflow_invalid_dag_fails_fast(env):
+    api, ctrl = env
+    api.create(make_workflow([
+        {"name": "a", "dependencies": ["a"],
+         "resource": {"apiVersion": "v1", "kind": "ConfigMap"}},
+    ]))
+    ctrl.reconcile_all()
+    wf = api.get(PIPELINES_API_VERSION, "Workflow", "wf", "kubeflow")
+    assert wf["status"]["phase"] == "Failed"
+    assert "cycle" in wf["status"]["message"]
+
+
+@pytest.mark.slow
+def test_workflow_e2e_real_job_through_kubelet(env):
+    """Full-stack pipeline: workflow → JaxJob → real subprocess worker via
+    the fake kubelet → job Succeeded → workflow Succeeded."""
+    from kubeflow_tpu.k8s.kubelet import FakeKubelet
+
+    api, ctrl = env
+    job_ctrl = JobController(api, "JaxJob")
+    task = job_task("smoke")
+    task["resource"]["spec"]["replicaSpecs"]["Worker"]["template"] = {
+        "spec": {"containers": [{
+            "name": "main",
+            "image": "kubeflow-tpu/worker:latest",
+            "command": ["python", "-m",
+                        "kubeflow_tpu.workloads.allreduce_smoke"],
+        }]},
+    }
+    api.create(make_workflow([task], name="e2e"))
+    kubelet = FakeKubelet(api, cpu_devices_per_pod=1)
+    try:
+        def tick():
+            ctrl.reconcile_all()
+            job_ctrl.reconcile_all()
+
+        tick()
+        kubelet.run_until_idle(reconcile=tick)
+        tick()
+    finally:
+        kubelet.shutdown()
+    wf = api.get(PIPELINES_API_VERSION, "Workflow", "e2e", "kubeflow")
+    assert wf["status"]["phase"] == "Succeeded", wf["status"]
+
+
+def test_application_aggregates_components(env):
+    api, _ = env
+    app_ctrl = ApplicationController(api)
+    api.create({
+        "apiVersion": PIPELINES_API_VERSION,
+        "kind": "Application",
+        "metadata": {"name": "kf", "namespace": "kubeflow"},
+        "spec": {"selector": {"matchLabels": {"part-of": "kf"}}},
+    })
+    api.create({
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": "d1", "namespace": "kubeflow",
+                     "labels": {"part-of": "kf"}},
+        "spec": {"replicas": 1},
+    })
+    api.create({
+        "apiVersion": "v1", "kind": "Service",
+        "metadata": {"name": "s1", "namespace": "kubeflow",
+                     "labels": {"part-of": "kf"}},
+        "spec": {},
+    })
+    # Unlabeled object is not aggregated.
+    api.create({
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": "other", "namespace": "kubeflow"},
+        "spec": {"replicas": 1},
+    })
+    app_ctrl.reconcile_all()
+    app = api.get(PIPELINES_API_VERSION, "Application", "kf", "kubeflow")
+    assert app["status"]["componentsReady"] == "1/2"  # Service ready, dep not
+    assert app["status"]["assemblyPhase"] == "Pending"
+
+    dep = api.get("apps/v1", "Deployment", "d1", "kubeflow")
+    dep.setdefault("status", {})["readyReplicas"] = 1
+    api.update_status(dep)
+    app_ctrl.reconcile_all()
+    app = api.get(PIPELINES_API_VERSION, "Application", "kf", "kubeflow")
+    assert app["status"]["assemblyPhase"] == "Succeeded"
+    assert app["status"]["componentsReady"] == "2/2"
