@@ -1,0 +1,247 @@
+"""GCP provisioning driver: project init, cluster + TPU node-pool
+create/update with blocking waits, IAM bindings, and platform secrets.
+
+The depth the reference's gcp KfApp has (bootstrap/pkg/kfapp/gcp/gcp.go):
+``gcpInitProject`` enables the service APIs (:1170-1199), ``updateDM``
+creates/updates infrastructure and ``blockingWait`` polls the operation
+until done (:480, :221-252), ``Apply`` then binds IAM roles and bootstraps
+k8s (namespace + admin binding, :567-651, :317-358) and ``createSecrets``
+materializes credentials as k8s Secrets (:1078-1168). Deployment Manager is
+replaced by direct gcloud container/TPU surface — the current-generation
+path for TPU node pools.
+
+All gcloud interaction goes through :class:`GcloudRunner`, which in dry-run
+mode records the exact commands and returns scripted outputs — the tests'
+seam, and also `kfctl generate && kfctl apply --dry-run`'s preview.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shutil
+import subprocess
+import time
+from dataclasses import dataclass, field
+
+import yaml
+
+logger = logging.getLogger(__name__)
+
+# Service APIs the platform needs enabled (gcpInitProject's enabledApis
+# list, gcp.go:1170-1199, with TPU replacing ML Engine).
+REQUIRED_SERVICES = (
+    "container.googleapis.com",
+    "tpu.googleapis.com",
+    "compute.googleapis.com",
+    "iam.googleapis.com",
+    "logging.googleapis.com",
+    "monitoring.googleapis.com",
+)
+
+OPERATION_POLL_SECONDS = 10.0
+OPERATION_TIMEOUT_SECONDS = 1800.0
+
+
+class GcloudError(RuntimeError):
+    pass
+
+
+@dataclass
+class GcloudRunner:
+    """Runs gcloud commands; dry_run records them and plays back scripted
+    stdout (FIFO per command prefix, then '{}')."""
+
+    dry_run: bool = False
+    history: list[list[str]] = field(default_factory=list)
+    scripted: dict[str, list[str]] = field(default_factory=dict)
+    sleep = staticmethod(time.sleep)
+
+    def run(self, *args: str) -> str:
+        argv = ["gcloud", *args]
+        self.history.append(argv)
+        if self.dry_run:
+            for prefix, outputs in self.scripted.items():
+                if " ".join(argv).startswith(prefix) and outputs:
+                    return outputs.pop(0)
+            return "{}"
+        if shutil.which("gcloud") is None:
+            raise GcloudError(
+                "gcloud is not installed; re-run with --dry-run to preview "
+                "the provisioning commands"
+            )
+        proc = subprocess.run(argv, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise GcloudError(
+                f"{' '.join(argv)} failed: {proc.stderr.strip()[:500]}"
+            )
+        return proc.stdout
+
+
+class GcpProvisioner:
+    """The gcp.go Apply flow against the configs generate() wrote."""
+
+    def __init__(self, runner: GcloudRunner):
+        self.runner = runner
+
+    # -- project ------------------------------------------------------
+
+    def init_project(self, project: str) -> None:
+        """Enable required service APIs (gcpInitProject, gcp.go:1170)."""
+        out = self.runner.run(
+            "services", "list", "--enabled", f"--project={project}",
+            "--format=json",
+        )
+        enabled = {s.get("config", {}).get("name", s.get("name", ""))
+                   for s in _json(out, [])}
+        for svc in REQUIRED_SERVICES:
+            if svc not in enabled:
+                self.runner.run(
+                    "services", "enable", svc, f"--project={project}"
+                )
+
+    # -- cluster + TPU pool --------------------------------------------
+
+    def ensure_cluster(self, cluster: dict) -> None:
+        """Create the cluster and its node pools if absent; block on the
+        returned operations (updateDM + blockingWait, gcp.go:480/:221)."""
+        project, zone = cluster["project"], cluster["zone"]
+        name = cluster["name"]
+        existing = _json(self.runner.run(
+            "container", "clusters", "list", f"--project={project}",
+            f"--zone={zone}", "--format=json",
+        ), [])
+        if name not in [c.get("name") for c in existing]:
+            pool = cluster["nodePools"][0]
+            self.runner.run(
+                "container", "clusters", "create", name,
+                f"--project={project}", f"--zone={zone}",
+                f"--machine-type={pool['machineType']}",
+                f"--num-nodes={pool['initialNodeCount']}",
+                "--async", "--format=json",
+            )
+            self.block_on_operations(project, zone)
+        live_pools = _json(self.runner.run(
+            "container", "node-pools", "list", f"--cluster={name}",
+            f"--project={project}", f"--zone={zone}", "--format=json",
+        ), [])
+        live_names = [p.get("name") for p in live_pools]
+        for pool in cluster["nodePools"][1:]:
+            if pool["name"] in live_names:
+                continue
+            args = [
+                "container", "node-pools", "create", pool["name"],
+                f"--cluster={name}", f"--project={project}",
+                f"--zone={zone}", f"--machine-type={pool['machineType']}",
+                f"--num-nodes={pool['initialNodeCount']}",
+            ]
+            topo = pool.get("placementPolicy", {}).get("tpuTopology")
+            if topo:
+                args.append(f"--tpu-topology={topo}")
+            if pool.get("autoscaling", {}).get("enabled"):
+                args += [
+                    "--enable-autoscaling",
+                    f"--min-nodes={pool['autoscaling']['minNodeCount']}",
+                    f"--max-nodes={pool['autoscaling']['maxNodeCount']}",
+                ]
+            self.runner.run(*args, "--async", "--format=json")
+            self.block_on_operations(project, zone)
+
+    def block_on_operations(self, project: str, zone: str,
+                            timeout: float = OPERATION_TIMEOUT_SECONDS
+                            ) -> None:
+        """Poll container operations until none are running — the
+        blockingWait loop (gcp.go:221-252), with its deadline."""
+        deadline = time.monotonic() + timeout
+        while True:
+            ops = _json(self.runner.run(
+                "container", "operations", "list", f"--project={project}",
+                f"--zone={zone}", "--format=json",
+            ), [])
+            pending = [op for op in ops
+                       if op.get("status") not in ("DONE", "ABORTING")]
+            errors = [op for op in ops
+                      if op.get("status") == "DONE" and op.get("error")]
+            if errors:
+                raise GcloudError(f"operation failed: {errors[0]}")
+            if not pending:
+                return
+            if time.monotonic() > deadline:
+                raise GcloudError(
+                    f"timed out waiting on operations: "
+                    f"{[op.get('name') for op in pending]}"
+                )
+            self.runner.sleep(OPERATION_POLL_SECONDS)
+
+    # -- IAM ------------------------------------------------------------
+
+    def apply_iam_bindings(self, project: str, bindings: list[dict]) -> None:
+        """Additive role bindings (the iam_bindings.yaml generate() wrote;
+        createIamBindings semantics, gcp.go:567-651)."""
+        for binding in bindings:
+            for member in binding.get("members", []):
+                self.runner.run(
+                    "projects", "add-iam-policy-binding", project,
+                    f"--member={member}", f"--role={binding['role']}",
+                    "--format=json",
+                )
+
+    # -- k8s bootstrap + secrets -----------------------------------------
+
+    def bootstrap_k8s(self, client, kfdef) -> None:
+        """Namespace + admin binding + platform secrets on the deployment
+        cluster (ConfigK8s/bindAdmin gcp.go:317-358, createSecrets :1078)."""
+        from kubeflow_tpu.k8s import objects as k8s
+
+        ns = kfdef.spec.namespace
+        client.apply(k8s.namespace_obj(ns))
+        client.apply(k8s.cluster_role_binding(
+            f"{kfdef.name}-admin", "cluster-admin",
+            f"{kfdef.name}-admin", ns,
+        ))
+        email = (f"{kfdef.name}-admin@{kfdef.spec.project}"
+                 ".iam.gserviceaccount.com")
+        key_json = self._service_account_key(email)
+        client.apply({
+            "apiVersion": "v1",
+            "kind": "Secret",
+            "metadata": {"name": "admin-gcp-sa", "namespace": ns},
+            "type": "Opaque",
+            "stringData": {"admin-gcp-sa.json": key_json},
+        })
+
+    def _service_account_key(self, email: str) -> str:
+        """Mint a key for the admin SA (createGcpSecret, gcp.go:1078-1120).
+        In dry-run the scripted output stands in for the key file."""
+        return self.runner.run(
+            "iam", "service-accounts", "keys", "create", "/dev/stdout",
+            f"--iam-account={email}", "--format=json",
+        )
+
+
+def provision(kfdef, app_dir: str, client=None, *,
+              runner: GcloudRunner | None = None) -> GcloudRunner:
+    """Full apply flow from the generated gcp_config/ directory."""
+    runner = runner or GcloudRunner()
+    prov = GcpProvisioner(runner)
+    cfg_dir = os.path.join(app_dir, "gcp_config")
+    with open(os.path.join(cfg_dir, "cluster.yaml")) as f:
+        cluster = yaml.safe_load(f)["cluster"]
+    with open(os.path.join(cfg_dir, "iam_bindings.yaml")) as f:
+        bindings = yaml.safe_load(f)["bindings"]
+
+    prov.init_project(cluster["project"])
+    prov.ensure_cluster(cluster)
+    prov.apply_iam_bindings(cluster["project"], bindings)
+    if client is not None:
+        prov.bootstrap_k8s(client, kfdef)
+    return runner
+
+
+def _json(text: str, default):
+    try:
+        out = json.loads(text or "null")
+    except ValueError:
+        return default
+    return out if out is not None else default

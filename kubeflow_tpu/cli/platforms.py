@@ -15,7 +15,6 @@ generateDMConfigs :951, updateDM :480, blockingWait :221), minikube
 
 from __future__ import annotations
 
-import json
 import logging
 import os
 import shutil
@@ -174,30 +173,22 @@ class GcpTpuPlatform(NonePlatform):
             yaml.safe_dump(iam, f, sort_keys=False)
 
     def apply(self, kfdef: KfDef) -> None:
-        if shutil.which("gcloud") is None:
+        """Full provisioning flow (gcp.go Apply semantics): enable service
+        APIs, create cluster + TPU node pools with blocking waits, bind IAM
+        roles, bootstrap the namespace/admin-binding/SA-secret. Without
+        gcloud installed this degrades to a dry run that logs the exact
+        command sequence (the preview the reference prints via DM configs).
+        """
+        from kubeflow_tpu.cli.gcp import GcloudRunner, provision
+
+        dry = shutil.which("gcloud") is None
+        runner = GcloudRunner(dry_run=dry)
+        client = None if dry else self.client(kfdef)
+        provision(kfdef, kfdef.spec.app_dir, client, runner=runner)
+        if dry:
             logger.warning(
-                "gcloud not installed; provision the cluster from "
-                "%s/gcp_config/cluster.yaml manually",
-                kfdef.spec.app_dir,
-            )
-            return
-        cfg = os.path.join(kfdef.spec.app_dir, "gcp_config", "cluster.yaml")
-        with open(cfg) as f:
-            cluster = yaml.safe_load(f)["cluster"]
-        existing = subprocess.run(
-            [
-                "gcloud", "container", "clusters", "list",
-                f"--project={cluster['project']}", f"--zone={cluster['zone']}",
-                "--format=json",
-            ],
-            capture_output=True,
-            text=True,
-        )
-        names = [c["name"] for c in json.loads(existing.stdout or "[]")]
-        if cluster["name"] not in names:
-            raise RuntimeError(
-                f"cluster {cluster['name']} not found in project; create it with "
-                f"gcloud container clusters create-auto (see {cfg})"
+                "gcloud not installed - dry run; would have executed:\n%s",
+                "\n".join("  " + " ".join(argv) for argv in runner.history),
             )
 
 

@@ -1,0 +1,123 @@
+"""GCP provisioning driver tests — the gcp.go Apply flow (service enable,
+cluster/node-pool create with blocking wait, IAM bindings, k8s bootstrap +
+secrets) exercised end to end in dry-run with scripted gcloud output."""
+
+import json
+import os
+
+import pytest
+
+from kubeflow_tpu.cli.gcp import (
+    GcloudError,
+    GcloudRunner,
+    GcpProvisioner,
+    provision,
+)
+from kubeflow_tpu.cli.platforms import GcpTpuPlatform
+from kubeflow_tpu.config.kfdef import KfDef
+
+
+def make_kfdef(tmp_path):
+    kfdef = KfDef.from_dict({
+        "apiVersion": "kubeflow-tpu.org/v1",
+        "kind": "KfDef",
+        "metadata": {"name": "kf"},
+        "spec": {
+            "platform": "gcp-tpu",
+            "project": "proj",
+            "zone": "us-central2-b",
+            "appDir": str(tmp_path),
+            "tpu": {"accelerator": "v5litepod-16", "topology": "4x4"},
+        },
+    })
+    GcpTpuPlatform().generate(kfdef, str(tmp_path))
+    return kfdef
+
+
+def cmds(runner, verb):
+    return [argv for argv in runner.history if verb in " ".join(argv)]
+
+
+def test_provision_full_flow_command_sequence(tmp_path, api):
+    kfdef = make_kfdef(tmp_path)
+    runner = GcloudRunner(dry_run=True, scripted={
+        # No services enabled yet -> all get enabled.
+        "gcloud services list": ["[]"],
+        # Cluster absent -> created; ops: one RUNNING poll then DONE.
+        "gcloud container clusters list": ["[]"],
+        "gcloud container operations list": [
+            json.dumps([{"name": "op1", "status": "RUNNING"}]),
+            json.dumps([{"name": "op1", "status": "DONE"}]),
+            "[]",  # node-pool wait
+        ],
+        "gcloud container node-pools list": ["[]"],
+        "gcloud iam service-accounts keys create":
+            ['{"type": "service_account"}'],
+    })
+    runner.sleep = lambda s: None
+    provision(kfdef, str(tmp_path), api, runner=runner)
+
+    enables = cmds(runner, "services enable")
+    assert any("tpu.googleapis.com" in " ".join(c) for c in enables)
+    assert len(cmds(runner, "clusters create")) == 1
+    pools = cmds(runner, "node-pools create")
+    assert len(pools) == 1
+    pool_cmd = " ".join(pools[0])
+    assert "--tpu-topology=4x4" in pool_cmd
+    assert "ct5lp-hightpu-4t" in pool_cmd
+    # Blocking wait actually polled twice for the cluster op.
+    assert len(cmds(runner, "operations list")) >= 2
+    assert len(cmds(runner, "add-iam-policy-binding")) >= 2
+
+    # K8s bootstrap: namespace, admin binding, SA-key secret.
+    assert api.get("v1", "Namespace", "kubeflow")
+    sec = api.get("v1", "Secret", "admin-gcp-sa", "kubeflow")
+    assert "service_account" in sec["stringData"]["admin-gcp-sa.json"]
+    binding = api.get("rbac.authorization.k8s.io/v1", "ClusterRoleBinding",
+                      "kf-admin")
+    assert binding["roleRef"]["name"] == "cluster-admin"
+
+
+def test_provision_skips_existing_cluster_and_services(tmp_path, api):
+    kfdef = make_kfdef(tmp_path)
+    runner = GcloudRunner(dry_run=True, scripted={
+        "gcloud services list": [json.dumps(
+            [{"config": {"name": s}} for s in (
+                "container.googleapis.com", "tpu.googleapis.com",
+                "compute.googleapis.com", "iam.googleapis.com",
+                "logging.googleapis.com", "monitoring.googleapis.com",
+            )]
+        )],
+        "gcloud container clusters list": ['[{"name": "kf"}]'],
+        "gcloud container node-pools list": [
+            '[{"name": "platform-pool"}, {"name": "tpu-pool"}]'
+        ],
+        "gcloud iam service-accounts keys create": ["{}"],
+    })
+    provision(kfdef, str(tmp_path), api, runner=runner)
+    assert not cmds(runner, "services enable")
+    assert not cmds(runner, "clusters create")
+    assert not cmds(runner, "node-pools create")
+
+
+def test_blocking_wait_surfaces_operation_error():
+    runner = GcloudRunner(dry_run=True, scripted={
+        "gcloud container operations list": [json.dumps(
+            [{"name": "op1", "status": "DONE",
+              "error": {"message": "quota exceeded"}}]
+        )],
+    })
+    with pytest.raises(GcloudError, match="quota"):
+        GcpProvisioner(runner).block_on_operations("proj", "zone")
+
+
+def test_blocking_wait_times_out():
+    runner = GcloudRunner(dry_run=True, scripted={
+        "gcloud container operations list": [
+            json.dumps([{"name": "op1", "status": "RUNNING"}])
+        ] * 100,
+    })
+    runner.sleep = lambda s: None
+    with pytest.raises(GcloudError, match="timed out"):
+        GcpProvisioner(runner).block_on_operations("proj", "zone",
+                                                   timeout=-1.0)
