@@ -34,6 +34,7 @@ SNAPSHOT_CASES: dict[str, tuple[str, dict]] = {
         {"name": "bert", "model_path": "gs://models/bert", "num_tpu_chips": 4},
     ),
     "pipeline-operator": ("pipeline-operator", {}),
+    "tensorboard": ("tensorboard", {"log_dir": "gs://bucket/logs"}),
     "application": ("application", {}),
 }
 

@@ -42,6 +42,13 @@ class RunConfig:
     checkpoint_dir: str | None = None
     checkpoint_every: int = 500
     seed: int = 0
+    # jax.profiler trace capture (SURVEY §5.1 — the subsystem the reference
+    # lacks): traces profile_steps steps starting at profile_start_step
+    # (after compilation) into profile_dir, viewable in tensorboard/xprof
+    # via the tensorboard manifest package.
+    profile_dir: str | None = None
+    profile_start_step: int = 3
+    profile_steps: int = 5
 
 
 def run(cfg: RunConfig, *, log=print) -> dict:
@@ -72,7 +79,18 @@ def run(cfg: RunConfig, *, log=print) -> dict:
     t_last = time.perf_counter()
     samples_since = 0
     throughput = 0.0
+    profiling = False
     for step in range(start_step, cfg.steps):
+        if cfg.profile_dir and info.process_id == 0:
+            if step - start_step == cfg.profile_start_step:
+                jax.profiler.start_trace(cfg.profile_dir)
+                profiling = True
+            elif (profiling and
+                  step - start_step ==
+                  cfg.profile_start_step + cfg.profile_steps):
+                jax.profiler.stop_trace()
+                profiling = False
+                log(f"profiler trace written to {cfg.profile_dir}")
         batch = place_batch(next(stream), mesh, model)
         state, metrics = step_fn(state, batch)
         samples_since += cfg.batch_size
@@ -90,6 +108,9 @@ def run(cfg: RunConfig, *, log=print) -> dict:
             and (step + 1) % cfg.checkpoint_every == 0
         ):
             ckpt_lib.save(cfg.checkpoint_dir, step + 1, state)
+    if profiling:  # short runs: close the trace instead of dropping it
+        jax.profiler.stop_trace()
+        log(f"profiler trace written to {cfg.profile_dir}")
     if cfg.checkpoint_dir and ckpt_lib.latest_step(cfg.checkpoint_dir) != cfg.steps:
         ckpt_lib.save(cfg.checkpoint_dir, cfg.steps, state, force=True)
 
