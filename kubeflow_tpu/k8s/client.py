@@ -102,6 +102,14 @@ class KindRegistry:
         except KeyError:
             raise ApiError.not_found(f"no REST mapping for kind {kind}")
 
+    def kind_for_plural(self, plural: str) -> str:
+        """Reverse mapping (REST path segment → kind), for HTTP frontends."""
+        with self._lock:
+            for kind, (p, _namespaced) in self._kinds.items():
+                if p == plural:
+                    return kind
+        raise ApiError.not_found(f"unknown resource {plural!r}")
+
     def namespaced(self, kind: str) -> bool:
         try:
             return self._kinds[kind][1]

@@ -1,0 +1,213 @@
+"""HTTP frontend for the fake apiserver — K8s REST semantics over a socket.
+
+Serves a :class:`~kubeflow_tpu.k8s.fake.FakeApiServer` with the real
+apiserver's path layout (``/api/v1/...`` core, ``/apis/<group>/<v>/...``
+groups, ``/namespaces/<ns>/`` scoping, ``/status`` subresource,
+``?labelSelector=``, ``?watch=true`` chunked JSON streams) so the real HTTP
+backend (:class:`~kubeflow_tpu.k8s.client.HttpK8sClient`) — path building,
+error mapping, watch streaming and all — is exercised against in-process
+state. The envtest analogue for the HTTP layer (the reference only tests
+client-go against kubebuilder envtest, profile_controller_suite_test.go),
+and a zero-dependency local dev apiserver:
+
+    python -m kubeflow_tpu.k8s.httpfake --port 8001
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from kubeflow_tpu.k8s.client import ApiError
+from kubeflow_tpu.k8s.fake import FakeApiServer
+
+_PATH_RE = re.compile(
+    r"^(?:/api/(?P<core_version>[^/]+)|/apis/(?P<group>[^/]+)/(?P<version>[^/]+))"
+    r"(?:/namespaces/(?P<namespace>[^/]+))?"
+    r"/(?P<plural>[^/]+)"
+    r"(?:/(?P<name>[^/]+))?"
+    r"(?:/(?P<subresource>status))?$"
+)
+
+
+def _status_body(code: int, reason: str, message: str) -> dict:
+    return {"kind": "Status", "apiVersion": "v1", "code": code,
+            "reason": reason, "message": message}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "kubeflow-tpu-fake-apiserver"
+    fake: FakeApiServer  # set by make_handler
+
+    def log_message(self, *args):  # quiet
+        pass
+
+    # -- plumbing ------------------------------------------------------
+
+    def _send_json(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_body(self) -> dict:
+        length = int(self.headers.get("Content-Length", 0))
+        return json.loads(self.rfile.read(length) or b"{}")
+
+    def _route(self):
+        """(api_version, kind, namespace, name, subresource, query)."""
+        url = urlparse(self.path)
+        m = _PATH_RE.match(url.path)
+        if not m:
+            raise ApiError(404, "NotFound", f"no route {url.path}")
+        g = m.groupdict()
+        if g["core_version"]:
+            api_version = g["core_version"]
+        else:
+            api_version = f"{g['group']}/{g['version']}"
+        # Cluster-scoped CRUD on namespaces arrives as the plural itself.
+        plural = g["plural"]
+        kind = self._kind_for(plural)
+        return (api_version, kind, g["namespace"], g["name"],
+                g["subresource"], parse_qs(url.query))
+
+    def _kind_for(self, plural: str) -> str:
+        return self.fake.registry.kind_for_plural(plural)
+
+    # -- methods -------------------------------------------------------
+
+    def do_GET(self):
+        try:
+            api_version, kind, ns, name, _sub, query = self._route()
+            if name:
+                self._send_json(
+                    200, self.fake.get(api_version, kind, name, ns)
+                )
+                return
+            if query.get("watch", ["false"])[0] == "true":
+                self._stream_watch(api_version, kind, ns)
+                return
+            selector = None
+            if "labelSelector" in query:
+                selector = dict(
+                    part.split("=", 1)
+                    for part in query["labelSelector"][0].split(",")
+                )
+            items = self.fake.list(api_version, kind, ns,
+                                   label_selector=selector)
+            self._send_json(200, {
+                "apiVersion": api_version, "kind": f"{kind}List",
+                "items": items,
+            })
+        except ApiError as e:
+            self._send_json(e.code, _status_body(e.code, e.reason, e.message))
+
+    def do_POST(self):
+        try:
+            obj = self._read_body()
+            self._send_json(201, self.fake.create(obj))
+        except ApiError as e:
+            self._send_json(e.code, _status_body(e.code, e.reason, e.message))
+
+    def do_PUT(self):
+        try:
+            _api, _kind, _ns, _name, sub, _q = self._route()
+            obj = self._read_body()
+            if sub == "status":
+                self._send_json(200, self.fake.update_status(obj))
+            else:
+                self._send_json(200, self.fake.update(obj))
+        except ApiError as e:
+            self._send_json(e.code, _status_body(e.code, e.reason, e.message))
+
+    def do_PATCH(self):
+        try:
+            api_version, kind, ns, name, _sub, _q = self._route()
+            if self.headers.get("Content-Type") not in (
+                "application/merge-patch+json", "application/json"
+            ):
+                raise ApiError(415, "UnsupportedMediaType",
+                               "only merge-patch is supported")
+            patch = self._read_body()
+            self._send_json(
+                200, self.fake.patch(api_version, kind, name, patch, ns)
+            )
+        except ApiError as e:
+            self._send_json(e.code, _status_body(e.code, e.reason, e.message))
+
+    def do_DELETE(self):
+        try:
+            api_version, kind, ns, name, _sub, _q = self._route()
+            self.fake.delete(api_version, kind, name, ns)
+            self._send_json(200, _status_body(200, "Success", "deleted"))
+        except ApiError as e:
+            self._send_json(e.code, _status_body(e.code, e.reason, e.message))
+
+    # -- watch ---------------------------------------------------------
+
+    def _stream_watch(self, api_version: str, kind: str,
+                      ns: str | None) -> None:
+        stream = self.fake.watch(api_version, kind, ns)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        try:
+            while True:
+                event = stream.next(timeout=1.0)
+                if event is None:
+                    # Idle heartbeat: a bare newline chunk (iter_lines skips
+                    # empty lines) so a disconnected client surfaces as a
+                    # broken pipe and this thread exits.
+                    payload = b"\n"
+                else:
+                    payload = json.dumps(
+                        {"type": event.type, "object": event.object}
+                    ).encode() + b"\n"
+                self.wfile.write(f"{len(payload):x}\r\n".encode())
+                self.wfile.write(payload + b"\r\n")
+                self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            stream.stop()
+
+
+def serve(fake: FakeApiServer, port: int = 0
+          ) -> tuple[ThreadingHTTPServer, int]:
+    """Serve ``fake`` on 127.0.0.1:<port> in a daemon thread; returns
+    (httpd, bound_port). Callers stop with ``httpd.shutdown()``."""
+    handler = type("BoundHandler", (_Handler,), {"fake": fake})
+    httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+    # Watch handlers park in long-lived streaming loops; they must not
+    # block interpreter exit or server_close.
+    httpd.daemon_threads = True
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, httpd.server_address[1]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--port", type=int, default=8001)
+    args = ap.parse_args(argv)
+    fake = FakeApiServer()
+    fake.ensure_namespace("default")
+    fake.ensure_namespace("kubeflow")
+    httpd, port = serve(fake, args.port)
+    print(f"fake apiserver listening on http://127.0.0.1:{port}")
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        httpd.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -346,6 +346,12 @@ def mpi_job(
     command,
 ) -> list[dict]:
     command = command or ["python", "-m", "kubeflow_tpu.workloads.allreduce_bench"]
+    # Launcher runs the mpi_launcher wrapper: writes the controller-shipped
+    # hostfile, waits for worker DNS, then mpirun (or single-process
+    # fallback) — the kubectl-delivery contract completed in-image.
+    launcher_command = [
+        "python", "-m", "kubeflow_tpu.workloads.mpi_launcher", "--", *command,
+    ]
     return [
         _job(
             jobs_api.MPI_JOB_KIND,
@@ -355,7 +361,7 @@ def mpi_job(
                 "Launcher": {
                     "replicas": 1,
                     "restartPolicy": "OnFailure",
-                    "template": _worker_template(image, command, 0),
+                    "template": _worker_template(image, launcher_command, 0),
                 },
                 "Worker": {
                     "replicas": num_workers,

@@ -358,3 +358,60 @@ def test_jaxjob_declined_gang_restart_does_not_churn(jaxjob_env):
     # the failure reason is ReplicaFailed (not BackoffLimitExceeded).
     assert "ReplicaFailed" in reasons
     assert got["status"].get("restartCount", 0) == 0
+
+
+def test_mpi_launcher_hostfile_wait_and_command(tmp_path):
+    """MPIJob launcher contract: hostfile written from the controller-shipped
+    env, workers waited on, mpirun line assembled (kubectl-delivery +
+    mpi-operator launcher semantics)."""
+    from kubeflow_tpu.workloads.mpi_launcher import (
+        build_command,
+        parse_hostfile,
+        wait_for_workers,
+        write_hostfile,
+    )
+
+    content = "w0.job.ns slots=4\nw1.job.ns slots=4\n# comment\n"
+    path = str(tmp_path / "etc" / "hostfile")
+    entries = write_hostfile(content, path)
+    assert entries == [("w0.job.ns", 4), ("w1.job.ns", 4)]
+    assert parse_hostfile(open(path).read()) == entries
+
+    resolved = {"w0.job.ns"}
+    calls = []
+
+    def resolve(host):
+        calls.append(host)
+        if host not in resolved:
+            resolved.add(host)  # appears on the second poll
+            raise OSError("not yet")
+        return "10.0.0.1"
+
+    wait_for_workers([h for h, _ in entries], timeout=10, poll=0.01,
+                     resolve=resolve, log=lambda *a: None)
+    assert calls.count("w1.job.ns") == 2  # actually polled until resolvable
+
+    cmd = build_command(["python", "train.py"], path, entries,
+                        mpirun="/usr/bin/mpirun")
+    assert cmd[:5] == ["/usr/bin/mpirun", "--hostfile", path, "-np", "8"]
+    assert cmd[-2:] == ["python", "train.py"]
+    # No mpirun / no workers -> run the command directly.
+    assert build_command(["python", "train.py"], path, [], mpirun=None) == [
+        "python", "train.py"
+    ]
+
+
+def test_mpi_launcher_main_single_process(tmp_path, monkeypatch):
+    """End to end in single-process mode: writes the hostfile and execs the
+    wrapped command (no MPI runtime in the test image)."""
+    import kubeflow_tpu.workloads.mpi_launcher as ml
+
+    hostfile = str(tmp_path / "hostfile")
+    monkeypatch.setenv(ml.ENV_HOSTFILE_CONTENT, "")
+    monkeypatch.setattr(ml.shutil, "which", lambda _: None)
+    ran = {}
+    monkeypatch.setattr(ml.subprocess, "call",
+                        lambda cmd: ran.setdefault("cmd", cmd) and 0 or 0)
+    rc = ml.main(["--hostfile", hostfile, "--", "echo", "ok"])
+    assert rc == 0
+    assert ran["cmd"] == ["echo", "ok"]
