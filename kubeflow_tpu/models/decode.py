@@ -1,0 +1,164 @@
+"""Autoregressive decoding with a KV cache for the transformer family.
+
+TPU-first incremental decoding: one prefill pass fills the cache for the
+whole (right-padded) prompt batch, then ``lax.scan`` decodes in lockstep —
+every step is a fixed-shape single-token forward against the cache, so the
+whole generate call is ONE compiled executable (no per-token dispatch, no
+shape churn). Ragged prompts are handled with a per-row validity mask and
+per-row RoPE positions: row ``b``'s token at decode step ``t`` carries true
+position ``length[b] + t`` even though it lives at cache slot ``T0 + t``.
+
+The reference serves generation through TF-Serving's black-box ModelServer;
+this is the equivalent capability for the platform's own engine
+(kubeflow/tf-serving/tf-serving-template.libsonnet:29-49 surface).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from kubeflow_tpu.ops import rms_norm
+from kubeflow_tpu.ops.rotary import apply_rotary, rotary_frequencies
+from kubeflow_tpu.models.transformer import TransformerConfig
+
+_NEG_INF = -1e30
+
+
+def init_cache(cfg: TransformerConfig, batch: int, total_len: int):
+    """Per-layer K/V cache, stacked on a leading layer dim like the params."""
+    shape = (cfg.n_layers, batch, total_len, cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def _cached_attention(x, layer, cfg, rope_bt, k_cache, v_cache, pos, valid):
+    """x: [B, S, D] at cache slots pos..pos+S; attends over the full cache
+    masked by ``valid`` [B, total]. Returns (out, k_cache, v_cache)."""
+    b, s, _d = x.shape
+    hd = cfg.head_dim
+    cos, sin = rope_bt  # [B, S, hd//2] gathered per row by the caller
+    q = (x @ layer["wq"].astype(cfg.dtype)).reshape(b, s, cfg.n_heads, hd)
+    k = (x @ layer["wk"].astype(cfg.dtype)).reshape(b, s, cfg.n_kv_heads, hd)
+    v = (x @ layer["wv"].astype(cfg.dtype)).reshape(b, s, cfg.n_kv_heads, hd)
+    q = _rope(q, cos, sin)
+    k = _rope(k, cos, sin)
+    k_cache = lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
+    v_cache = lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+
+    reps = cfg.n_heads // cfg.n_kv_heads
+    kk = jnp.repeat(k_cache, reps, axis=2)  # [B, total, H, hd]
+    vv = jnp.repeat(v_cache, reps, axis=2)
+    scores = jnp.einsum(
+        "bshd,bthd->bhst", q.astype(jnp.float32), kk.astype(jnp.float32)
+    ) * (hd ** -0.5)
+    total = k_cache.shape[1]
+    # Causality within the new block: query at slot pos+i sees key slot j
+    # iff j <= pos+i; prompt padding and unwritten slots are masked by
+    # ``valid`` (which already includes slots pos..pos+S for this block).
+    j_idx = jnp.arange(total)[None, None, :]
+    i_idx = pos + jnp.arange(s)[None, :, None]
+    mask = (j_idx <= i_idx) & valid[:, None, :]
+    scores = jnp.where(mask[:, None], scores, _NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+    out = jnp.einsum("bhst,bthd->bshd", p, vv)
+    out = out.reshape(b, s, cfg.n_heads * hd)
+    return out @ layer["wo"].astype(cfg.dtype), k_cache, v_cache
+
+
+def _rope(x, cos, sin):
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    c, s = cos[:, :, None, :], sin[:, :, None, :]
+    return jnp.concatenate(
+        [x1 * c - x2 * s, x1 * s + x2 * c], axis=-1
+    ).astype(x.dtype)
+
+
+def forward_cached(params, tokens, cfg: TransformerConfig, cache, pos,
+                   positions, valid):
+    """tokens [B, S] at cache slots pos..pos+S with true sequence positions
+    ``positions`` [B, S] → (logits [B, S, V], new cache)."""
+    cos_t, sin_t = rotary_frequencies(cfg.head_dim, cache["k"].shape[2],
+                                      theta=cfg.rope_theta)
+    rope_bt = (cos_t[positions], sin_t[positions])
+    x = params["embed"]["kernel"].astype(cfg.dtype)[tokens]
+
+    def layer_fn(x, layer_and_cache):
+        layer, k_cache, v_cache = layer_and_cache
+        h = rms_norm(x, layer["ln_attn"], eps=cfg.norm_eps)
+        attn, k_cache, v_cache = _cached_attention(
+            h, layer["attn"], cfg, rope_bt, k_cache, v_cache, pos, valid
+        )
+        x = x + attn
+        h = rms_norm(x, layer["ln_mlp"], eps=cfg.norm_eps)
+        gate = h @ layer["mlp"]["gate"].astype(cfg.dtype)
+        up = h @ layer["mlp"]["up"].astype(cfg.dtype)
+        x = x + (jax.nn.silu(gate) * up) @ layer["mlp"]["down"].astype(
+            cfg.dtype
+        )
+        return x, (k_cache, v_cache)
+
+    x, (k_new, v_new) = lax.scan(
+        layer_fn, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = rms_norm(x, params["final_norm"], eps=cfg.norm_eps)
+    head = (params["embed"]["kernel"].T if cfg.tie_embeddings
+            else params["lm_head"]["kernel"])
+    logits = x @ head.astype(cfg.dtype)
+    return logits.astype(jnp.float32), {"k": k_new, "v": v_new}
+
+
+def sample_token(logits, key, temperature, top_k: int = 0):
+    """logits [B, V], temperature [B] (<=0 → greedy), static top_k."""
+    greedy = jnp.argmax(logits, axis=-1)
+    if top_k and top_k < logits.shape[-1]:
+        kth = lax.top_k(logits, top_k)[0][:, -1:]
+        logits = jnp.where(logits < kth, _NEG_INF, logits)
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    sampled = jax.random.categorical(key, logits / temp, axis=-1)
+    return jnp.where(temperature <= 0.0, greedy, sampled).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "max_new_tokens",
+                                             "top_k"))
+def generate(params, prompt_tokens, prompt_lengths, cfg: TransformerConfig,
+             *, max_new_tokens: int, key, temperature, top_k: int = 0):
+    """prompt_tokens [B, T0] right-padded, prompt_lengths [B] →
+    (generated [B, max_new_tokens], prefill_logits [B, V]).
+
+    ``temperature`` [B]: <=0 rows decode greedily. One compiled call:
+    prefill + a scanned decode loop over the KV cache.
+    """
+    b, t0 = prompt_tokens.shape
+    total = t0 + max_new_tokens
+    cache = init_cache(cfg, b, total)
+
+    slot = jnp.arange(total)[None, :]
+    valid = slot < prompt_lengths[:, None]  # prompt slots only
+    positions = jnp.broadcast_to(jnp.arange(t0)[None], (b, t0))
+    logits, cache = forward_cached(
+        params, prompt_tokens, cfg, cache, 0, positions, valid
+    )
+    last = jnp.take_along_axis(
+        logits, (prompt_lengths - 1)[:, None, None], axis=1
+    )[:, 0]
+
+    def step(carry, i):
+        cache, valid, tok, logits_prev, key = carry
+        key, sub = jax.random.split(key)
+        tok = sample_token(logits_prev, sub, temperature, top_k)
+        slot_i = t0 + i
+        valid = valid.at[:, slot_i].set(True)
+        pos_i = (prompt_lengths + i)[:, None]  # true position per row
+        logits, cache = forward_cached(
+            params, tok[:, None], cfg, cache, slot_i, pos_i, valid
+        )
+        return (cache, valid, tok, logits[:, 0], key), tok
+
+    (_, _, _, _, _), toks = lax.scan(
+        step, (cache, valid, jnp.zeros((b,), jnp.int32), last, key),
+        jnp.arange(max_new_tokens),
+    )
+    return toks.T, last  # [B, max_new], [B, V]

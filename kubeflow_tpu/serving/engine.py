@@ -24,6 +24,10 @@ class EngineConfig:
     checkpoint_dir: str | None = None
     batch_size: int = 8
     max_seq_len: int = 128
+    # Autoregressive decode surface (transformer family): fixed compiled
+    # decode length (instances request up to this many), optional top-k.
+    max_new_tokens: int = 16
+    top_k: int = 0
 
 
 class InferenceEngine:
@@ -35,6 +39,7 @@ class InferenceEngine:
         self._lock = threading.Lock()
         self.params = self._load_params()
         self._predict = jax.jit(self._predict_fn)
+        self._seed = 0
         self._warm = False
 
     def _load_params(self):
@@ -109,6 +114,17 @@ class InferenceEngine:
             if not all(isinstance(t, int) and not isinstance(t, bool)
                        for t in toks):
                 raise ValueError("'tokens' must be a flat list of ints")
+            want = inst.get("max_new_tokens", 0)
+            if not isinstance(want, int) or want < 0:
+                raise ValueError("'max_new_tokens' must be a non-negative int")
+            if want > self.cfg.max_new_tokens:
+                raise ValueError(
+                    f"'max_new_tokens' {want} exceeds server limit "
+                    f"{self.cfg.max_new_tokens}"
+                )
+            temp = inst.get("temperature", 0.0)
+            if not isinstance(temp, (int, float)) or temp < 0:
+                raise ValueError("'temperature' must be a non-negative number")
         elif self.model.family == "resnet":
             if "images" not in inst:
                 raise ValueError("each instance needs 'images'")
@@ -143,6 +159,45 @@ class InferenceEngine:
             mask[i, : len(seq)] = 1.0
         return {"tokens": tokens, "pad_mask": mask}
 
+    def _generate_batch(self, instances: list[dict]) -> list[dict]:
+        """Autoregressive path: prefill + KV-cache decode in one compiled
+        call; per-row temperature, per-row requested length sliced out."""
+        from kubeflow_tpu.models.decode import generate
+
+        n = len(instances)
+        b, t = self.cfg.batch_size, self.cfg.max_seq_len
+        tokens = np.zeros((b, t), np.int32)
+        lengths = np.ones((b,), np.int32)
+        temperature = np.zeros((b,), np.float32)
+        for i, inst in enumerate(instances):
+            seq = np.asarray(inst["tokens"], np.int32)[:t]
+            tokens[i, : len(seq)] = seq
+            lengths[i] = len(seq)
+            temperature[i] = float(inst.get("temperature", 0.0))
+        with self._lock:
+            self._seed += 1
+            toks, last = generate(
+                self.params, jnp.asarray(tokens), jnp.asarray(lengths),
+                self.model.config,
+                max_new_tokens=self.cfg.max_new_tokens,
+                key=jax.random.PRNGKey(self._seed),
+                temperature=jnp.asarray(temperature),
+                top_k=self.cfg.top_k,
+            )
+        toks = np.asarray(toks)[:n]
+        last = np.asarray(last)[:n]
+        out = []
+        for i, inst in enumerate(instances):
+            want = min(int(inst.get("max_new_tokens", 0)),
+                       self.cfg.max_new_tokens)
+            out.append({
+                "logits": last[i].tolist(),
+                "next_token": int(toks[i, 0]) if want else
+                int(np.argmax(last[i])),
+                "tokens": toks[i, :want].tolist(),
+            })
+        return out
+
     def predict_batch(self, instances: list[dict]) -> list[dict]:
         """Pad instances to the server batch, run, slice real results."""
         if len(instances) > self.cfg.batch_size:
@@ -150,6 +205,9 @@ class InferenceEngine:
                 f"batch {len(instances)} exceeds limit {self.cfg.batch_size}"
             )
         n = len(instances)
+        if (self.model.family == "transformer"
+                and any(inst.get("max_new_tokens") for inst in instances)):
+            return self._generate_batch(instances)
         if self.model.family in ("transformer", "bert"):
             batch = self._pad_tokens(instances)
             if self.model.family == "transformer":

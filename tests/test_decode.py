@@ -1,0 +1,95 @@
+"""KV-cache decode correctness: the scanned incremental path must match the
+full re-forward at every step (tiny model, CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models import transformer
+from kubeflow_tpu.models.decode import generate
+from kubeflow_tpu.serving.engine import EngineConfig, InferenceEngine
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = transformer.config("lm-test-tiny")
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def greedy_reference(params, cfg, prompt, steps):
+    """Decode by re-running the full forward each step (no cache)."""
+    toks = list(prompt)
+    for _ in range(steps):
+        logits = transformer.apply(
+            params, jnp.asarray([toks], jnp.int32), cfg
+        )
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    return toks[len(prompt):]
+
+
+def test_generate_matches_full_forward(tiny):
+    cfg, params = tiny
+    prompt = [5, 17, 42, 7]
+    steps = 6
+    toks, last = generate(
+        params, jnp.asarray([prompt], jnp.int32), jnp.asarray([4]),
+        cfg, max_new_tokens=steps, key=jax.random.PRNGKey(1),
+        temperature=jnp.zeros((1,)),
+    )
+    assert toks.shape == (1, steps)
+    ref = greedy_reference(params, cfg, prompt, steps)
+    assert toks[0].tolist() == ref
+    # Prefill logits equal the full forward's last-position logits.
+    full = transformer.apply(params, jnp.asarray([prompt], jnp.int32), cfg)
+    np.testing.assert_allclose(np.asarray(last[0]),
+                               np.asarray(full[0, -1], np.float32),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_generate_ragged_batch_padding_invariance(tiny):
+    """A short prompt decodes the same whether batched with a longer one
+    (per-row positions + validity masking) or alone."""
+    cfg, params = tiny
+    short, long_ = [9, 3], [5, 17, 42, 7, 23, 11]
+    prompts = np.zeros((2, 6), np.int32)
+    prompts[0, :2] = short
+    prompts[1, :] = long_
+    toks, _ = generate(
+        params, jnp.asarray(prompts), jnp.asarray([2, 6]), cfg,
+        max_new_tokens=4, key=jax.random.PRNGKey(2),
+        temperature=jnp.zeros((2,)),
+    )
+    assert toks[0].tolist() == greedy_reference(params, cfg, short, 4)
+    assert toks[1].tolist() == greedy_reference(params, cfg, long_, 4)
+
+
+def test_generate_sampling_and_top_k(tiny):
+    cfg, params = tiny
+    prompt = jnp.asarray([[5, 17, 42]], jnp.int32)
+    toks, _ = generate(
+        params, prompt, jnp.asarray([3]), cfg, max_new_tokens=8,
+        key=jax.random.PRNGKey(3), temperature=jnp.asarray([1.5]), top_k=10,
+    )
+    assert toks.shape == (1, 8)
+    assert ((toks >= 0) & (toks < cfg.vocab_size)).all()
+
+
+def test_engine_generate_instances():
+    eng = InferenceEngine(EngineConfig(model="lm-test-tiny", batch_size=4,
+                                       max_seq_len=32, max_new_tokens=8))
+    out = eng.predict_batch([
+        {"tokens": [1, 2, 3], "max_new_tokens": 5},
+        {"tokens": [7, 8], "max_new_tokens": 2, "temperature": 0.7},
+        {"tokens": [4, 4, 4]},  # plain predict rides the same batch
+    ])
+    assert len(out[0]["tokens"]) == 5
+    assert len(out[1]["tokens"]) == 2
+    assert out[2]["tokens"] == []
+    assert isinstance(out[2]["next_token"], int)
+    # Greedy generation is the argmax continuation.
+    assert out[0]["next_token"] == int(np.argmax(out[0]["logits"]))
+    # Over-limit request rejected at validation.
+    with pytest.raises(ValueError):
+        eng.validate_instance({"tokens": [1], "max_new_tokens": 99})
