@@ -1,0 +1,9 @@
+"""Bootstrapper REST service — the in-cluster deploy API.
+
+Analogue of bootstrap/cmd/bootstrap/app/ksServer.go (routes at
+:1452-1460): the HTTP service that the click-to-deploy web flow drives,
+wrapping the coordinator's init/generate/apply lifecycle with per-app
+serialization and a /metrics surface.
+"""
+
+from kubeflow_tpu.bootstrap.service import BootstrapService  # noqa: F401

@@ -1,0 +1,28 @@
+"""CLI: `python -m kubeflow_tpu.bootstrap --port 8085 --work-dir /apps`
+(the bootstrapper Deployment entrypoint, bootstrap/cmd/bootstrap/main.go)."""
+
+from __future__ import annotations
+
+import argparse
+import threading
+
+from kubeflow_tpu.bootstrap.service import BootstrapService
+from kubeflow_tpu.config.kfdef import PLATFORM_FAKE
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--port", type=int, default=8085)
+    ap.add_argument("--work-dir", default="/var/lib/kubeflow-tpu/apps")
+    ap.add_argument("--default-platform", default=PLATFORM_FAKE)
+    args = ap.parse_args(argv)
+    service = BootstrapService(args.work_dir,
+                               default_platform=args.default_platform)
+    _httpd, port = service.serve(args.port)
+    print(f"bootstrapper listening on :{port} (apps in {args.work_dir})")
+    threading.Event().wait()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,186 @@
+"""Bootstrapper REST service.
+
+The ksServer analogue (bootstrap/cmd/bootstrap/app/ksServer.go): a deploy
+API that creates and applies platform apps on request, with the same route
+shape and semantics —
+
+- ``POST /kfctl/apps/create``  {name, platform?, project?, zone?, params?}
+  → init the app dir + generate manifests (CreateApp, ksServer.go:432)
+- ``POST /kfctl/apps/apply``   {name, what?} → apply (Apply, :1037)
+- ``POST /kfctl/e2eDeploy``    create+apply in one call (the click-to-deploy
+  entry, routes :1452-1460)
+- ``GET  /kfctl/apps``         list known apps + status
+- ``GET  /healthz``, ``GET /metrics`` (promhttp analogue, :1460)
+
+Per-app mutexes serialize concurrent deploys of the same app
+(ksServer.go:384's per-project sync.Mutex); different apps deploy
+concurrently. Apps live under ``--work-dir`` as ordinary kfctl app dirs, so
+the CLI and this service are interchangeable views of the same state.
+
+Entrypoint: ``python -m kubeflow_tpu.bootstrap``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import defaultdict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+from kubeflow_tpu.cli.coordinator import Coordinator
+from kubeflow_tpu.config.defaults import default_kfdef
+from kubeflow_tpu.config.kfdef import PLATFORM_FAKE
+
+
+class BootstrapService:
+    def __init__(self, work_dir: str, *, default_platform: str = PLATFORM_FAKE):
+        self.work_dir = Path(work_dir)
+        self.work_dir.mkdir(parents=True, exist_ok=True)
+        self.default_platform = default_platform
+        self._locks: dict[str, threading.Lock] = defaultdict(threading.Lock)
+        self._locks_guard = threading.Lock()
+        self._status: dict[str, dict] = {}
+        self.requests = 0
+        self.errors = 0
+
+    # ------------------------------------------------------------------
+    # operations (HTTP-independent, used by tests and the handler)
+    # ------------------------------------------------------------------
+
+    def _lock_for(self, name: str) -> threading.Lock:
+        with self._locks_guard:
+            return self._locks[name]
+
+    def _app_dir(self, name: str) -> Path:
+        if not name or "/" in name or name.startswith("."):
+            raise ValueError(f"invalid app name {name!r}")
+        return self.work_dir / name
+
+    def create_app(self, body: dict) -> dict:
+        name = body.get("name", "")
+        app_dir = self._app_dir(name)
+        with self._lock_for(name):
+            kfdef = default_kfdef(
+                name=name,
+                platform=body.get("platform", self.default_platform),
+                project=body.get("project", ""),
+                zone=body.get("zone", ""),
+            )
+            coord = Coordinator.init(kfdef, str(app_dir))
+            written = coord.generate("all")
+            self._status[name] = {"phase": "Created",
+                                  "manifests": len(written),
+                                  "updated": time.time()}
+            return {"name": name, "appDir": str(app_dir),
+                    "manifests": len(written)}
+
+    def apply_app(self, body: dict) -> dict:
+        name = body.get("name", "")
+        app_dir = self._app_dir(name)
+        if not (app_dir / "app.yaml").exists():
+            raise FileNotFoundError(f"app {name!r} not created")
+        with self._lock_for(name):
+            coord = Coordinator.load(str(app_dir))
+            report = coord.apply(body.get("what", "all"))
+            self._status[name] = {
+                "phase": "Deployed" if report.ok else "Failed",
+                "applied": len(report.applied),
+                "failed": dict(report.failed),
+                "updated": time.time(),
+            }
+            if not report.ok:
+                raise RuntimeError(
+                    f"apply failed for: {sorted(report.failed)}"
+                )
+            return {"name": name, "applied": len(report.applied)}
+
+    def e2e_deploy(self, body: dict) -> dict:
+        created = self.create_app(body)
+        applied = self.apply_app({"name": body.get("name", "")})
+        return {**created, **applied, "phase": "Deployed"}
+
+    def list_apps(self) -> dict:
+        apps = []
+        for app_yaml in sorted(self.work_dir.glob("*/app.yaml")):
+            name = app_yaml.parent.name
+            apps.append({"name": name,
+                         **self._status.get(name, {"phase": "Created"})})
+        return {"apps": apps}
+
+    def metrics(self) -> str:
+        deployed = sum(1 for s in self._status.values()
+                       if s.get("phase") == "Deployed")
+        return (
+            "# TYPE bootstrap_requests_total counter\n"
+            f"bootstrap_requests_total {self.requests}\n"
+            "# TYPE bootstrap_errors_total counter\n"
+            f"bootstrap_errors_total {self.errors}\n"
+            "# TYPE bootstrap_apps_deployed gauge\n"
+            f"bootstrap_apps_deployed {deployed}\n"
+        )
+
+    # ------------------------------------------------------------------
+    # HTTP
+    # ------------------------------------------------------------------
+
+    def make_handler(service: "BootstrapService"):
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def _send(self, code: int, payload, content_type="application/json"):
+                body = (payload if isinstance(payload, str)
+                        else json.dumps(payload)).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                service.requests += 1
+                if self.path == "/healthz":
+                    self._send(200, {"status": "ok"})
+                elif self.path == "/metrics":
+                    self._send(200, service.metrics(), "text/plain")
+                elif self.path == "/kfctl/apps":
+                    self._send(200, service.list_apps())
+                else:
+                    service.errors += 1
+                    self._send(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                service.requests += 1
+                routes = {
+                    "/kfctl/apps/create": service.create_app,
+                    "/kfctl/apps/apply": service.apply_app,
+                    "/kfctl/e2eDeploy": service.e2e_deploy,
+                }
+                handler = routes.get(self.path)
+                if handler is None:
+                    service.errors += 1
+                    self._send(404, {"error": f"no route {self.path}"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                    self._send(200, handler(body))
+                except (ValueError, FileNotFoundError,
+                        FileExistsError) as e:
+                    service.errors += 1
+                    self._send(400, {"error": str(e)})
+                except Exception as e:
+                    service.errors += 1
+                    self._send(500, {"error": str(e)})
+
+        return Handler
+
+    def serve(self, port: int = 0) -> tuple[ThreadingHTTPServer, int]:
+        httpd = ThreadingHTTPServer(("0.0.0.0", port), self.make_handler())
+        httpd.daemon_threads = True
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        return httpd, httpd.server_address[1]

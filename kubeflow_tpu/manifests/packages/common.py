@@ -203,3 +203,47 @@ def echo_server(namespace: str, image: str) -> list[dict]:
             labels=labels,
         ),
     ]
+
+
+@prototype(
+    "bootstrapper",
+    "In-cluster deploy REST service backing click-to-deploy "
+    "(bootstrap/cmd/bootstrap/app/ksServer.go:1452-1460 analogue)",
+    params=[
+        ParamSpec("namespace", DEFAULT_NAMESPACE),
+        ParamSpec("image", images.PLATFORM),
+    ],
+)
+def bootstrapper(namespace: str, image: str) -> list[dict]:
+    name = "bootstrapper"
+    labels = {"app": name}
+    return [
+        k8s.service_account(name, namespace, labels),
+        # The bootstrapper applies arbitrary platform manifests on request.
+        k8s.cluster_role_binding(name, "cluster-admin", name, namespace),
+        k8s.service(
+            name,
+            namespace,
+            selector=labels,
+            ports=[{"name": "http", "port": 80, "targetPort": 8085}],
+            labels=labels,
+            annotations=gateway_route(
+                name, "/kfctl/", f"{name}.{namespace}:80", rewrite="/kfctl/"
+            ),
+        ),
+        k8s.deployment(
+            name,
+            namespace,
+            containers=[
+                k8s.container(
+                    name,
+                    image,
+                    command=["python", "-m", "kubeflow_tpu.bootstrap",
+                             "--port", "8085"],
+                    ports={"http": 8085},
+                )
+            ],
+            labels=labels,
+            service_account=name,
+        ),
+    ]
