@@ -7,14 +7,15 @@ import argparse
 import threading
 
 from kubeflow_tpu.bootstrap.service import BootstrapService
-from kubeflow_tpu.config.kfdef import PLATFORM_FAKE
+from kubeflow_tpu.config.kfdef import PLATFORM_NONE
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--port", type=int, default=8085)
     ap.add_argument("--work-dir", default="/var/lib/kubeflow-tpu/apps")
-    ap.add_argument("--default-platform", default=PLATFORM_FAKE)
+    # In-cluster default is the real apiserver; "fake" is for dry runs.
+    ap.add_argument("--default-platform", default=PLATFORM_NONE)
     args = ap.parse_args(argv)
     service = BootstrapService(args.work_dir,
                                default_platform=args.default_platform)

@@ -31,19 +31,26 @@ from pathlib import Path
 
 from kubeflow_tpu.cli.coordinator import Coordinator
 from kubeflow_tpu.config.defaults import default_kfdef
-from kubeflow_tpu.config.kfdef import PLATFORM_FAKE
+from kubeflow_tpu.config.kfdef import PLATFORM_NONE
 
 
 class BootstrapService:
-    def __init__(self, work_dir: str, *, default_platform: str = PLATFORM_FAKE):
+    # Default platform is the real in-cluster apiserver; tests pass "fake".
+    def __init__(self, work_dir: str, *, default_platform: str = PLATFORM_NONE):
         self.work_dir = Path(work_dir)
         self.work_dir.mkdir(parents=True, exist_ok=True)
         self.default_platform = default_platform
         self._locks: dict[str, threading.Lock] = defaultdict(threading.Lock)
         self._locks_guard = threading.Lock()
         self._status: dict[str, dict] = {}
+        self._counter_lock = threading.Lock()
         self.requests = 0
         self.errors = 0
+
+    def _count(self, *, error: bool = False) -> None:
+        with self._counter_lock:  # handler threads race bare +=
+            self.requests += 1
+            self.errors += int(error)
 
     # ------------------------------------------------------------------
     # operations (HTTP-independent, used by tests and the handler)
@@ -62,13 +69,19 @@ class BootstrapService:
         name = body.get("name", "")
         app_dir = self._app_dir(name)
         with self._lock_for(name):
-            kfdef = default_kfdef(
-                name=name,
-                platform=body.get("platform", self.default_platform),
-                project=body.get("project", ""),
-                zone=body.get("zone", ""),
-            )
-            coord = Coordinator.init(kfdef, str(app_dir))
+            if (app_dir / "app.yaml").exists():
+                # Idempotent re-create so a retried e2eDeploy after a failed
+                # apply doesn't wedge on FileExistsError: reload and
+                # regenerate from the persisted app.yaml.
+                coord = Coordinator.load(str(app_dir))
+            else:
+                kfdef = default_kfdef(
+                    name=name,
+                    platform=body.get("platform", self.default_platform),
+                    project=body.get("project", ""),
+                    zone=body.get("zone", ""),
+                )
+                coord = Coordinator.init(kfdef, str(app_dir))
             written = coord.generate("all")
             self._status[name] = {"phase": "Created",
                                   "manifests": len(written),
@@ -142,19 +155,20 @@ class BootstrapService:
                 self.wfile.write(body)
 
             def do_GET(self):
-                service.requests += 1
                 if self.path == "/healthz":
+                    service._count()
                     self._send(200, {"status": "ok"})
                 elif self.path == "/metrics":
+                    service._count()
                     self._send(200, service.metrics(), "text/plain")
                 elif self.path == "/kfctl/apps":
+                    service._count()
                     self._send(200, service.list_apps())
                 else:
-                    service.errors += 1
+                    service._count(error=True)
                     self._send(404, {"error": f"no route {self.path}"})
 
             def do_POST(self):
-                service.requests += 1
                 routes = {
                     "/kfctl/apps/create": service.create_app,
                     "/kfctl/apps/apply": service.apply_app,
@@ -162,19 +176,21 @@ class BootstrapService:
                 }
                 handler = routes.get(self.path)
                 if handler is None:
-                    service.errors += 1
+                    service._count(error=True)
                     self._send(404, {"error": f"no route {self.path}"})
                     return
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     body = json.loads(self.rfile.read(length) or b"{}")
-                    self._send(200, handler(body))
+                    result = handler(body)
+                    service._count()
+                    self._send(200, result)
                 except (ValueError, FileNotFoundError,
                         FileExistsError) as e:
-                    service.errors += 1
+                    service._count(error=True)
                     self._send(400, {"error": str(e)})
                 except Exception as e:
-                    service.errors += 1
+                    service._count(error=True)
                     self._send(500, {"error": str(e)})
 
         return Handler

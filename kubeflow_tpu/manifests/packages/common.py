@@ -231,19 +231,32 @@ def bootstrapper(namespace: str, image: str) -> list[dict]:
                 name, "/kfctl/", f"{name}.{namespace}:80", rewrite="/kfctl/"
             ),
         ),
-        k8s.deployment(
-            name,
-            namespace,
-            containers=[
-                k8s.container(
-                    name,
-                    image,
-                    command=["python", "-m", "kubeflow_tpu.bootstrap",
-                             "--port", "8085"],
-                    ports={"http": 8085},
-                )
-            ],
-            labels=labels,
-            service_account=name,
-        ),
+        _bootstrapper_deployment(name, namespace, image, labels),
     ]
+
+
+def _bootstrapper_deployment(name, namespace, image, labels) -> dict:
+    container = k8s.container(
+        name,
+        image,
+        command=["python", "-m", "kubeflow_tpu.bootstrap",
+                 "--port", "8085"],
+        ports={"http": 8085},
+    )
+    # App dirs survive container restarts (the reference persists app state
+    # to a source repo, ksServer.go SaveAppToRepo:1006 — an emptyDir keeps
+    # restart continuity; point a PVC here for real durability).
+    container["volumeMounts"] = [
+        {"name": "apps", "mountPath": "/var/lib/kubeflow-tpu"}
+    ]
+    deployment = k8s.deployment(
+        name,
+        namespace,
+        containers=[container],
+        labels=labels,
+        service_account=name,
+    )
+    deployment["spec"]["template"]["spec"]["volumes"] = [
+        {"name": "apps", "emptyDir": {}}
+    ]
+    return deployment

@@ -39,6 +39,8 @@ class RunConfig:
     seq_len: int = 128
     steps: int = 100
     log_every: int = 10
+    # KTPU token-corpus file (train.tokenstore); empty = synthetic data.
+    data_path: str | None = None
     checkpoint_dir: str | None = None
     checkpoint_every: int = 500
     seed: int = 0
@@ -72,8 +74,27 @@ def run(cfg: RunConfig, *, log=print) -> dict:
             log(f"resumed from checkpoint step {start_step}")
 
     step_fn = build_train_step(model, opt_cfg, mesh)
-    stream = synthetic_stream(model, cfg.batch_size, cfg.seq_len,
-                              seed=cfg.seed)
+    if cfg.data_path:
+        from kubeflow_tpu.train.tokenstore import TokenStore
+
+        # Stateless in (seed, step): restarting at start_step replays the
+        # exact stream position — checkpoint resume is data-exact.
+        stream = TokenStore(cfg.data_path).stream(
+            cfg.batch_size, cfg.seq_len, seed=cfg.seed,
+            start_step=start_step, shard=info.process_id,
+            num_shards=info.num_processes,
+        )
+        if getattr(model.config, "context_parallel", False):
+            # Sequence-sharded batches need seq divisible by the mesh axis:
+            # ship the shifted pair, not the odd-length token array (same
+            # convention as data.synthetic_batch).
+            stream = (
+                {"inputs": b["tokens"][:, :-1], "targets": b["tokens"][:, 1:]}
+                for b in stream
+            )
+    else:
+        stream = synthetic_stream(model, cfg.batch_size, cfg.seq_len,
+                                  seed=cfg.seed)
 
     metrics = {}
     t_last = time.perf_counter()

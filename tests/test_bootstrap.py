@@ -15,7 +15,7 @@ from kubeflow_tpu.cli.platforms import FakePlatform
 @pytest.fixture()
 def svc(tmp_path):
     FakePlatform.reset()
-    service = BootstrapService(str(tmp_path))
+    service = BootstrapService(str(tmp_path), default_platform="fake")
     httpd, port = service.serve()
     yield service, f"http://127.0.0.1:{port}"
     httpd.shutdown()
@@ -74,11 +74,11 @@ def test_error_routes(svc):
     with pytest.raises(urllib.error.HTTPError) as e:
         post(base, "/kfctl/apps/create", {"name": "../evil"})
     assert e.value.code == 400
-    # Duplicate create → 400 (app.yaml exists).
+    # Re-create is idempotent (regenerates from the persisted app.yaml), so
+    # a retried e2eDeploy after a transient apply failure is not wedged.
     post(base, "/kfctl/apps/create", {"name": "dup"})
-    with pytest.raises(urllib.error.HTTPError) as e:
-        post(base, "/kfctl/apps/create", {"name": "dup"})
-    assert e.value.code == 400
+    code, out = post(base, "/kfctl/apps/create", {"name": "dup"})
+    assert code == 200 and out["manifests"] > 0
 
     code, metrics = get(base, "/metrics")
     assert "bootstrap_requests_total" in metrics
