@@ -1,0 +1,220 @@
+"""Gateway-routed platform E2E (VERDICT r1 item 5's done-criterion):
+requests flow client → gateway (annotation-discovered routes, forward-auth
+via gatekeeper) → real backends (model server, jupyter web app) against the
+fake cluster — the ambassador + basic-auth + web-app stack over real
+sockets (kubeflow/common/ambassador.libsonnet:7-226,
+components/gatekeeper/auth/AuthServer.go:32-210,
+jupyter-web-app routes.py:33-168)."""
+
+import hashlib
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.auth.gatekeeper import AuthService, make_server as \
+    make_auth_server
+from kubeflow_tpu.gateway import Gateway, RouteTable
+from kubeflow_tpu.manifests.core import generate
+from kubeflow_tpu.serving.engine import EngineConfig
+from kubeflow_tpu.serving.server import ModelServer
+from kubeflow_tpu.webapps.jupyter import JupyterApp, make_server as \
+    make_jupyter_server
+
+
+def http(method, url, payload=None, headers=None):
+    req = urllib.request.Request(
+        url, method=method,
+        data=json.dumps(payload).encode() if payload is not None else None,
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    with urllib.request.urlopen(req) as r:
+        return r.status, json.loads(r.read() or b"{}"), r.headers
+
+
+@pytest.fixture()
+def platform(api):
+    """Fake cluster + live backends + gateway with resolved routes."""
+    servers = []
+
+    # Model server (the tpu-serving Deployment's process).
+    model = ModelServer(
+        EngineConfig(model="lm-test-tiny", batch_size=4, max_seq_len=32),
+        port=0, batch_timeout_ms=2,
+    )
+    model.start()
+    servers.append(model.stop)
+
+    # Jupyter web app against the fake apiserver.
+    japp = make_jupyter_server(JupyterApp(api, "jax-notebook:latest"), 0)
+    threading.Thread(target=japp.serve_forever, daemon=True).start()
+    servers.append(japp.shutdown)
+    jport = japp.server_address[1]
+
+    # Apply the rendered serving + webapp manifests so routes come from
+    # REAL annotations (the same objects kfctl deploys), plus the Notebook
+    # CRD the web app's CRs require.
+    from kubeflow_tpu.apis.notebooks import notebook_crd
+
+    api.apply(notebook_crd())
+    for obj in generate("tpu-serving", {"name": "lm", "model_path": "",
+                                        "namespace": "kubeflow"}):
+        api.apply(obj)
+    for obj in generate("jupyter-web-app", {"namespace": "kubeflow"}):
+        api.apply(obj)
+
+    table = RouteTable()
+    n = table.refresh(api)
+    assert n >= 2
+
+    # In-cluster service addresses → local fixture ports.
+    backends = {
+        "lm.kubeflow:8500": f"127.0.0.1:{model.port}",
+        "jupyter-web-app.kubeflow:80": f"127.0.0.1:{jport}",
+    }
+    gw = Gateway(table, port=0, admin_port=0,
+                 resolve=lambda addr: backends.get(addr, addr))
+    gw.start()
+    servers.append(gw.stop)
+    base = f"http://127.0.0.1:{gw._proxy.server_address[1]}"
+    yield api, gw, base
+    for stop in servers:
+        stop()
+
+
+def test_predict_routed_through_gateway(platform):
+    _api, _gw, base = platform
+    code, out, _ = http(
+        "POST", f"{base}/models/lm/v1/models/lm-test-tiny:predict",
+        {"instances": [{"tokens": [1, 2, 3]}]},
+    )
+    assert code == 200
+    assert len(out["predictions"]) == 1
+    assert isinstance(out["predictions"][0]["next_token"], int)
+
+
+def test_notebook_crud_routed_through_gateway(platform):
+    api, _gw, base = platform
+    # The jupyter-web-app route prefix comes from its Service annotation.
+    code, out, _ = http(
+        "POST", f"{base}/jupyter/api/namespaces/kubeflow/notebooks",
+        {"name": "nb1", "tpuChips": 4, "workspace": {"size": "10Gi"}},
+    )
+    assert code == 201, out
+    # CR + PVC landed in the fake cluster.
+    nb = api.get("kubeflow-tpu.org/v1", "Notebook", "nb1", "kubeflow")
+    assert nb["spec"]["tpu"]["chips"] == 4
+    assert api.get("v1", "PersistentVolumeClaim", "nb1-workspace", "kubeflow")
+
+    code, listing, _ = http(
+        "GET", f"{base}/jupyter/api/namespaces/kubeflow/notebooks")
+    assert [n["name"] for n in listing["notebooks"]] == ["nb1"]
+
+
+def test_unrouted_path_404s(platform):
+    _api, _gw, base = platform
+    with pytest.raises(urllib.error.HTTPError) as e:
+        http("GET", f"{base}/no/such/route")
+    assert e.value.code == 404
+
+
+def test_gateway_forward_auth_with_gatekeeper(api):
+    """401 without a session; login at the gatekeeper mints a cookie the
+    gateway accepts (basic-auth ingress semantics)."""
+    auth = AuthService("admin",
+                       hashlib.sha256(b"hunter2").hexdigest())
+    auth_httpd = make_auth_server(auth, 0)
+    threading.Thread(target=auth_httpd.serve_forever, daemon=True).start()
+    auth_port = auth_httpd.server_address[1]
+
+    # One echo backend behind the gateway.
+    from kubeflow_tpu.gateway import Route
+
+    table = RouteTable()
+    table.set_routes([Route("auth", "/login", f"127.0.0.1:{auth_port}",
+                            rewrite="/login"),
+                      Route("gk", "/gk/", f"127.0.0.1:{auth_port}",
+                            rewrite="/")])
+    gw = Gateway(table, port=0, admin_port=0,
+                 auth_url=f"http://127.0.0.1:{auth_port}/auth")
+    gw.start()
+    base = f"http://127.0.0.1:{gw._proxy.server_address[1]}"
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            http("GET", f"{base}/gk/healthz")
+        assert e.value.code == 401
+
+        # Login directly at the gatekeeper → cookie (raw client: urllib
+        # follows the 302 and drops the Set-Cookie of the redirect itself).
+        from http.client import HTTPConnection
+
+        conn = HTTPConnection("127.0.0.1", auth_port)
+        conn.request("POST", "/login", b"username=admin&password=hunter2",
+                     {"Content-Type": "application/x-www-form-urlencoded"})
+        resp = conn.getresponse()
+        assert resp.status == 302
+        cookie = resp.getheader("Set-Cookie")
+        conn.close()
+        assert cookie
+        cookie = cookie.split(";")[0]
+
+        code, out, _ = http("GET", f"{base}/gk/healthz",
+                            headers={"Cookie": cookie})
+        assert code == 200 and out["status"] == "ok"
+
+        # Wrong password never mints a session.
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{auth_port}/login",
+            data=b"username=admin&password=wrong", method="POST",
+            headers={"Content-Type": "application/x-www-form-urlencoded"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req)
+        assert e.value.code == 401
+    finally:
+        gw.stop()
+        auth_httpd.shutdown()
+
+
+def test_admission_webhook_mutates_labeled_pods():
+    """gcp-admission-webhook semantics (main.go:131-158): a pod labeled with
+    a cred secret gains the secret volume + mount + env; TPU containers gain
+    platform env; unlabeled CPU pods pass through unpatched."""
+    import base64
+
+    from kubeflow_tpu.auth.webhook import (
+        CRED_LABEL,
+        mutate_pod,
+        review_response,
+    )
+
+    pod = {
+        "kind": "Pod",
+        "metadata": {"labels": {CRED_LABEL: "user-gcp-sa"}},
+        "spec": {"containers": [
+            {"name": "main",
+             "resources": {"limits": {"google.com/tpu": 4}}},
+        ]},
+    }
+    patches = mutate_pod(pod)
+    paths = [p["path"] for p in patches]
+    assert "/spec/volumes" in paths
+    assert "/spec/containers/0/volumeMounts" in paths
+    env_values = [p["value"] for p in patches if "env" in p["path"]]
+    flat = [e for v in env_values for e in (v if isinstance(v, list) else [v])]
+    names = {e["name"] for e in flat}
+    assert {"GOOGLE_APPLICATION_CREDENTIALS", "JAX_PLATFORMS",
+            "TPU_MIN_LOG_LEVEL"} <= names
+
+    assert mutate_pod({"kind": "Pod", "metadata": {},
+                       "spec": {"containers": [{"name": "c"}]}}) == []
+
+    review = review_response({
+        "apiVersion": "admission.k8s.io/v1",
+        "request": {"uid": "u1", "object": pod},
+    })
+    assert review["response"]["allowed"]
+    decoded = json.loads(base64.b64decode(review["response"]["patch"]))
+    assert decoded == patches
