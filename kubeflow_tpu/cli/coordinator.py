@@ -114,6 +114,13 @@ class Coordinator:
             for comp in self.kfdef.spec.components:
                 params = dict(comp.params)
                 objs = generate_prototype(comp.prototype_name, self._with_defaults(params))
+                if comp.overlay:
+                    from kubeflow_tpu.manifests.overlays import (
+                        Overlay,
+                        apply_overlay,
+                    )
+
+                    objs = apply_overlay(objs, Overlay.from_dict(comp.overlay))
                 self._label_objects(objs)
                 path = os.path.join(mdir, f"{comp.name}.yaml")
                 with open(path, "w") as f:

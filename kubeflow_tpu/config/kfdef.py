@@ -49,6 +49,10 @@ class ComponentConfig:
     name: str                         # instance name (also default object name prefix)
     prototype: str | None = None      # registry prototype; defaults to `name`
     params: dict[str, Any] = field(default_factory=dict)
+    # Kustomize-style overlay applied to the rendered objects (the v2
+    # package-manager surface, kustomize.go:62-170); see
+    # manifests.overlays.Overlay.from_dict for the accepted keys.
+    overlay: dict[str, Any] = field(default_factory=dict)
 
     @property
     def prototype_name(self) -> str:
@@ -127,6 +131,7 @@ class KfDef:
                 name=c["name"],
                 prototype=c.get("prototype"),
                 params=dict(c.get("params", {})),
+                overlay=dict(c.get("overlay", {})),
             )
             for c in spec_d.pop("components", [])
         ]
@@ -193,6 +198,7 @@ def _spec_to_dict(spec: KfDefSpec) -> dict:
                     "name": c.name,
                     **({"prototype": c.prototype} if c.prototype else {}),
                     **({"params": c.params} if c.params else {}),
+                    **({"overlay": c.overlay} if c.overlay else {}),
                 }
                 for c in v
             ]

@@ -1,0 +1,188 @@
+"""Kustomize-style overlays over generated manifests.
+
+The v2 package-manager analogue (bootstrap/pkg/kfapp/kustomize/
+kustomize.go:62-170 renders kustomize overlays instead of ksonnet params):
+an :class:`Overlay` transforms a prototype's rendered objects —
+
+- ``name_prefix``/``name_suffix`` with reference fixing (RBAC subjects and
+  roleRefs, pod serviceAccountName follow renamed targets);
+- ``namespace`` retargeting (cluster-scoped kinds untouched);
+- ``common_labels`` stamped onto metadata, workload selectors, and pod
+  templates (kustomize commonLabels semantics);
+- ``common_annotations``;
+- ``images`` (repo → replacement reference);
+- ``replicas`` by workload name;
+- ``patches``: strategic-merge-style deep merges targeted by kind/name.
+
+Overlays ride KfDef components (``component.overlay``), so one prototype
+serves many environments — the reference's per-platform kustomize overlay
+trees collapsed into config.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Any, Mapping
+
+CLUSTER_SCOPED_KINDS = {
+    "Namespace", "CustomResourceDefinition", "ClusterRole",
+    "ClusterRoleBinding", "PersistentVolume", "StorageClass",
+    "MutatingWebhookConfiguration", "ValidatingWebhookConfiguration",
+}
+
+_WORKLOAD_KINDS = {"Deployment", "StatefulSet", "DaemonSet", "Job"}
+
+
+@dataclass(frozen=True)
+class Overlay:
+    name_prefix: str = ""
+    name_suffix: str = ""
+    namespace: str | None = None
+    common_labels: Mapping[str, str] = field(default_factory=dict)
+    common_annotations: Mapping[str, str] = field(default_factory=dict)
+    images: Mapping[str, str] = field(default_factory=dict)
+    replicas: Mapping[str, int] = field(default_factory=dict)
+    patches: tuple = ()
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "Overlay":
+        known = {
+            "namePrefix": "name_prefix", "nameSuffix": "name_suffix",
+            "namespace": "namespace", "commonLabels": "common_labels",
+            "commonAnnotations": "common_annotations", "images": "images",
+            "replicas": "replicas", "patches": "patches",
+        }
+        unknown = set(d) - set(known)
+        if unknown:
+            raise ValueError(f"unknown overlay fields {sorted(unknown)}")
+        kwargs = {known[k]: v for k, v in d.items()}
+        if "patches" in kwargs:
+            kwargs["patches"] = tuple(kwargs["patches"])
+        return cls(**kwargs)
+
+    @property
+    def empty(self) -> bool:
+        return self == Overlay()
+
+
+def _deep_merge(dst: dict, patch: Mapping[str, Any]) -> None:
+    for k, v in patch.items():
+        if v is None:
+            dst.pop(k, None)
+        elif isinstance(v, Mapping) and isinstance(dst.get(k), dict):
+            _deep_merge(dst[k], v)
+        else:
+            dst[k] = copy.deepcopy(v)
+
+
+def _matches(target: Mapping[str, Any], obj: dict) -> bool:
+    if "kind" in target and obj.get("kind") != target["kind"]:
+        return False
+    if "name" in target and obj["metadata"].get("name") != target["name"]:
+        return False
+    return True
+
+
+def apply_overlay(objs: list[dict], overlay: Overlay) -> list[dict]:
+    objs = copy.deepcopy(objs)
+
+    # Pass 1: renames (and remember old→new per kind for reference fixing).
+    renames: dict[tuple[str, str], str] = {}
+    if overlay.name_prefix or overlay.name_suffix:
+        for obj in objs:
+            meta = obj.setdefault("metadata", {})
+            old = meta.get("name", "")
+            new = f"{overlay.name_prefix}{old}{overlay.name_suffix}"
+            renames[(obj.get("kind", ""), old)] = new
+            meta["name"] = new
+
+    for obj in objs:
+        kind = obj.get("kind", "")
+        meta = obj.setdefault("metadata", {})
+
+        if overlay.namespace and kind not in CLUSTER_SCOPED_KINDS:
+            meta["namespace"] = overlay.namespace
+
+        if overlay.common_labels:
+            meta.setdefault("labels", {}).update(overlay.common_labels)
+            spec = obj.get("spec", {})
+            if kind in _WORKLOAD_KINDS:
+                spec.setdefault("selector", {}).setdefault(
+                    "matchLabels", {}
+                ).update(overlay.common_labels)
+                tmpl_meta = spec.setdefault("template", {}).setdefault(
+                    "metadata", {}
+                )
+                tmpl_meta.setdefault("labels", {}).update(
+                    overlay.common_labels
+                )
+            elif kind == "Service" and isinstance(
+                spec.get("selector"), dict
+            ):
+                spec["selector"].update(overlay.common_labels)
+
+        if overlay.common_annotations:
+            meta.setdefault("annotations", {}).update(
+                overlay.common_annotations
+            )
+
+        _fix_references(obj, renames)
+        _apply_images(obj, overlay.images)
+
+        if kind in _WORKLOAD_KINDS and meta.get("name") in overlay.replicas:
+            obj.setdefault("spec", {})["replicas"] = (
+                overlay.replicas[meta["name"]]
+            )
+
+    for patch in overlay.patches:
+        target = patch.get("target", {})
+        body = patch.get("patch", {})
+        for obj in objs:
+            if _matches(target, obj):
+                _deep_merge(obj, body)
+    return objs
+
+
+def _fix_references(obj: dict, renames: Mapping[tuple[str, str], str]) -> None:
+    if not renames:
+        return
+    kind = obj.get("kind", "")
+    if kind in ("RoleBinding", "ClusterRoleBinding"):
+        ref = obj.get("roleRef", {})
+        new = renames.get((ref.get("kind", ""), ref.get("name", "")))
+        if new:
+            ref["name"] = new
+        for subject in obj.get("subjects", []):
+            new = renames.get((subject.get("kind", ""),
+                               subject.get("name", "")))
+            if new:
+                subject["name"] = new
+    pod_spec = None
+    if kind in _WORKLOAD_KINDS:
+        pod_spec = obj.get("spec", {}).get("template", {}).get("spec", {})
+    elif kind == "Pod":
+        pod_spec = obj.get("spec", {})
+    if pod_spec:
+        sa = pod_spec.get("serviceAccountName")
+        new = renames.get(("ServiceAccount", sa)) if sa else None
+        if new:
+            pod_spec["serviceAccountName"] = new
+
+
+def _apply_images(obj: dict, images: Mapping[str, str]) -> None:
+    if not images:
+        return
+    pod_spec = (obj.get("spec", {}).get("template", {}).get("spec", {})
+                if obj.get("kind") in _WORKLOAD_KINDS
+                else obj.get("spec", {}) if obj.get("kind") == "Pod"
+                else None)
+    if not pod_spec:
+        return
+    for container in pod_spec.get("containers", []):
+        image = container.get("image", "")
+        repo = image.split(":")[0].split("@")[0]
+        if image in images:
+            container["image"] = images[image]
+        elif repo in images:
+            container["image"] = images[repo]
