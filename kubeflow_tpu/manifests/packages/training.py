@@ -464,3 +464,44 @@ def chainer_job(
             num_slices,
         )
     ]
+
+
+@prototype(
+    "slice-healthcheck",
+    "Pre-flight TPU slice health probe JaxJob: device counts + timed psum "
+    "over ICI (the GPU driver-wait/availability-prober analogue, "
+    "openmpi controller.py:74-90, kubeflow-readiness.py:21-37)",
+    params=_JOB_PARAMS,
+)
+def slice_healthcheck(
+    name: str,
+    namespace: str,
+    image: str,
+    num_workers: int,
+    accelerator: str,
+    topology: str,
+    num_slices: int,
+    chips_per_worker: int,
+) -> list[dict]:
+    command = [
+        "python", "-m", "kubeflow_tpu.workloads.slice_health",
+        f"--expect-local-devices={chips_per_worker or 1}",
+    ]
+    return [
+        _job(
+            jobs_api.JAX_JOB_KIND,
+            name,
+            namespace,
+            {
+                "Worker": {
+                    "replicas": num_workers,
+                    "restartPolicy": "OnFailure",
+                    "template": _worker_template(image, command,
+                                                 chips_per_worker),
+                },
+            },
+            accelerator,
+            topology,
+            num_slices,
+        )
+    ]

@@ -137,9 +137,12 @@ class JobController(Controller):
     def _gang_restart_if_needed(self, job: dict, existing: dict) -> dict:
         """JaxJob restart is all-or-nothing: a lone restarted process cannot
         rejoin a completed jax.distributed.initialize rendezvous, so a
-        retryable worker failure restarts the whole gang."""
+        retryable worker failure restarts the whole gang. Pods in phase
+        Unknown (node unreachable — slice host reclaimed) count as failed:
+        waiting for the kubelet to come back would hang the collective."""
         failed = [p for p in existing.values()
-                  if p.get("status", {}).get("phase") == "Failed"]
+                  if p.get("status", {}).get("phase") in ("Failed",
+                                                          "Unknown")]
         retryable = [
             self._should_restart(
                 p, self._rspec_for_pod(job, p).get("restartPolicy",
@@ -153,11 +156,15 @@ class JobController(Controller):
         ns = job["metadata"]["namespace"]
         for pod_name in existing:
             self.client.delete_if_exists(POD_API, "Pod", pod_name, ns)
-        self._bump_restarts(job)
+        preempted = all(self._is_preempted(p) for p in failed)
+        self._bump_restarts(job, preempted=preempted)
         self._set_condition(
-            job, api.COND_RESTARTING, "GangRestarting",
-            "worker failed; restarting the whole gang (collective "
-            "rendezvous is all-or-nothing)",
+            job, api.COND_RESTARTING,
+            "GangPreempted" if preempted else "GangRestarting",
+            ("slice preempted; rescheduling the gang"
+             if preempted else
+             "worker failed; restarting the whole gang (collective "
+             "rendezvous is all-or-nothing)"),
         )
         return {}
 
@@ -203,7 +210,28 @@ class JobController(Controller):
                     raise
         return pods
 
+    @staticmethod
+    def _is_preempted(pod: dict) -> bool:
+        """Node preemption/shutdown killed the pod — an infrastructure
+        event, not a workload failure. Signals: the kubelet's graceful-
+        shutdown reasons on pod status, or the DisruptionTarget condition
+        the eviction API sets. The TPU-specific reality this handles: spot/
+        reserved slice reclaims take whole hosts at once, and the gang must
+        reschedule (resuming from checkpoint) rather than burn its
+        backoffLimit (SURVEY §5.3 — the elastic behavior the reference
+        lacks)."""
+        status = pod.get("status", {})
+        if status.get("reason") in ("Preempted", "Shutdown", "Terminated",
+                                    "NodeShutdown"):
+            return True
+        return any(
+            c.get("type") == "DisruptionTarget" and c.get("status") == "True"
+            for c in status.get("conditions", [])
+        )
+
     def _should_restart(self, pod: dict, restart_policy: str) -> bool:
+        if self._is_preempted(pod):
+            return True  # preemption is always retryable, any policy
         if restart_policy in ("Always", "OnFailure"):
             return True
         if restart_policy == "ExitCode":
@@ -217,8 +245,11 @@ class JobController(Controller):
             return True
         return False
 
-    def _bump_restarts(self, job: dict) -> None:
-        job["status"]["restartCount"] = job["status"].get("restartCount", 0) + 1
+    def _bump_restarts(self, job: dict, *, preempted: bool = False) -> None:
+        # Preemptions are tracked separately and do not count against
+        # runPolicy.backoffLimit — infrastructure churn must not fail jobs.
+        key = "preemptionCount" if preempted else "restartCount"
+        job["status"][key] = job["status"].get(key, 0) + 1
 
     # ------------------------------------------------------------------
     # pod construction + env injection

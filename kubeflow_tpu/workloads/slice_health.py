@@ -1,0 +1,92 @@
+"""Slice health probe: `python -m kubeflow_tpu.workloads.slice_health`.
+
+The TPU analogue of the reference's GPU driver-wait + availability prober
+(openmpi sidecar driver poll, controller.py:74-90; metric-collector
+kubeflow-readiness.py:21-37): verify the worker actually has its devices
+and the collective actually works, exit 0/1. Used three ways — an init/
+sidecar container gating workload start, a Job the operator can schedule as
+a pre-flight on a fresh slice, and a liveness probe command.
+
+Checks: local device count (> 0, and == --expect-local-devices when
+given), global device count across the rendezvous (== --expect-devices
+when given), and a timed psum over every device (the ICI path) against
+--max-collective-ms.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from kubeflow_tpu.runtime import strip_glog_args
+
+
+def main(argv=None) -> int:
+    argv = strip_glog_args(list(sys.argv[1:] if argv is None else argv))
+    p = argparse.ArgumentParser(description="TPU slice health probe")
+    p.add_argument("--expect-devices", type=int, default=0,
+                   help="required global device count (0 = any)")
+    p.add_argument("--expect-local-devices", type=int, default=0)
+    p.add_argument("--max-collective-ms", type=float, default=0.0,
+                   help="fail if the psum probe exceeds this (0 = no limit)")
+    p.add_argument("--skip-collective", action="store_true")
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.parallel.distributed import (
+        initialize_from_env,
+        shutdown,
+    )
+
+    report: dict = {"healthy": False}
+    try:
+        info = initialize_from_env()
+        n_local = jax.local_device_count()
+        n_global = jax.device_count()
+        report.update(process_id=info.process_id,
+                      local_devices=n_local, global_devices=n_global,
+                      platform=jax.devices()[0].platform)
+        if n_local < 1:
+            raise RuntimeError("no local devices")
+        if args.expect_local_devices and n_local != args.expect_local_devices:
+            raise RuntimeError(
+                f"local devices {n_local} != {args.expect_local_devices}"
+            )
+        if args.expect_devices and n_global != args.expect_devices:
+            raise RuntimeError(
+                f"global devices {n_global} != {args.expect_devices}"
+            )
+        if not args.skip_collective:
+            probe = jax.pmap(lambda x: jax.lax.psum(x, "d"), axis_name="d")
+            out = probe(jnp.ones((n_local,), jnp.float32))  # compile
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            out = probe(jnp.full((n_local,), 2.0, jnp.float32))
+            got = float(out[0])  # fetch = real completion
+            ms = (time.perf_counter() - t0) * 1e3
+            report.update(psum=got, collective_ms=round(ms, 3))
+            if got != 2.0 * n_global:
+                raise RuntimeError(f"psum wrong: {got} != {2.0 * n_global}")
+            if args.max_collective_ms and ms > args.max_collective_ms:
+                raise RuntimeError(
+                    f"collective {ms:.1f}ms > {args.max_collective_ms}ms"
+                )
+        report["healthy"] = True
+        return 0
+    except Exception as e:
+        report["error"] = str(e)
+        return 1
+    finally:
+        print(json.dumps(report))
+        try:
+            shutdown()
+        except Exception:
+            pass
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

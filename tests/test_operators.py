@@ -3,6 +3,7 @@ SURVEY.md §4). Pod phase transitions are simulated the way envtest does —
 by writing pod status directly."""
 
 import json
+import os
 
 import pytest
 
@@ -415,3 +416,79 @@ def test_mpi_launcher_main_single_process(tmp_path, monkeypatch):
     rc = ml.main(["--hostfile", hostfile, "--", "echo", "ok"])
     assert rc == 0
     assert ran["cmd"] == ["echo", "ok"]
+
+
+def test_jaxjob_preemption_reschedules_without_burning_backoff(jaxjob_env):
+    """Preemption (node reclaim) gang-reschedules under ANY restart policy
+    and never counts against backoffLimit (SURVEY §5.3 elastic semantics)."""
+    api, ctrl = jaxjob_env
+    job = make_job(replicas=2, runPolicy={"backoffLimit": 0})
+    job["spec"]["replicaSpecs"]["Worker"]["restartPolicy"] = "Never"
+    api.create(job)
+    ctrl.reconcile_all()
+    pods = api.list("v1", "Pod", "kubeflow")
+    assert len(pods) == 2
+
+    # Node reclaimed: kubelet marks the pod Failed reason=Preempted.
+    victim = pods[0]["metadata"]["name"]
+    pod = api.get("v1", "Pod", victim, "kubeflow")
+    pod["status"] = {"phase": "Failed", "reason": "Preempted",
+                     "containerStatuses": [{"name": "main", "state": {
+                         "terminated": {"exitCode": 137}}}]}
+    api.update_status(pod)
+
+    ctrl.reconcile_all()  # gang deleted
+    ctrl.reconcile_all()  # gang recreated
+    got = api.get(jobs_api.JOBS_API_VERSION, "JaxJob", "train", "kubeflow")
+    assert got["status"].get("preemptionCount", 0) == 1
+    assert got["status"].get("restartCount", 0) == 0
+    assert got["status"]["state"] != "Failed"  # backoffLimit=0 untouched
+    conds = {c["type"]: c["reason"] for c in got["status"]["conditions"]}
+    assert conds.get("Restarting") == "GangPreempted"
+    assert len(api.list("v1", "Pod", "kubeflow")) == 2  # rescheduled
+
+
+def test_jaxjob_unknown_phase_counts_as_gang_failure(jaxjob_env):
+    """A pod stuck in Unknown (node unreachable) triggers the gang restart
+    path instead of hanging the collective."""
+    api, ctrl = jaxjob_env
+    api.create(make_job(replicas=2))
+    ctrl.reconcile_all()
+    name = api.list("v1", "Pod", "kubeflow")[0]["metadata"]["name"]
+    pod = api.get("v1", "Pod", name, "kubeflow")
+    pod["status"] = {"phase": "Unknown",
+                     "conditions": [{"type": "DisruptionTarget",
+                                     "status": "True"}]}
+    api.update_status(pod)
+    ctrl.reconcile_all()
+    ctrl.reconcile_all()
+    got = api.get(jobs_api.JOBS_API_VERSION, "JaxJob", "train", "kubeflow")
+    assert got["status"].get("preemptionCount", 0) == 1
+    assert len(api.list("v1", "Pod", "kubeflow")) == 2
+
+
+def test_slice_health_probe_runs():
+    """The health probe passes on the virtual slice and fails on an
+    impossible expectation."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=2")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    ok = subprocess.run(
+        [sys.executable, "-m", "kubeflow_tpu.workloads.slice_health",
+         "--expect-local-devices", "2"],
+        capture_output=True, text=True, timeout=180, env=env,
+    )
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    report = json.loads(ok.stdout.strip().splitlines()[-1])
+    assert report["healthy"] and report["psum"] == 4.0
+
+    bad = subprocess.run(
+        [sys.executable, "-m", "kubeflow_tpu.workloads.slice_health",
+         "--expect-devices", "999"],
+        capture_output=True, text=True, timeout=180, env=env,
+    )
+    assert bad.returncode == 1
+    assert "999" in json.loads(bad.stdout.strip().splitlines()[-1])["error"]
