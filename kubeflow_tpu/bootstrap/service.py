@@ -33,6 +33,37 @@ from kubeflow_tpu.cli.coordinator import Coordinator
 from kubeflow_tpu.config.defaults import default_kfdef
 from kubeflow_tpu.config.kfdef import PLATFORM_NONE
 
+# Click-to-deploy page (the gcp-click-to-deploy React SPA's role,
+# components/gcp-click-to-deploy/src/DeployForm.tsx, server-rendered):
+# one form driving POST /kfctl/e2eDeploy.
+_DEPLOY_PAGE = """<!doctype html>
+<html><head><title>kubeflow-tpu deploy</title>
+<style>body{font-family:sans-serif;margin:2rem;max-width:40rem}
+label{display:block;margin:.5rem 0}input{width:100%}</style></head>
+<body><h1>Deploy kubeflow-tpu</h1>
+<form id="f">
+  <label>Deployment name <input name="name" value="kubeflow" required></label>
+  <label>Platform <input name="platform" placeholder="none | gcp-tpu"></label>
+  <label>GCP project <input name="project"></label>
+  <label>Zone <input name="zone" placeholder="us-central2-b"></label>
+  <button type="submit">Create deployment</button>
+</form>
+<pre id="out"></pre>
+<script>
+document.getElementById('f').addEventListener('submit', async (e) => {
+  e.preventDefault();
+  const body = Object.fromEntries(new FormData(e.target).entries());
+  for (const k of Object.keys(body)) if (!body[k]) delete body[k];
+  const out = document.getElementById('out');
+  out.textContent = 'deploying...';
+  const resp = await fetch('/kfctl/e2eDeploy', {
+    method: 'POST', headers: {'Content-Type': 'application/json'},
+    body: JSON.stringify(body)});
+  out.textContent = JSON.stringify(await resp.json(), null, 2);
+});
+</script></body></html>
+"""
+
 
 class BootstrapService:
     # Default platform is the real in-cluster apiserver; tests pass "fake".
@@ -155,7 +186,10 @@ class BootstrapService:
                 self.wfile.write(body)
 
             def do_GET(self):
-                if self.path == "/healthz":
+                if self.path in ("/", "/deploy"):
+                    service._count()
+                    self._send(200, _DEPLOY_PAGE, "text/html")
+                elif self.path == "/healthz":
                     service._count()
                     self._send(200, {"status": "ok"})
                 elif self.path == "/metrics":

@@ -1,0 +1,77 @@
+"""Third-party inference-server package.
+
+The reference ships manifest packages for external serving systems —
+seldon (kubeflow/seldon/core.libsonnet), nvidia-inference-server,
+openvino — that are GPU/x86 products with no TPU analogue to port. What
+their packages actually provide is "run an arbitrary inference image with
+the platform's routing/monitoring glue"; this package keeps that capability
+as one generic prototype: any OCI inference server + its ports, wired with
+the gateway route, prometheus annotations, and optional TPU resources.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.apis.jobs import tpu_resources
+from kubeflow_tpu.k8s import objects as k8s
+from kubeflow_tpu.manifests.core import ParamSpec, gateway_route, prototype
+from kubeflow_tpu.version import DEFAULT_NAMESPACE
+
+
+@prototype(
+    "inference-server",
+    "Generic third-party inference server Deployment + routed Service "
+    "(the seldon/nvidia/openvino package family generalized)",
+    params=[
+        ParamSpec("name"),
+        ParamSpec("namespace", DEFAULT_NAMESPACE),
+        ParamSpec("image", "REQUIRED", "inference server image"),
+        ParamSpec("port", 8080, "HTTP predict port"),
+        ParamSpec("command", None, "container command override (list)"),
+        ParamSpec("args", None, "container args (list)"),
+        ParamSpec("replicas", 1),
+        ParamSpec("num_tpu_chips", 0, "google.com/tpu per replica"),
+        ParamSpec("route_prefix", "", "gateway prefix (default /<name>/)"),
+    ],
+)
+def inference_server(
+    name: str,
+    namespace: str,
+    image: str,
+    port: int,
+    command,
+    args,
+    replicas: int,
+    num_tpu_chips: int,
+    route_prefix: str,
+) -> list[dict]:
+    labels = {"app": name, "app.kubernetes.io/component": "inference"}
+    prefix = route_prefix or f"/{name}/"
+    container = k8s.container(
+        name,
+        image,
+        command=list(command) if command else None,
+        args=[str(a) for a in args] if args else None,
+        ports={"http": port},
+        resources=tpu_resources(num_tpu_chips),
+    )
+    return [
+        k8s.deployment(
+            name,
+            namespace,
+            containers=[container],
+            replicas=replicas,
+            labels=labels,
+        ),
+        k8s.service(
+            name,
+            namespace,
+            selector=labels,
+            ports=[{"name": "http", "port": port, "targetPort": port}],
+            labels=labels,
+            annotations={
+                **gateway_route(name, prefix, f"{name}.{namespace}:{port}"),
+                "prometheus.io/scrape": "true",
+                "prometheus.io/port": str(port),
+            },
+        ),
+    ]

@@ -1,0 +1,97 @@
+"""MPI worker lifecycle sidecar:
+`python -m kubeflow_tpu.workloads.mpi_sidecar`.
+
+The openmpi-controller analogue (components/openmpi-controller/controller/
+controller.py:17-116): the reference runs this next to each MPI worker to
+(a) wait for the GPU driver, (b) poll the master pod's phase via the k8s
+API, and (c) tear the worker down when the master finishes, so workers
+don't idle forever after mpirun exits. TPU-recast:
+
+- the driver-wait becomes the slice health probe (devices visible);
+- the master poll watches the job's Launcher pod through the apiserver;
+- teardown is a clean exit (the pod's restartPolicy does the rest) —
+  the file-signal protocol is unnecessary because workers here are plain
+  processes the kubelet supervises, not sidecar-signaled containers.
+
+Exit code mirrors the launcher: 0 when the Launcher pod Succeeded,
+1 when it Failed or disappeared, so the worker pod's terminal state
+follows the job outcome.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from kubeflow_tpu.apis.jobs import ENV_JOB_NAME, ENV_JOB_NAMESPACE
+from kubeflow_tpu.runtime import add_client_args, client_from_args, \
+    strip_glog_args
+
+LABEL_JOB = "kubeflow-tpu.org/job-name"
+LABEL_REPLICA_TYPE = "kubeflow-tpu.org/replica-type"
+
+
+def wait_for_launcher(client, job_name: str, namespace: str, *,
+                      poll_seconds: float = 5.0, timeout: float = 0.0,
+                      grace_polls: int = 3, log=print,
+                      sleep=time.sleep) -> int:
+    """Poll the job's Launcher pod until it reaches a terminal phase.
+    Returns its exit status (0 Succeeded / 1 Failed-or-gone). A missing
+    launcher is tolerated for ``grace_polls`` polls (it may not be
+    scheduled yet), then treated as failure."""
+    deadline = time.monotonic() + timeout if timeout else None
+    missing = 0
+    while True:
+        pods = client.list(
+            "v1", "Pod", namespace,
+            label_selector={LABEL_JOB: job_name,
+                            LABEL_REPLICA_TYPE: "launcher"},
+        )
+        if not pods:
+            missing += 1
+            if missing > grace_polls:
+                log(f"launcher pod for {job_name} gone; exiting")
+                return 1
+        else:
+            missing = 0
+            phase = pods[0].get("status", {}).get("phase", "Pending")
+            if phase == "Succeeded":
+                log("launcher succeeded; tearing down worker")
+                return 0
+            if phase == "Failed":
+                log("launcher failed; tearing down worker")
+                return 1
+        if deadline and time.monotonic() > deadline:
+            log("timed out waiting on launcher")
+            return 1
+        sleep(poll_seconds)
+
+
+def main(argv=None) -> int:
+    argv = strip_glog_args(list(sys.argv[1:] if argv is None else argv))
+    p = argparse.ArgumentParser(
+        description="MPI worker lifecycle sidecar (launcher-phase watcher)"
+    )
+    add_client_args(p)
+    p.add_argument("--job-name", default=os.environ.get(ENV_JOB_NAME, ""))
+    p.add_argument("--job-namespace",
+                   default=os.environ.get(ENV_JOB_NAMESPACE, "default"))
+    p.add_argument("--poll-seconds", type=float, default=5.0)
+    p.add_argument("--timeout", type=float, default=0.0)
+    args = p.parse_args(argv)
+    if not args.job_name:
+        p.error(f"--job-name or ${ENV_JOB_NAME} required")
+    client = client_from_args(args)
+    rc = wait_for_launcher(
+        client, args.job_name, args.job_namespace,
+        poll_seconds=args.poll_seconds, timeout=args.timeout,
+        log=lambda m: print(json.dumps({"msg": m})),
+    )
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

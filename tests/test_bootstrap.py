@@ -109,3 +109,10 @@ def test_concurrent_deploys_serialize_per_app(svc):
         assert order and order[0].startswith("other")  # not blocked
     t1.join(timeout=30)
     assert any(o.startswith("same") for o in order)
+
+
+def test_click_to_deploy_page(svc):
+    _service, base = svc
+    code, page = get(base, "/")
+    assert code == 200
+    assert "e2eDeploy" in page and "<form" in page

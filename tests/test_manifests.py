@@ -155,3 +155,19 @@ def test_golden_snapshots():
             f"golden drift for {case_name}; regenerate with "
             "python -m kubeflow_tpu.manifests.snapshot --update and review the diff"
         )
+
+
+def test_inference_server_prototype():
+    from kubeflow_tpu.manifests.core import generate
+
+    objs = generate("inference-server", {
+        "name": "triton", "image": "nvcr.io/tritonserver:latest",
+        "port": 8000, "num_tpu_chips": 4,
+    })
+    dep = [o for o in objs if o["kind"] == "Deployment"][0]
+    c = dep["spec"]["template"]["spec"]["containers"][0]
+    assert c["resources"]["limits"]["google.com/tpu"] == 4
+    svc = [o for o in objs if o["kind"] == "Service"][0]
+    ann = svc["metadata"]["annotations"]
+    assert "kubeflow-tpu.org/gateway-route" in ann
+    assert ann["prometheus.io/scrape"] == "true"

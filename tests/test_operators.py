@@ -492,3 +492,39 @@ def test_slice_health_probe_runs():
     )
     assert bad.returncode == 1
     assert "999" in json.loads(bad.stdout.strip().splitlines()[-1])["error"]
+
+
+def test_mpi_sidecar_follows_launcher_phase(api):
+    """openmpi-controller semantics (controller.py:92-104): the worker
+    sidecar exits with the launcher pod's outcome."""
+    from kubeflow_tpu.workloads.mpi_sidecar import wait_for_launcher
+
+    api.create({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "job-launcher-0", "namespace": "kubeflow",
+                     "labels": {"kubeflow-tpu.org/job-name": "job",
+                                "kubeflow-tpu.org/replica-type": "launcher"}},
+        "spec": {"containers": [{"name": "l", "image": "i"}]},
+        "status": {"phase": "Running"},
+    })
+    phases = iter(["Running", "Succeeded"])
+
+    def tick(_):
+        pod = api.get("v1", "Pod", "job-launcher-0", "kubeflow")
+        pod["status"]["phase"] = next(phases)
+        api.update_status(pod)
+
+    rc = wait_for_launcher(api, "job", "kubeflow", poll_seconds=0,
+                           log=lambda *a: None, sleep=tick)
+    assert rc == 0
+
+    pod = api.get("v1", "Pod", "job-launcher-0", "kubeflow")
+    pod["status"]["phase"] = "Failed"
+    api.update_status(pod)
+    assert wait_for_launcher(api, "job", "kubeflow", poll_seconds=0,
+                             log=lambda *a: None, sleep=lambda s: None) == 1
+    # Launcher gone entirely -> failure after the grace polls.
+    api.delete("v1", "Pod", "job-launcher-0", "kubeflow")
+    assert wait_for_launcher(api, "job", "kubeflow", poll_seconds=0,
+                             grace_polls=1, log=lambda *a: None,
+                             sleep=lambda s: None) == 1
