@@ -23,7 +23,7 @@ from jax import lax
 
 from kubeflow_tpu.ops import rms_norm
 from kubeflow_tpu.ops.rotary import apply_rotary, rotary_frequencies
-from kubeflow_tpu.models.transformer import TransformerConfig
+from kubeflow_tpu.models.transformer import TransformerConfig, moe_ffn
 
 _NEG_INF = -1e30
 
@@ -77,9 +77,11 @@ def _rope(x, cos, sin):
 
 
 def forward_cached(params, tokens, cfg: TransformerConfig, cache, pos,
-                   positions, valid):
+                   positions, valid, token_valid=None):
     """tokens [B, S] at cache slots pos..pos+S with true sequence positions
-    ``positions`` [B, S] → (logits [B, S, V], new cache)."""
+    ``positions`` [B, S] → (logits [B, S, V], new cache). ``token_valid``
+    ([B, S]) marks real (non-pad) tokens in THIS block — MoE routing must
+    not let ragged-prefill padding claim expert capacity."""
     cos_t, sin_t = rotary_frequencies(cfg.head_dim, cache["k"].shape[2],
                                       theta=cfg.rope_theta)
     rope_bt = (cos_t[positions], sin_t[positions])
@@ -93,11 +95,15 @@ def forward_cached(params, tokens, cfg: TransformerConfig, cache, pos,
         )
         x = x + attn
         h = rms_norm(x, layer["ln_mlp"], eps=cfg.norm_eps)
-        gate = h @ layer["mlp"]["gate"].astype(cfg.dtype)
-        up = h @ layer["mlp"]["up"].astype(cfg.dtype)
-        x = x + (jax.nn.silu(gate) * up) @ layer["mlp"]["down"].astype(
-            cfg.dtype
-        )
+        if cfg.n_experts:
+            y, _aux = moe_ffn(h, layer["mlp"], cfg, token_valid=token_valid)
+            x = x + y
+        else:
+            gate = h @ layer["mlp"]["gate"].astype(cfg.dtype)
+            up = h @ layer["mlp"]["up"].astype(cfg.dtype)
+            x = x + (jax.nn.silu(gate) * up) @ layer["mlp"]["down"].astype(
+                cfg.dtype
+            )
         return x, (k_cache, v_cache)
 
     x, (k_new, v_new) = lax.scan(
@@ -139,7 +145,8 @@ def generate(params, prompt_tokens, prompt_lengths, cfg: TransformerConfig,
     valid = slot < prompt_lengths[:, None]  # prompt slots only
     positions = jnp.broadcast_to(jnp.arange(t0)[None], (b, t0))
     logits, cache = forward_cached(
-        params, prompt_tokens, cfg, cache, 0, positions, valid
+        params, prompt_tokens, cfg, cache, 0, positions, valid,
+        token_valid=jnp.arange(t0)[None] < prompt_lengths[:, None],
     )
     last = jnp.take_along_axis(
         logits, (prompt_lengths - 1)[:, None, None], axis=1

@@ -29,6 +29,7 @@ from kubeflow_tpu.ops import flash_attention, rms_norm
 from kubeflow_tpu.ops.rotary import apply_rotary, rotary_frequencies
 from kubeflow_tpu.parallel.mesh import (
     AXIS_DATA,
+    AXIS_EXPERT,
     AXIS_FSDP,
     AXIS_SEQUENCE,
     AXIS_TENSOR,
@@ -53,6 +54,14 @@ class TransformerConfig:
     # Attention runs through the sequence-axis ring kernel when True.
     context_parallel: bool = False
     remat: bool = True
+    # Mixture-of-Experts FFN (0 = dense). GShard-style top-k routing with a
+    # static capacity per expert (dropped tokens ride the residual), expert
+    # weights sharded over the mesh's `expert` axis — GSPMD inserts the
+    # dispatch/combine all-to-alls from the einsum shardings.
+    n_experts: int = 0
+    expert_top_k: int = 2
+    expert_capacity_factor: float = 1.25
+    router_aux_loss: float = 0.01
     # Attention implementation: None (auto = blockwise flash), "plain",
     # "xla" (kubeflow_tpu.ops.flash_attention's implementation arg) and the
     # kv block width — block_k == seq_len collapses the flash scan to one
@@ -92,6 +101,16 @@ PRESETS: dict[str, TransformerConfig] = {
         vocab_size=32_000, d_model=4096, n_layers=4, n_heads=32,
         n_kv_heads=8, d_ff=14_336, max_seq_len=2048,
     ),
+    # Mixtral-family shape at reduced depth (8 experts, top-2).
+    "moe-1b": TransformerConfig(
+        vocab_size=32_000, d_model=1024, n_layers=8, n_heads=16,
+        n_kv_heads=4, d_ff=3584, n_experts=8, expert_top_k=2,
+    ),
+    "moe-test-tiny": TransformerConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=128, remat=False, n_experts=4,
+        expert_top_k=2,
+    ),
 }
 
 
@@ -108,6 +127,9 @@ def init(key, cfg: TransformerConfig):
     """Parameter pytree; weights float32 (cast to cfg.dtype at apply time)."""
     d, f = cfg.d_model, cfg.d_ff
     hd = cfg.head_dim
+    # NOTE: split count must stay 8 — changing it would silently reshuffle
+    # every existing model's init for a given seed (threefry pairs counters
+    # with the split width). Extra keys come from fold_in, like lm_head.
     keys = jax.random.split(key, 8)
 
     def dense(k, shape, fan_in):
@@ -116,6 +138,20 @@ def init(key, cfg: TransformerConfig):
     def stack(k, shape, fan_in):
         return dense(k, (cfg.n_layers, *shape), fan_in)
 
+    if cfg.n_experts:
+        e = cfg.n_experts
+        mlp = {
+            "router": stack(jax.random.fold_in(key, 98), (d, e), d),
+            "gate": stack(keys[5], (e, d, f), d),
+            "up": stack(keys[6], (e, d, f), d),
+            "down": stack(keys[7], (e, f, d), f),
+        }
+    else:
+        mlp = {
+            "gate": stack(keys[5], (d, f), d),
+            "up": stack(keys[6], (d, f), d),
+            "down": stack(keys[7], (f, d), f),
+        }
     params = {
         "embed": {"kernel": dense(keys[0], (cfg.vocab_size, d), d)},
         "layers": {
@@ -125,11 +161,7 @@ def init(key, cfg: TransformerConfig):
                 "wv": stack(keys[3], (d, cfg.n_kv_heads * hd), d),
                 "wo": stack(keys[4], (cfg.n_heads * hd, d), cfg.n_heads * hd),
             },
-            "mlp": {
-                "gate": stack(keys[5], (d, f), d),
-                "up": stack(keys[6], (d, f), d),
-                "down": stack(keys[7], (f, d), f),
-            },
+            "mlp": mlp,
             "ln_attn": jnp.ones((cfg.n_layers, d), jnp.float32),
             "ln_mlp": jnp.ones((cfg.n_layers, d), jnp.float32),
         },
@@ -143,18 +175,34 @@ def init(key, cfg: TransformerConfig):
 
 
 def partition_rules(cfg: TransformerConfig) -> list[PartitionRule]:
-    """DP×FSDP×TP layout. Stacked layer weights carry a leading L dim (never
-    sharded). Megatron pairing: column-parallel in (wq/wk/wv/gate/up), row-
-    parallel out (wo/down) so each block needs one reduce per residual add."""
-    return [
+    """DP×FSDP×TP(×EP) layout. Stacked layer weights carry a leading L dim
+    (never sharded). Megatron pairing: column-parallel in (wq/wk/wv/gate/up),
+    row-parallel out (wo/down) so each block needs one reduce per residual
+    add. MoE expert weights [L, E, ...] shard E over the expert axis."""
+    rules = [
         PartitionRule(r"embed/kernel", P(AXIS_TENSOR, AXIS_FSDP)),
         PartitionRule(r"attn/w[qkv]", P(None, AXIS_FSDP, AXIS_TENSOR)),
         PartitionRule(r"attn/wo", P(None, AXIS_TENSOR, AXIS_FSDP)),
-        PartitionRule(r"mlp/(gate|up)", P(None, AXIS_FSDP, AXIS_TENSOR)),
-        PartitionRule(r"mlp/down", P(None, AXIS_TENSOR, AXIS_FSDP)),
-        PartitionRule(r"lm_head/kernel", P(AXIS_FSDP, AXIS_TENSOR)),
-        # norms replicated (fall through to default P()).
     ]
+    if cfg.n_experts:
+        rules += [
+            PartitionRule(r"mlp/router", P(None, AXIS_FSDP, None)),
+            PartitionRule(
+                r"mlp/(gate|up)",
+                P(None, AXIS_EXPERT, AXIS_FSDP, AXIS_TENSOR),
+            ),
+            PartitionRule(
+                r"mlp/down", P(None, AXIS_EXPERT, AXIS_TENSOR, AXIS_FSDP)
+            ),
+        ]
+    else:
+        rules += [
+            PartitionRule(r"mlp/(gate|up)", P(None, AXIS_FSDP, AXIS_TENSOR)),
+            PartitionRule(r"mlp/down", P(None, AXIS_TENSOR, AXIS_FSDP)),
+        ]
+    rules.append(PartitionRule(r"lm_head/kernel", P(AXIS_FSDP, AXIS_TENSOR)))
+    # norms replicated (fall through to default P()).
+    return rules
 
 
 def batch_partition_spec(cfg: TransformerConfig) -> P:
@@ -212,15 +260,100 @@ def _mlp(x, layer, cfg: TransformerConfig):
     return (jax.nn.silu(gate) * up) @ layer["down"].astype(cfg.dtype)
 
 
-def _layer_fn(cfg: TransformerConfig, mesh, rope, x, layer):
+def moe_ffn(x, mlp, cfg: TransformerConfig, token_valid=None):
+    """GShard-style MoE FFN: top-k routing with static per-expert capacity.
+
+    Everything is fixed-shape einsums (no gather/scatter, no dynamic
+    shapes): tokens are dispatched into [E, C, D] expert buffers via a
+    one-hot dispatch tensor, each expert runs a batched SwiGLU (weights
+    stacked on a leading E dim, sharded over the `expert` mesh axis —
+    GSPMD turns the dispatch/combine einsums into all-to-alls over ICI),
+    and outputs combine back weighted by the normalized gate. Tokens past
+    an expert's capacity are dropped and ride the residual connection.
+
+    x: [B, T, D] → (y [B, T, D], aux_loss scalar) — aux is the
+    load-balancing loss (Switch/GShard: E · Σ_e fraction_e · mean_prob_e).
+
+    ``token_valid`` ([B, T] bool): padding tokens claim no expert capacity
+    and are excluded from the aux statistics — without this, a ragged
+    serving batch's pad slots would evict real tokens from their experts.
+    """
+    b, t, d = x.shape
+    e = cfg.n_experts
+    k = min(cfg.expert_top_k, e)
+    n = b * t
+    capacity = max(int(n * k / e * cfg.expert_capacity_factor), k)
+    xf = x.reshape(n, d)
+
+    logits = (xf.astype(jnp.float32)
+              @ mlp["router"].astype(jnp.float32))  # router in fp32
+    probs = jax.nn.softmax(logits, axis=-1)  # [n, e]
+    gate_vals, expert_idx = lax.top_k(probs, k)  # [n, k]
+    gate_vals = gate_vals / jnp.maximum(
+        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9
+    )
+
+    oh_e = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [n, k, e]
+    n_valid = jnp.float32(n)
+    if token_valid is not None:
+        tv = token_valid.reshape(n).astype(jnp.float32)
+        oh_e = oh_e * tv[:, None, None]
+        n_valid = jnp.maximum(jnp.sum(tv), 1.0)
+    # Position of each (token, slot) within its expert, priority-major:
+    # all first choices are placed before any second choice (GShard order).
+    flat = oh_e.transpose(1, 0, 2).reshape(k * n, e)
+    pos = (jnp.cumsum(flat, axis=0) - flat).reshape(k, n, e).transpose(
+        1, 0, 2
+    )
+    slot_pos = jnp.sum(pos * oh_e, axis=-1)  # [n, k]
+    keep = slot_pos < capacity
+    oh_c = jax.nn.one_hot(
+        jnp.where(keep, slot_pos, 0), capacity, dtype=jnp.float32
+    ) * keep[..., None]  # [n, k, c]
+
+    dispatch = jnp.einsum("nke,nkc->nec", oh_e, oh_c)
+    combine = jnp.einsum(
+        "nke,nkc,nk->nec", oh_e, oh_c, gate_vals
+    ).astype(cfg.dtype)
+
+    expert_in = jnp.einsum(
+        "nd,nec->ecd", xf, dispatch.astype(cfg.dtype)
+    )  # [e, c, d]
+    g = jnp.einsum("ecd,edf->ecf", expert_in, mlp["gate"].astype(cfg.dtype))
+    u = jnp.einsum("ecd,edf->ecf", expert_in, mlp["up"].astype(cfg.dtype))
+    out = jnp.einsum(
+        "ecf,efd->ecd", jax.nn.silu(g) * u, mlp["down"].astype(cfg.dtype)
+    )
+    y = jnp.einsum("ecd,nec->nd", out, combine)
+
+    # Load-balance aux: fraction of top-1 tokens per expert × mean router
+    # prob per expert (differentiable through probs only; valid tokens only).
+    top1_frac = jnp.sum(oh_e[:, 0, :], axis=0) / n_valid
+    if token_valid is not None:
+        mean_prob = jnp.sum(
+            probs * token_valid.reshape(n, 1), axis=0
+        ) / n_valid
+    else:
+        mean_prob = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(top1_frac * mean_prob)
+    return y.reshape(b, t, d).astype(x.dtype), aux
+
+
+def _layer_fn(cfg: TransformerConfig, mesh, rope, carry, layer):
+    x, aux = carry
     act_spec = batch_partition_spec(cfg) + (None,)
     h = rms_norm(x, layer["ln_attn"], eps=cfg.norm_eps)
     x = x + _attention(h, layer["attn"], cfg, rope, mesh)
     x = _constrain(x, mesh, P(*act_spec))
     h = rms_norm(x, layer["ln_mlp"], eps=cfg.norm_eps)
-    x = x + _mlp(h, layer["mlp"], cfg)
+    if cfg.n_experts:
+        y, layer_aux = moe_ffn(h, layer["mlp"], cfg)
+        x = x + y
+        aux = aux + layer_aux
+    else:
+        x = x + _mlp(h, layer["mlp"], cfg)
     x = _constrain(x, mesh, P(*act_spec))
-    return x, None
+    return (x, aux), None
 
 
 def _embed_lookup(kernel, tokens, cfg: TransformerConfig, mesh):
@@ -237,8 +370,12 @@ def _embed_lookup(kernel, tokens, cfg: TransformerConfig, mesh):
     return kernel[tokens]
 
 
-def apply(params, tokens, cfg: TransformerConfig, *, mesh=None):
-    """tokens [B, T] int32 → logits [B, T, V] (cfg.dtype)."""
+def apply(params, tokens, cfg: TransformerConfig, *, mesh=None,
+          return_aux: bool = False):
+    """tokens [B, T] int32 → logits [B, T, V] (cfg.dtype).
+
+    ``return_aux=True`` additionally returns the summed MoE router
+    load-balance loss (0.0 for dense models)."""
     t = tokens.shape[1]
     rope = rotary_frequencies(cfg.head_dim, t, theta=cfg.rope_theta)
     x = _embed_lookup(
@@ -254,7 +391,9 @@ def apply(params, tokens, cfg: TransformerConfig, *, mesh=None):
             "none": None,
         }[cfg.remat_policy]
         layer_fn = jax.checkpoint(layer_fn, policy=policy)
-    x, _ = lax.scan(layer_fn, x, params["layers"])
+    (x, aux), _ = lax.scan(
+        layer_fn, (x, jnp.zeros((), jnp.float32)), params["layers"]
+    )
 
     x = rms_norm(x, params["final_norm"], eps=cfg.norm_eps)
     if cfg.tie_embeddings:
@@ -262,6 +401,8 @@ def apply(params, tokens, cfg: TransformerConfig, *, mesh=None):
     else:
         head = params["lm_head"]["kernel"]
     logits = x @ head.astype(cfg.dtype)
+    if return_aux:
+        return logits, aux
     return logits
 
 
@@ -274,5 +415,10 @@ def loss_fn(params, batch, cfg: TransformerConfig, *, mesh=None):
         inputs, targets = batch["inputs"], batch["targets"]
     else:
         inputs, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
-    logits = apply(params, inputs, cfg, mesh=mesh)
-    return softmax_cross_entropy(logits, targets, z_loss=1e-4)
+    logits, aux = apply(params, inputs, cfg, mesh=mesh, return_aux=True)
+    loss, metrics = softmax_cross_entropy(logits, targets, z_loss=1e-4)
+    if cfg.n_experts and cfg.router_aux_loss:
+        aux_loss = cfg.router_aux_loss * aux
+        metrics["router_aux_loss"] = aux_loss
+        loss = loss + aux_loss
+    return loss, metrics
