@@ -31,6 +31,7 @@ from kubeflow_tpu.parallel.mesh import (
     AXIS_DATA,
     AXIS_EXPERT,
     AXIS_FSDP,
+    AXIS_PIPELINE,
     AXIS_SEQUENCE,
     AXIS_TENSOR,
 )
@@ -62,6 +63,11 @@ class TransformerConfig:
     expert_top_k: int = 2
     expert_capacity_factor: float = 1.25
     router_aux_loss: float = 0.01
+    # Pipeline parallelism (0 = off): layers split into this many stages
+    # over the mesh's `pipeline` axis, GPipe-scheduled with
+    # pipeline_microbatches microbatches (parallel/pipeline.py).
+    pipeline_stages: int = 0
+    pipeline_microbatches: int = 4
     # Attention implementation: None (auto = blockwise flash), "plain",
     # "xla" (kubeflow_tpu.ops.flash_attention's implementation arg) and the
     # kv block width — block_k == seq_len collapses the flash scan to one
@@ -179,26 +185,31 @@ def partition_rules(cfg: TransformerConfig) -> list[PartitionRule]:
     (never sharded). Megatron pairing: column-parallel in (wq/wk/wv/gate/up),
     row-parallel out (wo/down) so each block needs one reduce per residual
     add. MoE expert weights [L, E, ...] shard E over the expert axis."""
+    # Stacked layer weights' leading L dim maps onto pipeline stages when
+    # pipeline parallelism is on (each stage holds its contiguous slice).
+    ldim = AXIS_PIPELINE if cfg.pipeline_stages > 1 else None
     rules = [
         PartitionRule(r"embed/kernel", P(AXIS_TENSOR, AXIS_FSDP)),
-        PartitionRule(r"attn/w[qkv]", P(None, AXIS_FSDP, AXIS_TENSOR)),
-        PartitionRule(r"attn/wo", P(None, AXIS_TENSOR, AXIS_FSDP)),
+        PartitionRule(r"attn/w[qkv]", P(ldim, AXIS_FSDP, AXIS_TENSOR)),
+        PartitionRule(r"attn/wo", P(ldim, AXIS_TENSOR, AXIS_FSDP)),
     ]
+    if cfg.pipeline_stages > 1:
+        rules.append(PartitionRule(r"layers/ln_", P(AXIS_PIPELINE)))
     if cfg.n_experts:
         rules += [
-            PartitionRule(r"mlp/router", P(None, AXIS_FSDP, None)),
+            PartitionRule(r"mlp/router", P(ldim, AXIS_FSDP, None)),
             PartitionRule(
                 r"mlp/(gate|up)",
-                P(None, AXIS_EXPERT, AXIS_FSDP, AXIS_TENSOR),
+                P(ldim, AXIS_EXPERT, AXIS_FSDP, AXIS_TENSOR),
             ),
             PartitionRule(
-                r"mlp/down", P(None, AXIS_EXPERT, AXIS_TENSOR, AXIS_FSDP)
+                r"mlp/down", P(ldim, AXIS_EXPERT, AXIS_TENSOR, AXIS_FSDP)
             ),
         ]
     else:
         rules += [
-            PartitionRule(r"mlp/(gate|up)", P(None, AXIS_FSDP, AXIS_TENSOR)),
-            PartitionRule(r"mlp/down", P(None, AXIS_TENSOR, AXIS_FSDP)),
+            PartitionRule(r"mlp/(gate|up)", P(ldim, AXIS_FSDP, AXIS_TENSOR)),
+            PartitionRule(r"mlp/down", P(ldim, AXIS_TENSOR, AXIS_FSDP)),
         ]
     rules.append(PartitionRule(r"lm_head/kernel", P(AXIS_FSDP, AXIS_TENSOR)))
     # norms replicated (fall through to default P()).
@@ -383,17 +394,43 @@ def apply(params, tokens, cfg: TransformerConfig, *, mesh=None,
     )
     x = _constrain(x, mesh, P(*(batch_partition_spec(cfg) + (None,))))
 
-    layer_fn = functools.partial(_layer_fn, cfg, mesh, rope)
-    if cfg.remat:
-        policy = {
-            "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-            "dots_batched": jax.checkpoint_policies.dots_saveable,
-            "none": None,
-        }[cfg.remat_policy]
-        layer_fn = jax.checkpoint(layer_fn, policy=policy)
-    (x, aux), _ = lax.scan(
-        layer_fn, (x, jnp.zeros((), jnp.float32)), params["layers"]
-    )
+    policy = {
+        "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        "dots_batched": jax.checkpoint_policies.dots_saveable,
+        "none": None,
+    }[cfg.remat_policy]
+
+    if cfg.pipeline_stages > 1 and mesh is not None:
+        if cfg.n_experts or cfg.context_parallel:
+            raise ValueError(
+                "pipeline_stages composes with dp/fsdp/tp, not (yet) with "
+                "MoE or context parallelism"
+            )
+        if cfg.n_layers % cfg.pipeline_stages:
+            raise ValueError(
+                f"n_layers {cfg.n_layers} not divisible by "
+                f"pipeline_stages {cfg.pipeline_stages}"
+            )
+        from kubeflow_tpu.parallel.pipeline import pipeline_apply
+
+        def one_layer(layer, h):
+            h2 = rms_norm(h, layer["ln_attn"], eps=cfg.norm_eps)
+            h = h + _attention(h2, layer["attn"], cfg, rope, None)
+            h2 = rms_norm(h, layer["ln_mlp"], eps=cfg.norm_eps)
+            return h + _mlp(h2, layer["mlp"], cfg)
+
+        if cfg.remat:
+            one_layer = jax.checkpoint(one_layer, policy=policy)
+        x = pipeline_apply(one_layer, params["layers"], x, mesh,
+                           n_micro=cfg.pipeline_microbatches)
+        aux = jnp.zeros((), jnp.float32)
+    else:
+        layer_fn = functools.partial(_layer_fn, cfg, mesh, rope)
+        if cfg.remat:
+            layer_fn = jax.checkpoint(layer_fn, policy=policy)
+        (x, aux), _ = lax.scan(
+            layer_fn, (x, jnp.zeros((), jnp.float32)), params["layers"]
+        )
 
     x = rms_norm(x, params["final_norm"], eps=cfg.norm_eps)
     if cfg.tie_embeddings:

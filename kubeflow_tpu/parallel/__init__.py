@@ -22,6 +22,7 @@ native to TPUs:
 from kubeflow_tpu.parallel.mesh import (
     AXIS_DATA,
     AXIS_EXPERT,
+    AXIS_PIPELINE,
     AXIS_FSDP,
     AXIS_SEQUENCE,
     AXIS_TENSOR,
@@ -39,6 +40,7 @@ from kubeflow_tpu.parallel.sharding import (
 __all__ = [
     "AXIS_DATA",
     "AXIS_EXPERT",
+    "AXIS_PIPELINE",
     "AXIS_FSDP",
     "AXIS_SEQUENCE",
     "AXIS_TENSOR",

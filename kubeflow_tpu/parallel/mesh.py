@@ -20,7 +20,10 @@ import numpy as np
 from jax.sharding import Mesh
 
 # Canonical axis names, outermost (DCN-tolerant) to innermost (ICI-hungry).
+# Pipeline sits next to data: stage boundaries move one activation per
+# microbatch step (point-to-point), the lowest-bandwidth collective here.
 AXIS_DATA = "data"
+AXIS_PIPELINE = "pipeline"
 AXIS_FSDP = "fsdp"
 AXIS_EXPERT = "expert"
 AXIS_SEQUENCE = "sequence"
@@ -28,6 +31,7 @@ AXIS_TENSOR = "tensor"
 
 MESH_AXES: tuple[str, ...] = (
     AXIS_DATA,
+    AXIS_PIPELINE,
     AXIS_FSDP,
     AXIS_EXPERT,
     AXIS_SEQUENCE,
@@ -45,6 +49,7 @@ class MeshConfig:
     """
 
     data: int = -1
+    pipeline: int = 1
     fsdp: int = 1
     expert: int = 1
     sequence: int = 1
@@ -58,6 +63,7 @@ class MeshConfig:
     def degrees(self) -> dict[str, int]:
         return {
             AXIS_DATA: self.data,
+            AXIS_PIPELINE: self.pipeline,
             AXIS_FSDP: self.fsdp,
             AXIS_EXPERT: self.expert,
             AXIS_SEQUENCE: self.sequence,

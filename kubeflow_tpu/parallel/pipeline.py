@@ -1,0 +1,119 @@
+"""Pipeline parallelism: GPipe microbatch schedule over the `pipeline`
+mesh axis.
+
+Layer stacks already carry a leading L dim (the lax.scan representation),
+so pipeline stages are just that dim sharded over the `pipeline` axis —
+each device holds L/S contiguous layers. The schedule runs inside
+``shard_map``: at step t, stage s processes microbatch t−s (bubble steps
+compute on garbage and discard — branchless, so the loop body stays one
+fused program), and activations move stage→stage+1 with a single
+``lax.ppermute`` per step. Total steps = n_micro + S − 1; efficiency
+n_micro / (n_micro + S − 1), the GPipe bubble.
+
+Backward is plain autodiff: ppermute transposes to the reverse permute, so
+the cotangents flow backward through the pipeline in the same schedule —
+no hand-written backward pass.
+
+The reference has no analogue (its parallelism is PS/allreduce replica
+counts, SURVEY §2.2); this is part of the §5.7 mandate alongside
+tensor/sequence/expert parallelism.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from kubeflow_tpu.parallel.mesh import AXIS_PIPELINE
+
+try:  # jax >= 0.6 exposes shard_map at top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def pipeline_apply(layer_fn, stage_params, x, mesh, *, n_micro: int):
+    """Run ``x`` through the full layer stack with GPipe scheduling.
+
+    ``layer_fn(layer_params, x) -> x`` applies ONE layer (weights without
+    the leading L dim). ``stage_params`` is the stacked [L, ...] pytree;
+    the L dim is split over the `pipeline` axis. ``x`` [B, T, D] keeps
+    whatever data/fsdp sharding it arrived with (those axes stay auto);
+    B must divide by n_micro. Returns [B, T, D], same sharding.
+    """
+    n_stages = mesh.shape[AXIS_PIPELINE]
+    if n_stages == 1:
+        # Degenerate: plain scan, no schedule.
+        def body(h, layer):
+            return layer_fn(layer, h), None
+
+        return lax.scan(body, x, stage_params)[0]
+
+    @functools.partial(
+        _shard_map,
+        mesh=mesh,
+        # Only `pipeline` is manual; data/fsdp/tensor/... stay auto, so the
+        # schedule composes with the other parallelism axes — GSPMD keeps
+        # sharding the per-stage compute from the outer annotations.
+        axis_names=frozenset({AXIS_PIPELINE}),
+        in_specs=(P(AXIS_PIPELINE), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def run(local_params, xb32):
+        # f32 at the shard_map boundary: the transpose of a replicated-in
+        # input is a psum over `pipeline`, and XLA CPU's AllReducePromotion
+        # crashes on bf16 all-reduces; compute stays in the caller's dtype.
+        xb = xb32.astype(x.dtype)
+        stage = lax.axis_index(AXIS_PIPELINE)
+        b = xb.shape[0]
+        if b % n_micro:
+            raise ValueError(
+                f"per-shard batch {b} not divisible by n_micro {n_micro}"
+            )
+        micro = xb.reshape(n_micro, b // n_micro, *xb.shape[1:])
+
+        def local_stack(h):
+            def body(h, layer):
+                return layer_fn(layer, h), None
+
+            return lax.scan(body, h, local_params)[0]
+
+        def step(carry, t):
+            state, out_buf = carry
+            # Stage 0 ingests microbatch t (clamped: bubble steps recompute
+            # an already-consumed microbatch and the result is discarded).
+            feed = micro[jnp.clip(t, 0, n_micro - 1)]
+            x_in = jnp.where(stage == 0, feed, state)
+            y = local_stack(x_in)
+            # Last stage owns microbatch t-(S-1)'s final activations.
+            idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+            take = (stage == n_stages - 1) & (t >= n_stages - 1)
+            out_buf = out_buf.at[idx].set(
+                jnp.where(take, y, out_buf[idx])
+            )
+            state = lax.ppermute(
+                y, AXIS_PIPELINE,
+                [(i, (i + 1) % n_stages) for i in range(n_stages)],
+            )
+            return (state, out_buf), None
+
+        steps = n_micro + n_stages - 1
+        init = (jnp.zeros_like(micro[0]), jnp.zeros_like(micro))
+        (_, out_buf), _ = lax.scan(step, init, jnp.arange(steps))
+        # Only the last stage holds real outputs; the psum of masked
+        # buffers replicates them across the pipeline axis so out_specs
+        # (replicated over `pipeline`) is truthful. The reduce runs in f32:
+        # XLA CPU's AllReducePromotion pass crashes cloning a bf16
+        # all-reduce (observed: "Invalid binary instruction opcode copy").
+        masked = jnp.where(
+            stage == n_stages - 1, out_buf, 0.0
+        ).astype(jnp.float32)
+        out = lax.psum(masked, AXIS_PIPELINE)
+        return out.reshape(xb.shape)
+
+    return run(stage_params, x.astype(jnp.float32)).astype(x.dtype)

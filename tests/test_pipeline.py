@@ -1,0 +1,106 @@
+"""Pipeline-parallelism tests: the GPipe schedule must be numerically a
+no-op versus the plain layer scan, forward and backward, and train
+end-to-end on a pipeline×data mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models import transformer
+from kubeflow_tpu.models.registry import get_model
+from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+from kubeflow_tpu.parallel.pipeline import pipeline_apply
+from kubeflow_tpu.train.data import place_batch, synthetic_batch
+from kubeflow_tpu.train.optimizers import OptimizerConfig
+from kubeflow_tpu.train.trainer import build_train_step, init_state
+
+
+def test_pipeline_apply_matches_scan():
+    """GPipe over 2 stages == plain scan over the stacked layers, for a
+    simple per-layer function, forward and grad."""
+    mesh = build_mesh(MeshConfig(data=-1, pipeline=2))
+    L, B, D = 4, 8, 16
+    key = jax.random.PRNGKey(0)
+    w = jax.random.normal(key, (L, D, D)) * 0.3
+    x = jax.random.normal(jax.random.PRNGKey(1), (B, D))
+
+    def layer_fn(layer_w, h):
+        return jnp.tanh(h @ layer_w)
+
+    def ref(w, x):
+        def body(h, lw):
+            return layer_fn(lw, h), None
+        return jax.lax.scan(body, x, w)[0]
+
+    def piped(w, x):
+        return pipeline_apply(layer_fn, w, x, mesh, n_micro=4)
+
+    with mesh:
+        out = jax.jit(piped)(w, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref(w, x)),
+                               rtol=1e-5, atol=1e-5)
+
+    # Gradients flow backward through the pipeline identically.
+    def loss_piped(w, x):
+        return jnp.sum(piped(w, x) ** 2)
+
+    def loss_ref(w, x):
+        return jnp.sum(ref(w, x) ** 2)
+
+    with mesh:
+        g_piped = jax.jit(jax.grad(loss_piped))(w, x)
+    g_ref = jax.grad(loss_ref)(w, x)
+    np.testing.assert_allclose(np.asarray(g_piped), np.asarray(g_ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_transformer_pipeline_matches_dense_forward():
+    """The full model under pp=2 produces the same logits as the plain
+    scan path with identical weights."""
+    cfg_pp = transformer.config("lm-test-tiny", pipeline_stages=2,
+                                pipeline_microbatches=2)
+    cfg_plain = transformer.config("lm-test-tiny")
+    params = transformer.init(jax.random.PRNGKey(0), cfg_plain)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 256)
+
+    ref = transformer.apply(params, tokens, cfg_plain)
+    mesh = build_mesh(MeshConfig(data=-1, pipeline=2))
+    with mesh:
+        out = jax.jit(
+            lambda p, t: transformer.apply(p, t, cfg_pp, mesh=mesh)
+        )(params, tokens)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        rtol=5e-2, atol=5e-2,
+    )
+
+
+def test_pipeline_train_step_end_to_end():
+    """Full sharded train step on a pipeline×data mesh: weights sharded by
+    stage, loss finite, two steps run."""
+    model = get_model("lm-test-tiny", pipeline_stages=2,
+                      pipeline_microbatches=2)
+    mesh = build_mesh(MeshConfig(data=-1, pipeline=2))
+    opt = OptimizerConfig(warmup_steps=1, total_steps=4)
+    state = init_state(jax.random.PRNGKey(0), model, opt, mesh)
+    wq_spec = str(state.params["layers"]["attn"]["wq"].sharding.spec)
+    assert "pipeline" in wq_spec
+    step = build_train_step(model, opt, mesh)
+    batch = place_batch(synthetic_batch(model, 8, 32), mesh, model)
+    state, metrics = step(state, batch)
+    state, metrics = step(state, batch)
+    assert jnp.isfinite(metrics["loss"]), metrics
+
+
+def test_pipeline_config_validation():
+    cfg = transformer.config("lm-test-tiny", pipeline_stages=3)
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    mesh = build_mesh(MeshConfig(data=-1, pipeline=2))
+    tokens = jnp.zeros((4, 8), jnp.int32)
+    with pytest.raises(ValueError, match="not divisible"):
+        transformer.apply(params, tokens, cfg, mesh=mesh)
+    cfg2 = transformer.config("moe-test-tiny", pipeline_stages=2)
+    params2 = transformer.init(jax.random.PRNGKey(0), cfg2)
+    with pytest.raises(ValueError, match="composes"):
+        transformer.apply(params2, tokens, cfg2, mesh=mesh)
