@@ -101,9 +101,15 @@ def build_train_step(model: ModelSpec, opt_cfg: OptimizerConfig,
     batch_spec = model.batch_partition_spec(model.config)
 
     def sharded_step(state, batch):
+        # Truncate the spec to each leaf's rank: a rank-4 image spec must
+        # not be applied to the rank-1 labels riding the same batch.
+        def leaf_sharding(x):
+            spec = tuple(batch_spec)[: x.ndim]
+            spec += (None,) * (x.ndim - len(spec))
+            return NamedSharding(mesh, P(*spec))
+
         batch = jax.lax.with_sharding_constraint(
-            batch,
-            jax.tree.map(lambda _: NamedSharding(mesh, batch_spec), batch),
+            batch, jax.tree.map(leaf_sharding, batch),
         )
         return step_fn(state, batch)
 
