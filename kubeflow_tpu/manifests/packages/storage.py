@@ -1,0 +1,63 @@
+"""Storage package: shared-filesystem volumes for checkpoints/datasets.
+
+The analogue of the reference's storage prototypes — the Filestore PV
+(kubeflow/gcp/google-cloud-filestore-pv.libsonnet, prototype
+google-cloud-filestore-pv.jsonnet) and NFS-backed PVs its jupyter/pipeline
+stacks mount. TPU training leans on these harder than the reference did:
+orbax checkpoints and KTPU token corpora live on exactly these volumes.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.k8s import objects as k8s
+from kubeflow_tpu.manifests.core import ParamSpec, prototype
+from kubeflow_tpu.version import DEFAULT_NAMESPACE
+
+
+@prototype(
+    "nfs-volume",
+    "NFS-backed PersistentVolume + Claim (filestore/NFS PV analogue, "
+    "kubeflow/gcp/google-cloud-filestore-pv.libsonnet)",
+    params=[
+        ParamSpec("name", "kubeflow-shared"),
+        ParamSpec("namespace", DEFAULT_NAMESPACE),
+        ParamSpec("server", "REQUIRED", "NFS/Filestore server IP or host"),
+        ParamSpec("path", "/shared", "export path"),
+        ParamSpec("capacity", "1Ti"),
+    ],
+)
+def nfs_volume(name: str, namespace: str, server: str, path: str,
+               capacity: str) -> list[dict]:
+    labels = {"app": name}
+    pv = {
+        "apiVersion": "v1",
+        "kind": "PersistentVolume",
+        "metadata": {"name": name, "labels": labels},
+        "spec": {
+            "capacity": {"storage": capacity},
+            "accessModes": ["ReadWriteMany"],
+            "persistentVolumeReclaimPolicy": "Retain",
+            "nfs": {"server": server, "path": path},
+        },
+    }
+    claim = k8s.pvc(name, namespace, capacity,
+                    access_modes=("ReadWriteMany",), storage_class="")
+    claim["spec"]["volumeName"] = name
+    return [pv, claim]
+
+
+@prototype(
+    "checkpoint-pvc",
+    "Namespaced ReadWriteMany claim for orbax checkpoints / token corpora",
+    params=[
+        ParamSpec("name", "checkpoints"),
+        ParamSpec("namespace", DEFAULT_NAMESPACE),
+        ParamSpec("size", "500Gi"),
+        ParamSpec("storage_class", "", "empty = cluster default"),
+    ],
+)
+def checkpoint_pvc(name: str, namespace: str, size: str,
+                   storage_class: str) -> list[dict]:
+    return [k8s.pvc(name, namespace, size,
+                    access_modes=("ReadWriteMany",),
+                    storage_class=storage_class or None)]

@@ -90,6 +90,27 @@ class MeshConfig:
         return degrees
 
 
+def hybrid_shapes(degrees: dict[str, int], num_slices: int
+                  ) -> tuple[tuple[int, ...], tuple[int, ...]]:
+    """Split resolved axis degrees into (per-slice ICI shape, DCN shape).
+
+    Multislice deployments put the slice dimension on the `data` axis
+    (gradient allreduce tolerates DCN latency; tensor/sequence/expert
+    traffic must stay on ICI — SURVEY §2.2's multislice mandate). The data
+    degree must be a multiple of num_slices."""
+    if degrees[AXIS_DATA] % num_slices:
+        raise ValueError(
+            f"data degree {degrees[AXIS_DATA]} not divisible by "
+            f"num_slices {num_slices}; multislice scales the data axis"
+        )
+    ici = tuple(
+        degrees[a] // num_slices if a == AXIS_DATA else degrees[a]
+        for a in MESH_AXES
+    )
+    dcn = tuple(num_slices if a == AXIS_DATA else 1 for a in MESH_AXES)
+    return ici, dcn
+
+
 def build_mesh(
     config: MeshConfig | None = None,
     *,
@@ -97,9 +118,13 @@ def build_mesh(
 ) -> Mesh:
     """Build a Mesh with the canonical axis names.
 
-    On TPU, delegates device placement to ``mesh_utils.create_device_mesh`` so
-    axes map contiguously onto the physical torus; on CPU/virtual devices it
-    reshapes the flat device list (placement is meaningless there).
+    On TPU, delegates device placement to ``mesh_utils.create_device_mesh``
+    so axes map contiguously onto the physical torus; on a multislice
+    deployment (devices report distinct ``slice_index``es — the MEGASCALE
+    path the operator configures) the hybrid builder keeps ICI-hungry axes
+    within slices and spans slices on the data axis over DCN. On
+    CPU/virtual devices it reshapes the flat device list (placement is
+    meaningless there).
     """
     config = config or MeshConfig()
     devices = list(devices if devices is not None else jax.devices())
@@ -108,9 +133,16 @@ def build_mesh(
     if devices[0].platform == "tpu":
         from jax.experimental import mesh_utils
 
-        mesh_devices = mesh_utils.create_device_mesh(
-            shape, devices=np.asarray(devices)
-        )
+        slice_ids = {getattr(d, "slice_index", 0) for d in devices}
+        if len(slice_ids) > 1:
+            ici, dcn = hybrid_shapes(degrees, len(slice_ids))
+            mesh_devices = mesh_utils.create_hybrid_device_mesh(
+                ici, dcn, devices=np.asarray(devices)
+            )
+        else:
+            mesh_devices = mesh_utils.create_device_mesh(
+                shape, devices=np.asarray(devices)
+            )
     else:
         mesh_devices = np.asarray(devices).reshape(shape)
     return Mesh(mesh_devices, MESH_AXES)

@@ -171,3 +171,16 @@ def test_inference_server_prototype():
     ann = svc["metadata"]["annotations"]
     assert "kubeflow-tpu.org/gateway-route" in ann
     assert ann["prometheus.io/scrape"] == "true"
+
+
+def test_storage_prototypes():
+    from kubeflow_tpu.manifests.core import generate
+
+    objs = generate("nfs-volume", {"server": "10.0.0.5"})
+    pv = [o for o in objs if o["kind"] == "PersistentVolume"][0]
+    assert pv["spec"]["nfs"]["server"] == "10.0.0.5"
+    claim = [o for o in objs if o["kind"] == "PersistentVolumeClaim"][0]
+    assert claim["spec"]["volumeName"] == pv["metadata"]["name"]
+    assert generate("checkpoint-pvc", {})[0]["spec"]["accessModes"] == [
+        "ReadWriteMany"
+    ]

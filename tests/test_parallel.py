@@ -141,3 +141,21 @@ def test_ring_attention_under_jit_sharded_inputs():
     out = f(sharded_q)
     ref = reference_attention(q, q, q, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_hybrid_shapes_for_multislice():
+    """Multislice puts the slice dim on data over DCN; ICI axes stay whole
+    within each slice."""
+    from kubeflow_tpu.parallel.mesh import MESH_AXES, hybrid_shapes
+
+    degrees = {"data": 4, "pipeline": 1, "fsdp": 2, "expert": 1,
+               "sequence": 1, "tensor": 2}
+    ici, dcn = hybrid_shapes(degrees, num_slices=2)
+    assert dict(zip(MESH_AXES, ici))["data"] == 2
+    assert dict(zip(MESH_AXES, ici))["tensor"] == 2
+    assert dict(zip(MESH_AXES, dcn)) == {
+        "data": 2, "pipeline": 1, "fsdp": 1, "expert": 1, "sequence": 1,
+        "tensor": 1,
+    }
+    with pytest.raises(ValueError, match="num_slices"):
+        hybrid_shapes({**degrees, "data": 3}, num_slices=2)
