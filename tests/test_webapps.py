@@ -1,0 +1,99 @@
+"""Dashboard + study web-app HTTP tests against the fake apiserver (the
+centraldashboard server.ts / katib-UI surfaces driven over real sockets)."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.apis import jobs as jobs_api
+from kubeflow_tpu.apis.notebooks import notebook_crd
+from kubeflow_tpu.apis.tuning import TUNING_API_VERSION, study_job_crd
+from kubeflow_tpu.dashboard import Dashboard, make_server as make_dash
+from kubeflow_tpu.webapps.study import StudyApp, make_server as make_study
+
+
+def get(base, path):
+    with urllib.request.urlopen(base + path) as r:
+        body = r.read()
+        ctype = r.headers.get("Content-Type", "")
+        return r.status, (json.loads(body) if "json" in ctype
+                          else body.decode())
+
+
+def post(base, path, payload):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req) as r:
+        return r.status, json.loads(r.read())
+
+
+@pytest.fixture()
+def cluster(api):
+    for crd in (*jobs_api.all_job_crds(), notebook_crd(), study_job_crd()):
+        api.apply(crd)
+    api.create({
+        "apiVersion": jobs_api.JOBS_API_VERSION, "kind": "JaxJob",
+        "metadata": {"name": "train1", "namespace": "kubeflow"},
+        "spec": {"replicaSpecs": {}},
+        "status": {"state": "Running"},
+    })
+    return api
+
+
+def test_dashboard_overview_and_html(cluster):
+    httpd = make_dash(Dashboard(cluster), 0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        code, ov = get(base, "/api/overview")
+        assert code == 200
+        assert [j["name"] for j in ov["jobs"]] == ["train1"]
+        assert ov["jobs"][0]["state"] == "Running"
+
+        code, page = get(base, "/")
+        assert code == 200
+        assert "train1" in page and "<h1>kubeflow-tpu</h1>" in page
+        assert get(base, "/healthz")[0] == 200
+    finally:
+        httpd.shutdown()
+
+
+def test_study_webapp_crud(cluster):
+    httpd = make_study(StudyApp(cluster), 0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        code, out = post(base, "/api/namespaces/kubeflow/studies", {
+            "name": "sweep1",
+            "objective": {"objectiveMetricName": "loss", "type": "minimize"},
+            "parameters": [
+                {"name": "lr", "type": "double", "min": 1e-4, "max": 1e-1},
+            ],
+            "maxTrials": 4,
+            "trialTemplate": {
+                "apiVersion": jobs_api.JOBS_API_VERSION,
+                "kind": "JaxJob",
+                "spec": {"replicaSpecs": {}},
+            },
+        })
+        assert code in (200, 201), out
+        live = cluster.get(TUNING_API_VERSION, "StudyJob", "sweep1",
+                           "kubeflow")
+        assert live["spec"]["objective"]["objectiveMetricName"] == "loss"
+
+        code, listing = get(base, "/api/namespaces/kubeflow/studies")
+        assert [s["name"] for s in listing["studies"]] == ["sweep1"]
+
+        req = urllib.request.Request(
+            f"{base}/api/namespaces/kubeflow/studies/sweep1",
+            method="DELETE")
+        with urllib.request.urlopen(req) as r:
+            assert r.status == 200
+        assert cluster.get_or_none(TUNING_API_VERSION, "StudyJob", "sweep1",
+                                   "kubeflow") is None
+    finally:
+        httpd.shutdown()
