@@ -98,14 +98,16 @@ PRESETS: dict[str, TransformerConfig] = {
         vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
         d_ff=128, max_seq_len=128, remat=False,
     ),
-    # Single-chip flagship bench config: llama3-8b's layer geometry (d=4096,
-    # GQA 32/8, ff=14336) at 4 layers / 32k vocab — 1.13B params, the widest
-    # matmuls that fit 16GB HBM with adafactor. MXU efficiency rises with
-    # contraction width (measured v5e: 72 TF/s at K=2048 vs 107 at K=4096),
-    # so this config clears 50% MFU where d=2048 models plateau at ~42%.
+    # Single-chip flagship bench config: llama-style blocks at d=4096 with
+    # a 5×d FFN and llama-3.2-style GQA (32 query / 4 kv heads), 3 layers /
+    # 32k vocab — 1.13B params, the widest matmuls that fit 16GB HBM with
+    # adafactor. MXU efficiency rises with contraction width (measured
+    # v5e: 72 TF/s at K=2048, 107 at K=4096, 162 at K=8192), so the shape
+    # ladder measured: L4/ff14336/kv8 53.4% MFU → L3/ff20480 57.9% →
+    # +kv4 60.1% (d=2048 models plateau at ~42%).
     "flagship-1b": TransformerConfig(
-        vocab_size=32_000, d_model=4096, n_layers=4, n_heads=32,
-        n_kv_heads=8, d_ff=14_336, max_seq_len=2048,
+        vocab_size=32_000, d_model=4096, n_layers=3, n_heads=32,
+        n_kv_heads=4, d_ff=20_480, max_seq_len=2048,
     ),
     # Mixtral-family shape at reduced depth (8 experts, top-2).
     "moe-1b": TransformerConfig(
