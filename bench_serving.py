@@ -31,6 +31,10 @@ def main() -> int:
     ap.add_argument("--requests", type=int, default=200)
     ap.add_argument("--seq-len", type=int, default=128)
     ap.add_argument("--concurrency", type=int, default=16)
+    ap.add_argument("--generate", action="store_true",
+                    help="benchmark KV-cache generation (LM) instead of "
+                         "single-forward predict")
+    ap.add_argument("--max-new-tokens", type=int, default=64)
     args = ap.parse_args()
 
     import grpc
@@ -40,18 +44,27 @@ def main() -> int:
     from kubeflow_tpu.serving.server import ModelServer
 
     on_tpu = jax.default_backend() == "tpu"
-    model = "bert-base" if on_tpu and not args.quick else "bert-test-tiny"
+    if args.generate:
+        model = "llama-1b" if on_tpu and not args.quick else "lm-test-tiny"
+    else:
+        model = "bert-base" if on_tpu and not args.quick else "bert-test-tiny"
 
     server = ModelServer(
-        EngineConfig(model=model, batch_size=8, max_seq_len=args.seq_len),
+        EngineConfig(model=model, batch_size=8, max_seq_len=args.seq_len,
+                     max_new_tokens=args.max_new_tokens),
         port=0, grpc_port=0, batch_timeout_ms=2.0,
     )
     server.start()
     tokens = list(range(2, 2 + args.seq_len - 2))
     instance = {"tokens": tokens}
+    if args.generate:
+        instance = {"tokens": tokens, "max_new_tokens": args.max_new_tokens}
 
+    channel_opts = [("grpc.max_send_message_length", 64 << 20),
+                    ("grpc.max_receive_message_length", 64 << 20)]
     try:
-        with grpc.insecure_channel(f"127.0.0.1:{server.grpc_port}") as chan:
+        with grpc.insecure_channel(f"127.0.0.1:{server.grpc_port}",
+                                   options=channel_opts) as chan:
             predict, _ = client_stubs(chan)
 
             # Warmup (compile both the singleton and the full batch shape).
@@ -80,8 +93,9 @@ def main() -> int:
     finally:
         server.stop()
 
-    print(json.dumps({
-        "metric": "serving_predict_p50_ms",
+    result = {
+        "metric": ("serving_generate_p50_ms" if args.generate
+                   else "serving_predict_p50_ms"),
         "value": round(percentile(lat, 50), 2),
         "unit": "ms",
         "vs_baseline": 1.0,  # reference publishes no latency numbers
@@ -91,7 +105,13 @@ def main() -> int:
         "throughput_rps": round(args.requests / wall, 1),
         "config": f"{model} seq{args.seq_len} batch8 grpc "
                   f"c{args.concurrency}",
-    }))
+    }
+    if args.generate:
+        result["decode_tokens_per_sec"] = round(
+            args.max_new_tokens * args.requests / wall, 1
+        )
+        result["config"] += f" gen{args.max_new_tokens}"
+    print(json.dumps(result))
     return 0
 
 

@@ -190,12 +190,16 @@ class InferenceEngine:
         for i, inst in enumerate(instances):
             want = min(int(inst.get("max_new_tokens", 0)),
                        self.cfg.max_new_tokens)
-            out.append({
-                "logits": last[i].tolist(),
+            pred = {
                 "next_token": int(toks[i, 0]) if want else
                 int(np.argmax(last[i])),
                 "tokens": toks[i, :want].tolist(),
-            })
+            }
+            # Full-vocab logits are huge as JSON (32k floats/row); include
+            # them only for plain predicts or on explicit request.
+            if not want or inst.get("return_logits"):
+                pred["logits"] = last[i].tolist()
+            out.append(pred)
         return out
 
     def predict_batch(self, instances: list[dict]) -> list[dict]:

@@ -38,12 +38,19 @@ class GrpcPredictionService:
     over gRPC. Shares the server's batcher, so REST and gRPC requests
     coalesce into the same TPU batches."""
 
+    # Big batches of full-vocab logits overflow grpc's 4MB default.
+    MAX_MESSAGE_BYTES = 64 * 1024 * 1024
+
     def __init__(self, model_server, *, port: int = DEFAULT_GRPC_PORT,
                  max_workers: int = 16):
         self.model_server = model_server
         self.port = port
         self._grpc_server = grpc.server(
-            futures.ThreadPoolExecutor(max_workers=max_workers)
+            futures.ThreadPoolExecutor(max_workers=max_workers),
+            options=[
+                ("grpc.max_send_message_length", self.MAX_MESSAGE_BYTES),
+                ("grpc.max_receive_message_length", self.MAX_MESSAGE_BYTES),
+            ],
         )
         self._grpc_server.add_generic_rpc_handlers(
             (_Handler(self.model_server),)

@@ -80,7 +80,7 @@ def test_engine_generate_instances():
     eng = InferenceEngine(EngineConfig(model="lm-test-tiny", batch_size=4,
                                        max_seq_len=32, max_new_tokens=8))
     out = eng.predict_batch([
-        {"tokens": [1, 2, 3], "max_new_tokens": 5},
+        {"tokens": [1, 2, 3], "max_new_tokens": 5, "return_logits": True},
         {"tokens": [7, 8], "max_new_tokens": 2, "temperature": 0.7},
         {"tokens": [4, 4, 4]},  # plain predict rides the same batch
     ])
@@ -88,6 +88,9 @@ def test_engine_generate_instances():
     assert len(out[1]["tokens"]) == 2
     assert out[2]["tokens"] == []
     assert isinstance(out[2]["next_token"], int)
+    # Full-vocab logits only on request (JSON size) or for plain predicts.
+    assert "logits" not in out[1]
+    assert "logits" in out[2]
     # Greedy generation is the argmax continuation.
     assert out[0]["next_token"] == int(np.argmax(out[0]["logits"]))
     # Over-limit request rejected at validation.
