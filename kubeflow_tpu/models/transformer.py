@@ -78,6 +78,11 @@ class TransformerConfig:
     # (recompute only elementwise), "none" saves nothing (full recompute,
     # minimum HBM traffic), "dots_batched" additionally saves batched dots.
     remat_policy: str = "dots"
+    # Iterate layers with lax.scan (O(1) compile in depth) or a Python
+    # loop. Scan stacks every saved activation through dynamic-update-
+    # slices — measured ~27% of step time at 3 layers — so shallow models
+    # should unroll; deep ones need scan for compile time.
+    scan_layers: bool = True
 
     @property
     def head_dim(self) -> int:
@@ -102,12 +107,15 @@ PRESETS: dict[str, TransformerConfig] = {
     # a 5×d FFN and llama-3.2-style GQA (32 query / 4 kv heads), 3 layers /
     # 32k vocab — 1.13B params, the widest matmuls that fit 16GB HBM with
     # adafactor. MXU efficiency rises with contraction width (measured
-    # v5e: 72 TF/s at K=2048, 107 at K=4096, 162 at K=8192), so the shape
-    # ladder measured: L4/ff14336/kv8 53.4% MFU → L3/ff20480 57.9% →
-    # +kv4 60.1% (d=2048 models plateau at ~42%).
+    # v5e: 72 TF/s at K=2048, 107 at K=4096, 162 at K=8192), and at 3
+    # layers the activations fit without remat while the unrolled layer
+    # loop avoids the scan's saved-activation stacking (~27% of step
+    # time). Ladder measured: L4/ff14336/kv8 scan+remat 53.4% MFU →
+    # L3/ff20480/kv4 60.4% → unrolled no-remat 69.9%.
     "flagship-1b": TransformerConfig(
         vocab_size=32_000, d_model=4096, n_layers=3, n_heads=32,
-        n_kv_heads=4, d_ff=20_480, max_seq_len=2048,
+        n_kv_heads=4, d_ff=20_480, max_seq_len=2048, remat=False,
+        scan_layers=False,
     ),
     # Mixtral-family shape at reduced depth (8 experts, top-2).
     "moe-1b": TransformerConfig(
@@ -430,9 +438,14 @@ def apply(params, tokens, cfg: TransformerConfig, *, mesh=None,
         layer_fn = functools.partial(_layer_fn, cfg, mesh, rope)
         if cfg.remat:
             layer_fn = jax.checkpoint(layer_fn, policy=policy)
-        (x, aux), _ = lax.scan(
-            layer_fn, (x, jnp.zeros((), jnp.float32)), params["layers"]
-        )
+        carry = (x, jnp.zeros((), jnp.float32))
+        if cfg.scan_layers:
+            carry, _ = lax.scan(layer_fn, carry, params["layers"])
+        else:
+            for i in range(cfg.n_layers):
+                layer = jax.tree.map(lambda w: w[i], params["layers"])
+                carry, _ = layer_fn(carry, layer)
+        x, aux = carry
 
     x = rms_norm(x, params["final_norm"], eps=cfg.norm_eps)
     if cfg.tie_embeddings:
