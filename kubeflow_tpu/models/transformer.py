@@ -4,8 +4,10 @@ Llama-3-family architecture — RMSNorm pre-norm, rotary positions, grouped-
 query flash attention, SwiGLU MLP — written TPU-first:
 
 - Layers are *stacked* (one leading L dim per weight) and iterated with
-  ``lax.scan``: compile time stays O(1) in depth and FSDP shards every layer
-  identically.
+  ``lax.scan`` (compile time O(1) in depth, FSDP shards every layer
+  identically) or, for shallow models, an unrolled Python loop
+  (``cfg.scan_layers=False`` — avoids the scan's saved-activation
+  stacking, measured ~27% of step time at 3 layers).
 - All matmuls run in bfloat16 against float32 master weights held by the
   optimizer; contractions request float32 accumulation on the MXU.
 - Sharding is declared as path rules (DP×FSDP×TP out of the box); activations

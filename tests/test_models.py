@@ -170,3 +170,30 @@ def test_resnet_train_vs_eval_modes_differ():
     assert stats  # collector populated for every BN layer
     assert not np.allclose(np.asarray(eval_logits),
                            np.asarray(train_logits))
+
+
+def test_unrolled_layer_loop_matches_scan():
+    """scan_layers=False (the flagship bench path) must produce the same
+    logits and loss as the lax.scan representation."""
+    import jax
+    import numpy as np
+
+    from kubeflow_tpu.models import transformer
+
+    cfg_scan = transformer.config("lm-test-tiny")
+    cfg_unroll = transformer.config("lm-test-tiny", scan_layers=False)
+    params = transformer.init(jax.random.PRNGKey(0), cfg_scan)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 256)
+
+    a = transformer.apply(params, tokens, cfg_scan)
+    b = transformer.apply(params, tokens, cfg_unroll)
+    # bf16 activations: scan and unrolled fuse/accumulate in different
+    # orders, so equality holds only to bf16 rounding scale.
+    np.testing.assert_allclose(np.asarray(a, np.float32),
+                               np.asarray(b, np.float32),
+                               rtol=5e-2, atol=5e-2)
+    batch = {"tokens": jax.random.randint(jax.random.PRNGKey(2), (4, 17),
+                                          0, 256)}
+    la, _ = transformer.loss_fn(params, batch, cfg_scan)
+    lb, _ = transformer.loss_fn(params, batch, cfg_unroll)
+    np.testing.assert_allclose(float(la), float(lb), rtol=1e-2)
