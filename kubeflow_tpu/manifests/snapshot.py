@@ -36,6 +36,14 @@ SNAPSHOT_CASES: dict[str, tuple[str, dict]] = {
     "pipeline-operator": ("pipeline-operator", {}),
     "tensorboard": ("tensorboard", {"log_dir": "gs://bucket/logs"}),
     "application": ("application", {}),
+    "bootstrapper": ("bootstrapper", {}),
+    "jupyter-web-app": ("jupyter-web-app", {}),
+    "slice-healthcheck": ("slice-healthcheck", {"name": "preflight"}),
+    "inference-server": (
+        "inference-server",
+        {"name": "external", "image": "example/infer:1", "port": 8080},
+    ),
+    "nfs-volume": ("nfs-volume", {"server": "10.0.0.2"}),
 }
 
 

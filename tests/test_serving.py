@@ -187,3 +187,31 @@ def test_grpc_predict_matches_rest():
             assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
     finally:
         server.stop()
+
+
+def test_rest_generation_request():
+    """REST predict with max_new_tokens exercises the KV-cache decode path
+    through the full server stack; logits are omitted unless asked."""
+    server = ModelServer(
+        EngineConfig(model="lm-test-tiny", batch_size=4, max_seq_len=32,
+                     max_new_tokens=8),
+        port=0, batch_timeout_ms=2,
+    )
+    server.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}"
+            "/v1/models/lm-test-tiny:predict",
+            data=json.dumps({"instances": [
+                {"tokens": [1, 2, 3], "max_new_tokens": 6},
+            ]}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req) as r:
+            out = json.loads(r.read())
+        pred = out["predictions"][0]
+        assert len(pred["tokens"]) == 6
+        assert pred["tokens"][0] == pred["next_token"]
+        assert "logits" not in pred
+    finally:
+        server.stop()
