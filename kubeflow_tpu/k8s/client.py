@@ -71,6 +71,7 @@ _BUILTIN_KINDS: dict[str, tuple[str, bool]] = {
     "Job": ("jobs", True),
     "CronJob": ("cronjobs", True),
     "Event": ("events", True),
+    "Lease": ("leases", True),
     "Role": ("roles", True),
     "RoleBinding": ("rolebindings", True),
     "ClusterRole": ("clusterroles", False),

@@ -73,6 +73,9 @@ def training_operator(
         ),
         k8s.policy_rule([""], ["pods", "services", "events", "configmaps"], ["*"]),
         k8s.policy_rule(["apps"], ["deployments", "statefulsets"], ["get", "list", "watch"]),
+        # Leader election holds a Lease when running replicated.
+        k8s.policy_rule(["coordination.k8s.io"], ["leases"],
+                        ["get", "list", "watch", "create", "update"]),
     ]
     if cluster_scoped:
         objs.append(k8s.cluster_role(name, rules, labels))
@@ -97,7 +100,10 @@ def training_operator(
                     name,
                     image,
                     command=["python", "-m", "kubeflow_tpu.operators"],
-                    args=["--alsologtostderr", "-v=1"],
+                    args=["--alsologtostderr", "-v=1"]
+                    + (["--leader-elect",
+                        "--leader-elect-name", name]
+                       if replicas > 1 else []),
                     env={"OPERATOR_CONFIG": "/etc/config/config.yaml"},
                     ports={"metrics": 8443},
                     volume_mounts=[k8s.volume_mount("config", "/etc/config", read_only=True)],

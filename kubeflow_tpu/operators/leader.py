@@ -1,0 +1,149 @@
+"""Lease-based leader election for replicated controller managers.
+
+The controller-runtime capability the training-operator Deployment's
+``replicas`` param promises ("leader-elected"): N manager pods run, one
+holds a ``coordination.k8s.io/v1`` Lease and reconciles; the rest stand by
+and take over when renewal lapses. Same semantics as client-go's
+leaderelection package (acquire if unheld or expired, renew at
+``renew_seconds`` intervals, lease valid ``lease_seconds``), built on the
+platform's own client so it runs against the fake apiserver in tests.
+"""
+
+from __future__ import annotations
+
+import datetime
+import logging
+import threading
+import uuid
+
+from kubeflow_tpu.k8s.client import ApiError, K8sClient
+
+log = logging.getLogger(__name__)
+
+LEASE_API_VERSION = "coordination.k8s.io/v1"
+
+
+def _now() -> datetime.datetime:
+    return datetime.datetime.now(datetime.timezone.utc)
+
+
+def _parse(ts: str) -> datetime.datetime:
+    return datetime.datetime.fromisoformat(ts.replace("Z", "+00:00"))
+
+
+class LeaderElector:
+    def __init__(self, client: K8sClient, *, name: str,
+                 namespace: str = "kubeflow",
+                 identity: str | None = None,
+                 lease_seconds: float = 15.0,
+                 renew_seconds: float = 5.0):
+        self.client = client
+        self.name = name
+        self.namespace = namespace
+        self.identity = identity or f"{name}-{uuid.uuid4().hex[:8]}"
+        self.lease_seconds = lease_seconds
+        self.renew_seconds = renew_seconds
+        self._stop = threading.Event()
+        self._is_leader = threading.Event()
+
+    # ------------------------------------------------------------------
+
+    def _lease_body(self) -> dict:
+        return {
+            "apiVersion": LEASE_API_VERSION,
+            "kind": "Lease",
+            "metadata": {"name": self.name, "namespace": self.namespace},
+            "spec": {
+                "holderIdentity": self.identity,
+                "leaseDurationSeconds": int(self.lease_seconds),
+                "renewTime": _now().isoformat(),
+            },
+        }
+
+    def try_acquire(self) -> bool:
+        """One acquire-or-renew attempt. Returns current leadership."""
+        try:
+            lease = self.client.get_or_none(
+                LEASE_API_VERSION, "Lease", self.name, self.namespace
+            )
+            if lease is None:
+                self.client.create(self._lease_body())
+                log.info("%s: acquired new lease as %s", self.name,
+                         self.identity)
+                self._is_leader.set()
+                return True
+            spec = lease.get("spec", {})
+            holder = spec.get("holderIdentity")
+            renew = spec.get("renewTime")
+            expired = True
+            if renew:
+                age = (_now() - _parse(renew)).total_seconds()
+                expired = age > spec.get("leaseDurationSeconds",
+                                         self.lease_seconds)
+            if holder == self.identity or expired:
+                lease["spec"] = self._lease_body()["spec"]
+                self.client.update(lease)  # CAS via resourceVersion
+                if not self._is_leader.is_set():
+                    log.info("%s: %s lease as %s", self.name,
+                             "took over expired" if holder != self.identity
+                             else "renewed", self.identity)
+                self._is_leader.set()
+                return True
+            self._is_leader.clear()
+            return False
+        except ApiError as e:
+            # 409 = lost the update race to another candidate.
+            if e.code != 409:
+                log.warning("%s: lease attempt failed: %s", self.name, e)
+            self._is_leader.clear()
+            return False
+
+    @property
+    def is_leader(self) -> bool:
+        return self._is_leader.is_set()
+
+    def wait_for_leadership(self, timeout: float | None = None) -> bool:
+        """Block (acquiring in a loop) until this candidate leads."""
+        import time
+
+        end = time.monotonic() + timeout if timeout else None
+        while not self._stop.is_set():
+            if self.try_acquire():
+                return True
+            if end and time.monotonic() > end:
+                return False
+            self._stop.wait(self.renew_seconds)
+        return False
+
+    def run(self) -> None:
+        """Acquire-then-renew loop (daemon thread); leadership state is
+        exposed via :attr:`is_leader`."""
+        while not self._stop.is_set():
+            self.try_acquire()
+            self._stop.wait(self.renew_seconds)
+
+    def start(self) -> threading.Thread:
+        t = threading.Thread(target=self.run, name=f"lease-{self.name}",
+                             daemon=True)
+        t.start()
+        return t
+
+    def release(self) -> None:
+        """Drop the lease on clean shutdown so a standby takes over fast."""
+        self._stop.set()
+        if not self._is_leader.is_set():
+            return
+        try:
+            lease = self.client.get_or_none(
+                LEASE_API_VERSION, "Lease", self.name, self.namespace
+            )
+            if lease and lease.get("spec", {}).get(
+                "holderIdentity"
+            ) == self.identity:
+                lease["spec"]["renewTime"] = (
+                    _now() - datetime.timedelta(days=1)
+                ).isoformat()
+                self.client.update(lease)
+        except ApiError:
+            pass
+        self._is_leader.clear()

@@ -163,6 +163,11 @@ def controller_main(
                    help="single reconcile pass over all objects, then exit")
     p.add_argument("--metrics-port", type=int, default=default_metrics_port,
                    help="health/metrics port (0 = disabled)")
+    p.add_argument("--leader-elect", action="store_true",
+                   help="hold a coordination.k8s.io Lease before "
+                        "reconciling (replicated manager Deployments)")
+    p.add_argument("--leader-elect-name", default="",
+                   help="lease name (default: derived from the manager)")
     args = p.parse_args(argv)
 
     logging.basicConfig(
@@ -185,6 +190,18 @@ def controller_main(
         counts = {"kubeflow_tpu_controllers_running": len(controllers)}
         health = HealthServer(args.metrics_port, lambda: counts)
         health.start()
+    elector = None
+    if args.leader_elect:
+        from kubeflow_tpu.operators.leader import LeaderElector
+
+        lease_name = (args.leader_elect_name
+                      or f"kubeflow-tpu-{description.split()[0]}")
+        elector = LeaderElector(client, name=lease_name,
+                                namespace=args.namespace)
+        log.info("waiting for leadership on lease %s as %s",
+                 lease_name, elector.identity)
+        elector.wait_for_leadership()
+        elector.start()  # keep renewing in the background
     threads = run_controllers(controllers)
     log.info("running %d controllers: %s", len(controllers),
              ", ".join(c.kind for c in controllers))
@@ -195,6 +212,8 @@ def controller_main(
         for c in controllers:
             c.stop()
     finally:
+        if elector:
+            elector.release()
         if health:
             health.stop()
     return 0

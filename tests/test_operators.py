@@ -528,3 +528,35 @@ def test_mpi_sidecar_follows_launcher_phase(api):
     assert wait_for_launcher(api, "job", "kubeflow", poll_seconds=0,
                              grace_polls=1, log=lambda *a: None,
                              sleep=lambda s: None) == 1
+
+
+def test_leader_election_single_holder_and_failover(api):
+    """Lease semantics: one holder at a time; standby takes over when the
+    lease expires or is released (client-go leaderelection analogue)."""
+    import datetime
+
+    from kubeflow_tpu.operators.leader import (
+        LEASE_API_VERSION,
+        LeaderElector,
+    )
+
+    a = LeaderElector(api, name="op", identity="a", lease_seconds=10)
+    b = LeaderElector(api, name="op", identity="b", lease_seconds=10)
+    assert a.try_acquire() is True
+    assert b.try_acquire() is False
+    assert a.is_leader and not b.is_leader
+    # Renewal keeps leadership.
+    assert a.try_acquire() is True
+
+    # Expire the lease: standby takes over.
+    lease = api.get(LEASE_API_VERSION, "Lease", "op", "kubeflow")
+    stale = (datetime.datetime.now(datetime.timezone.utc)
+             - datetime.timedelta(seconds=60)).isoformat()
+    lease["spec"]["renewTime"] = stale
+    api.update(lease)
+    assert b.try_acquire() is True
+    assert a.try_acquire() is False  # a lost it
+
+    # Clean release: a can immediately re-acquire.
+    b.release()
+    assert a.try_acquire() is True
