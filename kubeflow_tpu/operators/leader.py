@@ -56,7 +56,10 @@ class LeaderElector:
             "spec": {
                 "holderIdentity": self.identity,
                 "leaseDurationSeconds": int(self.lease_seconds),
-                "renewTime": _now().isoformat(),
+                # metav1.MicroTime requires fractional seconds; isoformat()
+                # drops them when microsecond == 0 (client-go uses
+                # RFC3339Micro for exactly this reason).
+                "renewTime": _now().strftime("%Y-%m-%dT%H:%M:%S.%fZ"),
             },
         }
 
@@ -103,10 +106,11 @@ class LeaderElector:
         return self._is_leader.is_set()
 
     def wait_for_leadership(self, timeout: float | None = None) -> bool:
-        """Block (acquiring in a loop) until this candidate leads."""
+        """Block (acquiring in a loop) until this candidate leads.
+        ``timeout=0`` makes a single non-blocking attempt."""
         import time
 
-        end = time.monotonic() + timeout if timeout else None
+        end = time.monotonic() + timeout if timeout is not None else None
         while not self._stop.is_set():
             if self.try_acquire():
                 return True
@@ -123,27 +127,39 @@ class LeaderElector:
             self._stop.wait(self.renew_seconds)
 
     def start(self) -> threading.Thread:
-        t = threading.Thread(target=self.run, name=f"lease-{self.name}",
-                             daemon=True)
-        t.start()
-        return t
+        self._thread = threading.Thread(
+            target=self.run, name=f"lease-{self.name}", daemon=True
+        )
+        self._thread.start()
+        return self._thread
 
     def release(self) -> None:
-        """Drop the lease on clean shutdown so a standby takes over fast."""
+        """Drop the lease on clean shutdown so a standby takes over fast.
+        Stops and joins the renew thread FIRST: an in-flight renewal after
+        the backdate would make the lease look freshly held by a dead
+        process, and a renewal just before it would 409 the backdate."""
         self._stop.set()
+        thread = getattr(self, "_thread", None)
+        if thread is not None:
+            thread.join(timeout=2 * self.renew_seconds)
         if not self._is_leader.is_set():
             return
-        try:
-            lease = self.client.get_or_none(
-                LEASE_API_VERSION, "Lease", self.name, self.namespace
-            )
-            if lease and lease.get("spec", {}).get(
-                "holderIdentity"
-            ) == self.identity:
-                lease["spec"]["renewTime"] = (
-                    _now() - datetime.timedelta(days=1)
-                ).isoformat()
+        backdated = (_now() - datetime.timedelta(days=1)).strftime(
+            "%Y-%m-%dT%H:%M:%S.%fZ"
+        )
+        for _attempt in range(3):  # retry lost-update races
+            try:
+                lease = self.client.get_or_none(
+                    LEASE_API_VERSION, "Lease", self.name, self.namespace
+                )
+                if not lease or lease.get("spec", {}).get(
+                    "holderIdentity"
+                ) != self.identity:
+                    break
+                lease["spec"]["renewTime"] = backdated
                 self.client.update(lease)
-        except ApiError:
-            pass
+                break
+            except ApiError as e:
+                if e.code != 409:
+                    break
         self._is_leader.clear()

@@ -191,29 +191,42 @@ def controller_main(
         health = HealthServer(args.metrics_port, lambda: counts)
         health.start()
     elector = None
-    if args.leader_elect:
-        from kubeflow_tpu.operators.leader import LeaderElector
-
-        lease_name = (args.leader_elect_name
-                      or f"kubeflow-tpu-{description.split()[0]}")
-        elector = LeaderElector(client, name=lease_name,
-                                namespace=args.namespace)
-        log.info("waiting for leadership on lease %s as %s",
-                 lease_name, elector.identity)
-        elector.wait_for_leadership()
-        elector.start()  # keep renewing in the background
-    threads = run_controllers(controllers)
-    log.info("running %d controllers: %s", len(controllers),
-             ", ".join(c.kind for c in controllers))
+    lost_leadership = False
     try:
-        for t in threads:
-            t.join()
+        if args.leader_elect:
+            from kubeflow_tpu.operators.leader import LeaderElector
+
+            # Default lease name must identify THIS manager, not the
+            # shared "kubeflow-tpu" prefix — different managers electing
+            # on one lease would block each other forever.
+            lease_name = (args.leader_elect_name
+                          or "-".join(description.split()[:2]))
+            elector = LeaderElector(client, name=lease_name,
+                                    namespace=args.namespace)
+            log.info("waiting for leadership on lease %s as %s",
+                     lease_name, elector.identity)
+            elector.wait_for_leadership()
+            elector.start()  # keep renewing in the background
+        threads = run_controllers(controllers)
+        log.info("running %d controllers: %s", len(controllers),
+                 ", ".join(c.kind for c in controllers))
+        while any(t.is_alive() for t in threads):
+            # Leadership loss is fatal (client-go OnStoppedLeading
+            # semantics): a deposed leader must not keep reconciling
+            # alongside the new one.
+            if elector is not None and not elector.is_leader:
+                log.error("lost leadership on lease; shutting down")
+                lost_leadership = True
+                break
+            for t in threads:
+                t.join(timeout=1.0)
     except KeyboardInterrupt:
+        pass
+    finally:
         for c in controllers:
             c.stop()
-    finally:
         if elector:
             elector.release()
         if health:
             health.stop()
-    return 0
+    return 1 if lost_leadership else 0

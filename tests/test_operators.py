@@ -560,3 +560,46 @@ def test_leader_election_single_holder_and_failover(api):
     # Clean release: a can immediately re-acquire.
     b.release()
     assert a.try_acquire() is True
+
+
+@pytest.mark.slow
+def test_leader_elected_manager_exits_on_leadership_loss(api):
+    """Split-brain guard end to end: a real manager process acquires the
+    Lease over HTTP, then exits nonzero when another identity steals it
+    (client-go OnStoppedLeading-is-fatal semantics)."""
+    import subprocess
+    import sys
+    import time
+
+    from kubeflow_tpu.apis.profiles import profile_crd
+    from kubeflow_tpu.k8s.httpfake import serve
+
+    api.apply(profile_crd())
+    httpd, port = serve(api)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               KUBEFLOW_TPU_APISERVER=f"http://127.0.0.1:{port}")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubeflow_tpu.operators.profile",
+         "--leader-elect", "--leader-elect-name", "smoke-lease",
+         "--metrics-port", "0"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    try:
+        lease = None
+        for _ in range(150):
+            lease = api.get_or_none("coordination.k8s.io/v1", "Lease",
+                                    "smoke-lease", "kubeflow")
+            if lease:
+                break
+            time.sleep(0.2)
+        assert lease, "manager never acquired the lease"
+        lease["spec"]["holderIdentity"] = "other"
+        lease["spec"]["renewTime"] = "2099-01-01T00:00:00.000000Z"
+        api.update(lease)
+        assert proc.wait(timeout=60) == 1
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        httpd.shutdown()
