@@ -123,12 +123,19 @@ class Gateway:
         admin_port: int = 8877,
         auth_url: str = "",
         resolve: Callable[[str], str] | None = None,
+        certfile: str = "",
+        keyfile: str = "",
     ):
         self.table = table
         self.port = port
         self.admin_port = admin_port
         self.auth_url = auth_url
         self.resolve = resolve or (lambda addr: addr)
+        # TLS termination at the gateway (the iap-ingress/cert-manager
+        # role, kubeflow/gcp/iap.libsonnet): cert+key mounted from a
+        # Secret; empty = plain HTTP (in-mesh or behind an LB).
+        self.certfile = certfile
+        self.keyfile = keyfile
         self.requests_total = 0
         self.errors_total = 0
         self._proxy: ThreadingHTTPServer | None = None
@@ -266,6 +273,14 @@ class Gateway:
         self._proxy = ThreadingHTTPServer(
             ("0.0.0.0", self.port), self._make_proxy_handler()
         )
+        if self.certfile:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(self.certfile, self.keyfile or None)
+            self._proxy.socket = ctx.wrap_socket(
+                self._proxy.socket, server_side=True
+            )
         threading.Thread(target=self._proxy.serve_forever,
                          daemon=True).start()
         if self.admin_port:

@@ -25,13 +25,18 @@ def main(argv=None) -> int:
                    help="forward-auth check endpoint (gatekeeper /auth); "
                         "empty = no auth")
     p.add_argument("--refresh-seconds", type=float, default=15.0)
+    p.add_argument("--tls-cert", default="",
+                   help="PEM cert chain for TLS termination (the "
+                        "iap/cert-manager ingress role); empty = HTTP")
+    p.add_argument("--tls-key", default="", help="PEM private key")
     args = p.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
     client = client_from_args(args)
     table = RouteTable()
     gw = Gateway(table, port=args.port, admin_port=args.admin_port,
-                 auth_url=args.auth_url)
+                 auth_url=args.auth_url, certfile=args.tls_cert,
+                 keyfile=args.tls_key)
     gw.start()
     log.info("gateway on :%d (admin :%d)", args.port, args.admin_port)
     try:

@@ -26,11 +26,21 @@ from kubeflow_tpu.version import DEFAULT_NAMESPACE
         ParamSpec("image", images.PLATFORM),
         ParamSpec("replicas", 3, "gateway replicas (ambassador default 3)"),
         ParamSpec("service_type", "ClusterIP", "ClusterIP | NodePort | LoadBalancer"),
+        ParamSpec("tls_secret", "",
+                  "TLS Secret (tls.crt/tls.key) for HTTPS termination — "
+                  "the iap-ingress/cert-manager role (empty = HTTP)"),
     ],
 )
-def gateway(namespace: str, image: str, replicas: int, service_type: str) -> list[dict]:
+def gateway(namespace: str, image: str, replicas: int, service_type: str,
+            tls_secret: str) -> list[dict]:
     name = "gateway"
     labels = {"app": name, "service": "gateway"}
+    tls_args, tls_mounts, tls_volumes = [], [], []
+    if tls_secret:
+        tls_args = ["--tls-cert=/etc/tls/tls.crt",
+                    "--tls-key=/etc/tls/tls.key"]
+        tls_mounts = [k8s.volume_mount("tls", "/etc/tls", read_only=True)]
+        tls_volumes = [k8s.secret_volume("tls", tls_secret)]
     return [
         k8s.service_account(name, namespace, labels),
         k8s.cluster_role(
@@ -62,15 +72,18 @@ def gateway(namespace: str, image: str, replicas: int, service_type: str) -> lis
                     name,
                     image,
                     command=["python", "-m", "kubeflow_tpu.gateway"],
-                    args=["--port=8080", "--admin-port=8877", f"--namespace={namespace}"],
+                    args=["--port=8080", "--admin-port=8877",
+                          f"--namespace={namespace}"] + tls_args,
                     ports={"http": 8080, "admin": 8877},
                     liveness_probe=k8s.http_probe("/healthz", 8877, initial_delay=30),
                     readiness_probe=k8s.http_probe("/healthz", 8877),
+                    volume_mounts=tls_mounts or None,
                 )
             ],
             replicas=replicas,
             labels=labels,
             service_account=name,
+            volumes=tls_volumes or None,
         ),
     ]
 
