@@ -21,6 +21,7 @@ from __future__ import annotations
 import os
 import subprocess
 import sys
+import tempfile
 import threading
 import time
 from dataclasses import dataclass, field
@@ -51,6 +52,10 @@ class _Running:
     proc: subprocess.Popen
     pod_name: str
     namespace: str
+    # stdout spools to an unlinked temp file, not a PIPE: a pod writing more
+    # than the ~64KB pipe buffer would otherwise block on write until the
+    # kubelet timeout kills it (verbose-but-healthy workloads would fail).
+    out_file: object = None
     started: float = field(default_factory=time.monotonic)
 
 
@@ -107,15 +112,24 @@ class FakeKubelet:
         argv += [str(a) for a in container.get("args", [])]
         if argv and argv[0] in ("python", "python3"):
             argv[0] = sys.executable
-        proc = subprocess.Popen(
-            argv,
-            env=self._child_env(pod),
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-            text=True,
-        )
+        if not argv:
+            self._set_phase(pod, "Failed", exit_code=127,
+                            log="container has no command or args")
+            return
+        out_file = tempfile.TemporaryFile()  # binary: tail-seek is exact
+        try:
+            proc = subprocess.Popen(
+                argv,
+                env=self._child_env(pod),
+                stdout=out_file,
+                stderr=subprocess.STDOUT,
+            )
+        except (OSError, ValueError) as e:  # nonexistent binary, bad argv …
+            out_file.close()
+            self._set_phase(pod, "Failed", exit_code=127, log=str(e))
+            return
         key = (pod["metadata"]["namespace"], pod["metadata"]["name"])
-        self._running[key] = _Running(proc, key[1], key[0])
+        self._running[key] = _Running(proc, key[1], key[0], out_file=out_file)
         self._set_phase(pod, "Running")
 
     def _set_phase(self, pod: dict, phase: str,
@@ -155,10 +169,18 @@ class FakeKubelet:
             if rc is None:
                 if time.monotonic() - run.started > self.timeout:
                     run.proc.kill()
+                    run.proc.wait()  # reap; also flushes remaining output
                     rc = -9
                 else:
                     continue
-            out = run.proc.stdout.read() if run.proc.stdout else ""
+            out = ""
+            if run.out_file is not None:
+                # Only the tail survives into status.log — don't
+                # materialize a long-running pod's full output.
+                size = run.out_file.seek(0, 2)
+                run.out_file.seek(max(0, size - 65536))
+                out = run.out_file.read().decode("utf-8", "replace")
+                run.out_file.close()
             pod = {"metadata": {"namespace": key[0], "name": key[1]}}
             try:
                 pod = self.client.get(POD_API, "Pod", key[1], key[0])
@@ -198,4 +220,7 @@ class FakeKubelet:
         for run in self._running.values():
             if run.proc.poll() is None:
                 run.proc.kill()
+                run.proc.wait()  # reap — no zombies across a test session
+            if run.out_file is not None:
+                run.out_file.close()
         self._running.clear()

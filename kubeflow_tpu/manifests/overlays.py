@@ -181,7 +181,11 @@ def _apply_images(obj: dict, images: Mapping[str, str]) -> None:
         return
     for container in pod_spec.get("containers", []):
         image = container.get("image", "")
-        repo = image.split(":")[0].split("@")[0]
+        # The tag separator is a ':' after the last '/': splitting on the
+        # first ':' would truncate port-qualified registries
+        # ('registry:5000/app' must keep repo 'registry:5000/app').
+        head, sep, last = image.rpartition("/")
+        repo = head + sep + last.split(":")[0].split("@")[0]
         if image in images:
             container["image"] = images[image]
         elif repo in images:

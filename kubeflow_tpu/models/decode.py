@@ -130,23 +130,33 @@ def sample_token(logits, key, temperature, top_k: int = 0):
 @functools.partial(jax.jit, static_argnames=("cfg", "max_new_tokens",
                                              "top_k"))
 def generate(params, prompt_tokens, prompt_lengths, cfg: TransformerConfig,
-             *, max_new_tokens: int, key, temperature, top_k: int = 0):
+             *, max_new_tokens: int, key, temperature, top_k: int = 0,
+             row_valid=None):
     """prompt_tokens [B, T0] right-padded, prompt_lengths [B] →
     (generated [B, max_new_tokens], prefill_logits [B, V]).
 
-    ``temperature`` [B]: <=0 rows decode greedily. One compiled call:
-    prefill + a scanned decode loop over the KV cache.
+    ``temperature`` [B]: <=0 rows decode greedily. ``row_valid`` [B] marks
+    real instances in a server-padded batch — pad rows must not claim MoE
+    expert capacity during decode and evict real tokens' expert choices.
+    One compiled call: prefill + a scanned decode loop over the KV cache.
     """
     b, t0 = prompt_tokens.shape
     total = t0 + max_new_tokens
     cache = init_cache(cfg, b, total)
+    # A zero-length row would wrap the last-logit gather to index -1 (the
+    # last prefill slot) and seed generation from garbage; clamp to 1 so
+    # the behavior is defined even if callers skip engine validation.
+    prompt_lengths = jnp.maximum(prompt_lengths, 1)
+    if row_valid is None:
+        row_valid = jnp.ones((b,), bool)
 
     slot = jnp.arange(total)[None, :]
     valid = slot < prompt_lengths[:, None]  # prompt slots only
     positions = jnp.broadcast_to(jnp.arange(t0)[None], (b, t0))
     logits, cache = forward_cached(
         params, prompt_tokens, cfg, cache, 0, positions, valid,
-        token_valid=jnp.arange(t0)[None] < prompt_lengths[:, None],
+        token_valid=(jnp.arange(t0)[None] < prompt_lengths[:, None])
+        & row_valid[:, None],
     )
     last = jnp.take_along_axis(
         logits, (prompt_lengths - 1)[:, None, None], axis=1
@@ -160,7 +170,8 @@ def generate(params, prompt_tokens, prompt_lengths, cfg: TransformerConfig,
         valid = valid.at[:, slot_i].set(True)
         pos_i = (prompt_lengths + i)[:, None]  # true position per row
         logits, cache = forward_cached(
-            params, tok[:, None], cfg, cache, slot_i, pos_i, valid
+            params, tok[:, None], cfg, cache, slot_i, pos_i, valid,
+            token_valid=row_valid[:, None],
         )
         return (cache, valid, tok, logits[:, 0], key), tok
 

@@ -13,7 +13,9 @@ from __future__ import annotations
 
 import datetime
 import logging
+import math
 import threading
+import time
 import uuid
 
 from kubeflow_tpu.k8s.client import ApiError, K8sClient
@@ -25,10 +27,6 @@ LEASE_API_VERSION = "coordination.k8s.io/v1"
 
 def _now() -> datetime.datetime:
     return datetime.datetime.now(datetime.timezone.utc)
-
-
-def _parse(ts: str) -> datetime.datetime:
-    return datetime.datetime.fromisoformat(ts.replace("Z", "+00:00"))
 
 
 class LeaderElector:
@@ -45,6 +43,14 @@ class LeaderElector:
         self.renew_seconds = renew_seconds
         self._stop = threading.Event()
         self._is_leader = threading.Event()
+        # Expiry is judged from locally *observed* (holder, renewTime)
+        # transitions in monotonic time, never by comparing the remote
+        # renewTime against the local wall clock — inter-node clock skew
+        # larger than lease_seconds must not let a standby seize a healthy
+        # leader's lease (client-go leaderelection semantics).
+        self._observed_record: tuple | None = None
+        self._observed_at: float | None = None
+        self._last_renew: float | None = None  # monotonic, successful renews
 
     # ------------------------------------------------------------------
 
@@ -55,7 +61,9 @@ class LeaderElector:
             "metadata": {"name": self.name, "namespace": self.namespace},
             "spec": {
                 "holderIdentity": self.identity,
-                "leaseDurationSeconds": int(self.lease_seconds),
+                # Lease durations are integer seconds in the K8s API; round
+                # up so a fractional lease_seconds never truncates to 0.
+                "leaseDurationSeconds": math.ceil(self.lease_seconds),
                 # metav1.MicroTime requires fractional seconds; isoformat()
                 # drops them when microsecond == 0 (client-go uses
                 # RFC3339Micro for exactly this reason).
@@ -73,16 +81,27 @@ class LeaderElector:
                 self.client.create(self._lease_body())
                 log.info("%s: acquired new lease as %s", self.name,
                          self.identity)
+                self._last_renew = time.monotonic()
                 self._is_leader.set()
                 return True
             spec = lease.get("spec", {})
             holder = spec.get("holderIdentity")
             renew = spec.get("renewTime")
-            expired = True
-            if renew:
-                age = (_now() - _parse(renew)).total_seconds()
-                expired = age > spec.get("leaseDurationSeconds",
-                                         self.lease_seconds)
+            if not holder:
+                # Voluntary release (release() clears holderIdentity) —
+                # the lease is explicitly up for grabs.
+                expired = True
+            else:
+                record = (holder, renew)
+                if record != self._observed_record:
+                    self._observed_record = record
+                    self._observed_at = time.monotonic()
+                if not renew:
+                    expired = True
+                else:
+                    age = time.monotonic() - self._observed_at
+                    expired = age > spec.get("leaseDurationSeconds",
+                                             self.lease_seconds)
             if holder == self.identity or expired:
                 lease["spec"] = self._lease_body()["spec"]
                 self.client.update(lease)  # CAS via resourceVersion
@@ -90,14 +109,24 @@ class LeaderElector:
                     log.info("%s: %s lease as %s", self.name,
                              "took over expired" if holder != self.identity
                              else "renewed", self.identity)
+                self._last_renew = time.monotonic()
                 self._is_leader.set()
                 return True
             self._is_leader.clear()
             return False
         except ApiError as e:
-            # 409 = lost the update race to another candidate.
-            if e.code != 409:
-                log.warning("%s: lease attempt failed: %s", self.name, e)
+            if e.code == 409:
+                # Lost the update race to another candidate — definitive.
+                self._is_leader.clear()
+                return False
+            log.warning("%s: lease attempt failed: %s", self.name, e)
+            # A transient apiserver error must not demote a leader whose
+            # lease is still valid (client-go retries until the renew
+            # deadline): keep leadership until our own last successful
+            # renew is a full lease duration old.
+            if self._is_leader.is_set() and self._last_renew is not None:
+                if time.monotonic() - self._last_renew <= self.lease_seconds:
+                    return True
             self._is_leader.clear()
             return False
 
@@ -108,8 +137,6 @@ class LeaderElector:
     def wait_for_leadership(self, timeout: float | None = None) -> bool:
         """Block (acquiring in a loop) until this candidate leads.
         ``timeout=0`` makes a single non-blocking attempt."""
-        import time
-
         end = time.monotonic() + timeout if timeout is not None else None
         while not self._stop.is_set():
             if self.try_acquire():
@@ -135,18 +162,18 @@ class LeaderElector:
 
     def release(self) -> None:
         """Drop the lease on clean shutdown so a standby takes over fast.
-        Stops and joins the renew thread FIRST: an in-flight renewal after
-        the backdate would make the lease look freshly held by a dead
-        process, and a renewal just before it would 409 the backdate."""
+        Release is *explicit* — holderIdentity is cleared (client-go
+        ReleaseOnCancel semantics), never inferred from timestamp
+        regression, which an NTP step on the leader could mimic. Stops and
+        joins the renew thread FIRST: an in-flight renewal after the clear
+        would make the lease look freshly held by a dead process, and a
+        renewal just before it would 409 the clear."""
         self._stop.set()
         thread = getattr(self, "_thread", None)
         if thread is not None:
             thread.join(timeout=2 * self.renew_seconds)
         if not self._is_leader.is_set():
             return
-        backdated = (_now() - datetime.timedelta(days=1)).strftime(
-            "%Y-%m-%dT%H:%M:%S.%fZ"
-        )
         for _attempt in range(3):  # retry lost-update races
             try:
                 lease = self.client.get_or_none(
@@ -156,7 +183,7 @@ class LeaderElector:
                     "holderIdentity"
                 ) != self.identity:
                     break
-                lease["spec"]["renewTime"] = backdated
+                lease["spec"]["holderIdentity"] = ""
                 self.client.update(lease)
                 break
             except ApiError as e:

@@ -174,6 +174,8 @@ class InferenceEngine:
             tokens[i, : len(seq)] = seq
             lengths[i] = len(seq)
             temperature[i] = float(inst.get("temperature", 0.0))
+        row_valid = np.zeros((b,), bool)
+        row_valid[:n] = True
         with self._lock:
             self._seed += 1
             toks, last = generate(
@@ -183,6 +185,7 @@ class InferenceEngine:
                 key=jax.random.PRNGKey(self._seed),
                 temperature=jnp.asarray(temperature),
                 top_k=self.cfg.top_k,
+                row_valid=jnp.asarray(row_valid),
             )
         toks = np.asarray(toks)[:n]
         last = np.asarray(last)[:n]

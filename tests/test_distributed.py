@@ -51,6 +51,33 @@ def worker_env(port: int, num: int, pid: int, devices: int) -> dict:
     return env
 
 
+def test_kubelet_verbose_pod_does_not_deadlock(api):
+    """A pod writing far more than the OS pipe buffer (~64KB) must still
+    run to completion — stdout spools to a file, so a verbose-but-healthy
+    workload can't block on write and get killed at the timeout."""
+    api.create({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "chatty", "namespace": "kubeflow"},
+        "spec": {"containers": [{
+            "name": "main",
+            "command": ["python", "-c",
+                        "import sys\n"
+                        "for _ in range(4000):\n"
+                        "    sys.stdout.write('x' * 256 + '\\n')\n"
+                        "print('done')"],
+        }]},
+        "status": {"phase": "Pending"},
+    })
+    kubelet = FakeKubelet(api, timeout=30)
+    try:
+        kubelet.run_until_idle(deadline=30)
+    finally:
+        kubelet.shutdown()
+    pod = api.get("v1", "Pod", "chatty", "kubeflow")
+    assert pod["status"]["phase"] == "Succeeded", pod["status"]
+    assert "done" in pod["status"].get("log", "")
+
+
 @pytest.mark.slow
 def test_two_process_rendezvous_psum():
     """2 processes × 2 CPU devices rendezvous and psum over all 4 devices."""

@@ -533,33 +533,74 @@ def test_mpi_sidecar_follows_launcher_phase(api):
 def test_leader_election_single_holder_and_failover(api):
     """Lease semantics: one holder at a time; standby takes over when the
     lease expires or is released (client-go leaderelection analogue)."""
-    import datetime
+    import time as _time
 
-    from kubeflow_tpu.operators.leader import (
-        LEASE_API_VERSION,
-        LeaderElector,
-    )
+    from kubeflow_tpu.operators.leader import LeaderElector
 
-    a = LeaderElector(api, name="op", identity="a", lease_seconds=10)
-    b = LeaderElector(api, name="op", identity="b", lease_seconds=10)
+    a = LeaderElector(api, name="op", identity="a", lease_seconds=1)
+    b = LeaderElector(api, name="op", identity="b", lease_seconds=1)
     assert a.try_acquire() is True
     assert b.try_acquire() is False
     assert a.is_leader and not b.is_leader
     # Renewal keeps leadership.
     assert a.try_acquire() is True
+    _time.sleep(0.6)
+    assert a.try_acquire() is True  # renewal resets b's observation clock
+    _time.sleep(0.6)
+    assert b.try_acquire() is False  # 1.2s since b's first observation,
+    # but only 0.6s since the record last changed — lease still healthy
 
-    # Expire the lease: standby takes over.
-    lease = api.get(LEASE_API_VERSION, "Lease", "op", "kubeflow")
-    stale = (datetime.datetime.now(datetime.timezone.utc)
-             - datetime.timedelta(seconds=60)).isoformat()
-    lease["spec"]["renewTime"] = stale
-    api.update(lease)
+    # Leader stops renewing → standby takes over after a full local
+    # lease duration with no observed transition.
+    _time.sleep(1.1)
     assert b.try_acquire() is True
     assert a.try_acquire() is False  # a lost it
 
     # Clean release: a can immediately re-acquire.
     b.release()
     assert a.try_acquire() is True
+
+
+def test_leader_election_tolerates_clock_skew(api):
+    """A leader on a node whose clock is minutes behind writes renewTimes
+    that look expired against the local wall clock, but it renews on
+    schedule — a standby must judge expiry from locally observed renewTime
+    *transitions* (monotonic), never wall-clock comparison, so a healthy
+    skewed leader is never seized from."""
+    import datetime
+    import time as _time
+
+    from kubeflow_tpu.operators.leader import (
+        LEASE_API_VERSION,
+        LeaderElector,
+    )
+
+    def skewed_stamp(seconds_ago):
+        return (datetime.datetime.now(datetime.timezone.utc)
+                - datetime.timedelta(seconds=seconds_ago)).strftime(
+                    "%Y-%m-%dT%H:%M:%S.%fZ")
+
+    api.create({
+        "apiVersion": LEASE_API_VERSION, "kind": "Lease",
+        "metadata": {"name": "skew", "namespace": "kubeflow"},
+        "spec": {"holderIdentity": "remote-leader",
+                 "leaseDurationSeconds": 0.3,
+                 "renewTime": skewed_stamp(600)},
+    })
+    b = LeaderElector(api, name="skew", identity="b", lease_seconds=0.3)
+    # First observation starts the local clock; stamp looks 600s stale but
+    # that alone must not grant the lease.
+    assert b.try_acquire() is False
+    # The skewed leader keeps renewing (stamp advances, still "stale").
+    for seconds_ago in (599, 598):
+        _time.sleep(0.2)
+        lease = api.get(LEASE_API_VERSION, "Lease", "skew", "kubeflow")
+        lease["spec"]["renewTime"] = skewed_stamp(seconds_ago)
+        api.update(lease)
+        assert b.try_acquire() is False  # record changed → leader healthy
+    # Renewals stop → after a locally-observed full lease duration b leads.
+    _time.sleep(0.4)
+    assert b.try_acquire() is True
 
 
 @pytest.mark.slow

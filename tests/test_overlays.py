@@ -67,6 +67,21 @@ def test_images_replicas_and_patches(rendered):
     assert tmpl["nodeSelector"] == {"pool": "platform"}
 
 
+def test_images_match_port_qualified_registry(rendered):
+    """A ':' in the registry host ('registry:5000/app') is not a tag
+    separator — repo matching must split only after the last '/'."""
+    dep = by_kind(rendered, "Deployment")[0]
+    dep["spec"]["template"]["spec"]["containers"][0]["image"] = (
+        "registry.internal:5000/platform:v1"
+    )
+    out = apply_overlay(rendered, Overlay(
+        images={"registry.internal:5000/platform": "mirror/platform:v2"},
+    ))
+    got = by_kind(out, "Deployment")[0]["spec"]["template"]["spec"][
+        "containers"][0]["image"]
+    assert got == "mirror/platform:v2"
+
+
 def test_overlay_rejects_unknown_fields():
     with pytest.raises(ValueError, match="unknown overlay"):
         Overlay.from_dict({"namesPrefix": "x"})
