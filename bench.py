@@ -8,9 +8,12 @@ maps onto the MXU, reported alongside raw tokens/s/chip. The reference
 publishes no numbers (BASELINE.md — machinery only), so ``vs_baseline``
 compares against this repo's frozen round-1 record in BENCH_BASELINE.json.
 
-Flagship workload: the ``flagship-1b`` decoder LM (1.13B params, llama3-8b
-layer geometry at 4 layers) — bf16 train step, blockwise flash attention,
-adafactor, jitted end to end, single chip.
+Two training workloads run on TPU (VERDICT r2 #1 — report both the shallow
+flagship and a realistic-depth model):
+- ``flagship-1b``: 3 wide llama blocks, 1.13B params — the peak-MFU config.
+- ``flagship-deep``: 16 llama-style layers, 1.53B params — the depth class
+  users actually bring (BERT/Llama geometry); reported as ``deep_mfu_pct``
+  (bs32 seq256, the BERT-class shape) and ``deep_mfu_seq512_pct``.
 """
 
 from __future__ import annotations
@@ -27,35 +30,21 @@ import jax
 PEAK_BF16 = 197e12
 
 
-def main() -> int:
-    parser = argparse.ArgumentParser()
-    parser.add_argument("--quick", action="store_true",
-                        help="small model / few steps (CI smoke)")
-    parser.add_argument("--steps", type=int, default=20)
-    parser.add_argument("--trace-dir", default=None,
-                        help="capture a jax.profiler trace of the timed steps")
-    args = parser.parse_args()
-
+def run_training(model_name: str, batch_size: int, seq_len: int,
+                 steps: int, opt_name: str, *, grad_dtype=None,
+                 trace_dir=None) -> dict:
+    """Train ``steps`` steps; returns tok/s-per-chip, MFU and final loss."""
     from kubeflow_tpu.models.registry import get_model
     from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
     from kubeflow_tpu.train.data import place_batch, synthetic_batch
     from kubeflow_tpu.train.optimizers import OptimizerConfig
     from kubeflow_tpu.train.trainer import build_train_step, init_state
 
-    on_tpu = jax.default_backend() == "tpu"
-    if args.quick or not on_tpu:
-        model = get_model("lm-test-tiny")
-        batch_size, seq_len = 8, 128
-        opt_name = "adamw"
-    else:
-        model = get_model("flagship-1b")
-        batch_size, seq_len = 4, 2048
-        opt_name = "adafactor"  # factored slots buy model width (= MFU)
-
+    model = get_model(model_name)
     n_devices = len(jax.devices())
     mesh = build_mesh(MeshConfig(data=n_devices))
     opt = OptimizerConfig(name=opt_name, warmup_steps=2,
-                          total_steps=args.steps + 2)
+                          total_steps=steps + 2, grad_dtype=grad_dtype)
     state = init_state(jax.random.PRNGKey(0), model, opt, mesh)
     n_params = sum(x.size for x in jax.tree.leaves(state.params))
     step_fn = build_train_step(model, opt, mesh)
@@ -67,22 +56,61 @@ def main() -> int:
     state, metrics = step_fn(state, batch)
     jax.block_until_ready(metrics["loss"])
 
-    if args.trace_dir:
-        jax.profiler.start_trace(args.trace_dir)
+    if trace_dir:
+        jax.profiler.start_trace(trace_dir)
     t0 = time.perf_counter()
-    for _ in range(args.steps):
+    for _ in range(steps):
         state, metrics = step_fn(state, batch)
     # A device-value fetch (not just block_until_ready) pins the wall time
     # to real execution through remote-dispatch tunnels.
     loss = float(metrics["loss"])
     dt = time.perf_counter() - t0
-    if args.trace_dir:
+    if trace_dir:
         jax.profiler.stop_trace()
 
-    tokens_per_sec = args.steps * batch_size * seq_len / dt
+    tokens_per_sec = steps * batch_size * seq_len / dt
     per_chip = tokens_per_sec / n_devices
-    mfu = 6.0 * n_params * per_chip / PEAK_BF16
+    return {
+        "mfu": 6.0 * n_params * per_chip / PEAK_BF16,
+        "tokens_per_sec_per_chip": per_chip,
+        "params_m": n_params / 1e6,
+        "final_loss": loss,
+        "config": f"{model_name} bs{batch_size} seq{seq_len} {opt_name} "
+                  f"bf16 x{n_devices}chip",
+    }
 
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true",
+                        help="small model / few steps (CI smoke)")
+    parser.add_argument("--steps", type=int, default=20)
+    parser.add_argument("--skip-deep", action="store_true",
+                        help="flagship only (fast iteration)")
+    parser.add_argument("--trace-dir", default=None,
+                        help="capture a jax.profiler trace of the timed steps")
+    args = parser.parse_args()
+
+    on_tpu = jax.default_backend() == "tpu"
+    if args.quick or not on_tpu:
+        flagship = run_training("lm-test-tiny", 8, 128, args.steps, "adamw",
+                                trace_dir=args.trace_dir)
+        deep = deep512 = None
+    else:
+        # adafactor: factored slots buy model width (= MFU).
+        flagship = run_training("flagship-1b", 4, 2048, args.steps,
+                                "adafactor", trace_dir=args.trace_dir)
+        deep = deep512 = None
+        if not args.skip_deep:
+            # Deep steps are ~4× faster than flagship steps; run more so
+            # per-step dispatch noise amortizes out of the measurement.
+            deep_steps = max(args.steps, 30)
+            deep = run_training("flagship-deep", 32, 256, deep_steps,
+                                "adafactor", grad_dtype="bfloat16")
+            deep512 = run_training("flagship-deep", 16, 512, deep_steps,
+                                   "adafactor", grad_dtype="bfloat16")
+
+    mfu = flagship["mfu"]
     # Frozen round-1 record (25,008 tok/s on a 509M model = 38.8% MFU);
     # not rewritten by later rounds, so vs_baseline tracks real progress.
     baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -93,18 +121,30 @@ def main() -> int:
     except (OSError, KeyError, ValueError):
         vs = 1.0
 
-    print(json.dumps({
+    out = {
         "metric": "flagship_lm_train_mfu",
         "value": round(mfu * 100, 2),
         "unit": "percent_of_peak_bf16",
         "vs_baseline": round(vs, 3),
-        "tokens_per_sec_per_chip": round(per_chip, 1),
-        "params_m": round(n_params / 1e6, 1),
-        "model_tflops_per_sec_per_chip": round(6e-12 * n_params * per_chip, 1),
-        "final_loss": round(loss, 4),
-        "config": f"{model.name} bs{batch_size} seq{seq_len} {opt_name} "
-                  f"bf16 x{n_devices}chip",
-    }))
+        "tokens_per_sec_per_chip": round(
+            flagship["tokens_per_sec_per_chip"], 1),
+        "params_m": round(flagship["params_m"], 1),
+        "model_tflops_per_sec_per_chip": round(
+            6e-12 * flagship["params_m"] * 1e6
+            * flagship["tokens_per_sec_per_chip"], 1),
+        "final_loss": round(flagship["final_loss"], 4),
+        "config": flagship["config"],
+    }
+    if deep is not None:
+        out.update({
+            "deep_mfu_pct": round(deep["mfu"] * 100, 2),
+            "deep_tokens_per_sec_per_chip": round(
+                deep["tokens_per_sec_per_chip"], 1),
+            "deep_params_m": round(deep["params_m"], 1),
+            "deep_config": deep["config"],
+            "deep_mfu_seq512_pct": round(deep512["mfu"] * 100, 2),
+        })
+    print(json.dumps(out))
     return 0
 
 

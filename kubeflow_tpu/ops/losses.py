@@ -13,6 +13,20 @@ import jax
 import jax.numpy as jnp
 
 
+def _nll_and_lse(logits, labels):
+    """Per-position (nll, lse) in fp32 — the shared numerical core of the
+    full and chunked CE paths. The subtracted max must be the SAME
+    stop-gradient value when added back, else grad(lse) gains a spurious
+    one_hot(argmax) term. Negative labels gather index 0; callers mask."""
+    logits32 = logits.astype(jnp.float32)
+    m = jax.lax.stop_gradient(jnp.max(logits32, axis=-1, keepdims=True))
+    lse = jnp.log(jnp.sum(jnp.exp(logits32 - m), axis=-1)) + m[..., 0]
+    label_logit = jnp.take_along_axis(
+        logits32, jnp.maximum(labels, 0)[..., None], axis=-1
+    )[..., 0]
+    return lse - label_logit, lse
+
+
 def softmax_cross_entropy(
     logits, labels, *, z_loss: float = 0.0, where=None
 ):
@@ -21,16 +35,7 @@ def softmax_cross_entropy(
     logits: [..., V]; labels: [...] int32, negative = ignore. Returns
     (loss, metrics dict with "loss", "z_loss", "tokens").
     """
-    logits32 = logits.astype(jnp.float32)
-    # The subtracted max must be the SAME stop-gradient value when added
-    # back, else grad(lse) gains a spurious one_hot(argmax) term.
-    m = jax.lax.stop_gradient(jnp.max(logits32, axis=-1, keepdims=True))
-    shifted = logits32 - m
-    lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1)) + m[..., 0]
-    label_logit = jnp.take_along_axis(
-        logits32, jnp.maximum(labels, 0)[..., None], axis=-1
-    )[..., 0]
-    nll = lse - label_logit
+    nll, lse = _nll_and_lse(logits, labels)
 
     mask = labels >= 0
     if where is not None:
@@ -42,6 +47,57 @@ def softmax_cross_entropy(
     metrics = {"loss": loss, "tokens": tokens}
     if z_loss:
         zl = z_loss * jnp.sum(jnp.square(lse) * maskf) / tokens
+        metrics["z_loss"] = zl
+        loss = loss + zl
+    return loss, metrics
+
+
+def chunked_lm_head_loss(x, head, labels, *, z_loss: float = 0.0,
+                         n_chunks: int = 4):
+    """LM head matmul + cross entropy without ever materializing the full
+    ``[N, V]`` logits tensor.
+
+    At vocab 32k and 8k tokens per step the fp32 logits alone are >1GB of
+    HBM live across the whole backward pass. Here rows are processed in
+    ``n_chunks`` chunks under ``jax.checkpoint``: forward keeps only the
+    per-chunk scalar sums, and backward *recomputes* each chunk's logits
+    when it needs them — peak logits memory drops by the chunk factor for
+    one extra head matmul per chunk. Numerics match
+    :func:`softmax_cross_entropy` (same max-shifted logsumexp in fp32,
+    same z-loss, same negative-label masking).
+
+    x: [N, D] final hidden states; head: [D, V]; labels: [N] int32
+    (negative = ignore). Returns (loss, metrics) like the unchunked path.
+    """
+    n, d = x.shape
+    if n % n_chunks:
+        raise ValueError(f"rows {n} not divisible by n_chunks {n_chunks}")
+    xc = x.reshape(n_chunks, n // n_chunks, d)
+    lc = labels.reshape(n_chunks, n // n_chunks)
+
+    @jax.checkpoint
+    def chunk_sums(xi, li):
+        nll, lse = _nll_and_lse(xi @ head, li)
+        maskf = (li >= 0).astype(jnp.float32)
+        return (
+            jnp.sum(nll * maskf),
+            jnp.sum(jnp.square(lse) * maskf),
+            jnp.sum(maskf),
+        )
+
+    def body(carry, inp):
+        nll, zsq, tok = chunk_sums(*inp)
+        return (carry[0] + nll, carry[1] + zsq, carry[2] + tok), None
+
+    zero = jnp.zeros((), jnp.float32)
+    (nll_sum, zsq_sum, tok_sum), _ = jax.lax.scan(
+        body, (zero, zero, zero), (xc, lc)
+    )
+    tokens = jnp.maximum(tok_sum, 1.0)
+    loss = nll_sum / tokens
+    metrics = {"loss": loss, "tokens": tokens}
+    if z_loss:
+        zl = z_loss * zsq_sum / tokens
         metrics["z_loss"] = zl
         loss = loss + zl
     return loss, metrics

@@ -23,6 +23,12 @@ class OptimizerConfig:
     # adamw/adam first-moment dtype; "bfloat16" halves that slot's HBM
     # (the second moment stays float32 for update accuracy).
     mu_dtype: str | None = None
+    # Differentiate w.r.t. a bfloat16 view of the float32 master weights:
+    # the gradient tree materializes at 2 bytes/param instead of 4. The
+    # backward pass already flows in bf16 activations, so the only added
+    # rounding is the final per-param accumulation — the standard trade
+    # for fitting wider models on one chip (master weights stay fp32).
+    grad_dtype: str | None = None
 
 
 def schedule(cfg: OptimizerConfig):

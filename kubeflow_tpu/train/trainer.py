@@ -78,8 +78,16 @@ def build_train_step(model: ModelSpec, opt_cfg: OptimizerConfig,
                                           mesh=mesh)
             return loss, metrics
 
+        diff_params = state.params
+        if opt_cfg.grad_dtype:
+            gdt = jnp.dtype(opt_cfg.grad_dtype)
+            diff_params = jax.tree.map(
+                lambda p: p.astype(gdt)
+                if jnp.issubdtype(p.dtype, jnp.floating) else p,
+                state.params,
+            )
         (loss, metrics), grads = jax.value_and_grad(loss_of, has_aux=True)(
-            state.params
+            diff_params
         )
         updates, opt_state = opt.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)

@@ -197,3 +197,79 @@ def test_unrolled_layer_loop_matches_scan():
     la, _ = transformer.loss_fn(params, batch, cfg_scan)
     lb, _ = transformer.loss_fn(params, batch, cfg_unroll)
     np.testing.assert_allclose(float(la), float(lb), rtol=1e-2)
+
+
+def test_grouped_scan_matches_per_layer_scan():
+    """scan_group_size>1 (chunked layer iteration) is numerically the same
+    model as the per-layer scan."""
+    import jax
+    import numpy as np
+
+    from kubeflow_tpu.models import transformer
+
+    cfg = transformer.config("lm-test-tiny")
+    cfg_grouped = transformer.config("lm-test-tiny", scan_group_size=2)
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 256)
+    a = transformer.apply(params, tokens, cfg)
+    b = transformer.apply(params, tokens, cfg_grouped)
+    np.testing.assert_allclose(np.asarray(a, np.float32),
+                               np.asarray(b, np.float32),
+                               rtol=5e-2, atol=5e-2)
+    # Indivisible group size is rejected, not silently truncated.
+    import pytest
+
+    with pytest.raises(ValueError, match="scan_group_size"):
+        transformer.apply(
+            params, tokens,
+            transformer.config("lm-test-tiny", scan_group_size=3),
+        )
+
+
+def test_chunked_lm_head_loss_matches_unchunked():
+    """cfg.loss_chunks computes the same loss/gradients as the full-logits
+    path — it only changes what is materialized, not the math."""
+    import jax
+    import numpy as np
+
+    from kubeflow_tpu.models import transformer
+
+    cfg = transformer.config("lm-test-tiny")
+    cfg_chunked = transformer.config("lm-test-tiny", loss_chunks=4)
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    batch = {"tokens": jax.random.randint(jax.random.PRNGKey(2), (4, 17),
+                                          0, 256)}
+    la, ma = transformer.loss_fn(params, batch, cfg)
+    lb, mb = transformer.loss_fn(params, batch, cfg_chunked)
+    np.testing.assert_allclose(float(la), float(lb), rtol=1e-3)
+    assert float(ma["tokens"]) == float(mb["tokens"])
+
+    ga = jax.grad(lambda p: transformer.loss_fn(p, batch, cfg)[0])(params)
+    gb = jax.grad(
+        lambda p: transformer.loss_fn(p, batch, cfg_chunked)[0]
+    )(params)
+    # bf16 activations: per-chunk accumulation rounds differently than the
+    # single fused head matmul, so grads agree only to bf16 noise scale.
+    for pa, pb in zip(jax.tree.leaves(ga), jax.tree.leaves(gb)):
+        np.testing.assert_allclose(np.asarray(pa, np.float32),
+                                   np.asarray(pb, np.float32),
+                                   rtol=5e-2, atol=3e-3)
+
+
+def test_llm_remat_policy_matches_dots():
+    """The named-save "llm" policy (flagship-deep) changes memory, never
+    values: loss and grads match the default policy."""
+    import jax
+    import numpy as np
+
+    from kubeflow_tpu.models import transformer
+
+    cfg = transformer.config("lm-test-tiny", remat=True)
+    cfg_llm = transformer.config("lm-test-tiny", remat=True,
+                                 remat_policy="llm", scan_layers=False)
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    batch = {"tokens": jax.random.randint(jax.random.PRNGKey(2), (2, 17),
+                                          0, 256)}
+    la, _ = transformer.loss_fn(params, batch, cfg)
+    lb, _ = transformer.loss_fn(params, batch, cfg_llm)
+    np.testing.assert_allclose(float(la), float(lb), rtol=1e-2)

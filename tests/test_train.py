@@ -141,6 +141,36 @@ def test_adafactor_and_bf16_mu_train_step():
         assert jnp.isfinite(metrics["loss"]), (cfg.name, metrics)
 
 
+def test_bf16_grad_dtype_trains_and_matches_direction():
+    """OptimizerConfig.grad_dtype="bfloat16" (the deep-flagship memory
+    recipe) still reduces loss; master params stay float32 throughout."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models.registry import get_model
+    from kubeflow_tpu.train.data import synthetic_batch
+    from kubeflow_tpu.train.optimizers import OptimizerConfig
+    from kubeflow_tpu.train.trainer import build_train_step, init_state
+
+    model = get_model("lm-test-tiny")
+    cfg = OptimizerConfig(name="adafactor", grad_dtype="bfloat16",
+                          warmup_steps=1, total_steps=8)
+    state = init_state(jax.random.PRNGKey(0), model, cfg)
+    step = build_train_step(model, cfg)
+    batch = synthetic_batch(model, 4, 64)
+    first = None
+    for _ in range(6):
+        state, metrics = step(state, batch)
+        if first is None:
+            first = float(metrics["loss"])
+    assert float(metrics["loss"]) < first
+    assert all(
+        p.dtype == jnp.float32
+        for p in jax.tree.leaves(state.params)
+        if jnp.issubdtype(p.dtype, jnp.floating)
+    )
+
+
 def test_tree_specs_rank_fallback():
     """A rule naming more dims than a leaf has falls back to replicated —
     factored optimizer slots share param paths but not param ranks."""
