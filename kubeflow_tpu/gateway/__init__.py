@@ -8,16 +8,27 @@ Services, keeps a longest-prefix route table, and proxies requests to the
 backing service. Optional forward-auth: every request is checked against the
 gatekeeper's /auth endpoint first (the IAP/basic-auth ingress role,
 kubeflow/common/basic-auth.libsonnet).
+
+Proxying is streaming end to end: response bodies are relayed chunk by
+chunk as the upstream produces them (chunked re-encoding when the upstream
+length is unknown — SSE/token streams flow unbuffered), and an HTTP/1.1
+Upgrade handshake (notebooks' websocket kernels,
+kubeflow/jupyter/jupyter.libsonnet:97-106 `use_websocket: true`) switches
+the connection to a transparent bidirectional TCP tunnel.
 """
 
 from __future__ import annotations
 
 import json
 import logging
+import socket
 import threading
+import time
 import urllib.error
+import urllib.parse
 import urllib.request
 from dataclasses import dataclass
+from http.client import HTTPConnection
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable
 
@@ -125,12 +136,14 @@ class Gateway:
         resolve: Callable[[str], str] | None = None,
         certfile: str = "",
         keyfile: str = "",
+        upstream_timeout: float = 60.0,
     ):
         self.table = table
         self.port = port
         self.admin_port = admin_port
         self.auth_url = auth_url
         self.resolve = resolve or (lambda addr: addr)
+        self.upstream_timeout = upstream_timeout
         # TLS termination at the gateway (the iap-ingress/cert-manager
         # role, kubeflow/gcp/iap.libsonnet): cert+key mounted from a
         # Secret; empty = plain HTTP (in-mesh or behind an LB).
@@ -138,6 +151,7 @@ class Gateway:
         self.keyfile = keyfile
         self.requests_total = 0
         self.errors_total = 0
+        self.tunnels_total = 0
         self._proxy: ThreadingHTTPServer | None = None
         self._admin: ThreadingHTTPServer | None = None
 
@@ -179,7 +193,8 @@ class Gateway:
                     self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
-                self.wfile.write(body)
+                if self.command != "HEAD":  # RFC 7231: HEAD has no body
+                    self.wfile.write(body)
 
             def _handle(self):
                 gw.requests_total += 1
@@ -205,36 +220,213 @@ class Gateway:
                 # Re-point at the resolved backend address.
                 target = target.replace(route.service,
                                         gw.resolve(route.service), 1)
+                parts = urllib.parse.urlsplit(target)
+                backend_path = parts.path + (
+                    "?" + parts.query if parts.query else ""
+                )
+                if self._is_upgrade():
+                    self._tunnel(route, parts.hostname, parts.port,
+                                 backend_path)
+                    return
+                self._proxy_http(route, parts.hostname, parts.port,
+                                 backend_path)
+
+            def _is_upgrade(self) -> bool:
+                conn_tokens = [
+                    t.strip().lower()
+                    for t in self.headers.get("Connection", "").split(",")
+                ]
+                return ("upgrade" in conn_tokens
+                        and bool(self.headers.get("Upgrade")))
+
+            # -- plain HTTP: streamed relay -----------------------------
+
+            def _proxy_http(self, route, host, port, path):
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length) if length else None
-                req = urllib.request.Request(
-                    target, data=body, method=self.command,
-                )
-                for k, v in self.headers.items():
-                    if k.lower() not in _HOP_HEADERS:
-                        req.add_header(k, v)
-                req.add_header("X-Forwarded-Prefix", route.prefix)
+                # The forwarded prefix is gateway-asserted — a client-
+                # supplied copy must never reach the backend (spoofing).
+                headers = {
+                    k: v for k, v in self.headers.items()
+                    if k.lower() not in _HOP_HEADERS
+                    and k.lower() != "x-forwarded-prefix"
+                }
+                headers["X-Forwarded-Prefix"] = route.prefix
+                conn = HTTPConnection(host, port,
+                                      timeout=gw.upstream_timeout)
                 try:
-                    with urllib.request.urlopen(req, timeout=60) as resp:
-                        payload = resp.read()
-                        headers = {
-                            k: v for k, v in resp.headers.items()
-                            if k.lower() not in _HOP_HEADERS
-                        }
-                        self._respond(resp.status, payload, headers)
-                except urllib.error.HTTPError as e:
-                    self._respond(e.code, e.read(),
-                                  {"Content-Type": e.headers.get(
-                                      "Content-Type", "application/json")})
+                    try:
+                        self._connect_upstream(conn)
+                        conn.request(self.command, path, body=body,
+                                     headers=headers)
+                        resp = conn.getresponse()
+                    except OSError as e:
+                        gw.errors_total += 1
+                        self._respond(
+                            502,
+                            json.dumps(
+                                {"error": f"upstream {route.service}: {e}"}
+                            ).encode(),
+                        )
+                        return
+                    self._relay_response(resp)
+                finally:
+                    conn.close()
+
+            def _connect_upstream(self, conn):
+                """Connect with one retry — connect-phase only, so an
+                in-flight request is never duplicated against a slow but
+                alive upstream (ksonnet.go:147-168's retry role at the
+                connection level)."""
+                try:
+                    conn.connect()
+                except OSError:
+                    conn.close()
+                    time.sleep(0.1)
+                    conn.connect()
+
+            def _relay_response(self, resp):
+                try:
+                    self.send_response(resp.status)
+                    for k, v in resp.getheaders():
+                        if k.lower() not in _HOP_HEADERS:
+                            self.send_header(k, v)
+                    upstream_len = resp.getheader("Content-Length")
+                    bodyless = (self.command == "HEAD"
+                                or resp.status in (204, 304)
+                                or 100 <= resp.status < 200)
+                    if bodyless or upstream_len is not None:
+                        if upstream_len is not None:
+                            self.send_header("Content-Length", upstream_len)
+                        self.end_headers()
+                        if not bodyless:
+                            self._relay_known_length(resp,
+                                                     int(upstream_len))
+                    else:
+                        self._relay_stream(resp)
+                    self.wfile.flush()
+                except OSError:
+                    # Mid-stream failure: the status line is already gone;
+                    # drop the connection rather than corrupt the body.
+                    gw.errors_total += 1
+                    self.close_connection = True
+
+            def _relay_known_length(self, resp, remaining: int) -> None:
+                while remaining > 0:
+                    data = resp.read(min(65536, remaining))
+                    if not data:
+                        # Upstream died short of its advertised length;
+                        # the client was promised more bytes — drop the
+                        # connection so it can't desync on a reuse.
+                        gw.errors_total += 1
+                        self.close_connection = True
+                        return
+                    self.wfile.write(data)
+                    remaining -= len(data)
+
+            def _relay_stream(self, resp) -> None:
+                """Unknown upstream length (chunked/EOF-delimited):
+                re-chunk and flush as data arrives so streaming bodies
+                (SSE, token streams) are never buffered. HTTP/1.0 clients
+                can't parse chunked — stream raw and close."""
+                chunked = self.request_version != "HTTP/1.0"
+                if chunked:
+                    self.send_header("Transfer-Encoding", "chunked")
+                else:
+                    self.close_connection = True
+                self.end_headers()
+                while True:
+                    data = resp.read1(65536)
+                    if not data:
+                        break
+                    if chunked:
+                        self.wfile.write(
+                            f"{len(data):x}\r\n".encode() + data + b"\r\n"
+                        )
+                    else:
+                        self.wfile.write(data)
+                    self.wfile.flush()
+                if chunked:
+                    self.wfile.write(b"0\r\n\r\n")
+
+            # -- HTTP/1.1 Upgrade: transparent TCP tunnel ---------------
+
+            def _tunnel(self, route, host, port, path):
+                """Forward the Upgrade handshake verbatim and then pump
+                bytes both ways — the websocket path notebooks need
+                (jupyter.libsonnet:97-106). The gateway never parses
+                frames; after the handshake it is a plain TCP relay, so
+                the backend's 101 (or its refusal) reaches the client
+                unmodified."""
+                try:
+                    backend = socket.create_connection(
+                        (host, port), timeout=gw.upstream_timeout
+                    )
                 except OSError as e:
                     gw.errors_total += 1
                     self._respond(
                         502,
-                        json.dumps({"error": f"upstream {route.service}: {e}"})
-                        .encode(),
+                        json.dumps(
+                            {"error": f"upstream {route.service}: {e}"}
+                        ).encode(),
                     )
+                    return
+                gw.tunnels_total += 1
+                lines = [f"{self.command} {path} HTTP/1.1",
+                         f"Host: {host}:{port}",
+                         f"X-Forwarded-Prefix: {route.prefix}"]
+                # Hop-by-hop headers are the handshake here — forward
+                # everything except Host (rewritten above) and any
+                # client-supplied forwarded-prefix (gateway-asserted).
+                lines += [
+                    f"{k}: {v}" for k, v in self.headers.items()
+                    if k.lower() not in ("host", "x-forwarded-prefix")
+                ]
+                try:
+                    backend.sendall(
+                        ("\r\n".join(lines) + "\r\n\r\n").encode()
+                    )
+                    # Tunnel sockets outlive the 60s request timeout.
+                    backend.settimeout(None)
+                    self.connection.settimeout(None)
+                    done = threading.Event()
+
+                    def pump(read, write):
+                        try:
+                            while True:
+                                data = read(65536)
+                                if not data:
+                                    break
+                                write(data)
+                        except (OSError, ValueError):
+                            pass
+                        finally:
+                            done.set()
+
+                    def write_client(data):
+                        self.wfile.write(data)
+                        self.wfile.flush()
+
+                    for read, write in (
+                        (self.rfile.read1, backend.sendall),
+                        (backend.recv, write_client),
+                    ):
+                        threading.Thread(target=pump, args=(read, write),
+                                         daemon=True).start()
+                    # First direction to close ends the tunnel; the
+                    # shutdown below unblocks the other pump.
+                    done.wait()
+                finally:
+                    for s in (backend, self.connection):
+                        try:
+                            s.shutdown(socket.SHUT_RDWR)
+                        except OSError:
+                            pass
+                    backend.close()
+                    self.close_connection = True
 
             do_GET = do_POST = do_PUT = do_PATCH = do_DELETE = _handle
+            do_HEAD = do_OPTIONS = _handle
 
         return Handler
 
@@ -253,6 +445,8 @@ class Gateway:
                         f"gateway_requests_total {gw.requests_total}\n"
                         "# TYPE gateway_errors_total counter\n"
                         f"gateway_errors_total {gw.errors_total}\n"
+                        "# TYPE gateway_upgrade_tunnels_total counter\n"
+                        f"gateway_upgrade_tunnels_total {gw.tunnels_total}\n"
                     ).encode()
                     ctype = "text/plain"
                 elif self.path in ("/healthz", "/readyz"):
