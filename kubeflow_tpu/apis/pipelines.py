@@ -19,6 +19,8 @@ PIPELINES_API_VERSION = f"{API_GROUP}/v1"
 
 WORKFLOW_KIND = "Workflow"
 WORKFLOW_PLURAL = "workflows"
+SCHEDULED_WORKFLOW_KIND = "ScheduledWorkflow"
+SCHEDULED_WORKFLOW_PLURAL = "scheduledworkflows"
 APPLICATION_KIND = "Application"
 APPLICATION_PLURAL = "applications"
 
@@ -38,6 +40,11 @@ def workflow_schema() -> dict:
             "dependencies": {
                 "type": "array", "items": {"type": "string"},
             },
+            # Failed task resources are deleted and recreated up to this
+            # many times with exponential backoff (the argo per-step
+            # retryStrategy surface, argo.libsonnet workflow-controller).
+            "retries": {"type": "integer", "minimum": 0},
+            "retryBackoffSeconds": {"type": "number", "minimum": 0},
             # The object this task creates, verbatim (a job CR, a
             # Deployment, ...). Ownership and completion tracking are the
             # controller's job; kind/apiVersion are required here so a
@@ -90,6 +97,60 @@ def workflow_crd() -> dict:
                     k8s.printer_column(
                         "Age", ".metadata.creationTimestamp", "date"
                     ),
+                ],
+            )
+        ],
+    )
+
+
+def scheduled_workflow_schema() -> dict:
+    return {
+        "type": "object",
+        "properties": {
+            "spec": {
+                "type": "object",
+                "required": ["schedule", "workflowSpec"],
+                "properties": {
+                    # Standard 5-field cron, evaluated in UTC.
+                    "schedule": {"type": "string", "minLength": 1},
+                    "suspend": {"type": "boolean"},
+                    # Runs in flight at once; further fire times are
+                    # skipped (not queued) while at the limit.
+                    "maxConcurrency": {"type": "integer", "minimum": 1},
+                    # Completed stamped Workflows retained per schedule;
+                    # run *records* (ConfigMap store) are pruned to this
+                    # count too. 0 = keep everything.
+                    "historyLimit": {"type": "integer", "minimum": 0},
+                    "workflowSpec": workflow_schema()["properties"]["spec"],
+                },
+            },
+            "status": {
+                "type": "object",
+                "x-kubernetes-preserve-unknown-fields": True,
+            },
+        },
+    }
+
+
+def scheduled_workflow_crd() -> dict:
+    return k8s.crd(
+        group=API_GROUP,
+        kind=SCHEDULED_WORKFLOW_KIND,
+        plural=SCHEDULED_WORKFLOW_PLURAL,
+        short_names=["swf"],
+        categories=["all", "kubeflow-tpu"],
+        versions=[
+            k8s.crd_version(
+                "v1",
+                schema=scheduled_workflow_schema(),
+                served=True,
+                storage=True,
+                printer_columns=[
+                    k8s.printer_column("Schedule", ".spec.schedule"),
+                    k8s.printer_column(
+                        "LastRun", ".status.lastScheduleTime"
+                    ),
+                    k8s.printer_column("Runs", ".status.runsStarted"),
                 ],
             )
         ],

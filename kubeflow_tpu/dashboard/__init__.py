@@ -15,6 +15,7 @@ from kubeflow_tpu.apis.notebooks import NOTEBOOK_KIND, NOTEBOOKS_API_VERSION
 from kubeflow_tpu.apis.tuning import STUDY_JOB_KIND, TUNING_API_VERSION
 from kubeflow_tpu.gateway import routes_from_service
 from kubeflow_tpu.k8s.client import ApiError, K8sClient
+from kubeflow_tpu.operators.runstore import RunStore
 from kubeflow_tpu.webapps import JsonHandler
 
 _PAGE = """<!doctype html>
@@ -29,6 +30,8 @@ td,th{{border:1px solid #ccc;padding:4px 10px}}</style></head>
 </tr>{notebooks}</table>
 <h2>Studies</h2><table><tr><th>Name</th><th>Namespace</th><th>State</th>
 <th>Best</th></tr>{studies}</table>
+<h2>Pipeline runs</h2><table><tr><th>Workflow</th><th>Schedule</th>
+<th>Phase</th><th>Started</th><th>Finished</th></tr>{runs}</table>
 </body></html>
 """
 
@@ -79,12 +82,21 @@ class Dashboard:
             "bestObjective": s.get("status", {}).get("bestObjective"),
         } for s in self._safe_list(TUNING_API_VERSION, STUDY_JOB_KIND)]
 
+    def runs(self) -> list[dict]:
+        """Workflow run history — outlives the Workflow CRs (RunStore,
+        the pipeline-persistenceagent surface)."""
+        try:
+            return RunStore(self.client).list_runs(self.namespace)
+        except ApiError:
+            return []
+
     def overview(self) -> dict:
         return {
             "components": self.components(),
             "jobs": self.jobs(),
             "notebooks": self.notebooks(),
             "studies": self.studies(),
+            "runs": self.runs(),
         }
 
     def render_html(self) -> str:
@@ -111,8 +123,16 @@ class Dashboard:
             f"<td>{esc(s['state'])}</td><td>{esc(s['bestObjective'])}</td>"
             "</tr>" for s in ov["studies"]
         )
+        runs = "".join(
+            f"<tr><td>{esc(r['workflow'])}</td>"
+            f"<td>{esc(r.get('scheduledWorkflow', ''))}</td>"
+            f"<td>{esc(r['phase'])}</td><td>{esc(r.get('startedAt', ''))}"
+            f"</td><td>{esc(r.get('finishedAt', ''))}</td></tr>"
+            for r in ov["runs"]
+        )
         return _PAGE.format(components=components, jobs=jobs,
-                            notebooks=notebooks, studies=studies)
+                            notebooks=notebooks, studies=studies,
+                            runs=runs)
 
 
 def make_server(dash: Dashboard, port: int) -> ThreadingHTTPServer:
@@ -122,6 +142,8 @@ def make_server(dash: Dashboard, port: int) -> ThreadingHTTPServer:
                 self.send_json(200, {"status": "ok"})
             elif self.path == "/api/overview":
                 self.send_json(200, dash.overview())
+            elif self.path == "/api/runs":
+                self.send_json(200, {"runs": dash.runs()})
             elif self.path in ("/", "/index.html"):
                 self.send_html(200, dash.render_html())
             else:

@@ -8,7 +8,11 @@ the final `kfctl apply` step instantiates, scripts/kfctl.sh:498-508).
 
 from __future__ import annotations
 
-from kubeflow_tpu.apis.pipelines import application_crd, workflow_crd
+from kubeflow_tpu.apis.pipelines import (
+    application_crd,
+    scheduled_workflow_crd,
+    workflow_crd,
+)
 from kubeflow_tpu.k8s import objects as k8s
 from kubeflow_tpu.manifests import images
 from kubeflow_tpu.manifests.core import ParamSpec, prototype
@@ -29,6 +33,7 @@ def pipeline_operator(namespace: str, image: str) -> list[dict]:
     labels = {"app": name}
     return [
         workflow_crd(),
+        scheduled_workflow_crd(),
         application_crd(),
         k8s.service_account(name, namespace, labels),
         k8s.cluster_role(
@@ -37,6 +42,7 @@ def pipeline_operator(namespace: str, image: str) -> list[dict]:
                 k8s.policy_rule(
                     [API_GROUP],
                     ["workflows", "workflows/status",
+                     "scheduledworkflows", "scheduledworkflows/status",
                      "applications", "applications/status"],
                     ["*"],
                 ),
@@ -55,6 +61,9 @@ def pipeline_operator(namespace: str, image: str) -> list[dict]:
                     [""], ["services", "events"],
                     ["get", "list", "watch", "create", "patch"],
                 ),
+                # Durable run records (persistence-agent role) live in
+                # ConfigMaps that outlast their Workflow CRs.
+                k8s.policy_rule([""], ["configmaps"], ["*"]),
             ],
             labels,
         ),
@@ -74,6 +83,53 @@ def pipeline_operator(namespace: str, image: str) -> list[dict]:
             service_account=name,
         ),
     ]
+
+
+@prototype(
+    "scheduled-workflow",
+    "Cron-scheduled Workflow stamping with run history "
+    "(pipeline-scheduledworkflow + persistenceagent analogue, "
+    "kubeflow/pipeline/pipeline-scheduledworkflow.libsonnet:1-60)",
+    params=[
+        ParamSpec("namespace", DEFAULT_NAMESPACE),
+        ParamSpec("name", "nightly-train"),
+        ParamSpec("schedule", "0 2 * * *", "5-field cron, UTC"),
+        ParamSpec("max_concurrency", 1),
+        ParamSpec("history_limit", 10,
+                  "completed runs + records retained"),
+        ParamSpec("image", images.JAX_TPU),
+    ],
+)
+def scheduled_workflow(namespace: str, name: str, schedule: str,
+                       max_concurrency: int, history_limit: int,
+                       image: str) -> list[dict]:
+    # Default stamped workflow: one single-worker JaxJob smoke train —
+    # the canned-example role of kubeflow/examples prototypes; users
+    # replace workflowSpec.tasks with their own DAG.
+    from kubeflow_tpu.manifests.core import generate
+
+    job = generate("jax-job-simple", {
+        "name": f"{name}-train", "namespace": namespace, "image": image,
+        "num_workers": 1,
+    })[0]
+    # No fixed name/namespace: each stamped run must get its own
+    # '{workflow}-{task}' job — a shared name would make run N+1 adopt
+    # run N's completed job and no-op.
+    job["metadata"].pop("name", None)
+    job["metadata"].pop("namespace", None)
+    return [{
+        "apiVersion": f"{API_GROUP}/v1",
+        "kind": "ScheduledWorkflow",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {
+            "schedule": schedule,
+            "maxConcurrency": max_concurrency,
+            "historyLimit": history_limit,
+            "workflowSpec": {
+                "tasks": [{"name": "train", "resource": job}],
+            },
+        },
+    }]
 
 
 @prototype(

@@ -34,6 +34,10 @@ SNAPSHOT_CASES: dict[str, tuple[str, dict]] = {
         {"name": "bert", "model_path": "gs://models/bert", "num_tpu_chips": 4},
     ),
     "pipeline-operator": ("pipeline-operator", {}),
+    "scheduled-workflow": (
+        "scheduled-workflow",
+        {"name": "nightly", "schedule": "30 2 * * *"},
+    ),
     "tensorboard": ("tensorboard", {"log_dir": "gs://bucket/logs"}),
     "application": ("application", {}),
     "bootstrapper": ("bootstrapper", {}),

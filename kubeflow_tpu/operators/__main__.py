@@ -18,6 +18,7 @@ def make_all_controllers(client):
     from kubeflow_tpu.operators.notebooks import NotebookController
     from kubeflow_tpu.operators.pipelines import (
         ApplicationController,
+        ScheduledWorkflowController,
         WorkflowController,
     )
     from kubeflow_tpu.operators.profiles import ProfileController
@@ -30,6 +31,7 @@ def make_all_controllers(client):
         StudyJobController(client),
         BenchmarkJobController(client),
         WorkflowController(client),
+        ScheduledWorkflowController(client),
         ApplicationController(client),
     ]
 

@@ -11,12 +11,14 @@ from kubeflow_tpu.runtime import controller_main
 def main(argv=None) -> int:
     from kubeflow_tpu.operators.pipelines import (
         ApplicationController,
+        ScheduledWorkflowController,
         WorkflowController,
     )
 
     return controller_main(
         argv,
         lambda client: [WorkflowController(client),
+                        ScheduledWorkflowController(client),
                         ApplicationController(client)],
         "kubeflow-tpu pipeline (workflow DAG + application) controller",
     )

@@ -15,6 +15,7 @@ readiness of everything matching the Application's selector into one status.
 from __future__ import annotations
 
 import copy
+import datetime
 
 from kubeflow_tpu.apis import jobs as jobs_api
 from kubeflow_tpu.apis.pipelines import (
@@ -24,15 +25,32 @@ from kubeflow_tpu.apis.pipelines import (
     PHASE_RUNNING,
     PHASE_SUCCEEDED,
     PIPELINES_API_VERSION,
+    SCHEDULED_WORKFLOW_KIND,
     WORKFLOW_KIND,
     toposort_tasks,
 )
 from kubeflow_tpu.k8s import objects as k8s
 from kubeflow_tpu.k8s.client import ApiError
 from kubeflow_tpu.operators.base import Controller
+from kubeflow_tpu.operators.runstore import RunStore, SCHEDULE_LABEL
+from kubeflow_tpu.utils.cron import CronSchedule
 
 LABEL_WORKFLOW = "kubeflow-tpu.org/workflow"
 LABEL_TASK = "kubeflow-tpu.org/workflow-task"
+
+
+def _utcnow() -> datetime.datetime:
+    return datetime.datetime.now(datetime.timezone.utc)
+
+
+def _stamp(dt: datetime.datetime) -> str:
+    return dt.strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+def _parse_stamp(ts: str) -> datetime.datetime:
+    return datetime.datetime.fromisoformat(
+        ts.replace("Z", "+00:00")
+    )
 
 _TERMINAL = (PHASE_SUCCEEDED, PHASE_FAILED)
 
@@ -75,6 +93,13 @@ class WorkflowController(Controller):
     api_version = PIPELINES_API_VERSION
     kind = WORKFLOW_KIND
     resync_seconds = 5.0
+    # Run-record retention for Workflows with no owning schedule.
+    adhoc_history_limit = 50
+
+    def __init__(self, client, now_fn=None):
+        super().__init__(client)
+        self.runs = RunStore(client)
+        self._now = now_fn or _utcnow
 
     def watched_kinds(self):
         # Tasks may create any kind; job CRs and Deployments cover the
@@ -91,6 +116,8 @@ class WorkflowController(Controller):
         before = copy.deepcopy(wf.get("status", {}))
         status = wf.setdefault("status", {})
         if status.get("phase") in _TERMINAL:
+            # Heal the durable record if the original write was lost.
+            self.runs.ensure_recorded(wf)
             return
         tasks = wf["spec"]["tasks"]
         try:
@@ -101,6 +128,7 @@ class WorkflowController(Controller):
             return
 
         status.setdefault("phase", PHASE_RUNNING)
+        status.setdefault("startedAt", _stamp(self._now()))
         task_status = status.setdefault("tasks", {})
         for t in tasks:
             task_status.setdefault(
@@ -120,10 +148,13 @@ class WorkflowController(Controller):
             if not all(task_status[d]["phase"] == PHASE_SUCCEEDED
                        for d in deps):
                 continue  # stays Pending
-            if failed:
-                continue  # stop launching new work once anything failed
             try:
-                live = self._ensure_resource(wf, t)
+                # Once something failed, stop LAUNCHING new work — but
+                # keep observing what's already in flight, or running
+                # tasks would never reach a terminal state and the
+                # workflow would hang in Running.
+                live = self._ensure_resource(wf, t,
+                                             create=not failed)
             except ApiError as e:
                 # Malformed task resource (bad kind, schema reject): fail
                 # the task visibly instead of log-and-retry forever.
@@ -132,7 +163,12 @@ class WorkflowController(Controller):
                               message=f"create failed: {e}")
                     continue
                 raise
+            if live is None:
+                continue  # not created (workflow already failing)
             phase, message = _resource_phase(live)
+            if phase == PHASE_FAILED and self._schedule_retry(wf, t, ts,
+                                                              live):
+                continue
             ts.update(phase=phase, message=message,
                       resourceName=live["metadata"]["name"],
                       resourceKind=live.get("kind", ""))
@@ -150,15 +186,69 @@ class WorkflowController(Controller):
         elif all(p == PHASE_SUCCEEDED for p in phases):
             status["phase"] = PHASE_SUCCEEDED
             status["message"] = f"{len(tasks)} tasks completed"
+        if status["phase"] in _TERMINAL and "finishedAt" not in status:
+            status["finishedAt"] = _stamp(self._now())
         # Only write on change: an unconditional PUT emits MODIFIED, which
         # requeues this object — a self-triggering hot loop under run().
         if status != before:
             self.client.update_status(wf)
+            # Durable run record (pipeline-persistenceagent role) —
+            # mirrors every status transition and survives CR deletion.
+            self.runs.record(wf)
+            if (status["phase"] in _TERMINAL
+                    and not wf["metadata"].get("labels", {}).get(
+                        SCHEDULE_LABEL)):
+                # Scheduled runs are pruned by their schedule's
+                # historyLimit; ad-hoc runs get a default retention so
+                # records can't accumulate without bound.
+                self.runs.prune_adhoc(wf["metadata"]["namespace"],
+                                      self.adhoc_history_limit)
+
+    def _schedule_retry(self, wf: dict, task: dict, ts: dict,
+                        live: dict) -> bool:
+        """Per-task retry with exponential backoff (argo retryStrategy
+        analogue): delete the failed resource once the backoff elapses so
+        the next reconcile recreates it. Returns True while a retry is
+        pending/armed (the task must not be marked Failed yet)."""
+        retries = int(task.get("retries", 0))
+        restarts = int(ts.get("restarts", 0))
+        if restarts >= retries:
+            return False
+        now = self._now()
+        next_at = ts.get("nextRetryAt")
+        if not next_at:
+            backoff = float(task.get("retryBackoffSeconds", 10.0))
+            backoff *= 2 ** restarts
+            ts.update(
+                phase=PHASE_RUNNING,
+                message=(f"failed; retry {restarts + 1}/{retries} in "
+                         f"{backoff:.0f}s"),
+                nextRetryAt=_stamp(
+                    now + datetime.timedelta(seconds=backoff)
+                ),
+            )
+            return True
+        if now < _parse_stamp(next_at):
+            return True  # backoff still running
+        try:
+            self.client.delete(
+                live.get("apiVersion", "v1"), live.get("kind", ""),
+                live["metadata"]["name"], live["metadata"]["namespace"],
+            )
+        except ApiError as e:
+            if e.code != 404:
+                raise
+        ts.pop("nextRetryAt", None)
+        ts.update(phase=PHASE_PENDING, restarts=restarts + 1,
+                  message=f"retry {restarts + 1}/{retries} launching")
+        return True
 
     # ------------------------------------------------------------------
 
-    def _ensure_resource(self, wf: dict, task: dict) -> dict:
-        """Create the task's object if absent; return the live object."""
+    def _ensure_resource(self, wf: dict, task: dict,
+                         create: bool = True) -> dict | None:
+        """Create the task's object if absent; return the live object.
+        ``create=False`` observes only (None when nothing exists)."""
         ns = wf["metadata"]["namespace"]
         resource = copy.deepcopy(task["resource"])
         meta = resource.setdefault("metadata", {})
@@ -172,7 +262,7 @@ class WorkflowController(Controller):
             resource.get("apiVersion", "v1"), resource.get("kind", ""),
             meta["name"], meta["namespace"],
         )
-        if live is not None:
+        if live is not None or not create:
             return live
         try:
             return self.client.create(resource)
@@ -183,6 +273,149 @@ class WorkflowController(Controller):
                     resource.get("kind", ""), meta["name"], meta["namespace"],
                 )
             raise
+
+
+class ScheduledWorkflowController(Controller):
+    """Cron-triggered Workflow stamping — the pipeline-scheduledworkflow
+    controller analogue (/root/reference/kubeflow/pipeline/
+    pipeline-scheduledworkflow.libsonnet:1-60). Each fire time creates one
+    Workflow from ``spec.workflowSpec`` (skipped, not queued, while
+    ``maxConcurrency`` runs are in flight); completed stamped Workflows
+    and their run records are pruned to ``spec.historyLimit``."""
+
+    api_version = PIPELINES_API_VERSION
+    kind = SCHEDULED_WORKFLOW_KIND
+    resync_seconds = 5.0
+
+    def __init__(self, client, now_fn=None):
+        super().__init__(client)
+        self.runs = RunStore(client)
+        self._now = now_fn or _utcnow
+
+    def reconcile(self, swf: dict) -> None:
+        swf = copy.deepcopy(swf)
+        before = copy.deepcopy(swf.get("status", {}))
+        status = swf.setdefault("status", {})
+        spec = swf["spec"]
+        name = swf["metadata"]["name"]
+        ns = swf["metadata"]["namespace"]
+
+        try:
+            schedule = CronSchedule.parse(spec["schedule"])
+        except ValueError as e:
+            status.update(conditions="Invalid", message=str(e))
+            if status != before:
+                self.client.update_status(swf)
+            return
+        if status.get("conditions") == "Invalid":
+            # The schedule was fixed; clear the stale condition.
+            status.pop("conditions", None)
+            status.pop("message", None)
+
+        # One stamped-Workflows LIST per reconcile, shared by the
+        # concurrency check and history pruning.
+        stamped = self._stamped(name, ns)
+        if not spec.get("suspend"):
+            self._fire_if_due(swf, schedule, status, stamped)
+
+        limit = int(spec.get("historyLimit", 10))
+        if limit:
+            self._prune_history(name, ns, limit, stamped)
+        if status != before:
+            self.client.update_status(swf)
+
+    # ------------------------------------------------------------------
+
+    def _stamped(self, name: str, ns: str) -> list[dict]:
+        return self.client.list(
+            PIPELINES_API_VERSION, WORKFLOW_KIND, ns,
+            label_selector={SCHEDULE_LABEL: name},
+        )
+
+    def _fire_if_due(self, swf: dict, schedule: CronSchedule,
+                     status: dict, stamped: list[dict]) -> None:
+        name = swf["metadata"]["name"]
+        ns = swf["metadata"]["namespace"]
+        now = self._now()
+        last_s = status.get("lastScheduleTime")
+        if last_s:
+            # Strictly after the last consumed fire time.
+            due = schedule.next_fire(_parse_stamp(last_s))
+        else:
+            # First fire: eligibility starts when THIS controller first
+            # observed the schedule (recorded in status, measured on our
+            # own clock — apiserver clock skew can neither suspend the
+            # schedule nor backfill pre-observation fires). The anchor
+            # minute itself is eligible.
+            anchor_s = status.setdefault("observedTime", _stamp(now))
+            start = _parse_stamp(anchor_s).replace(second=0,
+                                                   microsecond=0)
+            due = (start if schedule.matches(start)
+                   else schedule.next_fire(start))
+        status["nextScheduleTime"] = _stamp(schedule.next_fire(now))
+        if due > now:
+            return
+        # Consume every elapsed fire time and stamp once for the latest —
+        # a controller outage must not replay each missed fire (CronJob
+        # catch-up semantics with an implicit deadline of one interval).
+        while True:
+            nxt = schedule.next_fire(due)
+            if nxt > now:
+                break
+            due = nxt
+        active = [
+            wf for wf in stamped
+            if wf.get("status", {}).get("phase") not in _TERMINAL
+        ]
+        # One fire per reconcile; the time is consumed either way —
+        # at-capacity fires are skipped, not queued.
+        status["lastScheduleTime"] = _stamp(due)
+        if len(active) >= int(swf["spec"].get("maxConcurrency", 1)):
+            status["runsSkipped"] = int(status.get("runsSkipped", 0)) + 1
+            status["message"] = (
+                f"fire at {_stamp(due)} skipped: {len(active)} runs active"
+            )
+            return
+        run_name = f"{name}-{due.strftime('%Y%m%d%H%M')}"
+        wf = {
+            "apiVersion": PIPELINES_API_VERSION,
+            "kind": WORKFLOW_KIND,
+            "metadata": {
+                "name": run_name,
+                "namespace": ns,
+                "labels": {SCHEDULE_LABEL: name},
+                "ownerReferences": [k8s.object_ref(swf)],
+            },
+            "spec": copy.deepcopy(swf["spec"]["workflowSpec"]),
+        }
+        try:
+            self.client.create(wf)
+        except ApiError as e:
+            if e.code != 409:  # already stamped for this fire time
+                raise
+        status["runsStarted"] = int(status.get("runsStarted", 0)) + 1
+        status["message"] = f"started {run_name}"
+
+    def _prune_history(self, name: str, ns: str, limit: int,
+                       stamped: list[dict]) -> None:
+        done = sorted(
+            (wf for wf in stamped
+             if wf.get("status", {}).get("phase") in _TERMINAL),
+            key=lambda wf: wf.get("status", {}).get("startedAt", ""),
+            reverse=True,
+        )
+        removed = 0
+        for wf in done[limit:]:
+            try:
+                self.client.delete(PIPELINES_API_VERSION, WORKFLOW_KIND,
+                                   wf["metadata"]["name"], ns)
+                removed += 1
+            except ApiError:
+                pass
+        # Records track stamped Workflows 1:1 — only touch the ConfigMap
+        # store when something was actually deleted, not every resync.
+        if removed:
+            self.runs.prune(ns, name, limit)
 
 
 class ApplicationController(Controller):

@@ -1,0 +1,116 @@
+"""Minimal 5-field cron schedule parser.
+
+The ScheduledWorkflow controller's trigger clock — the role the reference
+delegates to the scheduledworkflow controller's cron library
+(/root/reference/kubeflow/pipeline/pipeline-scheduledworkflow.libsonnet).
+Standard syntax: ``minute hour day-of-month month day-of-week`` with ``*``,
+lists (``1,15``), ranges (``1-5``), and steps (``*/10``, ``8-18/2``).
+Day-of-month and day-of-week combine with OR when both are restricted
+(POSIX crontab semantics).
+"""
+
+from __future__ import annotations
+
+import datetime
+from dataclasses import dataclass
+
+_BOUNDS = ((0, 59), (0, 23), (1, 31), (1, 12), (0, 7))
+_NAMES = ("minute", "hour", "day-of-month", "month", "day-of-week")
+
+
+def _parse_field(text: str, lo: int, hi: int, name: str) -> frozenset[int]:
+    values: set[int] = set()
+    for part in text.split(","):
+        step = 1
+        if "/" in part:
+            part, _, step_s = part.partition("/")
+            try:
+                step = int(step_s)
+            except ValueError:
+                raise ValueError(f"bad step in {name}: {step_s!r}") from None
+            if step < 1:
+                raise ValueError(f"step must be >=1 in {name}")
+        if part == "*":
+            start, end = lo, hi
+        elif "-" in part:
+            a, _, b = part.partition("-")
+            try:
+                start, end = int(a), int(b)
+            except ValueError:
+                raise ValueError(f"bad range in {name}: {part!r}") from None
+        else:
+            try:
+                start = end = int(part)
+            except ValueError:
+                raise ValueError(f"bad value in {name}: {part!r}") from None
+        if not (lo <= start <= hi and lo <= end <= hi and start <= end):
+            raise ValueError(
+                f"{name} value out of range [{lo},{hi}]: {part!r}"
+            )
+        values.update(range(start, end + 1, step))
+    return frozenset(values)
+
+
+@dataclass(frozen=True)
+class CronSchedule:
+    minutes: frozenset[int]
+    hours: frozenset[int]
+    days: frozenset[int]
+    months: frozenset[int]
+    weekdays: frozenset[int]
+    # POSIX: when both day fields are restricted, either may match.
+    dom_restricted: bool
+    dow_restricted: bool
+
+    @classmethod
+    def parse(cls, expr: str) -> "CronSchedule":
+        fields = expr.split()
+        if len(fields) != 5:
+            raise ValueError(
+                f"cron needs 5 fields (minute hour dom month dow), "
+                f"got {len(fields)}: {expr!r}"
+            )
+        parsed = [
+            _parse_field(f, lo, hi, name)
+            for f, (lo, hi), name in zip(fields, _BOUNDS, _NAMES)
+        ]
+        # Vixie cron accepts both 0 and 7 for Sunday.
+        parsed[4] = frozenset(0 if v == 7 else v for v in parsed[4])
+        return cls(*parsed, dom_restricted=fields[2] != "*",
+                   dow_restricted=fields[4] != "*")
+
+    def matches(self, dt: datetime.datetime) -> bool:
+        # cron weekday: 0=Sunday; datetime.weekday(): 0=Monday (see
+        # _day_matches for the conversion and the POSIX dom/dow OR rule).
+        return (dt.minute in self.minutes and dt.hour in self.hours
+                and dt.month in self.months and self._day_matches(dt))
+
+    def _day_matches(self, dt: datetime.datetime) -> bool:
+        dom_ok = dt.day in self.days
+        dow_ok = (dt.weekday() + 1) % 7 in self.weekdays
+        if self.dom_restricted and self.dow_restricted:
+            return dom_ok or dow_ok
+        return dom_ok and dow_ok
+
+    def next_fire(self, after: datetime.datetime) -> datetime.datetime:
+        """First matching minute strictly after ``after`` (seconds
+        truncated). Scans by day with direct hour/minute enumeration —
+        any valid schedule fires within 4 years (covers Feb 29)."""
+        start = after.replace(second=0, microsecond=0)
+        start += datetime.timedelta(minutes=1)
+        day = start.replace(hour=0, minute=0)
+        limit = after + datetime.timedelta(days=4 * 366)
+        while day <= limit:
+            if day.month not in self.months:
+                year = day.year + (day.month == 12)
+                day = day.replace(year=year, month=day.month % 12 + 1,
+                                  day=1)
+                continue
+            if self._day_matches(day):
+                for hour in sorted(self.hours):
+                    for minute in sorted(self.minutes):
+                        cand = day.replace(hour=hour, minute=minute)
+                        if cand >= start:
+                            return cand
+            day += datetime.timedelta(days=1)
+        raise ValueError("no matching time within 4 years")

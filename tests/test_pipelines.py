@@ -250,3 +250,225 @@ def test_application_aggregates_components(env):
     app = api.get(PIPELINES_API_VERSION, "Application", "kf", "kubeflow")
     assert app["status"]["assemblyPhase"] == "Succeeded"
     assert app["status"]["componentsReady"] == "2/2"
+
+
+# ---------------------------------------------------------------------------
+# Cron schedule parsing (ScheduledWorkflow's trigger clock)
+# ---------------------------------------------------------------------------
+
+
+def test_cron_schedule_parse_and_match():
+    import datetime
+
+    from kubeflow_tpu.utils.cron import CronSchedule
+
+    utc = datetime.timezone.utc
+    s = CronSchedule.parse("*/15 8-10 * * 1-5")
+    assert s.matches(datetime.datetime(2026, 7, 29, 8, 45, tzinfo=utc))
+    assert not s.matches(datetime.datetime(2026, 7, 29, 8, 46, tzinfo=utc))
+    assert not s.matches(datetime.datetime(2026, 8, 1, 8, 45, tzinfo=utc))
+
+    nightly = CronSchedule.parse("0 2 * * *")
+    nxt = nightly.next_fire(datetime.datetime(2026, 7, 29, 2, 0, tzinfo=utc))
+    assert nxt == datetime.datetime(2026, 7, 30, 2, 0, tzinfo=utc)
+
+    # POSIX dom/dow OR: fires on the 1st AND on Mondays.
+    both = CronSchedule.parse("0 0 1 * 1")
+    assert both.matches(datetime.datetime(2026, 6, 1, 0, 0, tzinfo=utc))
+    assert both.matches(datetime.datetime(2026, 6, 8, 0, 0, tzinfo=utc))
+    assert not both.matches(datetime.datetime(2026, 6, 9, 0, 0, tzinfo=utc))
+
+    # Vixie cron: 7 is Sunday too.
+    sunday = CronSchedule.parse("0 2 * * 7")
+    assert sunday.matches(datetime.datetime(2026, 6, 7, 2, 0, tzinfo=utc))
+
+    for bad in ("* * * *", "61 * * * *", "*/0 * * * *", "a * * * *"):
+        with pytest.raises(ValueError):
+            CronSchedule.parse(bad)
+
+
+# ---------------------------------------------------------------------------
+# ScheduledWorkflow + run history + retry
+# ---------------------------------------------------------------------------
+
+
+def make_scheduled(name="nightly", schedule="*/5 * * * *", **spec):
+    from kubeflow_tpu.apis.pipelines import PIPELINES_API_VERSION
+
+    return {
+        "apiVersion": PIPELINES_API_VERSION,
+        "kind": "ScheduledWorkflow",
+        "metadata": {"name": name, "namespace": "kubeflow",
+                     "creationTimestamp": "2026-01-01T00:00:00Z"},
+        "spec": {
+            "schedule": schedule,
+            "workflowSpec": {"tasks": [job_task("train")]},
+            **spec,
+        },
+    }
+
+
+@pytest.fixture()
+def sched_env(api):
+    import datetime
+
+    from kubeflow_tpu.apis.pipelines import scheduled_workflow_crd
+    from kubeflow_tpu.operators.pipelines import (
+        ScheduledWorkflowController,
+    )
+
+    api.apply(workflow_crd())
+    api.apply(scheduled_workflow_crd())
+    for crd in jobs_api.all_job_crds():
+        api.apply(crd)
+    # Start off-cycle (minute 1): creating AT a fire minute fires at once.
+    clock = {"now": datetime.datetime(2026, 1, 1, 0, 1,
+                                      tzinfo=datetime.timezone.utc)}
+    now_fn = lambda: clock["now"]  # noqa: E731
+    swc = ScheduledWorkflowController(api, now_fn=now_fn)
+    wfc = WorkflowController(api, now_fn=now_fn)
+
+    def advance(minutes):
+        import datetime as dt
+
+        clock["now"] += dt.timedelta(minutes=minutes)
+
+    return api, swc, wfc, advance
+
+
+def _complete_active_runs(api, wfc):
+    wfc.reconcile_all()
+    for wf in api.list(PIPELINES_API_VERSION, "Workflow"):
+        for ts in wf.get("status", {}).get("tasks", {}).values():
+            if ts.get("resourceName"):
+                set_job_state(api, ts["resourceName"], "Succeeded")
+    wfc.reconcile_all()
+
+
+def test_scheduled_workflow_stamps_and_history_survives_deletion(sched_env):
+    """VERDICT r2 next #3 done-criterion: a cron-triggered train workflow
+    produces run records queryable after the Workflow CRs are deleted."""
+    from kubeflow_tpu.operators.runstore import RunStore
+
+    api, swc, wfc, advance = sched_env
+    api.create(make_scheduled())
+    swc.reconcile_all()  # not due yet
+    assert api.list(PIPELINES_API_VERSION, "Workflow") == []
+
+    advance(5)
+    swc.reconcile_all()
+    runs = api.list(PIPELINES_API_VERSION, "Workflow")
+    assert len(runs) == 1
+    assert runs[0]["metadata"]["name"] == "nightly-202601010005"
+    _complete_active_runs(api, wfc)
+
+    advance(5)
+    swc.reconcile_all()
+    assert len(api.list(PIPELINES_API_VERSION, "Workflow")) == 2
+    _complete_active_runs(api, wfc)
+
+    swf = api.get(PIPELINES_API_VERSION, "ScheduledWorkflow", "nightly",
+                  "kubeflow")
+    assert swf["status"]["runsStarted"] == 2
+    assert swf["status"]["lastScheduleTime"] == "2026-01-01T00:10:00Z"
+
+    # Delete every Workflow CR: history remains queryable.
+    for wf in api.list(PIPELINES_API_VERSION, "Workflow"):
+        api.delete(PIPELINES_API_VERSION, "Workflow",
+                   wf["metadata"]["name"], "kubeflow")
+    records = RunStore(api).list_runs("kubeflow", schedule="nightly")
+    assert len(records) == 2
+    assert all(r["phase"] == "Succeeded" for r in records)
+    assert all(r["startedAt"] and r["finishedAt"] for r in records)
+
+
+def test_scheduled_workflow_max_concurrency_skips(sched_env):
+    api, swc, wfc, advance = sched_env
+    api.create(make_scheduled(maxConcurrency=1))
+    swc.reconcile_all()  # anchor the schedule's observation time
+    advance(5)
+    swc.reconcile_all()
+    wfc.reconcile_all()  # run 1 starts and stays Running
+    advance(5)
+    swc.reconcile_all()  # at capacity → skipped, not queued
+    assert len(api.list(PIPELINES_API_VERSION, "Workflow")) == 1
+    swf = api.get(PIPELINES_API_VERSION, "ScheduledWorkflow", "nightly",
+                  "kubeflow")
+    assert swf["status"]["runsSkipped"] == 1
+    assert swf["status"]["runsStarted"] == 1
+
+
+def test_scheduled_workflow_outage_fires_once(sched_env):
+    """Missed fire times during an outage collapse into one catch-up run
+    (CronJob semantics), not one run per missed interval."""
+    api, swc, wfc, advance = sched_env
+    api.create(make_scheduled())
+    swc.reconcile_all()  # anchor the schedule's observation time
+    advance(60)  # 12 missed fires
+    swc.reconcile_all()
+    assert len(api.list(PIPELINES_API_VERSION, "Workflow")) == 1
+    swf = api.get(PIPELINES_API_VERSION, "ScheduledWorkflow", "nightly",
+                  "kubeflow")
+    assert swf["status"]["lastScheduleTime"] == "2026-01-01T01:00:00Z"
+
+
+def test_scheduled_workflow_history_limit_prunes(sched_env):
+    from kubeflow_tpu.operators.runstore import RunStore
+
+    api, swc, wfc, advance = sched_env
+    api.create(make_scheduled(historyLimit=1))
+    swc.reconcile_all()  # anchor the schedule's observation time
+    for _ in range(3):
+        advance(5)
+        swc.reconcile_all()
+        _complete_active_runs(api, wfc)
+    swc.reconcile_all()  # prune pass
+    live = api.list(PIPELINES_API_VERSION, "Workflow")
+    assert len(live) == 1  # newest kept
+    assert len(RunStore(api).list_runs("kubeflow", schedule="nightly")) == 1
+
+
+def test_workflow_task_retry_with_backoff(env):
+    """A failing task resource is deleted and recreated up to `retries`
+    times (argo retryStrategy analogue); restarts are visible in status
+    and the workflow only fails once retries are exhausted."""
+    api, ctrl = env
+    task = job_task("train")
+    task["retries"] = 1
+    task["retryBackoffSeconds"] = 0
+    api.create(make_workflow([task]))
+    ctrl.reconcile_all()
+    set_job_state(api, "wf-train", "Failed")
+
+    ctrl.reconcile_all()  # arms the retry (backoff 0 → due immediately)
+    ctrl.reconcile_all()  # deletes the failed job
+    wf = api.get(PIPELINES_API_VERSION, "Workflow", "wf", "kubeflow")
+    assert wf["status"]["phase"] == "Running"
+    assert wf["status"]["tasks"]["train"]["restarts"] == 1
+    assert api.get_or_none(jobs_api.JOBS_API_VERSION, "JaxJob",
+                           "wf-train", "kubeflow") is None
+
+    ctrl.reconcile_all()  # recreates the job
+    assert api.get(jobs_api.JOBS_API_VERSION, "JaxJob", "wf-train",
+                   "kubeflow")
+    set_job_state(api, "wf-train", "Succeeded")
+    ctrl.reconcile_all()
+    wf = api.get(PIPELINES_API_VERSION, "Workflow", "wf", "kubeflow")
+    assert wf["status"]["phase"] == "Succeeded"
+    assert wf["status"]["tasks"]["train"]["restarts"] == 1
+
+
+def test_workflow_retry_exhaustion_fails(env):
+    api, ctrl = env
+    task = job_task("train")
+    task["retries"] = 1
+    task["retryBackoffSeconds"] = 0
+    api.create(make_workflow([task]))
+    ctrl.reconcile_all()
+    for _ in range(2):  # fail attempt 1 → retry → fail attempt 2
+        set_job_state(api, "wf-train", "Failed")
+        ctrl.reconcile_all()
+        ctrl.reconcile_all()
+        ctrl.reconcile_all()
+    wf = api.get(PIPELINES_API_VERSION, "Workflow", "wf", "kubeflow")
+    assert wf["status"]["phase"] == "Failed"
