@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import json
 import logging
+import random
 import socket
 import threading
 import time
@@ -51,14 +52,29 @@ _HOP_HEADERS = {
 class Route:
     name: str
     prefix: str
-    service: str  # host:port
+    service: str  # host:port (the primary backend)
     rewrite: str = "/"
+    # Traffic splitting (the seldon abtest/mab/canary surface,
+    # /root/reference/kubeflow/seldon/prototypes, core.libsonnet:305):
+    # weighted variants — each request is routed to one backend drawn by
+    # weight. Empty = all traffic to `service`.
+    backends: tuple = ()  # ((host:port, weight), ...)
+    # Shadow/mirror target: every request is also sent fire-and-forget to
+    # this backend; its response is discarded and its failures invisible.
+    shadow: str = ""
 
-    def target_for(self, path: str) -> str:
+    def pick_service(self, rng) -> str:
+        if not self.backends:
+            return self.service
+        services = [b[0] for b in self.backends]
+        weights = [b[1] for b in self.backends]
+        return rng.choices(services, weights=weights)[0]
+
+    def target_for(self, path: str, service: str | None = None) -> str:
         """Rewrite `path` (which startswith prefix) onto the backend."""
         rest = path[len(self.prefix):]
         base = self.rewrite if self.rewrite.endswith("/") else self.rewrite + "/"
-        return "http://" + self.service + base + rest.lstrip("/")
+        return "http://" + (service or self.service) + base + rest.lstrip("/")
 
 
 def routes_from_service(svc: dict) -> list[Route]:
@@ -78,13 +94,27 @@ def routes_from_service(svc: dict) -> list[Route]:
     routes = []
     for spec in specs or []:
         try:
+            backends = tuple(
+                (b["service"], float(b.get("weight", 1)))
+                for b in spec.get("backends", [])
+            )
+            if backends and any(w < 0 for _s, w in backends):
+                raise ValueError("negative backend weight")
+            if backends and not any(w > 0 for _s, w in backends):
+                raise ValueError("all backend weights zero")
+            service = spec.get("service") or (
+                backends[0][0] if backends else None
+            )
+            if not service:
+                raise KeyError("service")
             routes.append(Route(
                 name=spec["name"], prefix=spec["prefix"],
-                service=spec["service"], rewrite=spec.get("rewrite", "/"),
+                service=service, rewrite=spec.get("rewrite", "/"),
+                backends=backends, shadow=spec.get("shadow", ""),
             ))
-        except (KeyError, TypeError):
-            log.warning("incomplete route spec in %s",
-                        svc["metadata"].get("name"))
+        except (KeyError, TypeError, ValueError) as e:
+            log.warning("bad route spec in %s: %s",
+                        svc["metadata"].get("name"), e)
     return routes
 
 
@@ -97,7 +127,16 @@ class RouteTable:
 
     def set_routes(self, routes: list[Route]) -> None:
         with self._lock:
-            self._routes = sorted(routes, key=lambda r: -len(r.prefix))
+            # Longest prefix first; on equal prefixes a split/shadow route
+            # beats a plain one (a serving-route canary for a model must
+            # override the model Service's own direct route, not lose the
+            # tie to listing order), then name for determinism.
+            self._routes = sorted(
+                routes,
+                key=lambda r: (-len(r.prefix),
+                               0 if (r.backends or r.shadow) else 1,
+                               r.name),
+            )
 
     def refresh(self, client: K8sClient, namespace: str | None = None) -> int:
         routes = []
@@ -137,6 +176,7 @@ class Gateway:
         certfile: str = "",
         keyfile: str = "",
         upstream_timeout: float = 60.0,
+        rng=None,
     ):
         self.table = table
         self.port = port
@@ -149,9 +189,12 @@ class Gateway:
         # Secret; empty = plain HTTP (in-mesh or behind an LB).
         self.certfile = certfile
         self.keyfile = keyfile
+        # Weight-draw source for traffic splitting (seedable in tests).
+        self.rng = rng or random.Random()
         self.requests_total = 0
         self.errors_total = 0
         self.tunnels_total = 0
+        self.shadow_total = 0
         self._proxy: ThreadingHTTPServer | None = None
         self._admin: ThreadingHTTPServer | None = None
 
@@ -216,10 +259,10 @@ class Gateway:
                                          "login": "/login"}).encode(),
                     )
                     return
-                target = route.target_for(self.path)
+                service = route.pick_service(gw.rng)  # weighted variant
+                target = route.target_for(self.path, service)
                 # Re-point at the resolved backend address.
-                target = target.replace(route.service,
-                                        gw.resolve(route.service), 1)
+                target = target.replace(service, gw.resolve(service), 1)
                 parts = urllib.parse.urlsplit(target)
                 backend_path = parts.path + (
                     "?" + parts.query if parts.query else ""
@@ -252,6 +295,8 @@ class Gateway:
                     and k.lower() != "x-forwarded-prefix"
                 }
                 headers["X-Forwarded-Prefix"] = route.prefix
+                if route.shadow:
+                    self._mirror(route, path, body, dict(headers))
                 conn = HTTPConnection(host, port,
                                       timeout=gw.upstream_timeout)
                 try:
@@ -265,13 +310,39 @@ class Gateway:
                         self._respond(
                             502,
                             json.dumps(
-                                {"error": f"upstream {route.service}: {e}"}
+                                {"error": f"upstream {host}:{port}: {e}"}
                             ).encode(),
                         )
                         return
                     self._relay_response(resp)
                 finally:
                     conn.close()
+
+            def _mirror(self, route, path, body, headers):
+                """Fire-and-forget request mirror (seldon shadow/outlier
+                surface): the shadow backend sees live traffic, its
+                response is discarded, its failures never touch the
+                client."""
+                addr = gw.resolve(route.shadow)
+                host, _, port_s = addr.partition(":")
+                method = self.command
+                headers["X-Shadow"] = "true"
+
+                def send():
+                    gw.shadow_total += 1
+                    try:
+                        conn = HTTPConnection(
+                            host, int(port_s or 80),
+                            timeout=gw.upstream_timeout,
+                        )
+                        conn.request(method, path, body=body,
+                                     headers=headers)
+                        conn.getresponse().read()
+                        conn.close()
+                    except (OSError, ValueError):
+                        pass
+
+                threading.Thread(target=send, daemon=True).start()
 
             def _connect_upstream(self, conn):
                 """Connect with one retry — connect-phase only, so an
@@ -367,7 +438,7 @@ class Gateway:
                     self._respond(
                         502,
                         json.dumps(
-                            {"error": f"upstream {route.service}: {e}"}
+                            {"error": f"upstream {host}:{port}: {e}"}
                         ).encode(),
                     )
                     return
@@ -447,6 +518,8 @@ class Gateway:
                         f"gateway_errors_total {gw.errors_total}\n"
                         "# TYPE gateway_upgrade_tunnels_total counter\n"
                         f"gateway_upgrade_tunnels_total {gw.tunnels_total}\n"
+                        "# TYPE gateway_shadow_requests_total counter\n"
+                        f"gateway_shadow_requests_total {gw.shadow_total}\n"
                     ).encode()
                     ctype = "text/plain"
                 elif self.path in ("/healthz", "/readyz"):

@@ -147,17 +147,26 @@ def generate(name: str, params: Mapping[str, Any] | None = None) -> list[dict]:
 GATEWAY_ROUTE_ANNOTATION = "kubeflow-tpu.org/gateway-route"
 
 
-def gateway_route(name: str, prefix: str, service: str, rewrite: str = "/") -> dict:
+def gateway_route(name: str, prefix: str, service: str, rewrite: str = "/",
+                  backends: list | None = None, shadow: str = "") -> dict:
     """Gateway route annotation for a Service — the platform-wide analogue of
     the `getambassador.io/config` annotations the reference attaches to every
     web-app Service (kubeflow/common/ambassador.libsonnet route pattern). The
     gateway proxy discovers Services carrying this annotation and routes
-    `prefix` to them."""
+    `prefix` to them.
+
+    ``backends`` ([{service, weight}, ...]) splits traffic by weight
+    (A/B / canary); ``shadow`` mirrors every request fire-and-forget —
+    the seldon abtest/shadow prototype surface
+    (kubeflow/seldon/prototypes, core.libsonnet:305)."""
     import yaml
 
+    spec: dict = {"name": name, "prefix": prefix, "service": service,
+                  "rewrite": rewrite}
+    if backends:
+        spec["backends"] = backends
+    if shadow:
+        spec["shadow"] = shadow
     return {
-        GATEWAY_ROUTE_ANNOTATION: yaml.safe_dump(
-            {"name": name, "prefix": prefix, "service": service, "rewrite": rewrite},
-            sort_keys=True,
-        )
+        GATEWAY_ROUTE_ANNOTATION: yaml.safe_dump(spec, sort_keys=True)
     }

@@ -168,3 +168,58 @@ def batch_predict(
             },
         }
     ]
+
+
+@prototype(
+    "serving-route",
+    "Traffic-split route for model serving: weighted A/B or canary "
+    "variants plus an optional shadow mirror (the seldon "
+    "abtest/mab/shadow prototype surface, kubeflow/seldon/prototypes/"
+    "serve-ab-test.jsonnet, core.libsonnet:305)",
+    params=[
+        ParamSpec("name"),
+        ParamSpec("namespace", DEFAULT_NAMESPACE),
+        ParamSpec("prefix", None, "route prefix; default /models/<name>/"),
+        ParamSpec("primary_service", None,
+                  "host:port of the main variant; default <name>.<ns>:8500"),
+        ParamSpec("canary_service", "", "host:port of the B/canary variant"),
+        ParamSpec("canary_weight", 10,
+                  "percent of traffic to the canary (0-100)"),
+        ParamSpec("shadow_service", "",
+                  "host:port mirrored fire-and-forget"),
+    ],
+)
+def serving_route(
+    name: str,
+    namespace: str,
+    prefix: str | None,
+    primary_service: str | None,
+    canary_service: str,
+    canary_weight: int,
+    shadow_service: str,
+) -> list[dict]:
+    prefix = prefix or f"/models/{name}/"
+    primary = primary_service or f"{name}.{namespace}:{REST_PORT}"
+    if not 0 <= int(canary_weight) <= 100:
+        raise ValueError(f"canary_weight {canary_weight} not in [0, 100]")
+    backends = None
+    if canary_service:
+        backends = [
+            {"service": primary, "weight": 100 - int(canary_weight)},
+            {"service": canary_service, "weight": int(canary_weight)},
+        ]
+    route = gateway_route(
+        f"{name}-route", prefix, primary,
+        backends=backends, shadow=shadow_service or "",
+    )
+    # Selector-less carrier Service: exists only to hold the route
+    # annotation the gateway discovers (the variants are full Services of
+    # their own deployments).
+    return [
+        k8s.service(
+            f"{name}-route", namespace, selector={},
+            ports=[{"name": "http", "port": REST_PORT}],
+            labels={"app": f"{name}-route"},
+            annotations=route,
+        )
+    ]

@@ -48,6 +48,11 @@ SNAPSHOT_CASES: dict[str, tuple[str, dict]] = {
         {"name": "external", "image": "example/infer:1", "port": 8080},
     ),
     "nfs-volume": ("nfs-volume", {"server": "10.0.0.2"}),
+    "serving-route": (
+        "serving-route",
+        {"name": "bert", "canary_service": "bert-v2.kubeflow:8500",
+         "canary_weight": 10, "shadow_service": "bert-shadow.kubeflow:8500"},
+    ),
 }
 
 

@@ -452,3 +452,126 @@ def test_streaming_chunked_response_not_buffered(api):
         release.set()
         gw.stop()
         backend.shutdown()
+
+
+class _IdentityBackend:
+    """HTTP backend answering with its own name (+ records requests)."""
+
+    def __init__(self, name):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        self.name = name
+        self.requests = []
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _reply(self):
+                outer.requests.append({
+                    "path": self.path,
+                    "shadow": self.headers.get("X-Shadow", ""),
+                })
+                body = json.dumps({"variant": outer.name}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            do_GET = do_POST = _reply
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()  # release the listen socket too
+
+
+def test_weighted_traffic_split_through_gateway(api):
+    """VERDICT r2 next #4 done-criterion: 100 requests split ~90/10
+    between two model-server variants, from the rendered serving-route
+    prototype's annotation (seldon abtest surface)."""
+    import random
+
+    from kubeflow_tpu.manifests.core import generate
+
+    primary, canary = _IdentityBackend("primary"), _IdentityBackend("canary")
+    # The model's own tpu-serving Service carries a plain route at the
+    # SAME prefix — the canary serving-route must win the tie, or the
+    # split is silently dead.
+    for obj in generate("tpu-serving", {"name": "bert", "model_path": ""}):
+        api.apply(obj)
+    svc = generate("serving-route", {
+        "name": "bert", "canary_service": "bert-v2.kubeflow:8500",
+        "canary_weight": 10,
+    })[0]
+    api.apply(svc)
+    table = RouteTable()
+    assert table.refresh(api) == 2
+    assert table.match("/models/bert/x").backends  # split route wins
+
+    backends = {
+        "bert.kubeflow:8500": f"127.0.0.1:{primary.port}",
+        "bert-v2.kubeflow:8500": f"127.0.0.1:{canary.port}",
+    }
+    gw = Gateway(table, port=0, admin_port=0,
+                 resolve=lambda a: backends.get(a, a),
+                 rng=random.Random(7))
+    gw.start()
+    try:
+        base = f"http://127.0.0.1:{gw._proxy.server_address[1]}"
+        hits = {"primary": 0, "canary": 0}
+        for _ in range(100):
+            _, out, _ = http("GET", f"{base}/models/bert/v1/models")
+            hits[out["variant"]] += 1
+        assert hits["primary"] + hits["canary"] == 100
+        assert 80 <= hits["primary"] <= 97, hits
+        assert 3 <= hits["canary"] <= 20, hits
+    finally:
+        gw.stop()
+        primary.close()
+        canary.close()
+
+
+def test_shadow_mirror_through_gateway(api):
+    """Shadow traffic: the mirror backend sees every request (marked
+    X-Shadow) but the client only ever sees the primary's response; a
+    dead shadow is invisible to the client."""
+    import time
+
+    from kubeflow_tpu.gateway import Route
+
+    primary, shadow = _IdentityBackend("primary"), _IdentityBackend("shadow")
+    table = RouteTable()
+    table.set_routes([Route(
+        name="m", prefix="/m/",
+        service=f"127.0.0.1:{primary.port}",
+        shadow=f"127.0.0.1:{shadow.port}",
+    )])
+    gw = Gateway(table, port=0, admin_port=0)
+    gw.start()
+    try:
+        base = f"http://127.0.0.1:{gw._proxy.server_address[1]}"
+        _, out, _ = http("POST", f"{base}/m/predict", {"x": 1})
+        assert out["variant"] == "primary"
+        for _ in range(50):  # mirror is async
+            if shadow.requests:
+                break
+            time.sleep(0.05)
+        assert shadow.requests and shadow.requests[0]["shadow"] == "true"
+        assert primary.requests[0]["shadow"] == ""
+
+        # Dead shadow: the client path is unaffected.
+        shadow.close()
+        _, out, _ = http("POST", f"{base}/m/predict", {"x": 2})
+        assert out["variant"] == "primary"
+    finally:
+        gw.stop()
+        primary.close()
