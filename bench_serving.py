@@ -40,7 +40,7 @@ def main() -> int:
     import grpc
 
     from kubeflow_tpu.serving.engine import EngineConfig
-    from kubeflow_tpu.serving.grpc_server import client_stubs
+    from kubeflow_tpu.serving.grpc_server import client_stubs, stream_stub
     from kubeflow_tpu.serving.server import ModelServer
 
     on_tpu = jax.default_backend() == "tpu"
@@ -90,6 +90,22 @@ def main() -> int:
             with ThreadPoolExecutor(args.concurrency) as pool:
                 conc = sorted(pool.map(one, range(args.requests)))
             wall = time.perf_counter() - t0
+
+            # Streaming TTFT: time until the FIRST token record arrives
+            # over the server-stream — the continuous decoder emits it after
+            # prefill + one step, long before the full generation lands.
+            ttft = []
+            if args.generate:
+                do_stream = stream_stub(chan)
+                n = max(10, args.requests // 10)
+                for _ in range(n):
+                    t0 = time.perf_counter()
+                    stream = do_stream(model, instance)
+                    next(stream)
+                    ttft.append((time.perf_counter() - t0) * 1e3)
+                    for _rec in stream:
+                        pass
+                ttft.sort()
     finally:
         server.stop()
 
@@ -110,6 +126,7 @@ def main() -> int:
         result["decode_tokens_per_sec"] = round(
             args.max_new_tokens * args.requests / wall, 1
         )
+        result["ttft_p50_ms"] = round(percentile(ttft, 50), 2)
         result["config"] += f" gen{args.max_new_tokens}"
     print(json.dumps(result))
     return 0

@@ -22,7 +22,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from kubeflow_tpu.ops import rms_norm
-from kubeflow_tpu.ops.rotary import apply_rotary, rotary_frequencies
+from kubeflow_tpu.ops.rotary import rotary_frequencies
 from kubeflow_tpu.models.transformer import TransformerConfig, moe_ffn
 
 _NEG_INF = -1e30
@@ -180,3 +180,176 @@ def generate(params, prompt_tokens, prompt_lengths, cfg: TransformerConfig,
         jnp.arange(max_new_tokens),
     )
     return toks.T, last  # [B, max_new], [B, V]
+
+
+# ---------------------------------------------------------------------------
+# Continuous-batching primitives (serving/continuous.py drives these)
+# ---------------------------------------------------------------------------
+#
+# The lockstep ``generate`` above compiles prefill+decode into one call — the
+# right shape for offline batches, the wrong one for a server: every request
+# waits for the slowest peer. The continuous path splits the work into three
+# fixed-shape executables so the scheduler can retire/admit rows between
+# steps: ``prefill`` (per request), ``insert_row`` (copy a prefilled row into
+# the persistent state), and ``decode_step`` (one token for ALL slots).
+# Unlike ``generate``'s shared scalar ``pos``, rows here sit at *different*
+# sequence positions, so the cache write and attention mask are per-row.
+
+
+def _ragged_attention(x, layer, cfg, rope_bt, k_cache, v_cache, pos_b, valid):
+    """Single-token attention where row ``b`` writes cache slot ``pos_b[b]``
+    — the continuous-batching variant of :func:`_cached_attention` (rows at
+    heterogeneous positions). x: [B, 1, D]; pos_b: [B]; valid: [B, total]."""
+    b, s, _d = x.shape
+    hd = cfg.head_dim
+    cos, sin = rope_bt
+    q = (x @ layer["wq"].astype(cfg.dtype)).reshape(b, s, cfg.n_heads, hd)
+    k = (x @ layer["wk"].astype(cfg.dtype)).reshape(b, s, cfg.n_kv_heads, hd)
+    v = (x @ layer["wv"].astype(cfg.dtype)).reshape(b, s, cfg.n_kv_heads, hd)
+    q = _rope(q, cos, sin)
+    k = _rope(k, cos, sin)
+    rows = jnp.arange(b)
+    # Out-of-bounds pos_b (a retired row parked at total) is dropped by
+    # scatter semantics — retired rows write nowhere.
+    k_cache = k_cache.at[rows, pos_b].set(k[:, 0])
+    v_cache = v_cache.at[rows, pos_b].set(v[:, 0])
+    reps = cfg.n_heads // cfg.n_kv_heads
+    kk = jnp.repeat(k_cache, reps, axis=2)
+    vv = jnp.repeat(v_cache, reps, axis=2)
+    scores = jnp.einsum(
+        "bshd,bthd->bhst", q.astype(jnp.float32), kk.astype(jnp.float32)
+    ) * (hd ** -0.5)
+    scores = jnp.where(valid[:, None, None, :], scores, _NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+    out = jnp.einsum("bhst,bthd->bshd", p, vv).reshape(b, s, cfg.n_heads * hd)
+    return out @ layer["wo"].astype(cfg.dtype), k_cache, v_cache
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "total_len"))
+def prefill(params, prompt_tokens, prompt_lengths, cfg: TransformerConfig, *,
+            total_len: int):
+    """One request's prompt pass: tokens [B, T0] right-padded → (cache with
+    ``total_len`` slots, last-position logits [B, V]). Slots beyond the true
+    length hold pad junk; decode overwrites them before the mask admits them.
+    """
+    b, t0 = prompt_tokens.shape
+    cache = init_cache(cfg, b, total_len)
+    prompt_lengths = jnp.maximum(prompt_lengths, 1)
+    valid = jnp.arange(total_len)[None, :] < prompt_lengths[:, None]
+    positions = jnp.broadcast_to(jnp.arange(t0)[None], (b, t0))
+    logits, cache = forward_cached(
+        params, prompt_tokens, cfg, cache, 0, positions, valid,
+        token_valid=positions < prompt_lengths[:, None],
+    )
+    last = jnp.take_along_axis(
+        logits, (prompt_lengths - 1)[:, None, None], axis=1
+    )[:, 0]
+    return cache, last
+
+
+def init_decode_state(cfg: TransformerConfig, slots: int, total_len: int,
+                      seed: int = 0):
+    """Persistent server decode state: ``slots`` in-flight rows over a shared
+    fixed-shape KV cache. ``length`` is each row's next write slot (== tokens
+    held so far); inactive rows are parked with ``active`` False."""
+    return {
+        "cache": init_cache(cfg, slots, total_len),
+        "length": jnp.zeros((slots,), jnp.int32),
+        "remaining": jnp.zeros((slots,), jnp.int32),
+        "active": jnp.zeros((slots,), bool),
+        "temperature": jnp.zeros((slots,), jnp.float32),
+        "last_logits": jnp.zeros((slots, cfg.vocab_size), jnp.float32),
+        "key": jax.random.PRNGKey(seed),
+    }
+
+
+@functools.partial(jax.jit, donate_argnames=("state",))
+def insert_row(state, slot, row_cache, last_logits, length, remaining,
+               temperature):
+    """Copy a prefilled request (batch-1 ``prefill`` outputs) into row
+    ``slot`` of the persistent state. ``slot`` is traced — one executable
+    serves every slot index."""
+    k = lax.dynamic_update_slice(
+        state["cache"]["k"], row_cache["k"], (0, slot, 0, 0, 0)
+    )
+    v = lax.dynamic_update_slice(
+        state["cache"]["v"], row_cache["v"], (0, slot, 0, 0, 0)
+    )
+    return {
+        "cache": {"k": k, "v": v},
+        "length": state["length"].at[slot].set(length),
+        "remaining": state["remaining"].at[slot].set(remaining),
+        "active": state["active"].at[slot].set(remaining > 0),
+        "temperature": state["temperature"].at[slot].set(temperature),
+        "last_logits": state["last_logits"].at[slot].set(last_logits[0]),
+        "key": state["key"],
+    }
+
+
+@functools.partial(jax.jit, donate_argnames=("state",))
+def retire_row(state, slot):
+    """Host-initiated early stop (EOS): park the row so the next
+    ``decode_step`` neither samples nor writes for it."""
+    return {**state, "active": state["active"].at[slot].set(False)}
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "top_k"),
+                   donate_argnames=("state",))
+def decode_step(state, params, cfg: TransformerConfig, top_k: int = 0):
+    """One token for every active row: sample from each row's last logits,
+    run the [slots, 1] forward at per-row positions, refresh the state.
+    Returns (state, sampled token [slots], emitted mask [slots]) — the host
+    dispatches ``token[i]`` to request ``i`` wherever ``emitted[i]``."""
+    b = state["length"].shape[0]
+    total = state["cache"]["k"].shape[2]
+    emit = state["active"]
+    key, sub = jax.random.split(state["key"])
+    tok = sample_token(state["last_logits"], sub, state["temperature"], top_k)
+    p_b = state["length"]
+    cos_t, sin_t = rotary_frequencies(cfg.head_dim, total,
+                                      theta=cfg.rope_theta)
+    rope_bt = (cos_t[p_b[:, None]], sin_t[p_b[:, None]])
+    x = params["embed"]["kernel"].astype(cfg.dtype)[tok][:, None]
+    valid = jnp.arange(total)[None, :] <= p_b[:, None]
+
+    def layer_fn(x, layer_and_cache):
+        layer, k_cache, v_cache = layer_and_cache
+        h = rms_norm(x, layer["ln_attn"], eps=cfg.norm_eps)
+        attn, k_cache, v_cache = _ragged_attention(
+            h, layer["attn"], cfg, rope_bt, k_cache, v_cache, p_b, valid
+        )
+        x = x + attn
+        h = rms_norm(x, layer["ln_mlp"], eps=cfg.norm_eps)
+        if cfg.n_experts:
+            y, _aux = moe_ffn(h, layer["mlp"], cfg, token_valid=emit[:, None])
+            x = x + y
+        else:
+            gate = h @ layer["mlp"]["gate"].astype(cfg.dtype)
+            up = h @ layer["mlp"]["up"].astype(cfg.dtype)
+            x = x + (jax.nn.silu(gate) * up) @ layer["mlp"]["down"].astype(
+                cfg.dtype
+            )
+        return x, (k_cache, v_cache)
+
+    x, (k_new, v_new) = lax.scan(
+        layer_fn, x, (params["layers"], state["cache"]["k"],
+                      state["cache"]["v"])
+    )
+    x = rms_norm(x, params["final_norm"], eps=cfg.norm_eps)
+    head = (params["embed"]["kernel"].T if cfg.tie_embeddings
+            else params["lm_head"]["kernel"])
+    logits = (x @ head.astype(cfg.dtype)).astype(jnp.float32)[:, 0]
+    step_inc = emit.astype(jnp.int32)
+    length = p_b + step_inc
+    remaining = state["remaining"] - step_inc
+    new_state = {
+        "cache": {"k": k_new, "v": v_new},
+        "length": length,
+        "remaining": remaining,
+        "active": emit & (remaining > 0) & (length < total),
+        "temperature": state["temperature"],
+        "last_logits": jnp.where(emit[:, None], logits,
+                                 state["last_logits"]),
+        "key": key,
+    }
+    return new_state, tok, emit

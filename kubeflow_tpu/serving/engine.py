@@ -28,6 +28,14 @@ class EngineConfig:
     # decode length (instances request up to this many), optional top-k.
     max_new_tokens: int = 16
     top_k: int = 0
+    # Sampling this id ends a generation early (frees the decode slot);
+    # None disables (the synthetic test models have no EOS convention).
+    eos_id: int | None = None
+    # "continuous": per-request lengths decoupled, streamable (default).
+    # "lockstep": one compiled prefill+decode per batch — fewer dispatches,
+    # the right mode when host↔device RTT dominates (remote TPU tunnels)
+    # or for offline batch predict.
+    decode_mode: str = "continuous"
 
 
 class InferenceEngine:

@@ -9,6 +9,7 @@ schema) across both ports, no generated-stub toolchain in the serving image:
 
     service kubeflow.tpu.serving.PredictionService {
       rpc Predict (bytes json)          returns (bytes json);
+      rpc PredictStream (bytes json)    returns (stream bytes json);
       rpc GetModelMetadata (bytes json) returns (bytes json);
     }
 
@@ -83,6 +84,12 @@ class _Handler(grpc.GenericRpcHandler):
                 request_deserializer=bytes,
                 response_serializer=bytes,
             )
+        if method == f"/{SERVICE}/PredictStream":
+            return grpc.unary_stream_rpc_method_handler(
+                self._predict_stream,
+                request_deserializer=bytes,
+                response_serializer=bytes,
+            )
         if method == f"/{SERVICE}/GetModelMetadata":
             return grpc.unary_unary_rpc_method_handler(
                 self._metadata,
@@ -126,6 +133,30 @@ class _Handler(grpc.GenericRpcHandler):
             # :9000 traffic too.
             server.metrics.observe(time.perf_counter() - t0, error)
 
+    def _predict_stream(self, request: bytes, context):
+        """Server-streaming generation: one JSON message per token, then a
+        terminal ``{"done": true}`` record — the :9000 twin of the REST
+        chunked ``"stream": true`` predict."""
+        import time
+
+        server = self.model_server
+        t0 = time.perf_counter()
+        error = True
+        try:
+            body = self._parse(request, context)
+            name = body.get("model") or server.engine.cfg.model
+            try:
+                records = server.handle_predict_stream(name, body)
+            except KeyError as e:
+                context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+            except (ValueError, TimeoutError) as e:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            for rec in records:
+                yield _json_bytes(rec)
+            error = False
+        finally:
+            server.metrics.observe(time.perf_counter() - t0, error)
+
     def _metadata(self, request: bytes, context) -> bytes:
         server = self.model_server
         body = self._parse(request, context)
@@ -166,3 +197,21 @@ def client_stubs(channel: grpc.Channel):
         return json.loads(resp)
 
     return do_predict, do_metadata
+
+
+def stream_stub(channel: grpc.Channel):
+    """Returns a callable yielding decoded records from PredictStream."""
+    predict_stream = channel.unary_stream(
+        f"/{SERVICE}/PredictStream",
+        request_serializer=bytes,
+        response_deserializer=bytes,
+    )
+
+    def do_stream(model: str, instance: dict, timeout: float = 60.0):
+        for msg in predict_stream(
+            _json_bytes({"model": model, "instances": [instance]}),
+            timeout=timeout,
+        ):
+            yield json.loads(msg)
+
+    return do_stream

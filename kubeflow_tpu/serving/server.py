@@ -70,6 +70,31 @@ class ModelServer:
         self.grpc_port = grpc_port
         self._httpd: ThreadingHTTPServer | None = None
         self._grpc = None
+        # Generation rides the continuous-batching decoder (per-request
+        # lengths decoupled, tokens streamable); plain predicts keep the
+        # dynamic batcher. Lazily built: non-LM servers never pay for it.
+        self._decoder = None
+        self._decoder_lock = threading.Lock()
+
+    @property
+    def decoder(self):
+        if (self.engine.model.family != "transformer"
+                or self.engine.cfg.max_new_tokens <= 0
+                or self.engine.cfg.decode_mode != "continuous"):
+            return None
+        with self._decoder_lock:
+            if self._decoder is None:
+                from kubeflow_tpu.serving.continuous import ContinuousDecoder
+
+                self._decoder = ContinuousDecoder(
+                    self.engine.params, self.engine.model.config,
+                    slots=self.engine.cfg.batch_size,
+                    prefill_len=self.engine.cfg.max_seq_len,
+                    max_new_tokens=self.engine.cfg.max_new_tokens,
+                    top_k=self.engine.cfg.top_k,
+                    eos_id=self.engine.cfg.eos_id,
+                )
+            return self._decoder
 
     # ------------------------------------------------------------------
 
@@ -81,11 +106,80 @@ class ModelServer:
             raise ValueError("body must contain non-empty 'instances'")
         for inst in instances:
             self.engine.validate_instance(inst)
-        # Enqueue every instance first so the batcher can coalesce a
-        # multi-instance request into full batches, then collect.
-        pending = [self.batcher.submit_async(inst) for inst in instances]
-        preds = [self.batcher.collect(p) for p in pending]
+        # Generation requests go to the continuous decoder (per-request
+        # lengths are decoupled — a short request returns as soon as ITS
+        # tokens are done); plain predicts coalesce in the dynamic batcher.
+        handles = []
+        for inst in instances:
+            if inst.get("max_new_tokens") and self.decoder is not None:
+                handles.append(("gen", inst, self.decoder.submit(
+                    inst["tokens"], inst["max_new_tokens"],
+                    float(inst.get("temperature", 0.0)),
+                )))
+            else:
+                handles.append(("batch", inst,
+                                self.batcher.submit_async(inst)))
+        preds = []
+        for kind, inst, h in handles:
+            if kind == "gen":
+                preds.append(self._gen_prediction(inst, h.result()))
+            else:
+                preds.append(self.batcher.collect(h))
         return {"predictions": preds}
+
+    @staticmethod
+    def _gen_prediction(inst: dict, res: dict) -> dict:
+        """Shape a decoder result like the lockstep generate path did
+        (engine._generate_batch), so clients see one schema either way."""
+        import numpy as np
+
+        toks = res["tokens"]
+        pred = {
+            "next_token": int(toks[0]) if toks
+            else int(np.argmax(res["prefill_logits"])),
+            "tokens": toks,
+            "finish_reason": res["finish_reason"],
+        }
+        if not toks or inst.get("return_logits"):
+            pred["logits"] = res["prefill_logits"].tolist()
+        return pred
+
+    def handle_predict_stream(self, name: str, body: dict):
+        """Streaming generation: yields JSON-line dicts, one per token, then
+        a terminal ``{"done": true, ...}`` record. Exactly one instance per
+        stream (the chunked-HTTP / gRPC-stream unit is a single sequence)."""
+        if name != self.engine.cfg.model:
+            raise KeyError(f"model {name!r} not served")
+        instances = body.get("instances")
+        if not isinstance(instances, list) or len(instances) != 1:
+            raise ValueError("streaming needs exactly one instance")
+        inst = instances[0]
+        self.engine.validate_instance(inst)
+        if not inst.get("max_new_tokens"):
+            raise ValueError("streaming needs 'max_new_tokens' > 0")
+        if self.decoder is None:
+            raise ValueError("model does not support generation")
+        handle = self.decoder.submit(
+            inst["tokens"], inst["max_new_tokens"],
+            float(inst.get("temperature", 0.0)),
+        )
+
+        # Validation above runs eagerly (before the HTTP 200 goes out); only
+        # the token iteration is deferred.
+        def _records():
+            index = 0
+            for tok in handle.tokens():
+                yield {"token": tok, "index": index}
+                index += 1
+            res = handle.result()
+            yield {
+                "done": True,
+                "tokens": res["tokens"],
+                "finish_reason": res["finish_reason"],
+                "ttft_ms": round(1000 * (res["ttft_s"] or 0.0), 3),
+            }
+
+        return _records()
 
     def handle_metadata(self, name: str) -> dict:
         if name != self.engine.cfg.model:
@@ -120,8 +214,19 @@ class ModelServer:
                     code = 200 if server.engine.ready else 503
                     self._send(code, {"ready": server.engine.ready})
                 elif self.path == "/monitoring/prometheus/metrics":
-                    self._send(200, server.metrics.render(),
-                               content_type="text/plain")
+                    text = server.metrics.render()
+                    if server._decoder is not None:
+                        d = server._decoder.metrics()
+                        text += (
+                            "# TYPE serving_decode_steps_total counter\n"
+                            f"serving_decode_steps_total {d['decode_steps']}\n"
+                            "# TYPE serving_tokens_emitted_total counter\n"
+                            "serving_tokens_emitted_total "
+                            f"{d['tokens_emitted']}\n"
+                            "# TYPE serving_ttft_avg_seconds gauge\n"
+                            f"serving_ttft_avg_seconds {d['ttft_avg_s']:.6f}\n"
+                        )
+                    self._send(200, text, content_type="text/plain")
                 elif self.path.startswith("/v1/models/"):
                     name = self.path[len("/v1/models/"):]
                     try:
@@ -130,6 +235,38 @@ class ModelServer:
                         self._send(404, {"error": str(e)})
                 else:
                     self._send(404, {"error": f"no route {self.path}"})
+
+            # Chunked transfer-encoding requires HTTP/1.1 on the status
+            # line — the BaseHTTPRequestHandler default is HTTP/1.0, under
+            # which spec-compliant clients would read the chunk framing as
+            # payload.
+            protocol_version = "HTTP/1.1"
+
+            def _chunk(self, rec: dict) -> None:
+                data = (json.dumps(rec) + "\n").encode()
+                self.wfile.write(f"{len(data):x}\r\n".encode())
+                self.wfile.write(data + b"\r\n")
+                self.wfile.flush()
+
+            def _send_stream(self, records) -> None:
+                """Chunked transfer-encoding, one JSON line per record —
+                each token flushes to the client as it is sampled (the
+                gateway's streamed proxying passes chunks through). Once
+                the 200 goes out this owns the connection: a mid-stream
+                decoder failure becomes an error record + clean terminal
+                chunk, never a second status line."""
+                self.send_response(200)
+                self.send_header("Content-Type", "application/jsonlines")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                try:
+                    for rec in records:
+                        self._chunk(rec)
+                except Exception as e:
+                    self._chunk({"error": str(e), "done": True})
+                finally:
+                    self.wfile.write(b"0\r\n\r\n")
+                    self.wfile.flush()
 
             def do_POST(self):
                 t0 = time.perf_counter()
@@ -140,7 +277,12 @@ class ModelServer:
                     if self.path.startswith("/v1/models/") and \
                             self.path.endswith(":predict"):
                         name = self.path[len("/v1/models/"):-len(":predict")]
-                        self._send(200, server.handle_predict(name, body))
+                        if body.get("stream"):
+                            self._send_stream(
+                                server.handle_predict_stream(name, body)
+                            )
+                        else:
+                            self._send(200, server.handle_predict(name, body))
                     else:
                         error = True
                         self._send(404, {"error": f"no route {self.path}"})
@@ -192,3 +334,6 @@ class ModelServer:
         if self._grpc is not None:
             self._grpc.stop()
         self.batcher.stop()
+        with self._decoder_lock:
+            if self._decoder is not None:
+                self._decoder.stop()

@@ -64,10 +64,10 @@ def test_dynamic_batcher_coalesces():
 def test_rest_server_predict_metadata_health_metrics():
     server = ModelServer(
         EngineConfig(model="lm-test-tiny", batch_size=4, max_seq_len=32),
-        port=18500, batch_timeout_ms=2,
+        port=0, batch_timeout_ms=2,
     )
     server.start()
-    base = "http://127.0.0.1:18500"
+    base = f"http://127.0.0.1:{server.port}"
     try:
         def get(path):
             with urllib.request.urlopen(base + path) as r:
