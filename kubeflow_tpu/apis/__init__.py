@@ -1,3 +1,4 @@
+"""CRD type surfaces (group/version/kind + object builders) for the platform's APIs."""
 from kubeflow_tpu.apis import jobs
 
 __all__ = ["jobs"]

@@ -232,7 +232,9 @@ def validate_job(job: Mapping) -> None:
     spec = job.get("spec", {})
     replica_specs = spec.get("replicaSpecs", {})
     if not replica_specs:
-        raise JobValidationError(f"{kind} {job['metadata'].get('name')}: spec.replicaSpecs is empty")
+        raise JobValidationError(
+            f"{kind} {job['metadata'].get('name')}: spec.replicaSpecs is empty"
+        )
     allowed = REPLICA_TYPES[kind]
     for rt, rspec in replica_specs.items():
         if rt not in allowed:

@@ -57,7 +57,8 @@ def profile_crd() -> dict:
     )
 
 
-def profile(name: str, owner_name: str, owner_kind: str = "User", quota: dict | None = None) -> dict:
+def profile(name: str, owner_name: str, owner_kind: str = "User",
+            quota: dict | None = None) -> dict:
     spec: dict = {"owner": {"kind": owner_kind, "name": owner_name}}
     if quota:
         spec["resourceQuota"] = quota

@@ -161,11 +161,13 @@ class GcpTpuPlatform(NonePlatform):
             "bindings": [
                 {
                     "role": "roles/tpu.admin",
-                    "members": [f"serviceAccount:{kfdef.name}-admin@{kfdef.spec.project}.iam.gserviceaccount.com"],
+                    "members": [f"serviceAccount:{kfdef.name}-admin"
+                                f"@{kfdef.spec.project}.iam.gserviceaccount.com"],
                 },
                 {
                     "role": "roles/logging.logWriter",
-                    "members": [f"serviceAccount:{kfdef.name}-vm@{kfdef.spec.project}.iam.gserviceaccount.com"],
+                    "members": [f"serviceAccount:{kfdef.name}-vm"
+                                f"@{kfdef.spec.project}.iam.gserviceaccount.com"],
                 },
             ]
         }

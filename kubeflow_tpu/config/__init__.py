@@ -1,3 +1,4 @@
+"""KfDef configuration types and platform defaults (kfctl's config surface)."""
 from kubeflow_tpu.config.kfdef import KfDef, KfDefSpec, Param
 from kubeflow_tpu.config import defaults
 

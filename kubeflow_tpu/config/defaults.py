@@ -11,9 +11,7 @@ from kubeflow_tpu.config.kfdef import (
     ComponentConfig,
     KfDef,
     KfDefSpec,
-    PLATFORM_FAKE,
     PLATFORM_GCP_TPU,
-    PLATFORM_MINIKUBE,
     PLATFORM_NONE,
     TpuSpec,
 )

@@ -1,3 +1,4 @@
+"""Kubernetes object model, API clients, and the in-process fake apiserver."""
 from kubeflow_tpu.k8s import objects
 from kubeflow_tpu.k8s.client import ApiError, K8sClient
 from kubeflow_tpu.k8s.fake import FakeApiServer

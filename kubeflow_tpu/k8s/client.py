@@ -20,8 +20,8 @@ import json
 import logging
 import queue
 import threading
-from dataclasses import dataclass, field
-from typing import Any, Callable, Iterator, Mapping, Sequence
+from dataclasses import dataclass
+from typing import Any, Callable, Iterator, Mapping
 
 
 class ApiError(Exception):
@@ -146,7 +146,8 @@ class K8sClient:
     def update_status(self, obj: dict) -> dict:
         raise NotImplementedError
 
-    def patch(self, api_version: str, kind: str, name: str, patch: dict, namespace: str | None = None) -> dict:
+    def patch(self, api_version: str, kind: str, name: str, patch: dict,
+              namespace: str | None = None) -> dict:
         raise NotImplementedError
 
     def delete(self, api_version: str, kind: str, name: str, namespace: str | None = None) -> None:
@@ -177,7 +178,8 @@ class K8sClient:
         merged["metadata"]["resourceVersion"] = existing["metadata"].get("resourceVersion")
         return self.update(merged)
 
-    def get_or_none(self, api_version: str, kind: str, name: str, namespace: str | None = None) -> dict | None:
+    def get_or_none(self, api_version: str, kind: str, name: str,
+                    namespace: str | None = None) -> dict | None:
         try:
             return self.get(api_version, kind, name, namespace)
         except ApiError as e:
@@ -185,7 +187,8 @@ class K8sClient:
                 return None
             raise
 
-    def delete_if_exists(self, api_version: str, kind: str, name: str, namespace: str | None = None) -> bool:
+    def delete_if_exists(self, api_version: str, kind: str, name: str,
+                         namespace: str | None = None) -> bool:
         try:
             self.delete(api_version, kind, name, namespace)
             return True
@@ -292,7 +295,8 @@ class HttpK8sClient(K8sClient):
 
     # -- path building ---------------------------------------------------
 
-    def _path(self, api_version: str, kind: str, namespace: str | None, name: str | None = None) -> str:
+    def _path(self, api_version: str, kind: str, namespace: str | None,
+              name: str | None = None) -> str:
         plural = self._registry.plural(kind)
         parts = [_api_prefix(api_version)]
         if self._registry.namespaced(kind) and namespace:
@@ -302,7 +306,9 @@ class HttpK8sClient(K8sClient):
             parts.append(f"/{name}")
         return "".join(parts)
 
-    def _request(self, method: str, path: str, body: dict | None = None, params: dict | None = None, content_type: str = "application/json") -> dict:
+    def _request(self, method: str, path: str, body: dict | None = None,
+                 params: dict | None = None,
+                 content_type: str = "application/json") -> dict:
         url = self._cfg.host + path
         resp = self._session.request(
             method,
@@ -315,7 +321,9 @@ class HttpK8sClient(K8sClient):
         if resp.status_code >= 400:
             try:
                 status = resp.json()
-                raise ApiError(resp.status_code, status.get("reason", "Error"), status.get("message", resp.text))
+                raise ApiError(resp.status_code,
+                               status.get("reason", "Error"),
+                               status.get("message", resp.text))
             except ValueError:
                 raise ApiError(resp.status_code, "Error", resp.text)
         return resp.json() if resp.content else {}
@@ -333,7 +341,9 @@ class HttpK8sClient(K8sClient):
     def get(self, api_version: str, kind: str, name: str, namespace: str | None = None) -> dict:
         return self._request("GET", self._path(api_version, kind, namespace, name))
 
-    def list(self, api_version: str, kind: str, namespace: str | None = None, label_selector: Mapping[str, str] | None = None) -> list[dict]:
+    def list(self, api_version: str, kind: str,
+             namespace: str | None = None,
+             label_selector: Mapping[str, str] | None = None) -> list[dict]:
         params = {}
         if label_selector:
             params["labelSelector"] = ",".join(f"{k}={v}" for k, v in label_selector.items())
@@ -346,7 +356,12 @@ class HttpK8sClient(K8sClient):
 
     def update(self, obj: dict) -> dict:
         m = obj["metadata"]
-        updated = self._request("PUT", self._path(obj["apiVersion"], obj["kind"], m.get("namespace"), m["name"]), body=obj)
+        updated = self._request(
+            "PUT",
+            self._path(obj["apiVersion"], obj["kind"],
+                       m.get("namespace"), m["name"]),
+            body=obj,
+        )
         if obj["kind"] == "CustomResourceDefinition":
             self._registry.register_crd(obj)
         return updated
@@ -356,7 +371,8 @@ class HttpK8sClient(K8sClient):
         path = self._path(obj["apiVersion"], obj["kind"], m.get("namespace"), m["name"]) + "/status"
         return self._request("PUT", path, body=obj)
 
-    def patch(self, api_version: str, kind: str, name: str, patch: dict, namespace: str | None = None) -> dict:
+    def patch(self, api_version: str, kind: str, name: str, patch: dict,
+              namespace: str | None = None) -> dict:
         return self._request(
             "PATCH",
             self._path(api_version, kind, namespace, name),
@@ -389,7 +405,9 @@ class HttpK8sClient(K8sClient):
                 resp = self._session.get(url, params={"watch": "true"}, stream=True, timeout=3600)
                 holder["resp"] = resp
                 if resp.status_code >= 400:
-                    logging.warning("watch %s failed: HTTP %s %s", path, resp.status_code, resp.text[:200])
+                    logging.warning("watch %s failed: HTTP %s %s",
+                                    path, resp.status_code,
+                                    resp.text[:200])
                     return
                 for line in resp.iter_lines():
                     if not line:

@@ -60,7 +60,8 @@ class FakeApiServer(K8sClient):
     # internals
     # ------------------------------------------------------------------
 
-    def _key(self, api_version: str, kind: str, namespace: str | None, name: str) -> tuple[str, str, str, str]:
+    def _key(self, api_version: str, kind: str, namespace: str | None,
+             name: str) -> tuple[str, str, str, str]:
         ns = namespace or "" if self._registry.namespaced(kind) else ""
         return (api_version, kind, ns, name)
 
@@ -144,7 +145,9 @@ class FakeApiServer(K8sClient):
             key = self._key(obj["apiVersion"], obj["kind"], m.get("namespace"), m["name"])
             existing = self._store.get(key)
             if existing is None:
-                raise ApiError.not_found(f"{obj['kind']} {m.get('namespace', '')}/{m['name']} not found")
+                raise ApiError.not_found(
+                    f"{obj['kind']} {m.get('namespace', '')}/{m['name']}"
+                    " not found")
             sent_rv = m.get("resourceVersion")
             if sent_rv is not None and sent_rv != existing["metadata"]["resourceVersion"]:
                 raise ApiError.conflict(
@@ -175,7 +178,8 @@ class FakeApiServer(K8sClient):
     def update_status(self, obj: dict) -> dict:
         return self._update(obj, subresource="status")
 
-    def patch(self, api_version: str, kind: str, name: str, patch: dict, namespace: str | None = None) -> dict:
+    def patch(self, api_version: str, kind: str, name: str, patch: dict,
+              namespace: str | None = None) -> dict:
         with self._lock:
             current = self.get(api_version, kind, name, namespace)
             patched = merge_patch(current, patch)
@@ -185,7 +189,8 @@ class FakeApiServer(K8sClient):
             if "status" in patch:
                 with_status = self._update(patched, subresource="status")
                 if set(patch.keys()) - {"status"}:
-                    patched["metadata"]["resourceVersion"] = with_status["metadata"]["resourceVersion"]
+                    patched["metadata"]["resourceVersion"] = (
+                        with_status["metadata"]["resourceVersion"])
                     return self._update(patched, subresource=None)
                 return with_status
             return self._update(patched, subresource=None)

@@ -12,7 +12,7 @@ dict so callers can always reach in and set exotic fields directly.
 
 from __future__ import annotations
 
-from typing import Any, Iterable, Mapping, Sequence
+from typing import Any, Mapping, Sequence
 
 # ---------------------------------------------------------------------------
 # Small helpers
@@ -306,7 +306,9 @@ def namespace_obj(name: str, labels: Mapping[str, str] | None = None) -> dict:
     return {"apiVersion": "v1", "kind": "Namespace", "metadata": metadata(name, labels=labels)}
 
 
-def pvc(name: str, namespace: str, storage: str, access_modes: Sequence[str] = ("ReadWriteOnce",), storage_class: str | None = None) -> dict:
+def pvc(name: str, namespace: str, storage: str,
+        access_modes: Sequence[str] = ("ReadWriteOnce",),
+        storage_class: str | None = None) -> dict:
     return {
         "apiVersion": "v1",
         "kind": "PersistentVolumeClaim",
@@ -476,7 +478,8 @@ def pvc_volume(name: str, claim: str) -> dict:
     return {"name": name, "persistentVolumeClaim": {"claimName": claim}}
 
 
-def volume_mount(name: str, mount_path: str, read_only: bool | None = None, sub_path: str | None = None) -> dict:
+def volume_mount(name: str, mount_path: str, read_only: bool | None = None,
+                 sub_path: str | None = None) -> dict:
     return _clean(
         {"name": name, "mountPath": mount_path, "readOnly": read_only, "subPath": sub_path}
     )

@@ -1,3 +1,4 @@
+"""Typed manifest layer: prototypes, packages, overlays (the ksonnet analogue)."""
 from kubeflow_tpu.manifests.core import (
     ParamSpec,
     Prototype,

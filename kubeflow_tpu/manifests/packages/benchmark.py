@@ -40,7 +40,8 @@ def benchmark_operator(namespace: str, image: str) -> list[dict]:
                     ["jaxjobs", "jaxjobs/status", "tfjobs", "pytorchjobs", "mpijobs"],
                     ["*"],
                 ),
-                k8s.policy_rule([""], ["pods", "pods/log", "events"], ["get", "list", "watch", "create", "patch"]),
+                k8s.policy_rule([""], ["pods", "pods/log", "events"],
+                                ["get", "list", "watch", "create", "patch"]),
             ],
             labels,
         ),

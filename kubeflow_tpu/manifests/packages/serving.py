@@ -11,7 +11,7 @@ replaced by google.com/tpu.
 
 from __future__ import annotations
 
-from kubeflow_tpu.apis.jobs import TPU_RESOURCE, tpu_resources
+from kubeflow_tpu.apis.jobs import tpu_resources
 from kubeflow_tpu.k8s import objects as k8s
 from kubeflow_tpu.manifests import images
 from kubeflow_tpu.manifests.core import ParamSpec, gateway_route, prototype

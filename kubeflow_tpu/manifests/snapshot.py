@@ -63,7 +63,9 @@ def render_case(case_name: str) -> str:
 
 
 def golden_dir() -> str:
-    return os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(__file__))), "tests", "golden")
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(__file__)))
+    return os.path.join(repo_root, "tests", "golden")
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -9,7 +9,6 @@ batch sharding.
 
 from __future__ import annotations
 
-import functools
 from dataclasses import dataclass, replace
 from typing import Sequence
 

@@ -20,7 +20,7 @@ query flash attention, SwiGLU MLP — written TPU-first:
 from __future__ import annotations
 
 import functools
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass, replace
 
 import jax
 import jax.numpy as jnp

@@ -12,7 +12,6 @@ from __future__ import annotations
 from typing import Iterator
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding
 

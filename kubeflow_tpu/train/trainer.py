@@ -10,7 +10,6 @@ parameters and optimizer slots update in place in HBM.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
 from typing import Any
 
 import chex

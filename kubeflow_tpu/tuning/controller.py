@@ -11,7 +11,6 @@ asking the suggestion algorithm for the next assignments.
 from __future__ import annotations
 
 import copy
-import json
 import re
 
 from kubeflow_tpu.apis.jobs import JOBS_API_VERSION

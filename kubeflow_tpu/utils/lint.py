@@ -1,0 +1,203 @@
+"""Static analysis gate — the platform's own lint engine.
+
+The reference gates every PR with flake8 + boilerplate checks
+(testing/test_flake8.py, scripts/check_boilerplate-style gates); this image
+ships no linter and the platform must not depend on one being installed, so
+the gate is implemented here on the stdlib ``ast``/``tokenize`` machinery.
+``tests/test_lint.py`` runs it over the whole repo; ``python -m
+kubeflow_tpu.utils.lint [paths]`` runs it from the command line / CI
+workflow.
+
+Checks (each maps to a flake8 family):
+- E9  syntax errors (the file must parse)
+- E501 line too long (default 100, URLs in comments exempt)
+- W291/W293 trailing whitespace
+- W191 tabs in indentation
+- F401 unused imports (module scope; ``__init__.py`` re-exports and
+  ``# noqa`` lines exempt)
+- E711 comparisons to None with ==/!=
+- E722 bare ``except:``
+- D100 missing module docstring (the boilerplate-check analogue: every
+  module must say what it is)
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+import tokenize
+from dataclasses import dataclass
+from pathlib import Path
+
+
+@dataclass(frozen=True)
+class Violation:
+    path: str
+    line: int
+    code: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+MAX_LINE = 100
+
+
+def _noqa_lines(source: str) -> set[int]:
+    out = set()
+    for i, line in enumerate(source.splitlines(), 1):
+        if "# noqa" in line:
+            out.add(i)
+    return out
+
+
+def _check_lines(path: str, source: str, noqa: set[int]) -> list[Violation]:
+    out = []
+    for i, line in enumerate(source.splitlines(), 1):
+        if i in noqa:
+            continue
+        stripped = line.rstrip("\n")
+        if len(stripped) > MAX_LINE and "http" not in stripped:
+            out.append(Violation(path, i, "E501",
+                                 f"line too long ({len(stripped)} > "
+                                 f"{MAX_LINE})"))
+        if stripped != stripped.rstrip():
+            out.append(Violation(path, i, "W291", "trailing whitespace"))
+        indent = stripped[: len(stripped) - len(stripped.lstrip())]
+        if "\t" in indent:
+            out.append(Violation(path, i, "W191", "tab in indentation"))
+    return out
+
+
+class _ImportTracker(ast.NodeVisitor):
+    """Module-scope import bindings vs names used anywhere in the file."""
+
+    def __init__(self) -> None:
+        self.imports: dict[str, tuple[int, str]] = {}  # binding -> (line, desc)
+        self.used: set[str] = set()
+        self._depth = 0
+
+    def visit_Import(self, node: ast.Import) -> None:
+        if self._depth == 0:
+            for alias in node.names:
+                name = alias.asname or alias.name.split(".")[0]
+                self.imports[name] = (node.lineno, alias.name)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module == "__future__":  # compiler directive, never "used"
+            return
+        if self._depth == 0:
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                name = alias.asname or alias.name
+                self.imports[name] = (node.lineno, alias.name)
+
+    def _scoped(self, node) -> None:
+        self._depth += 1
+        self.generic_visit(node)
+        self._depth -= 1
+
+    visit_FunctionDef = _scoped
+    visit_AsyncFunctionDef = _scoped
+    visit_ClassDef = _scoped
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if isinstance(node.ctx, ast.Load):
+            self.used.add(node.id)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        self.generic_visit(node)
+
+
+def _check_ast(path: str, source: str, noqa: set[int]) -> list[Violation]:
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Violation(path, e.lineno or 0, "E999",
+                          f"syntax error: {e.msg}")]
+    out = []
+
+    if not (Path(path).name == "__init__.py" and not source.strip()):
+        doc = ast.get_docstring(tree)
+        if not doc:
+            out.append(Violation(path, 1, "D100",
+                                 "missing module docstring"))
+
+    is_init = Path(path).name == "__init__.py"
+    if not is_init:  # __init__ re-exports bind names for importers
+        tracker = _ImportTracker()
+        tracker.visit(tree)
+        # Names exported via __all__ strings count as used.
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name) and t.id == "__all__"
+                            for t in node.targets)):
+                for elt in ast.walk(node.value):
+                    if isinstance(elt, ast.Constant) and isinstance(
+                            elt.value, str):
+                        tracker.used.add(elt.value)
+        for name, (line, desc) in tracker.imports.items():
+            if name not in tracker.used and line not in noqa:
+                out.append(Violation(path, line, "F401",
+                                     f"'{desc}' imported but unused"))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Compare) and node.lineno not in noqa:
+            for op, comp in zip(node.ops, node.comparators):
+                if (isinstance(op, (ast.Eq, ast.NotEq))
+                        and isinstance(comp, ast.Constant)
+                        and comp.value is None):
+                    out.append(Violation(
+                        path, node.lineno, "E711",
+                        "comparison to None should be 'is None'"))
+        if (isinstance(node, ast.ExceptHandler) and node.type is None
+                and node.lineno not in noqa):
+            out.append(Violation(path, node.lineno, "E722",
+                                 "bare 'except:'"))
+    return out
+
+
+def lint_file(path: str | Path) -> list[Violation]:
+    path = Path(path)
+    try:
+        with tokenize.open(path) as f:
+            source = f.read()
+    except (OSError, UnicodeDecodeError, SyntaxError) as e:
+        return [Violation(str(path), 0, "E902", str(e))]
+    noqa = _noqa_lines(source)
+    return (_check_lines(str(path), source, noqa)
+            + _check_ast(str(path), source, noqa))
+
+
+EXCLUDE_DIRS = {".git", "__pycache__", ".pytest_cache", "build", "dist"}
+
+
+def lint_tree(*roots: str | Path) -> list[Violation]:
+    out = []
+    for root in roots:
+        root = Path(root)
+        if root.is_file():
+            out.extend(lint_file(root))
+            continue
+        for path in sorted(root.rglob("*.py")):
+            if any(part in EXCLUDE_DIRS for part in path.parts):
+                continue
+            out.extend(lint_file(path))
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    roots = args or ["."]
+    violations = lint_tree(*roots)
+    for v in violations:
+        print(v)
+    print(f"{len(violations)} violation(s)")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

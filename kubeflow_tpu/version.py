@@ -1,3 +1,4 @@
+"""Platform version and API-group constants."""
 __version__ = "0.1.0"
 
 # API group for all CRDs this platform owns (the analogue of kubeflow.org in

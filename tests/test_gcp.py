@@ -3,7 +3,6 @@ cluster/node-pool create with blocking wait, IAM bindings, k8s bootstrap +
 secrets) exercised end to end in dry-run with scripted gcloud output."""
 
 import json
-import os
 
 import pytest
 

@@ -4,7 +4,7 @@
 import pytest
 
 from kubeflow_tpu.config import defaults
-from kubeflow_tpu.config.kfdef import ComponentConfig, KfDef, KfDefSpec, TpuSpec
+from kubeflow_tpu.config.kfdef import ComponentConfig, KfDef, KfDefSpec
 
 
 def test_round_trip(tmp_path):
@@ -58,7 +58,8 @@ def test_component_params_preserved(tmp_path):
         "app",
         KfDefSpec(
             components=[
-                ComponentConfig("serve-bert", prototype="tpu-serving", params={"model_path": "gs://m"})
+                ComponentConfig("serve-bert", prototype="tpu-serving",
+                                params={"model_path": "gs://m"})
             ]
         ),
     )

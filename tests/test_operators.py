@@ -10,7 +10,6 @@ import pytest
 from kubeflow_tpu.apis import jobs as jobs_api
 from kubeflow_tpu.apis.notebooks import notebook, notebook_crd
 from kubeflow_tpu.apis.profiles import profile, profile_crd
-from kubeflow_tpu.k8s import objects as k8s
 from kubeflow_tpu.operators.jobs import JobController
 from kubeflow_tpu.operators.notebooks import NotebookController
 from kubeflow_tpu.operators.profiles import ProfileController

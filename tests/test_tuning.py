@@ -1,9 +1,6 @@
 """Suggestion algorithms + study/benchmark controller tests (the
 katib_studyjob_test.py analogue, driven on the fake apiserver)."""
 
-import numpy as np
-import pytest
-
 from kubeflow_tpu.apis import jobs as jobs_api
 from kubeflow_tpu.apis.benchmark import benchmark_job, benchmark_job_crd
 from kubeflow_tpu.apis.tuning import (
