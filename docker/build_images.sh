@@ -1,0 +1,22 @@
+#!/bin/sh
+# Build every platform image, tagged with the names the manifest layer
+# renders (kubeflow_tpu/manifests/images.py) — the analogue of the
+# reference's per-component build_image.sh scripts
+# (components/tensorflow-notebook-image/build_image.sh).
+#
+# Usage: docker/build_images.sh [VERSION]
+set -e
+
+cd "$(dirname "$0")/.."
+VERSION="${1:-$(python -c 'from kubeflow_tpu.version import __version__; print(__version__)')}"
+
+docker build -f docker/platform/Dockerfile \
+    -t "ghcr.io/kubeflow-tpu/platform:${VERSION}" .
+docker build -f docker/serving/Dockerfile \
+    -t "ghcr.io/kubeflow-tpu/serving:${VERSION}" .
+docker build -f docker/jax-tpu/Dockerfile \
+    -t "ghcr.io/kubeflow-tpu/jax-tpu:0.9.0" .
+docker build -f docker/notebook/Dockerfile \
+    -t "ghcr.io/kubeflow-tpu/jax-notebook:0.9.0" .
+
+echo "built: platform serving jax-tpu jax-notebook (version ${VERSION})"

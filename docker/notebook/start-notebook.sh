@@ -1,0 +1,21 @@
+#!/bin/sh
+# Launch JupyterLab for the notebook CR. Mirrors
+# components/tensorflow-notebook-image/start-notebook.sh +
+# pvc-check.sh: make sure the mounted workspace is writable by the
+# notebook user before the server starts (a root-owned PVC otherwise
+# fails with an opaque 500 on first save).
+set -e
+
+WORKDIR="${NOTEBOOK_WORKDIR:-/home/jovyan}"
+if [ ! -w "$WORKDIR" ]; then
+    echo "notebook workspace $WORKDIR is not writable by $(id -u)" >&2
+    exit 1
+fi
+
+exec jupyter lab \
+    --ip=0.0.0.0 \
+    --port="${NOTEBOOK_PORT:-8888}" \
+    --notebook-dir="$WORKDIR" \
+    --no-browser \
+    --ServerApp.token="${NOTEBOOK_TOKEN:-}" \
+    "$@"

@@ -1,0 +1,1 @@
+"""Native (C++) runtime components; built by the Makefile here."""
