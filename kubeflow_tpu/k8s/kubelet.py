@@ -38,6 +38,7 @@ _ADDRESS_ENV = (
     "MEGASCALE_COORDINATOR_ADDRESS",
     "MASTER_ADDR",
     "DMLC_PS_ROOT_URI",
+    "CHAINERMN_MASTER_ADDR",
 )
 
 
