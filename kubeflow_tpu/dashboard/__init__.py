@@ -2,16 +2,21 @@
 
 The centraldashboard analogue (components/centraldashboard/app/server.ts +
 k8s_service.ts): aggregates component links (Services carrying gateway-route
-annotations), training jobs, notebooks, and studies into one landing page.
+annotations), training jobs, notebooks, and studies into one landing page,
+with the namespace selector and activity feed of the reference's SPA
+(components/centraldashboard/public/components/namespace-selector.js,
+dashboard-view.js) served as query-filtered HTML + JSON.
 """
 
 from __future__ import annotations
 
 import html
 from http.server import ThreadingHTTPServer
+from urllib.parse import parse_qs, urlsplit
 
 from kubeflow_tpu.apis.jobs import ALL_JOB_KINDS, JOBS_API_VERSION
 from kubeflow_tpu.apis.notebooks import NOTEBOOK_KIND, NOTEBOOKS_API_VERSION
+from kubeflow_tpu.apis.pipelines import PIPELINES_API_VERSION, WORKFLOW_KIND
 from kubeflow_tpu.apis.tuning import STUDY_JOB_KIND, TUNING_API_VERSION
 from kubeflow_tpu.gateway import routes_from_service
 from kubeflow_tpu.k8s.client import ApiError, K8sClient
@@ -23,6 +28,9 @@ _PAGE = """<!doctype html>
 <style>body{{font-family:sans-serif;margin:2rem}}table{{border-collapse:collapse}}
 td,th{{border:1px solid #ccc;padding:4px 10px}}</style></head>
 <body><h1>kubeflow-tpu</h1>
+<form method="get" action="/">Namespace:
+<select name="namespace" onchange="this.form.submit()">{ns_options}</select>
+<noscript><button type="submit">Go</button></noscript></form>
 <h2>Components</h2><ul>{components}</ul>
 <h2>Jobs</h2><table><tr><th>Kind</th><th>Name</th><th>Namespace</th>
 <th>State</th></tr>{jobs}</table>
@@ -32,6 +40,8 @@ td,th{{border:1px solid #ccc;padding:4px 10px}}</style></head>
 <th>Best</th></tr>{studies}</table>
 <h2>Pipeline runs</h2><table><tr><th>Workflow</th><th>Schedule</th>
 <th>Phase</th><th>Started</th><th>Finished</th></tr>{runs}</table>
+<h2>Activity</h2><table><tr><th>Time</th><th>Kind</th><th>Object</th>
+<th>Event</th><th>Message</th></tr>{activity}</table>
 </body></html>
 """
 
@@ -41,70 +51,139 @@ class Dashboard:
         self.client = client
         self.namespace = namespace
 
-    def _safe_list(self, api_version: str, kind: str) -> list[dict]:
+    def _safe_list(self, api_version: str, kind: str,
+                   namespace: str | None = None) -> list[dict]:
         try:
-            return self.client.list(api_version, kind, self.namespace)
+            return self.client.list(api_version, kind,
+                                    namespace or self.namespace)
         except ApiError:
             return []
 
-    def components(self) -> list[dict]:
+    def namespaces(self) -> list[str]:
+        """Names for the namespace selector (reference:
+        namespace-selector.js fed by /api/namespaces)."""
+        try:
+            return sorted(ns["metadata"]["name"]
+                          for ns in self.client.list("v1", "Namespace"))
+        except ApiError:
+            return []
+
+    def components(self, namespace: str | None = None) -> list[dict]:
         out = []
-        for svc in self._safe_list("v1", "Service"):
+        for svc in self._safe_list("v1", "Service", namespace):
             for route in routes_from_service(svc):
                 out.append({"name": route.name, "prefix": route.prefix,
                             "service": route.service})
         return out
 
-    def jobs(self) -> list[dict]:
-        out = []
-        for kind in ALL_JOB_KINDS:
-            for job in self._safe_list(JOBS_API_VERSION, kind):
-                out.append({
-                    "kind": kind,
-                    "name": job["metadata"]["name"],
-                    "namespace": job["metadata"]["namespace"],
-                    "state": job.get("status", {}).get("state", "Unknown"),
-                })
-        return out
+    def _raw_jobs(self, namespace: str | None = None
+                  ) -> list[tuple[str, dict]]:
+        return [
+            (kind, job)
+            for kind in ALL_JOB_KINDS
+            for job in self._safe_list(JOBS_API_VERSION, kind, namespace)
+        ]
 
-    def notebooks(self) -> list[dict]:
+    def jobs(self, namespace: str | None = None,
+             raw: list[tuple[str, dict]] | None = None) -> list[dict]:
+        return [{
+            "kind": kind,
+            "name": job["metadata"]["name"],
+            "namespace": job["metadata"]["namespace"],
+            "state": job.get("status", {}).get("state", "Unknown"),
+        } for kind, job in (raw if raw is not None
+                            else self._raw_jobs(namespace))]
+
+    def notebooks(self, namespace: str | None = None) -> list[dict]:
         return [{
             "name": nb["metadata"]["name"],
             "namespace": nb["metadata"]["namespace"],
             "state": nb.get("status", {}).get("state", "Unknown"),
-        } for nb in self._safe_list(NOTEBOOKS_API_VERSION, NOTEBOOK_KIND)]
+        } for nb in self._safe_list(NOTEBOOKS_API_VERSION, NOTEBOOK_KIND,
+                                    namespace)]
 
-    def studies(self) -> list[dict]:
+    def studies(self, namespace: str | None = None) -> list[dict]:
         return [{
             "name": s["metadata"]["name"],
             "namespace": s["metadata"]["namespace"],
             "state": s.get("status", {}).get("state", "Unknown"),
             "bestObjective": s.get("status", {}).get("bestObjective"),
-        } for s in self._safe_list(TUNING_API_VERSION, STUDY_JOB_KIND)]
+        } for s in self._safe_list(TUNING_API_VERSION, STUDY_JOB_KIND,
+                                   namespace)]
 
-    def runs(self) -> list[dict]:
+    def runs(self, namespace: str | None = None) -> list[dict]:
         """Workflow run history — outlives the Workflow CRs (RunStore,
         the pipeline-persistenceagent surface)."""
         try:
-            return RunStore(self.client).list_runs(self.namespace)
+            return RunStore(self.client).list_runs(
+                namespace or self.namespace)
         except ApiError:
             return []
 
-    def overview(self) -> dict:
+    def activity(self, namespace: str | None = None, limit: int = 50,
+                 raw_jobs: list[tuple[str, dict]] | None = None
+                 ) -> list[dict]:
+        """Recent state transitions harvested from object conditions —
+        the dashboard-view.js activity feed, without a separate event
+        store: every controller already timestamps its condition flips.
+        ``raw_jobs`` lets overview() share one apiserver sweep between the
+        job table and the feed."""
+        events = []
+        if raw_jobs is None:
+            raw_jobs = self._raw_jobs(namespace)
+        for kind, job in raw_jobs:
+            m = job["metadata"]
+            for cond in job.get("status", {}).get("conditions", []):
+                if cond.get("status") != "True":
+                    continue
+                events.append({
+                    "time": cond.get("lastTransitionTime", ""),
+                    "kind": kind,
+                    "name": m["name"],
+                    "namespace": m["namespace"],
+                    "event": cond.get("type", ""),
+                    "message": cond.get("message", ""),
+                })
+        for wf in self._safe_list(PIPELINES_API_VERSION, WORKFLOW_KIND,
+                                  namespace):
+            m = wf["metadata"]
+            status = wf.get("status", {})
+            if status.get("phase"):
+                events.append({
+                    "time": status.get("finishedAt")
+                    or status.get("startedAt", ""),
+                    "kind": WORKFLOW_KIND,
+                    "name": m["name"],
+                    "namespace": m["namespace"],
+                    "event": status["phase"],
+                    "message": status.get("message", ""),
+                })
+        events.sort(key=lambda e: e["time"], reverse=True)
+        return events[:limit]
+
+    def overview(self, namespace: str | None = None) -> dict:
+        raw_jobs = self._raw_jobs(namespace)
         return {
-            "components": self.components(),
-            "jobs": self.jobs(),
-            "notebooks": self.notebooks(),
-            "studies": self.studies(),
-            "runs": self.runs(),
+            "namespaces": self.namespaces(),
+            "components": self.components(namespace),
+            "jobs": self.jobs(raw=raw_jobs),
+            "notebooks": self.notebooks(namespace),
+            "studies": self.studies(namespace),
+            "runs": self.runs(namespace),
+            "activity": self.activity(namespace, raw_jobs=raw_jobs),
         }
 
-    def render_html(self) -> str:
-        ov = self.overview()
+    def render_html(self, namespace: str | None = None) -> str:
+        ov = self.overview(namespace)
 
         def esc(v) -> str:
             return html.escape(str(v))
 
+        ns_options = "<option value=\"\">all namespaces</option>" + "".join(
+            f"<option value=\"{esc(ns)}\""
+            f"{' selected' if ns == namespace else ''}>{esc(ns)}</option>"
+            for ns in ov["namespaces"]
+        )
         components = "".join(
             f"<li><a href=\"{esc(c['prefix'])}\">{esc(c['name'])}</a> "
             f"→ {esc(c['service'])}</li>" for c in ov["components"]
@@ -130,23 +209,35 @@ class Dashboard:
             f"</td><td>{esc(r.get('finishedAt', ''))}</td></tr>"
             for r in ov["runs"]
         )
-        return _PAGE.format(components=components, jobs=jobs,
-                            notebooks=notebooks, studies=studies,
-                            runs=runs)
+        activity = "".join(
+            f"<tr><td>{esc(e['time'])}</td><td>{esc(e['kind'])}</td>"
+            f"<td>{esc(e['namespace'])}/{esc(e['name'])}</td>"
+            f"<td>{esc(e['event'])}</td><td>{esc(e['message'])}</td></tr>"
+            for e in ov["activity"]
+        )
+        return _PAGE.format(ns_options=ns_options, components=components,
+                            jobs=jobs, notebooks=notebooks, studies=studies,
+                            runs=runs, activity=activity)
 
 
 def make_server(dash: Dashboard, port: int) -> ThreadingHTTPServer:
     class Handler(JsonHandler):
         def do_GET(self):
-            if self.path in ("/healthz", "/readyz"):
+            url = urlsplit(self.path)
+            ns = parse_qs(url.query).get("namespace", [None])[0] or None
+            if url.path in ("/healthz", "/readyz"):
                 self.send_json(200, {"status": "ok"})
-            elif self.path == "/api/overview":
-                self.send_json(200, dash.overview())
-            elif self.path == "/api/runs":
-                self.send_json(200, {"runs": dash.runs()})
-            elif self.path in ("/", "/index.html"):
-                self.send_html(200, dash.render_html())
+            elif url.path == "/api/overview":
+                self.send_json(200, dash.overview(ns))
+            elif url.path == "/api/runs":
+                self.send_json(200, {"runs": dash.runs(ns)})
+            elif url.path == "/api/activity":
+                self.send_json(200, {"activity": dash.activity(ns)})
+            elif url.path == "/api/namespaces":
+                self.send_json(200, {"namespaces": dash.namespaces()})
+            elif url.path in ("/", "/index.html"):
+                self.send_html(200, dash.render_html(ns))
             else:
-                self.send_json(404, {"error": f"no route {self.path}"})
+                self.send_json(404, {"error": f"no route {url.path}"})
 
     return ThreadingHTTPServer(("0.0.0.0", port), Handler)

@@ -9,6 +9,7 @@ import pytest
 
 from kubeflow_tpu.apis import jobs as jobs_api
 from kubeflow_tpu.apis.notebooks import notebook_crd
+from kubeflow_tpu.apis.pipelines import PIPELINES_API_VERSION, workflow_crd
 from kubeflow_tpu.apis.tuning import TUNING_API_VERSION, study_job_crd
 from kubeflow_tpu.dashboard import Dashboard, make_server as make_dash
 from kubeflow_tpu.webapps.study import StudyApp, make_server as make_study
@@ -58,6 +59,67 @@ def test_dashboard_overview_and_html(cluster):
         assert code == 200
         assert "train1" in page and "<h1>kubeflow-tpu</h1>" in page
         assert get(base, "/healthz")[0] == 200
+    finally:
+        httpd.shutdown()
+
+
+def test_dashboard_namespace_filter_and_activity(cluster):
+    """The namespace-selector + activity-feed surfaces
+    (centraldashboard namespace-selector.js / dashboard-view.js): the
+    JSON API and HTML filter by ?namespace=, and condition flips show up
+    as a time-ordered event feed."""
+    cluster.create({
+        "apiVersion": jobs_api.JOBS_API_VERSION, "kind": "JaxJob",
+        "metadata": {"name": "other-train", "namespace": "default"},
+        "spec": {"replicaSpecs": {}},
+        "status": {"state": "Succeeded", "conditions": [
+            {"type": "Created", "status": "True", "reason": "",
+             "message": "gang created",
+             "lastTransitionTime": "2026-07-30T10:00:00Z"},
+            {"type": "Succeeded", "status": "True", "reason": "",
+             "message": "all workers finished",
+             "lastTransitionTime": "2026-07-30T10:05:00Z"},
+        ]},
+    })
+    cluster.apply(workflow_crd())
+    cluster.create({
+        "apiVersion": PIPELINES_API_VERSION, "kind": "Workflow",
+        "metadata": {"name": "nightly", "namespace": "default"},
+        "spec": {"tasks": [{"name": "t", "resource": {
+            "apiVersion": "v1", "kind": "ConfigMap"}}]},
+        "status": {"phase": "Succeeded",
+                   "startedAt": "2026-07-30T10:06:00Z",
+                   "finishedAt": "2026-07-30T10:07:00Z"},
+    })
+    httpd = make_dash(Dashboard(cluster), 0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        # Unfiltered: both namespaces' jobs, namespaces listed.
+        _, ov = get(base, "/api/overview")
+        assert {j["name"] for j in ov["jobs"]} == {"train1", "other-train"}
+        assert {"kubeflow", "default"} <= set(ov["namespaces"])
+
+        # Filtered to default: only other-train, in API and HTML.
+        _, ov = get(base, "/api/overview?namespace=default")
+        assert [j["name"] for j in ov["jobs"]] == ["other-train"]
+        _, page = get(base, "/?namespace=default")
+        assert "other-train" in page and "train1" not in page
+
+        # Activity feed: newest first (the workflow finish), then the job
+        # conditions, filtered the same way.
+        _, act = get(base, "/api/activity?namespace=default")
+        events = act["activity"]
+        assert [e["event"] for e in events[:3]] == [
+            "Succeeded", "Succeeded", "Created"]
+        assert events[0]["kind"] == "Workflow"
+        assert events[1]["message"] == "all workers finished"
+        assert all(e["namespace"] == "default" for e in events)
+        _, act_all = get(base, "/api/activity")
+        assert len(act_all["activity"]) >= len(events)
+
+        _, ns = get(base, "/api/namespaces")
+        assert "default" in ns["namespaces"]
     finally:
         httpd.shutdown()
 
