@@ -120,6 +120,10 @@ def test_wheel_build_includes_native_package_data(tmp_path):
     kubeflow_tpu.native with the compiled .so."""
     import zipfile
 
+    # The recipes run `make -C kubeflow_tpu/native` before the wheel; a
+    # fresh checkout has no .so (gitignored), so mirror that stage here.
+    subprocess.run(["make", "-C", str(REPO / "kubeflow_tpu" / "native")],
+                   check=True, capture_output=True)
     r = subprocess.run(
         [sys.executable, "-m", "pip", "wheel", "--no-deps",
          "--no-build-isolation", "-w", str(tmp_path), str(REPO)],
