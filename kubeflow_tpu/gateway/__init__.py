@@ -59,6 +59,13 @@ class Route:
     # weighted variants — each request is routed to one backend drawn by
     # weight. Empty = all traffic to `service`.
     backends: tuple = ()  # ((host:port, weight), ...)
+    # "weighted": static draw by weight. "epsilon-greedy": the seldon
+    # multi-armed-bandit router (epsilon-greedy prototype) — explore a
+    # random variant with probability epsilon, otherwise exploit the
+    # best observed reward; rewards come from response status (5xx/
+    # connect-fail = 0) or the admin feedback endpoint.
+    strategy: str = "weighted"
+    epsilon: float = 0.1
     # Shadow/mirror target: every request is also sent fire-and-forget to
     # this backend; its response is discarded and its failures invisible.
     shadow: str = ""
@@ -73,8 +80,49 @@ class Route:
     def target_for(self, path: str, service: str | None = None) -> str:
         """Rewrite `path` (which startswith prefix) onto the backend."""
         rest = path[len(self.prefix):]
-        base = self.rewrite if self.rewrite.endswith("/") else self.rewrite + "/"
-        return "http://" + (service or self.service) + base + rest.lstrip("/")
+        base = (self.rewrite if self.rewrite.endswith("/")
+                else self.rewrite + "/")
+        return ("http://" + (service or self.service) + base
+                + rest.lstrip("/"))
+
+
+class BanditStats:
+    """Per-(route, backend) reward averages for epsilon-greedy routes."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._stats: dict[tuple[str, str], list[float]] = {}
+
+    def record(self, route: str, service: str, reward: float) -> None:
+        with self._lock:
+            cell = self._stats.setdefault((route, service), [0.0, 0])
+            cell[0] += reward
+            cell[1] += 1
+
+    def pick(self, route: Route, rng) -> str:
+        """Explore uniformly with prob epsilon; otherwise exploit the best
+        mean reward. Untried backends are optimistic (mean 1.0), so every
+        variant gets traffic before exploitation locks in."""
+        services = [b[0] for b in route.backends]
+        if rng.random() < route.epsilon:
+            return rng.choice(services)
+        with self._lock:
+            def mean(svc: str) -> float:
+                total, n = self._stats.get((route.name, svc), (0.0, 0))
+                return total / n if n else 1.0
+
+            best = max(mean(s) for s in services)
+            top = [s for s in services if mean(s) == best]
+        return rng.choice(top)
+
+    def snapshot(self, route_name: str) -> dict:
+        with self._lock:
+            return {
+                svc: {"reward_sum": round(total, 4), "trials": n,
+                      "mean": round(total / n, 4) if n else None}
+                for (rname, svc), (total, n) in self._stats.items()
+                if rname == route_name
+            }
 
 
 def routes_from_service(svc: dict) -> list[Route]:
@@ -107,10 +155,17 @@ def routes_from_service(svc: dict) -> list[Route]:
             )
             if not service:
                 raise KeyError("service")
+            strategy = spec.get("strategy", "weighted")
+            if strategy not in ("weighted", "epsilon-greedy"):
+                raise ValueError(f"unknown strategy {strategy!r}")
+            epsilon = float(spec.get("epsilon", 0.1))
+            if not 0.0 <= epsilon <= 1.0:
+                raise ValueError("epsilon must be in [0, 1]")
             routes.append(Route(
                 name=spec["name"], prefix=spec["prefix"],
                 service=service, rewrite=spec.get("rewrite", "/"),
-                backends=backends, shadow=spec.get("shadow", ""),
+                backends=backends, strategy=strategy, epsilon=epsilon,
+                shadow=spec.get("shadow", ""),
             ))
         except (KeyError, TypeError, ValueError) as e:
             log.warning("bad route spec in %s: %s",
@@ -154,7 +209,13 @@ class RouteTable:
 
     def snapshot(self) -> list[dict]:
         with self._lock:
-            return [vars(r) for r in self._routes]
+            # Copies, not the live __dict__ of the frozen Routes — callers
+            # (the admin handler) annotate these per request.
+            return [dict(vars(r)) for r in self._routes]
+
+    def find(self, name: str) -> Route | None:
+        with self._lock:
+            return next((r for r in self._routes if r.name == name), None)
 
 
 class Gateway:
@@ -191,6 +252,8 @@ class Gateway:
         self.keyfile = keyfile
         # Weight-draw source for traffic splitting (seedable in tests).
         self.rng = rng or random.Random()
+        # Reward averages for epsilon-greedy (bandit) routes.
+        self.bandit = BanditStats()
         self.requests_total = 0
         self.errors_total = 0
         self.tunnels_total = 0
@@ -259,7 +322,10 @@ class Gateway:
                                          "login": "/login"}).encode(),
                     )
                     return
-                service = route.pick_service(gw.rng)  # weighted variant
+                if route.strategy == "epsilon-greedy" and route.backends:
+                    service = gw.bandit.pick(route, gw.rng)
+                else:
+                    service = route.pick_service(gw.rng)  # weighted draw
                 target = route.target_for(self.path, service)
                 # Re-point at the resolved backend address.
                 target = target.replace(service, gw.resolve(service), 1)
@@ -272,7 +338,7 @@ class Gateway:
                                  backend_path)
                     return
                 self._proxy_http(route, parts.hostname, parts.port,
-                                 backend_path)
+                                 backend_path, service)
 
             def _is_upgrade(self) -> bool:
                 conn_tokens = [
@@ -284,7 +350,7 @@ class Gateway:
 
             # -- plain HTTP: streamed relay -----------------------------
 
-            def _proxy_http(self, route, host, port, path):
+            def _proxy_http(self, route, host, port, path, service=None):
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length) if length else None
                 # The forwarded prefix is gateway-asserted — a client-
@@ -297,6 +363,8 @@ class Gateway:
                 headers["X-Forwarded-Prefix"] = route.prefix
                 if route.shadow:
                     self._mirror(route, path, body, dict(headers))
+                bandit = (route.strategy == "epsilon-greedy"
+                          and service is not None)
                 conn = HTTPConnection(host, port,
                                       timeout=gw.upstream_timeout)
                 try:
@@ -306,6 +374,8 @@ class Gateway:
                                      headers=headers)
                         resp = conn.getresponse()
                     except OSError as e:
+                        if bandit:
+                            gw.bandit.record(route.name, service, 0.0)
                         gw.errors_total += 1
                         self._respond(
                             502,
@@ -314,6 +384,10 @@ class Gateway:
                             ).encode(),
                         )
                         return
+                    if bandit:
+                        # Implicit reward: server errors are failures.
+                        gw.bandit.record(route.name, service,
+                                         0.0 if resp.status >= 500 else 1.0)
                     self._relay_response(resp)
                 finally:
                     conn.close()
@@ -508,7 +582,11 @@ class Gateway:
 
             def do_GET(self):
                 if self.path == "/routes":
-                    body = json.dumps(gw.table.snapshot()).encode()
+                    routes = gw.table.snapshot()
+                    for r in routes:
+                        if r.get("strategy") == "epsilon-greedy":
+                            r["bandit"] = gw.bandit.snapshot(r["name"])
+                    body = json.dumps(routes).encode()
                     ctype = "application/json"
                 elif self.path == "/metrics":
                     body = (
@@ -530,6 +608,60 @@ class Gateway:
                     return
                 self.send_response(200)
                 self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                """POST /routes/<name>/feedback {"service", "reward"} —
+                the seldon /send-feedback analogue: callers grade a
+                variant's answer (0..1) after the fact, steering the
+                epsilon-greedy router beyond what status codes reveal."""
+                parts = self.path.strip("/").split("/")
+                if (len(parts) != 3 or parts[0] != "routes"
+                        or parts[2] != "feedback"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                route = gw.table.find(parts[1])
+                if route is None:
+                    body = json.dumps(
+                        {"error": f"no route {parts[1]!r}"}).encode()
+                    self.send_response(404)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(length))
+                    service = payload["service"]
+                    reward = float(payload["reward"])
+                    if not 0.0 <= reward <= 1.0:
+                        raise ValueError("reward must be in [0, 1]")
+                    # Only the route's real variants are gradeable — a
+                    # typo'd service must not 200-and-steer-nothing, and
+                    # validation bounds the stats table to routes×backends.
+                    variants = {b[0] for b in route.backends}
+                    if service not in variants:
+                        raise ValueError(
+                            f"service {service!r} is not a variant of "
+                            f"route {parts[1]!r}")
+                except (ValueError, KeyError, TypeError) as e:
+                    body = json.dumps({"error": str(e)}).encode()
+                    self.send_response(400)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                gw.bandit.record(parts[1], service, reward)
+                body = json.dumps(
+                    {"ok": True,
+                     "stats": gw.bandit.snapshot(parts[1])}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)

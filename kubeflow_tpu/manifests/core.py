@@ -148,7 +148,8 @@ GATEWAY_ROUTE_ANNOTATION = "kubeflow-tpu.org/gateway-route"
 
 
 def gateway_route(name: str, prefix: str, service: str, rewrite: str = "/",
-                  backends: list | None = None, shadow: str = "") -> dict:
+                  backends: list | None = None, shadow: str = "",
+                  strategy: str = "", epsilon: float | None = None) -> dict:
     """Gateway route annotation for a Service — the platform-wide analogue of
     the `getambassador.io/config` annotations the reference attaches to every
     web-app Service (kubeflow/common/ambassador.libsonnet route pattern). The
@@ -167,6 +168,10 @@ def gateway_route(name: str, prefix: str, service: str, rewrite: str = "/",
         spec["backends"] = backends
     if shadow:
         spec["shadow"] = shadow
+    if strategy:
+        spec["strategy"] = strategy
+    if epsilon is not None:
+        spec["epsilon"] = epsilon
     return {
         GATEWAY_ROUTE_ANNOTATION: yaml.safe_dump(spec, sort_keys=True)
     }

@@ -187,6 +187,11 @@ def batch_predict(
                   "percent of traffic to the canary (0-100)"),
         ParamSpec("shadow_service", "",
                   "host:port mirrored fire-and-forget"),
+        ParamSpec("strategy", "weighted",
+                  "weighted (static split) or epsilon-greedy "
+                  "(multi-armed bandit over the variants)"),
+        ParamSpec("epsilon", 0.1,
+                  "bandit exploration rate (epsilon-greedy only)"),
     ],
 )
 def serving_route(
@@ -197,11 +202,19 @@ def serving_route(
     canary_service: str,
     canary_weight: int,
     shadow_service: str,
+    strategy: str,
+    epsilon: float,
 ) -> list[dict]:
     prefix = prefix or f"/models/{name}/"
     primary = primary_service or f"{name}.{namespace}:{REST_PORT}"
     if not 0 <= int(canary_weight) <= 100:
         raise ValueError(f"canary_weight {canary_weight} not in [0, 100]")
+    if strategy not in ("weighted", "epsilon-greedy"):
+        raise ValueError(f"unknown strategy {strategy!r}")
+    if strategy == "epsilon-greedy" and not canary_service:
+        # One backend is nothing to explore — the gateway would silently
+        # fall back to plain routing while the user believes a bandit runs.
+        raise ValueError("epsilon-greedy needs a canary_service variant")
     backends = None
     if canary_service:
         backends = [
@@ -211,6 +224,8 @@ def serving_route(
     route = gateway_route(
         f"{name}-route", prefix, primary,
         backends=backends, shadow=shadow_service or "",
+        strategy=strategy if strategy != "weighted" else "",
+        epsilon=float(epsilon) if strategy == "epsilon-greedy" else None,
     )
     # Selector-less carrier Service: exists only to hold the route
     # annotation the gateway discovers (the variants are full Services of

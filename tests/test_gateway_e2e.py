@@ -540,6 +540,157 @@ def test_weighted_traffic_split_through_gateway(api):
         canary.close()
 
 
+class _FailingBackend:
+    """HTTP backend that always answers 500 (a broken model variant)."""
+
+    def __init__(self):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _reply(self):
+                body = b'{"error": "broken variant"}'
+                self.send_response(500)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            do_GET = do_POST = _reply
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_epsilon_greedy_bandit_routes_around_failures(api):
+    """The seldon multi-armed-bandit surface: an epsilon-greedy route
+    learns from response statuses — a variant answering 500s converges
+    to only the exploration share of traffic, no manual weight change."""
+    import random
+
+    from kubeflow_tpu.manifests.core import generate
+
+    good, bad = _IdentityBackend("good"), _FailingBackend()
+    svc = generate("serving-route", {
+        "name": "bert", "canary_service": "bert-v2.kubeflow:8500",
+        "strategy": "epsilon-greedy", "epsilon": 0.2,
+    })[0]
+    api.apply(svc)
+    table = RouteTable()
+    table.refresh(api)
+    route = table.match("/models/bert/x")
+    assert route.strategy == "epsilon-greedy"
+
+    backends = {
+        "bert.kubeflow:8500": f"127.0.0.1:{good.port}",
+        "bert-v2.kubeflow:8500": f"127.0.0.1:{bad.port}",
+    }
+    gw = Gateway(table, port=0, admin_port=0,
+                 resolve=lambda a: backends.get(a, a),
+                 rng=random.Random(11))
+    gw.start()
+    try:
+        base = f"http://127.0.0.1:{gw._proxy.server_address[1]}"
+        statuses = []
+        for _ in range(100):
+            try:
+                code, _out, _ = http("GET", f"{base}/models/bert/v1/models")
+            except urllib.error.HTTPError as e:
+                code = e.code
+            statuses.append(code)
+        # Exploration is 20% split over 2 arms → ~10% of traffic still
+        # probes the broken variant; exploitation goes to the healthy one.
+        failures = sum(1 for s in statuses if s == 500)
+        assert failures <= 25, failures
+        assert statuses.count(200) >= 75
+        stats = gw.bandit.snapshot("bert-route")
+        assert stats["bert.kubeflow:8500"]["mean"] == 1.0
+        assert stats["bert-v2.kubeflow:8500"]["mean"] == 0.0
+        assert (stats["bert.kubeflow:8500"]["trials"]
+                > stats["bert-v2.kubeflow:8500"]["trials"])
+    finally:
+        gw.stop()
+        good.close()
+        bad.close()
+
+
+def test_bandit_feedback_endpoint_steers_routing(api):
+    """Explicit rewards (the seldon /send-feedback analogue) through the
+    admin API flip the bandit's preference between two healthy variants,
+    and /routes exposes the per-variant stats."""
+    import random
+
+    from kubeflow_tpu.gateway import Route
+
+    a, b = _IdentityBackend("a"), _IdentityBackend("b")
+    table = RouteTable()
+    table.set_routes([Route(
+        name="m", prefix="/m/",
+        service=f"127.0.0.1:{a.port}",
+        backends=((f"127.0.0.1:{a.port}", 1), (f"127.0.0.1:{b.port}", 1)),
+        strategy="epsilon-greedy", epsilon=0.0,  # pure exploitation
+    )])
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        admin_port = s.getsockname()[1]
+    gw = Gateway(table, port=0, admin_port=admin_port,
+                 rng=random.Random(3))
+    gw.start()
+    try:
+        admin = f"http://127.0.0.1:{admin_port}"
+        # Grade variant b higher than every status-derived reward can be
+        # beaten by: a gets 0.2, b gets 1.0.
+        code, out, _ = http("POST", f"{admin}/routes/m/feedback",
+                            {"service": f"127.0.0.1:{a.port}",
+                             "reward": 0.2})
+        assert code == 200 and out["ok"]
+        http("POST", f"{admin}/routes/m/feedback",
+             {"service": f"127.0.0.1:{b.port}", "reward": 1.0})
+
+        base = f"http://127.0.0.1:{gw._proxy.server_address[1]}"
+        hits = {"a": 0, "b": 0}
+        for _ in range(20):
+            _, out, _ = http("GET", f"{base}/m/x")
+            hits[out["variant"]] += 1
+        # b keeps winning: its implicit 200-rewards sustain mean 1.0
+        # while a stays anchored by the 0.2 grade.
+        assert hits["b"] == 20, hits
+
+        code, routes, _ = http("GET", f"{admin}/routes")
+        m = next(r for r in routes if r["name"] == "m")
+        assert m["bandit"][f"127.0.0.1:{b.port}"]["trials"] >= 20
+
+        # Bad feedback is rejected: out-of-range reward, a service that
+        # is not a variant of the route, an unknown route.
+        for path, payload, want in (
+            ("m", {"service": f"127.0.0.1:{a.port}", "reward": 2.0}, 400),
+            ("m", {"service": "typo:8500", "reward": 0.5}, 400),
+            ("ghost", {"service": f"127.0.0.1:{a.port}",
+                       "reward": 0.5}, 404),
+        ):
+            try:
+                code, _out, _ = http(
+                    "POST", f"{admin}/routes/{path}/feedback", payload)
+            except urllib.error.HTTPError as e:
+                code = e.code
+            assert code == want, (path, payload, code)
+    finally:
+        gw.stop()
+        a.close()
+        b.close()
+
+
 def test_shadow_mirror_through_gateway(api):
     """Shadow traffic: the mirror backend sees every request (marked
     X-Shadow) but the client only ever sees the primary's response; a
