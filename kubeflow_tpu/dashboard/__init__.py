@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import html
 from http.server import ThreadingHTTPServer
-from urllib.parse import parse_qs, urlsplit
+from urllib.parse import parse_qs, quote, unquote, urlsplit
 
 from kubeflow_tpu.apis.jobs import ALL_JOB_KINDS, JOBS_API_VERSION
 from kubeflow_tpu.apis.notebooks import NOTEBOOK_KIND, NOTEBOOKS_API_VERSION
@@ -22,6 +22,16 @@ from kubeflow_tpu.gateway import routes_from_service
 from kubeflow_tpu.k8s.client import ApiError, K8sClient
 from kubeflow_tpu.operators.runstore import RunStore
 from kubeflow_tpu.webapps import JsonHandler
+
+_EMBED_PAGE = """<!doctype html>
+<html><head><title>{name} — kubeflow-tpu</title>
+<style>body{{margin:0;font-family:sans-serif}}
+nav{{padding:6px 12px;background:#f4f4f4;border-bottom:1px solid #ccc}}
+iframe{{border:0;width:100vw;height:calc(100vh - 40px)}}</style></head>
+<body><nav><a href="/">kubeflow-tpu</a> / {name}</nav>
+<iframe src="{src}" title="{name}"></iframe>
+</body></html>
+"""
 
 _PAGE = """<!doctype html>
 <html><head><title>kubeflow-tpu</title>
@@ -173,6 +183,21 @@ class Dashboard:
             "activity": self.activity(namespace, raw_jobs=raw_jobs),
         }
 
+    def render_embed(self, component: str) -> str | None:
+        """In-place component view (centraldashboard's iframe-container
+        pattern, public/components/iframe-container.js): the web app
+        renders inside the dashboard chrome, reached through the gateway
+        at its annotated prefix."""
+        for c in self.components():
+            # Only path-shaped prefixes may become an auto-loading iframe
+            # src: the annotation is namespace-user-controlled, and a
+            # javascript: URI would execute in the dashboard origin on
+            # page load (html.escape cannot prevent that).
+            if c["name"] == component and c["prefix"].startswith("/"):
+                return _EMBED_PAGE.format(name=html.escape(component),
+                                          src=html.escape(c["prefix"]))
+        return None
+
     def render_html(self, namespace: str | None = None) -> str:
         ov = self.overview(namespace)
 
@@ -185,8 +210,10 @@ class Dashboard:
             for ns in ov["namespaces"]
         )
         components = "".join(
-            f"<li><a href=\"{esc(c['prefix'])}\">{esc(c['name'])}</a> "
-            f"→ {esc(c['service'])}</li>" for c in ov["components"]
+            f"<li><a href=\"/embed/{esc(quote(c['name'], safe=''))}\">"
+            f"{esc(c['name'])}</a> → {esc(c['service'])} "
+            f"(<a href=\"{esc(c['prefix'])}\">direct</a>)</li>"
+            for c in ov["components"]
         ) or "<li>(none)</li>"
         jobs = "".join(
             f"<tr><td>{esc(j['kind'])}</td><td>{esc(j['name'])}</td>"
@@ -235,6 +262,12 @@ def make_server(dash: Dashboard, port: int) -> ThreadingHTTPServer:
                 self.send_json(200, {"activity": dash.activity(ns)})
             elif url.path == "/api/namespaces":
                 self.send_json(200, {"namespaces": dash.namespaces()})
+            elif url.path.startswith("/embed/"):
+                page = dash.render_embed(unquote(url.path[len("/embed/"):]))
+                if page is None:
+                    self.send_json(404, {"error": "unknown component"})
+                else:
+                    self.send_html(200, page)
             elif url.path in ("/", "/index.html"):
                 self.send_html(200, dash.render_html(ns))
             else:

@@ -124,6 +124,69 @@ def test_dashboard_namespace_filter_and_activity(cluster):
         httpd.shutdown()
 
 
+def test_dashboard_embeds_components(cluster):
+    """Components render inside the dashboard chrome via /embed/<name>
+    (the iframe-container pattern), iframe src = the gateway prefix."""
+    cluster.create({
+        "apiVersion": "v1", "kind": "Service",
+        "metadata": {
+            "name": "jupyter-web-app", "namespace": "kubeflow",
+            "annotations": {
+                "kubeflow-tpu.org/gateway-route":
+                    "{name: jupyter, prefix: /jupyter/, "
+                    "service: 'jupyter-web-app.kubeflow:80'}",
+            },
+        },
+        "spec": {"ports": [{"port": 80}]},
+    })
+    httpd = make_dash(Dashboard(cluster), 0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        code, page = get(base, "/embed/jupyter")
+        assert code == 200
+        assert '<iframe src="/jupyter/"' in page
+        # The landing page links components to their embed view.
+        _, index = get(base, "/")
+        assert '/embed/jupyter' in index
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get(base, "/embed/nope")
+        assert e.value.code == 404
+
+        # A javascript: prefix from a hostile annotation must never
+        # become an auto-loading iframe src.
+        cluster.create({
+            "apiVersion": "v1", "kind": "Service",
+            "metadata": {
+                "name": "evil", "namespace": "kubeflow",
+                "annotations": {
+                    "kubeflow-tpu.org/gateway-route":
+                        "{name: evil app, prefix: 'javascript:alert(1)', "
+                        "service: 'evil.kubeflow:80'}",
+                },
+            },
+            "spec": {"ports": [{"port": 80}]},
+        })
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get(base, "/embed/evil%20app")
+        assert e.value.code == 404
+        # Space-bearing names still round-trip through the landing link
+        # once the prefix is path-shaped.
+        cluster.patch("v1", "Service", "evil", {
+            "metadata": {"annotations": {
+                "kubeflow-tpu.org/gateway-route":
+                    "{name: evil app, prefix: /ok/, "
+                    "service: 'evil.kubeflow:80'}",
+            }},
+        }, "kubeflow")
+        _, index = get(base, "/")
+        assert "/embed/evil%20app" in index
+        code, page = get(base, "/embed/evil%20app")
+        assert code == 200 and '<iframe src="/ok/"' in page
+    finally:
+        httpd.shutdown()
+
+
 def test_study_webapp_crud(cluster):
     httpd = make_study(StudyApp(cluster), 0)
     threading.Thread(target=httpd.serve_forever, daemon=True).start()
