@@ -183,17 +183,24 @@ class Dashboard:
             "activity": self.activity(namespace, raw_jobs=raw_jobs),
         }
 
+    @staticmethod
+    def _embeddable(prefix: str) -> bool:
+        """Only same-origin path-shaped prefixes may become an
+        auto-loading iframe src: the annotation is namespace-user-
+        controlled, and a javascript: URI or protocol-relative
+        //host (or \\-tricked) URL would load attacker content in the
+        dashboard chrome on page load (html.escape cannot prevent it)."""
+        return (prefix.startswith("/")
+                and not prefix.startswith("//")
+                and not prefix.startswith("/\\"))
+
     def render_embed(self, component: str) -> str | None:
         """In-place component view (centraldashboard's iframe-container
         pattern, public/components/iframe-container.js): the web app
         renders inside the dashboard chrome, reached through the gateway
         at its annotated prefix."""
         for c in self.components():
-            # Only path-shaped prefixes may become an auto-loading iframe
-            # src: the annotation is namespace-user-controlled, and a
-            # javascript: URI would execute in the dashboard origin on
-            # page load (html.escape cannot prevent that).
-            if c["name"] == component and c["prefix"].startswith("/"):
+            if c["name"] == component and self._embeddable(c["prefix"]):
                 return _EMBED_PAGE.format(name=html.escape(component),
                                           src=html.escape(c["prefix"]))
         return None
@@ -209,11 +216,18 @@ class Dashboard:
             f"{' selected' if ns == namespace else ''}>{esc(ns)}</option>"
             for ns in ov["namespaces"]
         )
+        def component_link(c) -> str:
+            # Non-embeddable prefixes link straight to the component —
+            # an /embed link would just 404 on the _embeddable guard.
+            if not self._embeddable(c["prefix"]):
+                return (f"<li><a href=\"{esc(c['prefix'])}\">"
+                        f"{esc(c['name'])}</a> → {esc(c['service'])}</li>")
+            return (f"<li><a href=\"/embed/{esc(quote(c['name'], safe=''))}"
+                    f"\">{esc(c['name'])}</a> → {esc(c['service'])} "
+                    f"(<a href=\"{esc(c['prefix'])}\">direct</a>)</li>")
+
         components = "".join(
-            f"<li><a href=\"/embed/{esc(quote(c['name'], safe=''))}\">"
-            f"{esc(c['name'])}</a> → {esc(c['service'])} "
-            f"(<a href=\"{esc(c['prefix'])}\">direct</a>)</li>"
-            for c in ov["components"]
+            component_link(c) for c in ov["components"]
         ) or "<li>(none)</li>"
         jobs = "".join(
             f"<tr><td>{esc(j['kind'])}</td><td>{esc(j['name'])}</td>"

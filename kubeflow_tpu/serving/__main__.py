@@ -24,7 +24,29 @@ def main(argv=None) -> int:
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--batch-timeout-ms", type=float, default=5.0)
     p.add_argument("--max-seq-len", type=int, default=128)
+    p.add_argument("--max-new-tokens", type=int, default=16,
+                   help="per-request generation cap (0 disables the "
+                        "decode path entirely)")
+    p.add_argument("--top-k", type=int, default=0)
+    p.add_argument("--eos-id", type=int, default=-1,
+                   help="token id ending a generation early; -1 disables")
+    p.add_argument("--decode-mode", default="continuous",
+                   choices=["continuous", "lockstep"],
+                   help="continuous: per-request lengths decoupled + "
+                        "streaming; lockstep: one compiled call per batch")
+    p.add_argument("--dtype", default="",
+                   choices=["", "bfloat16", "float32"],
+                   help="compute dtype override; empty keeps the model "
+                        "preset's dtype")
+    # Metrics are always served at /monitoring/prometheus/metrics; the
+    # flag exists so the rendered manifest args stay valid
+    # (tf-serving-template.libsonnet enablePrometheus parity).
+    p.add_argument("--enable-prometheus", action="store_true")
     args = p.parse_args(argv)
+    if args.eos_id >= 0 and args.decode_mode != "continuous":
+        # Only the continuous decoder implements early stop; silently
+        # generating past EOS would return post-EOS garbage.
+        p.error("--eos-id requires --decode-mode=continuous")
 
     server = ModelServer(
         EngineConfig(
@@ -32,6 +54,11 @@ def main(argv=None) -> int:
             checkpoint_dir=args.model_path or None,
             batch_size=args.batch_size,
             max_seq_len=args.max_seq_len,
+            max_new_tokens=args.max_new_tokens,
+            top_k=args.top_k,
+            eos_id=None if args.eos_id < 0 else args.eos_id,
+            decode_mode=args.decode_mode,
+            dtype=args.dtype,
         ),
         port=args.rest_port,
         grpc_port=None if args.grpc_port < 0 else args.grpc_port,

@@ -36,6 +36,9 @@ class EngineConfig:
     # the right mode when host↔device RTT dominates (remote TPU tunnels)
     # or for offline batch predict.
     decode_mode: str = "continuous"
+    # Compute dtype override ("bfloat16"/"float32"); empty keeps the
+    # model preset's dtype. The tpu-serving manifest's --dtype arg.
+    dtype: str = ""
 
 
 class InferenceEngine:
@@ -43,7 +46,8 @@ class InferenceEngine:
 
     def __init__(self, cfg: EngineConfig):
         self.cfg = cfg
-        self.model: ModelSpec = get_model(cfg.model)
+        overrides = {"dtype": jnp.dtype(cfg.dtype)} if cfg.dtype else {}
+        self.model: ModelSpec = get_model(cfg.model, **overrides)
         self._lock = threading.Lock()
         self.params = self._load_params()
         self._predict = jax.jit(self._predict_fn)

@@ -116,26 +116,42 @@ def test_every_rendered_command_parses_help():
     assert not failures, "\n\n".join(failures)
 
 
+_SENTINEL = "--cc-unknown-sentinel"
+
+
 def test_rendered_args_are_accepted_by_each_parser():
-    """Run every rendered command with its exact manifest args plus a
-    trailing --help: argparse consumes the real flags left-to-right (so an
-    unknown/renamed option fails with rc 2) and then exits 0 at --help —
-    catching arg renames that would CrashLoop the rendered Deployment."""
+    """Run every rendered command with its exact manifest args plus an
+    unknown sentinel option. argparse collects ALL unrecognized optionals
+    and lists them in one error — so the expected outcome is rc 2 naming
+    ONLY the sentinel. A renamed/removed real flag shows up next to it
+    (a trailing --help can't catch this: its action fires before
+    unknown-option validation, masking bogus rendered args that would
+    CrashLoop the Deployment at container start)."""
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     env.pop("XLA_FLAGS", None)
 
     def run_cmd(cmd):
         module, *args = cmd
         proc = subprocess.run(
-            [sys.executable, "-m", module, *args, "--help"],
+            [sys.executable, "-m", module, *args, _SENTINEL],
             capture_output=True, text=True, timeout=120, env=env,
         )
         return cmd, proc
 
     with ThreadPoolExecutor(max_workers=8) as pool:
         results = list(pool.map(run_cmd, COMMANDS))
-    failures = [
-        f"{' '.join(cmd)}: rc={proc.returncode}\n{proc.stderr[-500:]}"
-        for cmd, proc in results if proc.returncode != 0
-    ]
+    failures = []
+    for cmd, proc in results:
+        unrecognized = [
+            line for line in proc.stderr.splitlines()
+            if "unrecognized arguments" in line
+        ]
+        ok = (proc.returncode == 2 and unrecognized
+              and all(
+                  line.split("unrecognized arguments:")[1].strip()
+                  == _SENTINEL for line in unrecognized
+              ))
+        if not ok:
+            failures.append(f"{' '.join(cmd)}: rc={proc.returncode}\n"
+                            f"{proc.stderr[-500:]}")
     assert not failures, "\n\n".join(failures)

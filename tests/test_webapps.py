@@ -170,6 +170,22 @@ def test_dashboard_embeds_components(cluster):
         with pytest.raises(urllib.error.HTTPError) as e:
             get(base, "/embed/evil%20app")
         assert e.value.code == 404
+        # ...nor may a protocol-relative //host prefix (browsers resolve
+        # it as https://host — same attack, different spelling).
+        cluster.patch("v1", "Service", "evil", {
+            "metadata": {"annotations": {
+                "kubeflow-tpu.org/gateway-route":
+                    "{name: evil app, prefix: '//evil.example/', "
+                    "service: 'evil.kubeflow:80'}",
+            }},
+        }, "kubeflow")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get(base, "/embed/evil%20app")
+        assert e.value.code == 404
+        # The landing page must not offer an /embed link that 404s for
+        # such components — it links them directly instead.
+        _, index = get(base, "/")
+        assert "/embed/evil%20app" not in index
         # Space-bearing names still round-trip through the landing link
         # once the prefix is path-shaped.
         cluster.patch("v1", "Service", "evil", {
