@@ -53,6 +53,11 @@ SNAPSHOT_CASES: dict[str, tuple[str, dict]] = {
         {"name": "bert", "canary_service": "bert-v2.kubeflow:8500",
          "canary_weight": 10, "shadow_service": "bert-shadow.kubeflow:8500"},
     ),
+    "serving-route-bandit": (
+        "serving-route",
+        {"name": "bert", "canary_service": "bert-v2.kubeflow:8500",
+         "strategy": "epsilon-greedy", "epsilon": 0.2},
+    ),
 }
 
 

@@ -670,6 +670,12 @@ def test_bandit_feedback_endpoint_steers_routing(api):
         code, routes, _ = http("GET", f"{admin}/routes")
         m = next(r for r in routes if r["name"] == "m")
         assert m["bandit"][f"127.0.0.1:{b.port}"]["trials"] >= 20
+        # The admin view annotates copies, not the live Route objects —
+        # a second snapshot must show identical structure, and the route
+        # the proxy matches must not have grown a 'bandit' attribute.
+        _, routes2, _ = http("GET", f"{admin}/routes")
+        assert {r["name"] for r in routes2} == {r["name"] for r in routes}
+        assert not hasattr(gw.table.match("/m/x"), "bandit")
 
         # Bad feedback is rejected: out-of-range reward, a service that
         # is not a variant of the route, an unknown route.
