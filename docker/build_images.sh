@@ -10,13 +10,17 @@ set -e
 cd "$(dirname "$0")/.."
 VERSION="${1:-$(python -c 'from kubeflow_tpu.version import __version__; print(__version__)')}"
 
-docker build -f docker/platform/Dockerfile \
+docker build -f docker/platform/Dockerfile --target runtime \
     -t "ghcr.io/kubeflow-tpu/platform:${VERSION}" .
+docker build -f docker/platform/Dockerfile --target ci \
+    -t "ghcr.io/kubeflow-tpu/platform-ci:${VERSION}" .
 docker build -f docker/serving/Dockerfile \
     -t "ghcr.io/kubeflow-tpu/serving:${VERSION}" .
-docker build -f docker/jax-tpu/Dockerfile \
+docker build -f docker/jax-tpu/Dockerfile --target runtime \
     -t "ghcr.io/kubeflow-tpu/jax-tpu:0.9.0" .
+docker build -f docker/jax-tpu/Dockerfile --target ci \
+    -t "ghcr.io/kubeflow-tpu/jax-tpu-ci:0.9.0" .
 docker build -f docker/notebook/Dockerfile \
     -t "ghcr.io/kubeflow-tpu/jax-notebook:0.9.0" .
 
-echo "built: platform serving jax-tpu jax-notebook (version ${VERSION})"
+echo "built: platform platform-ci serving jax-tpu jax-tpu-ci jax-notebook (version ${VERSION})"

@@ -23,3 +23,10 @@ NOTEBOOK = "ghcr.io/kubeflow-tpu/jax-notebook:0.9.0"
 
 # Serving image: the TPU model server (replaces tensorflow/serving).
 SERVING = f"ghcr.io/kubeflow-tpu/serving:{__version__}"
+
+# CI stages (`--target ci` of the platform / jax-tpu recipes): the runtime
+# image plus the repo's tests/ and bench harness, so ci/pipeline.yaml's
+# tasks have their sources on disk in-cluster (the reference bakes its
+# harness into a test-worker image the same way, testing/Dockerfile).
+PLATFORM_CI = f"ghcr.io/kubeflow-tpu/platform-ci:{__version__}"
+JAX_TPU_CI = "ghcr.io/kubeflow-tpu/jax-tpu-ci:0.9.0"

@@ -288,9 +288,14 @@ def insert_row(state, slot, row_cache, last_logits, length, remaining,
 
 @functools.partial(jax.jit, donate_argnames=("state",))
 def retire_row(state, slot):
-    """Host-initiated early stop (EOS): park the row so the next
-    ``decode_step`` neither samples nor writes for it."""
-    return {**state, "active": state["active"].at[slot].set(False)}
+    """Host-initiated early stop (EOS): clear ``active`` and park the row's
+    write position at ``total`` so the next ``decode_step`` neither samples
+    for it nor lands its cache scatter (out-of-bounds scatter updates are
+    dropped). ``insert_row`` resets ``length`` on readmission."""
+    total = state["cache"]["k"].shape[2]
+    return {**state,
+            "active": state["active"].at[slot].set(False),
+            "length": state["length"].at[slot].set(total)}
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "top_k"),

@@ -65,7 +65,11 @@ class StreamHandle:
     def tokens(self, timeout: float = 60.0):
         """Yield tokens as the decode loop emits them."""
         while True:
-            item = self._req.stream.get(timeout=timeout)
+            try:
+                item = self._req.stream.get(timeout=timeout)
+            except queue.Empty:
+                # queue.Empty's str() is blank — surface a real timeout.
+                raise TimeoutError("token stream timed out") from None
             if item is _DONE:
                 if self._req.error is not None:
                     raise self._req.error
@@ -234,7 +238,17 @@ class ContinuousDecoder:
                 )
                 self.steps += 1
                 self._dispatch(np.asarray(toks), np.asarray(emitted))
-            except Exception as e:  # fail every in-flight request
+            except Exception as e:
+                # A failed prefill/decode_step may have invalidated
+                # self._state (the jitted calls donate its buffers), so the
+                # decoder cannot safely take more work: mark it stopped and
+                # fail everything — in-flight, just-admitted, and queued —
+                # with the original error. Later submits get a clear
+                # "decoder is stopped" instead of a donation error.
+                with self._cv:
+                    self._stopped = True
+                    queued = list(self._pending)
+                    self._pending.clear()
                 for slot in range(self.slots):
                     req = self._slot_req[slot]
                     if req is not None:
@@ -244,6 +258,9 @@ class ContinuousDecoder:
                 for req, _slot in pending:
                     if not req.done.is_set():
                         self._finish(req, error=e)
+                for req in queued:
+                    self._finish(req, error=e)
+                return
 
     # ------------------------------------------------------------------
 

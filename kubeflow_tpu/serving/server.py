@@ -289,7 +289,13 @@ class ModelServer:
                 except KeyError as e:
                     error = True
                     self._send(404, {"error": str(e)})
-                except (ValueError, TimeoutError) as e:
+                except TimeoutError as e:
+                    # An overloaded/stalled decoder is a server-side
+                    # failure, not a bad request.
+                    error = True
+                    self._send(503, {"error": str(e) or "generation "
+                                     "timed out"})
+                except ValueError as e:
                     error = True
                     self._send(400, {"error": str(e)})
                 except Exception as e:

@@ -155,6 +155,11 @@ def run_server(root_uri: str, root_port: int, server_id: int,
 
     pushes = 0
     stop = threading.Event()
+    # One thread serves each worker connection; pushes and pulls from
+    # different workers interleave, so shard updates and snapshots share a
+    # lock (without it a pull could read a half-applied update and the
+    # pushes counter could drop increments).
+    shard_lock = threading.Lock()
 
     def serve_conn(sock: socket.socket) -> None:
         nonlocal pushes, shard
@@ -163,11 +168,14 @@ def run_server(root_uri: str, root_port: int, server_id: int,
             while True:
                 msg = _recv(sock)
                 if msg["op"] == "pull":
-                    _send(sock, {"shard": shard.tolist()})
+                    with shard_lock:
+                        snapshot = shard.tolist()
+                    _send(sock, {"shard": snapshot})
                 elif msg["op"] == "push":
                     grad = np.asarray(msg["grad"], np.float64)
-                    shard -= lr * grad  # in-place SGD on the shard
-                    pushes += 1
+                    with shard_lock:
+                        shard -= lr * grad  # in-place SGD on the shard
+                        pushes += 1
                     _send(sock, {"ok": True})
                 elif msg["op"] == "done":
                     _send(sock, {"ok": True})
@@ -214,10 +222,7 @@ def run_worker(root_uri: str, root_port: int, worker_id: int,
     sched = _connect(root_uri, root_port)
     _send(sched, {"role": "worker", "id": worker_id})
     table = _recv(sched)
-    server_socks = [
-        _connect("127.0.0.1" if a == "127.0.0.1" else a, p)
-        for a, p in table["servers"]
-    ]
+    server_socks = [_connect(a, p) for a, p in table["servers"]]
 
     rng = np.random.default_rng(42 + worker_id)
     w_true = np.linspace(-1.0, 1.0, dim)

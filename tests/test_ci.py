@@ -1,11 +1,14 @@
-"""The repo's own CI/release pipeline definition stays valid.
+"""The repo's own CI/release pipeline definition stays valid AND runnable.
 
 The reference gates its repo with prow_config.yaml routing into Argo
 workflows (/root/reference/prow_config.yaml, testing/workflows/); this
 repo's equivalent is ci/pipeline.yaml — a Workflow + ScheduledWorkflow of
 the platform's own pipeline layer. These tests keep it loadable, schema-
-valid, acyclic, and pointing at real images and entrypoints, and prove
-the fake apiserver admits both documents.
+valid, acyclic, pointing at real images and entrypoints, and — the part
+that bit round 3 — prove each task could actually execute in the image it
+names: repo files a command references must be baked into that image's CI
+stage, and image builds must use the kaniko executor's real flag surface
+(no shell, no docker daemon).
 """
 
 import importlib
@@ -29,6 +32,31 @@ def _docs():
                                    .read_text()))
 
 
+def _all_tasks():
+    wf, swf = _docs()
+    return wf["spec"]["tasks"] + swf["spec"]["workflowTemplate"]["spec"][
+        "tasks"]
+
+
+def _containers(task):
+    return task["resource"]["spec"]["template"]["spec"]["containers"]
+
+
+def _ci_stage_copies(dockerfile: Path) -> set[str]:
+    """Paths COPY'd (from the build context) into the Dockerfile's final
+    `ci` stage — what exists under /workspace in the *-ci image."""
+    copied: set[str] = set()
+    in_ci = False
+    for raw in dockerfile.read_text().splitlines():
+        line = raw.strip()
+        if line.upper().startswith("FROM "):
+            in_ci = line.lower().endswith(" as ci")
+        elif in_ci and line.upper().startswith("COPY "):
+            *sources, _dest = line.split()[1:]
+            copied.update(sources)
+    return copied
+
+
 def test_pipeline_parses_and_kinds():
     wf, swf = _docs()
     assert wf["kind"] == "Workflow"
@@ -48,20 +76,20 @@ def test_pipeline_admitted_by_apiserver(api):
 def test_pipeline_dag_gate_order():
     wf, _ = _docs()
     order = toposort_tasks(wf["spec"]["tasks"])  # raises on cycles
-    # lint gates everything; release-tag is last (the prow gate order).
+    # The CI image is built before any test stage runs in it (otherwise
+    # tests exercise the previous run's image); lint gates the test
+    # ladder; release-tag is last (the prow gate order).
+    assert order.index("build-platform-ci-image") < order.index("lint")
     assert order.index("lint") < order.index("unit-tests")
     assert order.index("unit-tests") < order.index("e2e-tests")
     assert order[-1] == "release-tag"
 
 
 def test_pipeline_images_match_manifest_constants():
-    wf, swf = _docs()
-    known = {images.PLATFORM, images.JAX_TPU, images.NOTEBOOK,
-             images.SERVING}
-    tasks = wf["spec"]["tasks"] + swf["spec"]["workflowTemplate"]["spec"][
-        "tasks"]
-    for task in tasks:
-        for c in task["resource"]["spec"]["template"]["spec"]["containers"]:
+    known = {images.PLATFORM, images.PLATFORM_CI, images.JAX_TPU,
+             images.JAX_TPU_CI, images.NOTEBOOK, images.SERVING}
+    for task in _all_tasks():
+        for c in _containers(task):
             img = c["image"]
             if "kubeflow-tpu" in img:
                 assert img in known, f"task {task['name']}: {img}"
@@ -69,13 +97,13 @@ def test_pipeline_images_match_manifest_constants():
 
 def test_pipeline_commands_exist():
     """Every `python -m <module>` module imports; every file argument
-    exists; the schedule parses."""
-    wf, swf = _docs()
-    tasks = wf["spec"]["tasks"] + swf["spec"]["workflowTemplate"]["spec"][
-        "tasks"]
-    for task in tasks:
-        for c in task["resource"]["spec"]["template"]["spec"]["containers"]:
-            cmd = c["command"]
+    exists in the repo; the schedule parses."""
+    _, swf = _docs()
+    for task in _all_tasks():
+        for c in _containers(task):
+            cmd = c.get("command")
+            if cmd is None:
+                continue  # kaniko tasks: args-only, checked below
             if cmd[:2] == ["python", "-m"]:
                 assert importlib.util.find_spec(cmd[2]) is not None, cmd
             elif cmd[0] == "python" and cmd[1].endswith(".py"):
@@ -83,3 +111,71 @@ def test_pipeline_commands_exist():
             elif cmd[0] == "sh":
                 assert (REPO / cmd[1]).exists(), cmd
     CronSchedule.parse(swf["spec"]["schedule"])  # raises if invalid
+
+
+def test_tasks_runnable_inside_their_images():
+    """Round-3 advisor finding: tasks referenced repo files (tests/,
+    bench.py) that the runtime images don't contain. Any task whose
+    command names a repo path must run in a *-ci image whose Dockerfile
+    `ci` stage COPYs that path into /workspace, with workingDir set."""
+    ci_stage = {
+        images.PLATFORM_CI: _ci_stage_copies(
+            REPO / "docker" / "platform" / "Dockerfile"),
+        images.JAX_TPU_CI: _ci_stage_copies(
+            REPO / "docker" / "jax-tpu" / "Dockerfile"),
+    }
+    for task in _all_tasks():
+        for c in _containers(task):
+            cmd = c.get("command") or []
+            needed = [a.rstrip("/") for a in cmd[1:]
+                      if (REPO / a).exists() and not a.startswith("-")]
+            if cmd[:2] == ["python", "-m"]:
+                needed = [a.rstrip("/") for a in cmd[3:]
+                          if (REPO / a).exists()]
+            if not needed:
+                continue
+            img = c["image"]
+            assert img in ci_stage, (
+                f"task {task['name']} references repo paths {needed} but "
+                f"runs in {img}, which has no CI stage")
+            assert c.get("workingDir") == "/workspace", task["name"]
+            for path in needed:
+                top = path.split("/")[0]
+                assert top in ci_stage[img], (
+                    f"task {task['name']}: {path} not COPY'd into the "
+                    f"ci stage of {img}")
+
+
+def test_image_builds_use_real_kaniko_surface():
+    """Image-build tasks must drive the kaniko executor via its flags —
+    no shell, no docker daemon — with a --dockerfile that exists and a
+    --destination matching the manifest image constants."""
+    known = {images.PLATFORM, images.PLATFORM_CI, images.JAX_TPU,
+             images.JAX_TPU_CI, images.NOTEBOOK, images.SERVING}
+    build_tasks = [t for t in _all_tasks() if "build-" in t["name"]]
+    assert len(build_tasks) >= 4  # one per Dockerfile at minimum
+    destinations = set()
+    for task in build_tasks:
+        spec = task["resource"]["spec"]["template"]["spec"]
+        for c in _containers(task):
+            assert c["image"].startswith("gcr.io/kaniko-project/executor")
+            assert "command" not in c, (
+                f"{task['name']}: kaniko has no shell; use args")
+            flags = dict(a.split("=", 1) for a in c["args"])
+            assert (REPO / flags["--dockerfile"]).exists(), task["name"]
+            assert "--destination" in flags
+            # Unpinned contexts build whatever the branch tip is at task
+            # start — the pushed image would not match the tested commit.
+            assert "#" in flags["--context"], (
+                f"{task['name']}: git context must pin a ref")
+            # kaniko pushes need a docker config: the registry-credentials
+            # secret mounted at /kaniko/.docker.
+            mounts = {m["mountPath"] for m in c.get("volumeMounts", [])}
+            assert "/kaniko/.docker" in mounts, task["name"]
+            vols = {v["name"]: v for v in spec.get("volumes", [])}
+            assert "registry-credentials" in vols, task["name"]
+            destinations.add(flags["--destination"])
+    assert destinations == known, (
+        "every manifest image constant must be built by exactly the "
+        f"kaniko tasks; missing={known - destinations} "
+        f"extra={destinations - known}")
