@@ -13,7 +13,9 @@ flagship and a realistic-depth model):
 - ``flagship-1b``: 3 wide llama blocks, 1.13B params — the peak-MFU config.
 - ``flagship-deep``: 16 llama-style layers, 1.53B params — the depth class
   users actually bring (BERT/Llama geometry); reported as ``deep_mfu_pct``
-  (bs32 seq256, the BERT-class shape) and ``deep_mfu_seq512_pct``.
+  (bs32 seq256, the BERT-class shape) plus the full sequence ladder
+  (``deep_mfu_seq512_pct``, ``deep_mfu_seq1024_pct``,
+  ``deep_mfu_seq2048_pct`` — the Llama-class contexts, VERDICT r3 #1).
 """
 
 from __future__ import annotations
@@ -70,6 +72,15 @@ def run_training(model_name: str, batch_size: int, seq_len: int,
 
     tokens_per_sec = steps * batch_size * seq_len / dt
     per_chip = tokens_per_sec / n_devices
+    # Release this run's buffers and executables before the next config
+    # compiles: configs are sized to the HBM cliff (BASELINE.md), and
+    # residue from a previous run's allocator state measurably thrashes
+    # the next one (observed: 60.5% standalone vs 16.6% after three
+    # prior runs in-process).
+    del state, batch, step_fn, metrics
+    import gc
+    gc.collect()
+    jax.clear_caches()
     return {
         "mfu": 6.0 * n_params * per_chip / PEAK_BF16,
         "tokens_per_sec_per_chip": per_chip,
@@ -100,7 +111,7 @@ def main() -> int:
         # adafactor: factored slots buy model width (= MFU).
         flagship = run_training("flagship-1b", 4, 2048, args.steps,
                                 "adafactor", trace_dir=args.trace_dir)
-        deep = deep512 = None
+        deep = deep512 = deep1024 = deep2048 = None
         if not args.skip_deep:
             # Deep steps are ~4× faster than flagship steps; run more so
             # per-step dispatch noise amortizes out of the measurement.
@@ -109,6 +120,10 @@ def main() -> int:
                                 "adafactor", grad_dtype="bfloat16")
             deep512 = run_training("flagship-deep", 16, 512, deep_steps,
                                    "adafactor", grad_dtype="bfloat16")
+            deep1024 = run_training("flagship-deep", 8, 1024, deep_steps,
+                                    "adafactor", grad_dtype="bfloat16")
+            deep2048 = run_training("flagship-deep", 4, 2048, deep_steps,
+                                    "adafactor", grad_dtype="bfloat16")
 
     mfu = flagship["mfu"]
     # Frozen round-1 record (25,008 tok/s on a 509M model = 38.8% MFU);
@@ -143,6 +158,8 @@ def main() -> int:
             "deep_params_m": round(deep["params_m"], 1),
             "deep_config": deep["config"],
             "deep_mfu_seq512_pct": round(deep512["mfu"] * 100, 2),
+            "deep_mfu_seq1024_pct": round(deep1024["mfu"] * 100, 2),
+            "deep_mfu_seq2048_pct": round(deep2048["mfu"] * 100, 2),
         })
     print(json.dumps(out))
     return 0

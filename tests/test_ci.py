@@ -166,8 +166,14 @@ def test_image_builds_use_real_kaniko_surface():
             assert "--destination" in flags
             # Unpinned contexts build whatever the branch tip is at task
             # start — the pushed image would not match the tested commit.
-            assert "#" in flags["--context"], (
-                f"{task['name']}: git context must pin a ref")
+            # The pin must be the release ref for the CURRENT version
+            # (release-qualification semantics, see ci/pipeline.yaml
+            # header): a stale pin would test and ship an old tag forever.
+            from kubeflow_tpu.version import __version__
+            assert flags["--context"].endswith(
+                f"#refs/tags/v{__version__}"), (
+                f"{task['name']}: git context must pin the v{__version__} "
+                "release ref")
             # kaniko pushes need a docker config: the registry-credentials
             # secret mounted at /kaniko/.docker.
             mounts = {m["mountPath"] for m in c.get("volumeMounts", [])}

@@ -273,3 +273,37 @@ def test_llm_remat_policy_matches_dots():
     la, _ = transformer.loss_fn(params, batch, cfg)
     lb, _ = transformer.loss_fn(params, batch, cfg_llm)
     np.testing.assert_allclose(float(la), float(lb), rtol=1e-2)
+
+
+@pytest.mark.parametrize("policy", ["llm_qkv", "llm_res", "llm_attn"])
+def test_round4_remat_policies_match_baseline(policy):
+    """The r4 remat layouts (saved q/k/v, saved splash residuals,
+    attention-outside-checkpoint) change memory/recompute, never values."""
+    import jax
+    import numpy as np
+
+    from kubeflow_tpu.models import transformer
+
+    cfg = transformer.config("lm-test-tiny", remat=True)
+    cfg_p = transformer.config("lm-test-tiny", remat=True,
+                               remat_policy=policy, scan_layers=False)
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    batch = {"tokens": jax.random.randint(jax.random.PRNGKey(2), (2, 17),
+                                          0, 256)}
+    la, _ = transformer.loss_fn(params, batch, cfg)
+    lb, _ = transformer.loss_fn(params, batch, cfg_p)
+    np.testing.assert_allclose(float(la), float(lb), rtol=1e-2)
+
+
+def test_llm_attn_policy_rejects_moe():
+    import pytest as _pytest
+
+    from kubeflow_tpu.models import transformer
+
+    cfg = transformer.config("moe-test-tiny", remat=True,
+                             remat_policy="llm_attn", scan_layers=False)
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    batch = {"tokens": jax.random.randint(jax.random.PRNGKey(2), (2, 16),
+                                          0, 256)}
+    with _pytest.raises(ValueError, match="llm_attn"):
+        transformer.loss_fn(params, batch, cfg)
