@@ -7,7 +7,14 @@ batched throughput. The reference publishes correctness-only serving tests
 (testing/test_tf_serving.py:40-60, tolerance 0.001 — no latency figure), so
 these are record-setting numbers, not comparisons.
 
-Usage: python bench_serving.py [--quick] [--requests N]
+``--generate`` benchmarks LM generation in BOTH decode modes (VERDICT r3
+#5: the continuous path's numbers must land in the bench artifact next to
+lockstep): the continuous decoder with ``--decode-chunk`` steps fused per
+dispatch (TTFT over the token stream, full-generation p50, decode tok/s
+under mixed-length concurrent load), then the lockstep engine on the same
+shapes.
+
+Usage: python bench_serving.py [--quick] [--requests N] [--generate]
 """
 
 from __future__ import annotations
@@ -25,29 +32,12 @@ def percentile(sorted_vals, p):
     return sorted_vals[i]
 
 
-def main() -> int:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--quick", action="store_true")
-    ap.add_argument("--requests", type=int, default=200)
-    ap.add_argument("--seq-len", type=int, default=128)
-    ap.add_argument("--concurrency", type=int, default=16)
-    ap.add_argument("--generate", action="store_true",
-                    help="benchmark KV-cache generation (LM) instead of "
-                         "single-forward predict")
-    ap.add_argument("--max-new-tokens", type=int, default=64)
-    args = ap.parse_args()
-
+def _bench_predict(args, model) -> dict:
     import grpc
 
     from kubeflow_tpu.serving.engine import EngineConfig
-    from kubeflow_tpu.serving.grpc_server import client_stubs, stream_stub
+    from kubeflow_tpu.serving.grpc_server import client_stubs
     from kubeflow_tpu.serving.server import ModelServer
-
-    on_tpu = jax.default_backend() == "tpu"
-    if args.generate:
-        model = "llama-1b" if on_tpu and not args.quick else "lm-test-tiny"
-    else:
-        model = "bert-base" if on_tpu and not args.quick else "bert-test-tiny"
 
     server = ModelServer(
         EngineConfig(model=model, batch_size=8, max_seq_len=args.seq_len,
@@ -55,23 +45,17 @@ def main() -> int:
         port=0, grpc_port=0, batch_timeout_ms=2.0,
     )
     server.start()
-    tokens = list(range(2, 2 + args.seq_len - 2))
-    instance = {"tokens": tokens}
-    if args.generate:
-        instance = {"tokens": tokens, "max_new_tokens": args.max_new_tokens}
-
+    instance = {"tokens": list(range(2, 2 + args.seq_len - 2))}
     channel_opts = [("grpc.max_send_message_length", 64 << 20),
                     ("grpc.max_receive_message_length", 64 << 20)]
     try:
         with grpc.insecure_channel(f"127.0.0.1:{server.grpc_port}",
                                    options=channel_opts) as chan:
             predict, _ = client_stubs(chan)
-
             # Warmup (compile both the singleton and the full batch shape).
             predict(model, [instance])
             predict(model, [instance] * 8)
 
-            # Sequential single-instance latency over gRPC.
             lat = []
             for _ in range(args.requests):
                 t0 = time.perf_counter()
@@ -79,8 +63,6 @@ def main() -> int:
                 lat.append((time.perf_counter() - t0) * 1e3)
             lat.sort()
 
-            # Batched throughput: concurrent clients drive the dynamic
-            # batcher at full batch occupancy.
             def one(_):
                 t0 = time.perf_counter()
                 predict(model, [instance])
@@ -90,28 +72,11 @@ def main() -> int:
             with ThreadPoolExecutor(args.concurrency) as pool:
                 conc = sorted(pool.map(one, range(args.requests)))
             wall = time.perf_counter() - t0
-
-            # Streaming TTFT: time until the FIRST token record arrives
-            # over the server-stream — the continuous decoder emits it after
-            # prefill + one step, long before the full generation lands.
-            ttft = []
-            if args.generate:
-                do_stream = stream_stub(chan)
-                n = max(10, args.requests // 10)
-                for _ in range(n):
-                    t0 = time.perf_counter()
-                    stream = do_stream(model, instance)
-                    next(stream)
-                    ttft.append((time.perf_counter() - t0) * 1e3)
-                    for _rec in stream:
-                        pass
-                ttft.sort()
     finally:
         server.stop()
 
-    result = {
-        "metric": ("serving_generate_p50_ms" if args.generate
-                   else "serving_predict_p50_ms"),
+    return {
+        "metric": "serving_predict_p50_ms",
         "value": round(percentile(lat, 50), 2),
         "unit": "ms",
         "vs_baseline": 1.0,  # reference publishes no latency numbers
@@ -122,12 +87,122 @@ def main() -> int:
         "config": f"{model} seq{args.seq_len} batch8 grpc "
                   f"c{args.concurrency}",
     }
-    if args.generate:
-        result["decode_tokens_per_sec"] = round(
-            args.max_new_tokens * args.requests / wall, 1
+
+
+def _bench_generate(args, model) -> dict:
+    """Continuous (chunked) AND lockstep generation on the same shapes."""
+    import grpc
+
+    from kubeflow_tpu.serving.engine import EngineConfig
+    from kubeflow_tpu.serving.grpc_server import client_stubs, stream_stub
+    from kubeflow_tpu.serving.server import ModelServer
+
+    tokens = list(range(2, 2 + args.seq_len - 2))
+    gen = args.max_new_tokens
+    instance = {"tokens": tokens, "max_new_tokens": gen}
+    # Mixed-length concurrent load: the continuous scheduler's reason to
+    # exist — short requests should not wait for long peers.
+    mixed_wants = [max(1, gen // 8), gen // 4 or 1, gen // 2 or 1, gen]
+    channel_opts = [("grpc.max_send_message_length", 64 << 20),
+                    ("grpc.max_receive_message_length", 64 << 20)]
+    n = max(10, args.requests // 10)
+    out = {}
+
+    for mode, chunk in (("continuous", args.decode_chunk), ("lockstep", 1)):
+        server = ModelServer(
+            EngineConfig(model=model, batch_size=8, max_seq_len=args.seq_len,
+                         max_new_tokens=gen, decode_mode=mode,
+                         decode_chunk=chunk),
+            port=0, grpc_port=0, batch_timeout_ms=2.0,
         )
-        result["ttft_p50_ms"] = round(percentile(ttft, 50), 2)
-        result["config"] += f" gen{args.max_new_tokens}"
+        server.start()
+        try:
+            with grpc.insecure_channel(f"127.0.0.1:{server.grpc_port}",
+                                       options=channel_opts) as chan:
+                predict, _ = client_stubs(chan)
+                predict(model, [instance])  # warmup/compile
+                predict(model, [instance] * 8)
+
+                lat = []
+                for _ in range(args.requests):
+                    t0 = time.perf_counter()
+                    predict(model, [instance])
+                    lat.append((time.perf_counter() - t0) * 1e3)
+                lat.sort()
+
+                def one(i):
+                    want = mixed_wants[i % len(mixed_wants)]
+                    t0 = time.perf_counter()
+                    predict(model, [{"tokens": tokens,
+                                     "max_new_tokens": want}])
+                    return want, (time.perf_counter() - t0) * 1e3
+
+                t0 = time.perf_counter()
+                with ThreadPoolExecutor(args.concurrency) as pool:
+                    mixed = list(pool.map(one, range(args.requests)))
+                wall = time.perf_counter() - t0
+                toks_emitted = sum(w for w, _ in mixed)
+
+                prefix = "" if mode == "continuous" else "lockstep_"
+                out[f"{prefix}p50_ms"] = round(percentile(lat, 50), 2)
+                out[f"{prefix}p99_ms"] = round(percentile(lat, 99), 2)
+                out[f"{prefix}decode_tokens_per_sec"] = round(
+                    toks_emitted / wall, 1)
+                out[f"{prefix}mixed_p50_ms"] = round(percentile(
+                    sorted(ms for _, ms in mixed), 50), 2)
+
+                if mode == "continuous":
+                    # TTFT over the token stream (prefill + first chunk).
+                    do_stream = stream_stub(chan)
+                    ttft = []
+                    for _ in range(n):
+                        t0 = time.perf_counter()
+                        stream = do_stream(model, instance)
+                        next(stream)
+                        ttft.append((time.perf_counter() - t0) * 1e3)
+                        for _rec in stream:
+                            pass
+                    ttft.sort()
+                    out["ttft_p50_ms"] = round(percentile(ttft, 50), 2)
+        finally:
+            server.stop()
+
+    out.update({
+        "metric": "serving_generate_p50_ms",
+        "value": out["p50_ms"],
+        "unit": "ms",
+        "vs_baseline": 1.0,
+        "continuous_vs_lockstep": round(
+            out["p50_ms"] / max(out["lockstep_p50_ms"], 1e-9), 2),
+        "config": f"{model} seq{args.seq_len} batch8 grpc "
+                  f"c{args.concurrency} gen{gen} "
+                  f"chunk{args.decode_chunk}",
+    })
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--requests", type=int, default=200)
+    ap.add_argument("--seq-len", type=int, default=128)
+    ap.add_argument("--concurrency", type=int, default=16)
+    ap.add_argument("--generate", action="store_true",
+                    help="benchmark KV-cache generation (LM) in both "
+                         "decode modes instead of single-forward predict")
+    ap.add_argument("--max-new-tokens", type=int, default=64)
+    ap.add_argument("--decode-chunk", type=int, default=8,
+                    help="decode steps fused per dispatch in the "
+                         "continuous-mode measurement")
+    args = ap.parse_args()
+
+    on_tpu = jax.default_backend() == "tpu"
+    if args.generate:
+        model = "llama-1b" if on_tpu and not args.quick else "lm-test-tiny"
+        result = _bench_generate(args, model)
+    else:
+        model = "bert-base" if on_tpu and not args.quick else "bert-test-tiny"
+        result = _bench_predict(args, model)
     print(json.dumps(result))
     return 0
 

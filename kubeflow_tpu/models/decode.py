@@ -298,13 +298,13 @@ def retire_row(state, slot):
             "length": state["length"].at[slot].set(total)}
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "top_k"),
-                   donate_argnames=("state",))
-def decode_step(state, params, cfg: TransformerConfig, top_k: int = 0):
-    """One token for every active row: sample from each row's last logits,
-    run the [slots, 1] forward at per-row positions, refresh the state.
-    Returns (state, sampled token [slots], emitted mask [slots]) — the host
-    dispatches ``token[i]`` to request ``i`` wherever ``emitted[i]``."""
+def _decode_step_body(state, params, cfg: TransformerConfig, top_k: int,
+                      eos_id: int | None):
+    """One decode step (traceable body shared by :func:`decode_step` and
+    :func:`decode_chunk`). With ``eos_id`` set, a row that samples it is
+    parked ON DEVICE (active cleared, write position parked at ``total``
+    like :func:`retire_row`) so a fused multi-step loop needs no host
+    round-trip per token to stop at EOS."""
     b = state["length"].shape[0]
     total = state["cache"]["k"].shape[2]
     emit = state["active"]
@@ -347,14 +347,54 @@ def decode_step(state, params, cfg: TransformerConfig, top_k: int = 0):
     step_inc = emit.astype(jnp.int32)
     length = p_b + step_inc
     remaining = state["remaining"] - step_inc
+    active = emit & (remaining > 0) & (length < total)
+    if eos_id is not None:
+        hit_eos = emit & (tok == eos_id)
+        active = active & ~hit_eos
+        # Park like retire_row: an out-of-bounds write position drops the
+        # row's cache scatter on subsequent fused steps.
+        length = jnp.where(hit_eos, total, length)
     new_state = {
         "cache": {"k": k_new, "v": v_new},
         "length": length,
         "remaining": remaining,
-        "active": emit & (remaining > 0) & (length < total),
+        "active": active,
         "temperature": state["temperature"],
         "last_logits": jnp.where(emit[:, None], logits,
                                  state["last_logits"]),
         "key": key,
     }
     return new_state, tok, emit
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "top_k", "eos_id"),
+                   donate_argnames=("state",))
+def decode_step(state, params, cfg: TransformerConfig, top_k: int = 0,
+                eos_id: int | None = None):
+    """One token for every active row: sample from each row's last logits,
+    run the [slots, 1] forward at per-row positions, refresh the state.
+    Returns (state, sampled token [slots], emitted mask [slots]) — the host
+    dispatches ``token[i]`` to request ``i`` wherever ``emitted[i]``."""
+    return _decode_step_body(state, params, cfg, top_k, eos_id)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "steps", "top_k", "eos_id"),
+                   donate_argnames=("state",))
+def decode_chunk(state, params, cfg: TransformerConfig, steps: int,
+                 top_k: int = 0, eos_id: int | None = None):
+    """``steps`` decode steps fused into ONE device dispatch via
+    ``lax.scan`` — the high-RTT-link decode path (VERDICT r3 #5: a
+    per-token dispatch costs ~2 tunnel round-trips here, so 32 tokens
+    paid ~64 RTTs; a K-step chunk pays 2 RTTs per K tokens). EOS and
+    row-exhaustion are handled inside the loop on device (rows park
+    exactly as :func:`retire_row` would). Returns
+    (state, tokens [steps, slots], emitted [steps, slots]); the host
+    flushes each request's stream once per chunk."""
+
+    def body(s, _):
+        s, tok, emit = _decode_step_body(s, params, cfg, top_k, eos_id)
+        return s, (tok, emit)
+
+    state, (toks, emits) = lax.scan(body, state, None, length=steps)
+    return state, toks, emits

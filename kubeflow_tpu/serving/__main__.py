@@ -34,6 +34,10 @@ def main(argv=None) -> int:
                    choices=["continuous", "lockstep"],
                    help="continuous: per-request lengths decoupled + "
                         "streaming; lockstep: one compiled call per batch")
+    p.add_argument("--decode-chunk", type=int, default=1,
+                   help="decode steps fused per device dispatch in "
+                        "continuous mode; set ~max-new-tokens on "
+                        "high-RTT links")
     p.add_argument("--dtype", default="",
                    choices=["", "bfloat16", "float32"],
                    help="compute dtype override; empty keeps the model "
@@ -58,6 +62,7 @@ def main(argv=None) -> int:
             top_k=args.top_k,
             eos_id=None if args.eos_id < 0 else args.eos_id,
             decode_mode=args.decode_mode,
+            decode_chunk=args.decode_chunk,
             dtype=args.dtype,
         ),
         port=args.rest_port,

@@ -36,6 +36,14 @@ class EngineConfig:
     # the right mode when host↔device RTT dominates (remote TPU tunnels)
     # or for offline batch predict.
     decode_mode: str = "continuous"
+    # Decode steps fused into one device dispatch in continuous mode
+    # (models/decode.py:decode_chunk). 1 = per-token dispatch (finest
+    # streaming/admission granularity; right for local TPU). K>1 pays
+    # K× fewer host↔device round-trips at up-to-K-step admission delay —
+    # set ~max_new_tokens on high-RTT links (measured on the dev tunnel:
+    # chunk 31 → 1.79× lockstep full-gen p50 vs chunk 8's 2.6×,
+    # BASELINE.md round 4) while keeping per-request decoupling.
+    decode_chunk: int = 1
     # Compute dtype override ("bfloat16"/"float32"); empty keeps the
     # model preset's dtype. The tpu-serving manifest's --dtype arg.
     dtype: str = ""

@@ -93,6 +93,7 @@ class ModelServer:
                     max_new_tokens=self.engine.cfg.max_new_tokens,
                     top_k=self.engine.cfg.top_k,
                     eos_id=self.engine.cfg.eos_id,
+                    chunk_size=self.engine.cfg.decode_chunk,
                 )
             return self._decoder
 
@@ -220,6 +221,9 @@ class ModelServer:
                         text += (
                             "# TYPE serving_decode_steps_total counter\n"
                             f"serving_decode_steps_total {d['decode_steps']}\n"
+                            "# TYPE serving_decode_dispatches_total counter\n"
+                            "serving_decode_dispatches_total "
+                            f"{d['decode_dispatches']}\n"
                             "# TYPE serving_tokens_emitted_total counter\n"
                             "serving_tokens_emitted_total "
                             f"{d['tokens_emitted']}\n"
