@@ -20,8 +20,12 @@ import jax
 from kubeflow_tpu.apis.jobs import (
     ENV_COORDINATOR_ADDRESS,
     ENV_NUM_PROCESSES,
+    ENV_NUM_SLICES,
     ENV_PROCESS_ID,
+    ENV_SLICE_ID,
 )
+
+ENV_MEGASCALE_COORDINATOR = "MEGASCALE_COORDINATOR_ADDRESS"
 
 
 @dataclass(frozen=True)
@@ -29,10 +33,22 @@ class ProcessInfo:
     coordinator_address: str | None
     num_processes: int
     process_id: int
+    # Multislice (MEGASCALE) topology, injected by the JaxJob controller
+    # when spec.tpu.numSlices > 1 (operators/jobs.py): libtpu's DCN
+    # transport reads MEGASCALE_COORDINATOR_ADDRESS; the mesh layer reads
+    # num_slices to put the slice dimension on the data axis
+    # (parallel/mesh.py hybrid placement).
+    num_slices: int = 1
+    slice_id: int = 0
+    megascale_coordinator: str | None = None
 
     @property
     def is_distributed(self) -> bool:
         return self.num_processes > 1
+
+    @property
+    def is_multislice(self) -> bool:
+        return self.num_slices > 1
 
 
 def process_info_from_env(environ=None) -> ProcessInfo:
@@ -41,6 +57,9 @@ def process_info_from_env(environ=None) -> ProcessInfo:
         coordinator_address=env.get(ENV_COORDINATOR_ADDRESS),
         num_processes=int(env.get(ENV_NUM_PROCESSES, "1")),
         process_id=int(env.get(ENV_PROCESS_ID, "0")),
+        num_slices=int(env.get(ENV_NUM_SLICES, "1")),
+        slice_id=int(env.get(ENV_SLICE_ID, "0")),
+        megascale_coordinator=env.get(ENV_MEGASCALE_COORDINATOR),
     )
 
 
@@ -48,8 +67,26 @@ def initialize_from_env(environ=None) -> ProcessInfo:
     """Join the job's collective. No-op for single-process jobs, so the same
     worker image runs unmodified on one chip or a multi-host slice (the
     property the reference gets from launcher.py tolerating absent TF_CONFIG).
+
+    On a multislice gang the controller also injects the MEGASCALE vars;
+    libtpu reads them from the process environment at backend init, so
+    when the caller passed an explicit ``environ`` they are exported
+    before ``jax.distributed.initialize`` creates the TPU client.
     """
     info = process_info_from_env(environ)
+    if info.is_multislice:
+        if not info.megascale_coordinator:
+            raise RuntimeError(
+                f"{ENV_NUM_SLICES}>1 but {ENV_MEGASCALE_COORDINATOR} is "
+                "unset; the JaxJob controller must inject the DCN "
+                "coordinator address"
+            )
+        # libtpu reads these from os.environ, not from any argument —
+        # assign unconditionally so a stale inherited value can't make
+        # libtpu and the mesh layer disagree on the DCN topology.
+        os.environ[ENV_MEGASCALE_COORDINATOR] = info.megascale_coordinator
+        os.environ[ENV_NUM_SLICES] = str(info.num_slices)
+        os.environ[ENV_SLICE_ID] = str(info.slice_id)
     if info.is_distributed:
         if not info.coordinator_address:
             raise RuntimeError(

@@ -111,20 +111,26 @@ def hybrid_shapes(degrees: dict[str, int], num_slices: int
     return ici, dcn
 
 
-def build_mesh(
+def arrange_devices(
     config: MeshConfig | None = None,
     *,
     devices: Sequence[jax.Device] | None = None,
-) -> Mesh:
-    """Build a Mesh with the canonical axis names.
+    num_slices: int | None = None,
+) -> np.ndarray:
+    """Place devices into the canonical [data, pipeline, fsdp, expert,
+    sequence, tensor] array (the Mesh body, separated from Mesh
+    construction so placement is unit-testable with fabricated devices).
 
-    On TPU, delegates device placement to ``mesh_utils.create_device_mesh``
-    so axes map contiguously onto the physical torus; on a multislice
+    On TPU, placement delegates to ``mesh_utils.create_device_mesh`` so
+    axes map contiguously onto the physical torus; on a multislice
     deployment (devices report distinct ``slice_index``es — the MEGASCALE
-    path the operator configures) the hybrid builder keeps ICI-hungry axes
-    within slices and spans slices on the data axis over DCN. On
-    CPU/virtual devices it reshapes the flat device list (placement is
-    meaningless there).
+    path the operator configures) the hybrid builder keeps ICI-hungry
+    axes within slices and spans slices on the data axis over DCN. On
+    CPU/virtual devices the flat device list is reshaped (placement is
+    meaningless there), but ``num_slices`` still applies the hybrid
+    data-axis split with slice-major device grouping — the emulation the
+    multichip dryrun and the fake-slice E2E run so the DCN-mapped mesh
+    path executes without multislice hardware.
     """
     config = config or MeshConfig()
     devices = list(devices if devices is not None else jax.devices())
@@ -134,18 +140,47 @@ def build_mesh(
         from jax.experimental import mesh_utils
 
         slice_ids = {getattr(d, "slice_index", 0) for d in devices}
-        if len(slice_ids) > 1:
-            ici, dcn = hybrid_shapes(degrees, len(slice_ids))
-            mesh_devices = mesh_utils.create_hybrid_device_mesh(
+        n_slices = num_slices or len(slice_ids)
+        if n_slices > 1:
+            if len(slice_ids) != n_slices:
+                # create_hybrid_device_mesh requires the devices to
+                # actually span n_slices slices; fail with the real
+                # reason instead of its internal shape error.
+                raise ValueError(
+                    f"num_slices={n_slices} but the TPU devices report "
+                    f"{len(slice_ids)} distinct slice_index value(s) — "
+                    "multislice placement needs a multislice gang "
+                    "(MEGASCALE env via the JaxJob controller)"
+                )
+            ici, dcn = hybrid_shapes(degrees, n_slices)
+            return mesh_utils.create_hybrid_device_mesh(
                 ici, dcn, devices=np.asarray(devices)
             )
-        else:
-            mesh_devices = mesh_utils.create_device_mesh(
-                shape, devices=np.asarray(devices)
-            )
-    else:
-        mesh_devices = np.asarray(devices).reshape(shape)
-    return Mesh(mesh_devices, MESH_AXES)
+        return mesh_utils.create_device_mesh(
+            shape, devices=np.asarray(devices)
+        )
+    if num_slices and num_slices > 1:
+        # Emulated multislice: hybrid_shapes validates the DCN split; the
+        # slice-major layout then comes for free from the plain reshape —
+        # data is the leading mesh axis, so contiguous per-slice device
+        # groups land on contiguous data-axis rows (the same logical
+        # layout create_hybrid_device_mesh produces).
+        hybrid_shapes(degrees, num_slices)
+    return np.asarray(devices).reshape(shape)
+
+
+def build_mesh(
+    config: MeshConfig | None = None,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+    num_slices: int | None = None,
+) -> Mesh:
+    """Build a Mesh with the canonical axis names (see
+    :func:`arrange_devices` for placement semantics)."""
+    return Mesh(
+        arrange_devices(config, devices=devices, num_slices=num_slices),
+        MESH_AXES,
+    )
 
 
 def single_device_mesh(device: jax.Device | None = None) -> Mesh:

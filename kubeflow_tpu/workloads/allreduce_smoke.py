@@ -48,6 +48,38 @@ def main(argv=None) -> int:
         "expected": want,
         "ok": abs(got - want) < 1e-4,
     }
+
+    if info.is_multislice:
+        # Multislice gang: the controller injected the MEGASCALE env;
+        # prove the DCN-mapped mesh path end to end — hybrid placement
+        # (slices span the data axis, parallel/mesh.py), a global array
+        # sharded over it, and a cross-slice reduction.
+        import os
+
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+
+        mesh = build_mesh(MeshConfig(data=-1),
+                          num_slices=info.num_slices)
+        arr = jax.make_array_from_callback(
+            (n_global,), NamedSharding(mesh, P("data")),
+            lambda idx: np.full((1,), args.value, np.float32),
+        )
+        total = jax.jit(jnp.sum,
+                        out_shardings=NamedSharding(mesh, P()))(arr)
+        result.update({
+            "num_slices": info.num_slices,
+            "slice_id": info.slice_id,
+            "megascale_coordinator":
+                os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"),
+            "hybrid_mesh_data_degree": mesh.shape["data"],
+            "dcn_psum": float(total),
+            "ok": result["ok"]
+            and abs(float(total) - args.value * n_global) < 1e-4,
+        })
+
     print(json.dumps(result))
     shutdown()
     return 0 if result["ok"] else 1
