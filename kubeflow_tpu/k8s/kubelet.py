@@ -48,6 +48,12 @@ def _loopback(value: str) -> str:
     return f"127.0.0.1{sep}{port}" if sep else "127.0.0.1"
 
 
+# Tail of a pod's output kept in status.log (the kubectl-logs analogue).
+# Sized so a few-hundred-step training log survives whole — the
+# preemption-resume E2E reads per-step losses out of it.
+_LOG_TAIL = 16384
+
+
 @dataclass
 class _Running:
     proc: subprocess.Popen
@@ -134,7 +140,8 @@ class FakeKubelet:
         self._set_phase(pod, "Running")
 
     def _set_phase(self, pod: dict, phase: str,
-                   exit_code: int | None = None, log: str = "") -> None:
+                   exit_code: int | None = None, log: str = "",
+                   reason: str | None = None) -> None:
         name = pod["metadata"]["name"]
         ns = pod["metadata"]["namespace"]
         try:
@@ -143,6 +150,8 @@ class FakeKubelet:
             return  # pod deleted under us (gang restart / job teardown)
         status = current.setdefault("status", {})
         status["phase"] = phase
+        if reason is not None:
+            status["reason"] = reason
         if exit_code is not None:
             container = current["spec"]["containers"][0]
             status["containerStatuses"] = [{
@@ -150,8 +159,19 @@ class FakeKubelet:
                 "state": {"terminated": {"exitCode": exit_code}},
             }]
         if log:
-            status["log"] = log[-4000:]
+            status["log"] = log[-_LOG_TAIL:]
         self.client.update_status(current)
+
+    @staticmethod
+    def _read_tail(run: "_Running") -> str:
+        """Drain the pod's spooled output (last 64KB) and close the file."""
+        if run.out_file is None:
+            return ""
+        size = run.out_file.seek(0, 2)
+        run.out_file.seek(max(0, size - 65536))
+        out = run.out_file.read().decode("utf-8", "replace")
+        run.out_file.close()
+        return out
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -174,14 +194,9 @@ class FakeKubelet:
                     rc = -9
                 else:
                     continue
-            out = ""
-            if run.out_file is not None:
-                # Only the tail survives into status.log — don't
-                # materialize a long-running pod's full output.
-                size = run.out_file.seek(0, 2)
-                run.out_file.seek(max(0, size - 65536))
-                out = run.out_file.read().decode("utf-8", "replace")
-                run.out_file.close()
+            # Only the tail survives into status.log — don't materialize
+            # a long-running pod's full output.
+            out = self._read_tail(run)
             pod = {"metadata": {"namespace": key[0], "name": key[1]}}
             try:
                 pod = self.client.get(POD_API, "Pod", key[1], key[0])
@@ -194,6 +209,34 @@ class FakeKubelet:
                 )
             del self._running[key]
         return len(self._running)
+
+    def evict(self, name: str, namespace: str = "kubeflow",
+              reason: str = "Preempted") -> bool:
+        """Node-pressure eviction: kill the pod's process mid-run and mark
+        it Failed with ``reason`` — exactly what a real kubelet reports
+        when the node is reclaimed, and the signal the JobController's
+        gang logic keys preemption handling on (restart without burning
+        backoffLimit).
+
+        Returns False without touching status if the pod is not actively
+        running (already finished or never started): fabricating a
+        preemption on a completed pod would make the controller restart a
+        job that succeeded. A finished-but-unreaped process is left for
+        ``step()`` to reap with its real exit status."""
+        key = (namespace, name)
+        run = self._running.get(key)
+        if run is None or run.proc.poll() is not None:
+            return False
+        del self._running[key]
+        run.proc.kill()
+        run.proc.wait()
+        try:
+            pod = self.client.get(POD_API, "Pod", name, namespace)
+        except ApiError:
+            return False
+        self._set_phase(pod, "Failed", exit_code=137,
+                        log=self._read_tail(run), reason=reason)
+        return True
 
     def run_until_idle(self, *, reconcile=None, deadline: float = 180.0,
                        poll: float = 0.2) -> None:

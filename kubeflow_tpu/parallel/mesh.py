@@ -136,22 +136,23 @@ def arrange_devices(
     devices = list(devices if devices is not None else jax.devices())
     degrees = config.resolve(len(devices))
     shape = tuple(degrees[a] for a in MESH_AXES)
+    if num_slices is not None and num_slices < 1:
+        raise ValueError(f"num_slices must be >= 1, got {num_slices}")
     if devices[0].platform == "tpu":
         from jax.experimental import mesh_utils
 
         slice_ids = {getattr(d, "slice_index", 0) for d in devices}
-        n_slices = num_slices or len(slice_ids)
+        n_slices = len(slice_ids) if num_slices is None else num_slices
+        if len(slice_ids) != n_slices:
+            # An explicit degree must match what the hardware reports —
+            # a mismatch would either feed create_hybrid_device_mesh an
+            # impossible DCN shape or (num_slices=1 on a multislice gang)
+            # silently map ICI-hungry axes across the DCN boundary.
+            raise ValueError(
+                f"num_slices={n_slices} but the TPU devices report "
+                f"{len(slice_ids)} distinct slice_index value(s)"
+            )
         if n_slices > 1:
-            if len(slice_ids) != n_slices:
-                # create_hybrid_device_mesh requires the devices to
-                # actually span n_slices slices; fail with the real
-                # reason instead of its internal shape error.
-                raise ValueError(
-                    f"num_slices={n_slices} but the TPU devices report "
-                    f"{len(slice_ids)} distinct slice_index value(s) — "
-                    "multislice placement needs a multislice gang "
-                    "(MEGASCALE env via the JaxJob controller)"
-                )
             ici, dcn = hybrid_shapes(degrees, n_slices)
             return mesh_utils.create_hybrid_device_mesh(
                 ici, dcn, devices=np.asarray(devices)

@@ -47,8 +47,11 @@ def synthetic_batch(model: ModelSpec, batch_size: int, seq_len: int = 512,
 
 
 def synthetic_stream(model: ModelSpec, batch_size: int, seq_len: int = 512,
-                     seed: int = 0) -> Iterator[dict]:
-    step = 0
+                     seed: int = 0, start_step: int = 0) -> Iterator[dict]:
+    """Stateless in (seed, step): a run resumed at ``start_step`` replays
+    the exact batches the uninterrupted run would have seen — the same
+    data-exact-resume contract the token-store stream keeps."""
+    step = start_step
     while True:
         yield synthetic_batch(model, batch_size, seq_len, seed=seed + step)
         step += 1

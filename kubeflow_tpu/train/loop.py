@@ -57,7 +57,12 @@ def run(cfg: RunConfig, *, log=print) -> dict:
     """Train; returns final metrics {step, loss, samples_per_sec, ...}."""
     info = initialize_from_env()
     model = get_model(cfg.model, **cfg.model_overrides)
-    mesh = build_mesh(cfg.mesh)
+    # A multislice gang (MEGASCALE env) must get the hybrid DCN placement —
+    # slices span the data axis; ICI-hungry axes stay within slices.
+    mesh = build_mesh(
+        cfg.mesh,
+        num_slices=info.num_slices if info.is_multislice else None,
+    )
     opt_cfg = cfg.optimizer
 
     state = init_state(jax.random.PRNGKey(cfg.seed), model, opt_cfg, mesh)
@@ -94,7 +99,7 @@ def run(cfg: RunConfig, *, log=print) -> dict:
             )
     else:
         stream = synthetic_stream(model, cfg.batch_size, cfg.seq_len,
-                                  seed=cfg.seed)
+                                  seed=cfg.seed, start_step=start_step)
 
     metrics = {}
     t_last = time.perf_counter()
