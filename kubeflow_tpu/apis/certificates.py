@@ -1,0 +1,168 @@
+"""Certificate / Issuer / Endpoint CRD APIs — the secure-entrypoint types.
+
+Analogues of the reference's cert-manager + cloud-endpoints surface:
+
+- Issuer — the root of trust a Certificate references. ``selfSigned``
+  issuers hold a platform-generated CA (the in-cluster analogue of
+  cert-manager's selfSigned/CA issuers); the ``acme`` stanza mirrors the
+  reference's letsencrypt issuer param
+  (/root/reference/kubeflow/gcp/prototypes/cert-manager.jsonnet:8
+  ``acmeUrl https://acme-v02.api.letsencrypt.org/directory``) and drives
+  the order state machine in the controller.
+- Certificate — dnsNames + issuerRef + secretName + duration/renewBefore;
+  the controller issues into the Secret and rotates before expiry
+  (iap.libsonnet wires the equivalent secret into the ESP/envoy ingress,
+  /root/reference/kubeflow/gcp/iap.libsonnet:1-1041).
+- Endpoint — hostname → target service record, the cloud-endpoints
+  analogue (/root/reference/kubeflow/gcp/prototypes/cloud-endpoints.jsonnet:1-11
+  maintains Cloud DNS records for <name>.endpoints.<project>.cloud.goog);
+  here records land in the platform's zone ConfigMap, which in-cluster
+  resolvers and the deploy UI read.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.k8s import objects as k8s
+from kubeflow_tpu.version import API_GROUP
+
+CERT_API_GROUP = f"cert.{API_GROUP}"
+CERTS_API_VERSION = f"{CERT_API_GROUP}/v1"
+ISSUER_KIND = "Issuer"
+ISSUER_PLURAL = "issuers"
+CERTIFICATE_KIND = "Certificate"
+CERTIFICATE_PLURAL = "certificates"
+ENDPOINT_KIND = "Endpoint"
+ENDPOINT_PLURAL = "endpoints"
+
+# The DNS-zone record store the Endpoint controller maintains.
+DNS_ZONE_CONFIGMAP = "kubeflow-dns-zone"
+
+COND_READY = "Ready"
+
+# ACME-style order states (the issuance state machine).
+ORDER_PENDING = "Pending"
+ORDER_VALIDATED = "Validated"
+ORDER_ISSUED = "Issued"
+
+
+def issuer_crd() -> dict:
+    schema = {
+        "type": "object",
+        "properties": {
+            "spec": {
+                "type": "object",
+                "properties": {
+                    "selfSigned": {
+                        "type": "object",
+                        "properties": {
+                            "commonName": {"type": "string"},
+                        },
+                    },
+                    "acme": {
+                        "type": "object",
+                        "properties": {
+                            "url": {"type": "string"},
+                            "email": {"type": "string"},
+                        },
+                    },
+                },
+                "x-kubernetes-preserve-unknown-fields": True,
+            },
+            "status": {"type": "object",
+                       "x-kubernetes-preserve-unknown-fields": True},
+        },
+    }
+    return k8s.crd(
+        group=CERT_API_GROUP,
+        kind=ISSUER_KIND,
+        plural=ISSUER_PLURAL,
+        categories=["kubeflow-tpu"],
+        versions=[k8s.crd_version(
+            "v1", schema=schema, storage=True,
+            printer_columns=[
+                k8s.printer_column("Ready", ".status.ready"),
+                k8s.printer_column("Age", ".metadata.creationTimestamp",
+                                   "date"),
+            ],
+        )],
+    )
+
+
+def certificate_crd() -> dict:
+    schema = {
+        "type": "object",
+        "properties": {
+            "spec": {
+                "type": "object",
+                "required": ["secretName", "dnsNames", "issuerRef"],
+                "properties": {
+                    "secretName": {"type": "string"},
+                    "dnsNames": {
+                        "type": "array",
+                        "items": {"type": "string"},
+                        "minItems": 1,
+                    },
+                    "issuerRef": {
+                        "type": "object",
+                        "required": ["name"],
+                        "properties": {"name": {"type": "string"}},
+                    },
+                    "durationSeconds": {"type": "integer", "minimum": 1},
+                    "renewBeforeSeconds": {"type": "integer", "minimum": 0},
+                },
+            },
+            "status": {"type": "object",
+                       "x-kubernetes-preserve-unknown-fields": True},
+        },
+    }
+    return k8s.crd(
+        group=CERT_API_GROUP,
+        kind=CERTIFICATE_KIND,
+        plural=CERTIFICATE_PLURAL,
+        short_names=["cert"],
+        categories=["kubeflow-tpu"],
+        versions=[k8s.crd_version(
+            "v1", schema=schema, storage=True,
+            printer_columns=[
+                k8s.printer_column("Ready", ".status.ready"),
+                k8s.printer_column("NotAfter", ".status.notAfter"),
+                k8s.printer_column("Revision", ".status.revision"),
+            ],
+        )],
+    )
+
+
+def endpoint_crd() -> dict:
+    schema = {
+        "type": "object",
+        "properties": {
+            "spec": {
+                "type": "object",
+                "required": ["hostname", "target"],
+                "properties": {
+                    "hostname": {"type": "string"},
+                    "target": {"type": "string"},
+                },
+            },
+            "status": {"type": "object",
+                       "x-kubernetes-preserve-unknown-fields": True},
+        },
+    }
+    return k8s.crd(
+        group=CERT_API_GROUP,
+        kind=ENDPOINT_KIND,
+        plural=ENDPOINT_PLURAL,
+        categories=["kubeflow-tpu"],
+        versions=[k8s.crd_version(
+            "v1", schema=schema, storage=True,
+            printer_columns=[
+                k8s.printer_column("Hostname", ".spec.hostname"),
+                k8s.printer_column("Target", ".spec.target"),
+                k8s.printer_column("Ready", ".status.ready"),
+            ],
+        )],
+    )
+
+
+def all_cert_crds() -> list[dict]:
+    return [issuer_crd(), certificate_crd(), endpoint_crd()]

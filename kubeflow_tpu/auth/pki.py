@@ -1,0 +1,165 @@
+"""X.509 issuance primitives for the certificate controller.
+
+The reference delegates certificate lifecycle to cert-manager
+(/root/reference/kubeflow/gcp/prototypes/cert-manager.jsonnet:1-12 deploys
+the upstream controller with an ACME letsencrypt issuer;
+iap.libsonnet:1-1041 wires the resulting secrets into the ingress). This
+platform issues in-process: a self-signed CA per Issuer CR and leaf
+certificates signed by it, with the rotation state machine living in
+:mod:`kubeflow_tpu.operators.certificates`.
+
+Uses the ``cryptography`` package (present in the base image); imports are
+function-local so the rest of the platform never pays for (or fails on)
+it — anything importing this module is already certificate machinery.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from datetime import datetime, timedelta, timezone
+
+_EC_CURVE = "secp256r1"  # small keys, fast issuance; TLS-universal
+
+
+@dataclass(frozen=True)
+class KeyCert:
+    """PEM-encoded private key + certificate (and the issuing CA chain)."""
+
+    key_pem: str
+    cert_pem: str
+    ca_pem: str = ""
+
+    @property
+    def chain_pem(self) -> str:
+        """Leaf followed by CA — what a TLS server presents."""
+        return self.cert_pem + self.ca_pem
+
+
+def _new_key():
+    from cryptography.hazmat.primitives.asymmetric import ec
+
+    return ec.generate_private_key(ec.SECP256R1())
+
+
+def _key_pem(key) -> str:
+    from cryptography.hazmat.primitives import serialization
+
+    return key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption(),
+    ).decode()
+
+
+def _cert_pem(cert) -> str:
+    from cryptography.hazmat.primitives import serialization
+
+    return cert.public_bytes(serialization.Encoding.PEM).decode()
+
+
+def make_ca(common_name: str, *, days: int = 3650) -> KeyCert:
+    """Self-signed CA — the Issuer CR's root of trust."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.x509.oid import NameOID
+
+    key = _new_key()
+    name = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, common_name)]
+    )
+    now = datetime.now(timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - timedelta(minutes=5))
+        .not_valid_after(now + timedelta(days=days))
+        .add_extension(x509.BasicConstraints(ca=True, path_length=0),
+                       critical=True)
+        .add_extension(
+            x509.KeyUsage(
+                digital_signature=True, key_cert_sign=True, crl_sign=True,
+                content_commitment=False, key_encipherment=False,
+                data_encipherment=False, key_agreement=False,
+                encipher_only=False, decipher_only=False,
+            ),
+            critical=True,
+        )
+        .sign(key, hashes.SHA256())
+    )
+    pem = _cert_pem(cert)
+    return KeyCert(key_pem=_key_pem(key), cert_pem=pem, ca_pem=pem)
+
+
+def issue(
+    ca: KeyCert,
+    dns_names: list[str],
+    *,
+    duration_seconds: int,
+    common_name: str | None = None,
+) -> KeyCert:
+    """Issue a leaf certificate for ``dns_names`` signed by ``ca``."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.serialization import (
+        load_pem_private_key,
+    )
+    from cryptography.x509.oid import (
+        ExtendedKeyUsageOID,
+        NameOID,
+    )
+
+    if not dns_names:
+        raise ValueError("certificate needs at least one dnsName")
+    ca_key = load_pem_private_key(ca.key_pem.encode(), password=None)
+    ca_cert = x509.load_pem_x509_certificate(ca.cert_pem.encode())
+    key = _new_key()
+    now = datetime.now(timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(x509.Name([x509.NameAttribute(
+            NameOID.COMMON_NAME, common_name or dns_names[0])]))
+        .issuer_name(ca_cert.subject)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - timedelta(minutes=5))
+        .not_valid_after(now + timedelta(seconds=duration_seconds))
+        .add_extension(
+            x509.SubjectAlternativeName(
+                [x509.DNSName(n) for n in dns_names]),
+            critical=False,
+        )
+        .add_extension(x509.BasicConstraints(ca=False, path_length=None),
+                       critical=True)
+        .add_extension(
+            x509.ExtendedKeyUsage([ExtendedKeyUsageOID.SERVER_AUTH]),
+            critical=False,
+        )
+        .sign(ca_key, hashes.SHA256())
+    )
+    return KeyCert(key_pem=_key_pem(key), cert_pem=_cert_pem(cert),
+                   ca_pem=ca.cert_pem)
+
+
+def cert_info(cert_pem: str) -> dict:
+    """Expiry/identity facts the rotation state machine keys on."""
+    from cryptography import x509
+    from cryptography.x509.oid import ExtensionOID
+
+    cert = x509.load_pem_x509_certificate(cert_pem.encode())
+    try:
+        san = cert.extensions.get_extension_for_oid(
+            ExtensionOID.SUBJECT_ALTERNATIVE_NAME
+        ).value
+        dns_names = san.get_values_for_type(x509.DNSName)
+    except x509.ExtensionNotFound:
+        dns_names = []
+    return {
+        "serial": format(cert.serial_number, "x"),
+        "not_before": cert.not_valid_before_utc,
+        "not_after": cert.not_valid_after_utc,
+        "dns_names": list(dns_names),
+        "issuer": cert.issuer.rfc4514_string(),
+    }

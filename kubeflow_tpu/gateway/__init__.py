@@ -236,6 +236,10 @@ class Gateway:
         resolve: Callable[[str], str] | None = None,
         certfile: str = "",
         keyfile: str = "",
+        cert_reload_seconds: float = 5.0,
+        redirect_port: int | None = None,
+        redirect_target_port: int | None = None,
+        challenge_lookup: Callable[[str], str | None] | None = None,
         upstream_timeout: float = 60.0,
         rng=None,
     ):
@@ -247,9 +251,26 @@ class Gateway:
         self.upstream_timeout = upstream_timeout
         # TLS termination at the gateway (the iap-ingress/cert-manager
         # role, kubeflow/gcp/iap.libsonnet): cert+key mounted from a
-        # Secret; empty = plain HTTP (in-mesh or behind an LB).
+        # Secret; empty = plain HTTP (in-mesh or behind an LB). The
+        # mounted files are WATCHED: when the certificate controller
+        # rotates the secret, new handshakes pick up the new cert from
+        # the same SSLContext without dropping the listener or any
+        # established connection (cert_reload_seconds poll; 0 disables).
         self.certfile = certfile
         self.keyfile = keyfile
+        self.cert_reload_seconds = cert_reload_seconds
+        # components/https-redirect analogue: a plain-HTTP listener that
+        # 301s every request to the HTTPS entrypoint. None = disabled.
+        # ``redirect_target_port`` is the EXTERNALLY advertised HTTPS port
+        # (None = omit, the :443 default) — behind a Service mapping
+        # 443→bind-port, the bind port must never leak into Location.
+        self.redirect_port = redirect_port
+        self.redirect_target_port = redirect_target_port
+        # ACME HTTP-01: serves /.well-known/acme-challenge/<token> from
+        # the certificate controller's published challenges (the path a
+        # letsencrypt-style validator fetches pre-issuance).
+        self.challenge_lookup = challenge_lookup
+        self.cert_reloads = 0
         # Weight-draw source for traffic splitting (seedable in tests).
         self.rng = rng or random.Random()
         # Reward averages for epsilon-greedy (bandit) routes.
@@ -260,6 +281,9 @@ class Gateway:
         self.shadow_total = 0
         self._proxy: ThreadingHTTPServer | None = None
         self._admin: ThreadingHTTPServer | None = None
+        self._redirect: ThreadingHTTPServer | None = None
+        self._ssl_ctx = None
+        self._cert_watch_stop = threading.Event()
 
     # -- auth ---------------------------------------------------------------
 
@@ -306,6 +330,16 @@ class Gateway:
                 gw.requests_total += 1
                 if self.path == "/healthz":
                     self._respond(200, b'{"status":"ok"}')
+                    return
+                if self.path.startswith("/.well-known/acme-challenge/"):
+                    token = self.path.rsplit("/", 1)[1]
+                    body = (gw.challenge_lookup(token)
+                            if gw.challenge_lookup else None)
+                    if body is None:
+                        self._respond(404, b'{"error":"unknown challenge"}')
+                    else:
+                        self._respond(200, body.encode(),
+                                      {"Content-Type": "text/plain"})
                     return
                 route = gw.table.match(self.path)
                 if route is None:
@@ -668,20 +702,90 @@ class Gateway:
 
         return Handler
 
+    def _watch_certs(self) -> None:
+        """Poll the cert/key files; on change, reload them into the SAME
+        SSLContext — new handshakes present the rotated certificate while
+        the listener and every established connection stay up (the
+        rotation contract the certificate controller relies on)."""
+        import os
+
+        def stamp():
+            try:
+                return (os.stat(self.certfile).st_mtime_ns,
+                        os.stat(self.keyfile).st_mtime_ns
+                        if self.keyfile else 0)
+            except OSError:
+                return None
+
+        last = stamp()
+        while not self._cert_watch_stop.wait(self.cert_reload_seconds):
+            now = stamp()
+            if now is None or now == last:
+                continue
+            try:
+                self._ssl_ctx.load_cert_chain(self.certfile,
+                                              self.keyfile or None)
+                self.cert_reloads += 1
+                last = now
+            except (OSError, ValueError):
+                # Mid-rotation read (cert/key momentarily mismatched):
+                # keep serving the previous pair; next poll retries.
+                pass
+
+    def _make_redirect_handler(gw: "Gateway"):
+        class Redirect(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _redirect(self):
+                host = (self.headers.get("Host") or "").split(":")[0]
+                if not host:
+                    # No Host → no valid Location to build.
+                    self.send_response(400)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                target = gw.redirect_target_port
+                port = "" if target in (None, 443) else f":{target}"
+                self.send_response(301)
+                self.send_header("Location",
+                                 f"https://{host}{port}{self.path}")
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            do_GET = do_POST = do_PUT = do_DELETE = do_HEAD = _redirect
+
+        return Redirect
+
     def start(self) -> None:
         self._proxy = ThreadingHTTPServer(
             ("0.0.0.0", self.port), self._make_proxy_handler()
         )
+        self.port = self._proxy.server_address[1]  # resolve port 0
         if self.certfile:
             import ssl
 
-            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-            ctx.load_cert_chain(self.certfile, self.keyfile or None)
-            self._proxy.socket = ctx.wrap_socket(
+            self._ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            self._ssl_ctx.load_cert_chain(self.certfile,
+                                          self.keyfile or None)
+            self._proxy.socket = self._ssl_ctx.wrap_socket(
                 self._proxy.socket, server_side=True
             )
+            if self.cert_reload_seconds > 0:
+                threading.Thread(target=self._watch_certs,
+                                 daemon=True).start()
         threading.Thread(target=self._proxy.serve_forever,
                          daemon=True).start()
+        if self.redirect_port is not None:
+            self._redirect = ThreadingHTTPServer(
+                ("0.0.0.0", self.redirect_port),
+                self._make_redirect_handler(),
+            )
+            self.redirect_port = self._redirect.server_address[1]
+            threading.Thread(target=self._redirect.serve_forever,
+                             daemon=True).start()
         if self.admin_port:
             self._admin = ThreadingHTTPServer(
                 ("0.0.0.0", self.admin_port), self._make_admin_handler()
@@ -690,6 +794,7 @@ class Gateway:
                              daemon=True).start()
 
     def stop(self) -> None:
-        for httpd in (self._proxy, self._admin):
+        self._cert_watch_stop.set()
+        for httpd in (self._proxy, self._admin, self._redirect):
             if httpd:
                 httpd.shutdown()

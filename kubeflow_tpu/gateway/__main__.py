@@ -29,14 +29,55 @@ def main(argv=None) -> int:
                    help="PEM cert chain for TLS termination (the "
                         "iap/cert-manager ingress role); empty = HTTP")
     p.add_argument("--tls-key", default="", help="PEM private key")
+    p.add_argument("--watch-certs", type=float, default=5.0,
+                   help="seconds between cert-file freshness checks; the "
+                        "certificate controller's rotations hot-reload "
+                        "without dropping connections (0 disables)")
+    p.add_argument("--redirect-port", type=int, default=None,
+                   help="plain-HTTP port 301ing to the HTTPS entrypoint "
+                        "(components/https-redirect analogue)")
+    p.add_argument("--redirect-target-port", type=int, default=None,
+                   help="externally advertised HTTPS port for redirect "
+                        "Locations (default: omitted = 443); required "
+                        "when the public port differs from the bind port")
+    p.add_argument("--serve-acme-challenges", action="store_true",
+                   help="serve /.well-known/acme-challenge/<token> from "
+                        "the certificate controller's published tokens")
     args = p.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
     client = client_from_args(args)
     table = RouteTable()
+    challenge_lookup = None
+    if args.serve_acme_challenges:
+        from kubeflow_tpu.operators.certificates import (
+            ACME_CHALLENGE_CONFIGMAP,
+        )
+
+        def challenge_lookup(token: str) -> str | None:
+            from kubeflow_tpu.k8s.client import ApiError
+
+            try:
+                cm = client.get("v1", "ConfigMap",
+                                ACME_CHALLENGE_CONFIGMAP, args.namespace)
+            except ApiError as e:
+                if e.code != 404:
+                    # RBAC/addressing problems must be debuggable, not
+                    # silent 404s on every challenge.
+                    log.warning("acme challenge lookup failed: %s", e)
+                return None
+            # HTTP-01 body is the token itself (key-authorization
+            # simplified to the platform's in-cluster validation).
+            return token if token in (cm.get("data") or {}).values() \
+                else None
+
     gw = Gateway(table, port=args.port, admin_port=args.admin_port,
                  auth_url=args.auth_url, certfile=args.tls_cert,
-                 keyfile=args.tls_key)
+                 keyfile=args.tls_key,
+                 cert_reload_seconds=args.watch_certs,
+                 redirect_port=args.redirect_port,
+                 redirect_target_port=args.redirect_target_port,
+                 challenge_lookup=challenge_lookup)
     gw.start()
     log.info("gateway on :%d (admin :%d)", args.port, args.admin_port)
     try:

@@ -218,7 +218,7 @@ class FakeKubelet:
         gang logic keys preemption handling on (restart without burning
         backoffLimit).
 
-        Returns False without touching status if the pod is not actively
+        Returns False without killing anything if the pod is not actively
         running (already finished or never started): fabricating a
         preemption on a completed pod would make the controller restart a
         job that succeeded. A finished-but-unreaped process is left for
@@ -230,12 +230,13 @@ class FakeKubelet:
         del self._running[key]
         run.proc.kill()
         run.proc.wait()
+        log = self._read_tail(run)  # always drain+close the spool
         try:
             pod = self.client.get(POD_API, "Pod", name, namespace)
         except ApiError:
-            return False
-        self._set_phase(pod, "Failed", exit_code=137,
-                        log=self._read_tail(run), reason=reason)
+            return True  # evicted; pod object deleted concurrently
+        self._set_phase(pod, "Failed", exit_code=137, log=log,
+                        reason=reason)
         return True
 
     def run_until_idle(self, *, reconcile=None, deadline: float = 180.0,

@@ -1,0 +1,215 @@
+"""Secure-entrypoint package: certificate lifecycle + managed ingress + DNS.
+
+The analogue of the reference's GCP entrypoint machinery — its largest
+single package:
+
+- ``cert-manager`` ↔ /root/reference/kubeflow/gcp/prototypes/cert-manager.jsonnet:1-12
+  (upstream cert-manager Deployment with a letsencrypt ACME issuer): here
+  the platform's own Issuer/Certificate CRDs + controller.
+- ``secure-ingress`` ↔ prototypes/iap-ingress.jsonnet:5-12 +
+  kubeflow/gcp/iap.libsonnet:1-1041 (envoy config, backend/cert wiring)
+  + components/https-redirect: a gateway terminating TLS with a
+  controller-managed certificate (hot-reloaded on rotation), an HTTP
+  listener 301ing to HTTPS, and the ACME challenge route.
+- ``cloud-endpoints`` ↔ prototypes/cloud-endpoints.jsonnet:1-11 (DNS
+  records for <name>.endpoints.<project>.cloud.goog): an Endpoint CR the
+  controller records into the platform DNS-zone ConfigMap.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.apis.certificates import (
+    CERT_API_GROUP,
+    CERTS_API_VERSION,
+    all_cert_crds,
+)
+from kubeflow_tpu.k8s import objects as k8s
+from kubeflow_tpu.manifests import images
+from kubeflow_tpu.manifests.core import ParamSpec, prototype
+from kubeflow_tpu.version import DEFAULT_NAMESPACE
+
+
+@prototype(
+    "cert-manager",
+    "Certificate lifecycle: Issuer/Certificate CRDs + the issuance and "
+    "rotation controller (cert-manager.jsonnet analogue)",
+    params=[
+        ParamSpec("namespace", DEFAULT_NAMESPACE),
+        ParamSpec("image", images.PLATFORM),
+        ParamSpec("acme_url", "https://acme-v02.api.letsencrypt.org/directory",
+                  "ACME directory for acme-type issuers "
+                  "(cert-manager.jsonnet acmeUrl param)"),
+        ParamSpec("acme_email", "", "registration email for acme issuers"),
+    ],
+)
+def cert_manager(namespace: str, image: str, acme_url: str,
+                 acme_email: str) -> list[dict]:
+    name = "cert-manager"
+    labels = {"app": name}
+    return [
+        *all_cert_crds(),
+        k8s.service_account(name, namespace, labels),
+        k8s.cluster_role(
+            name,
+            [
+                k8s.policy_rule([CERT_API_GROUP], ["*"], ["*"]),
+                k8s.policy_rule([""], ["secrets", "configmaps"], ["*"]),
+            ],
+            labels,
+        ),
+        k8s.cluster_role_binding(name, name, name, namespace),
+        k8s.deployment(
+            name,
+            namespace,
+            containers=[
+                k8s.container(
+                    name,
+                    image,
+                    command=["python", "-m", "kubeflow_tpu.operators"],
+                    args=[f"--namespace={namespace}"],
+                    env={"ACME_DIRECTORY_URL": acme_url,
+                         "ACME_EMAIL": acme_email},
+                )
+            ],
+            labels=labels,
+            service_account=name,
+        ),
+    ]
+
+
+@prototype(
+    "secure-ingress",
+    "Public entrypoint: gateway TLS from a controller-managed certificate "
+    "(hot rotation), https-redirect, ACME challenge route, DNS record "
+    "(iap-ingress + https-redirect analogue)",
+    params=[
+        ParamSpec("namespace", DEFAULT_NAMESPACE),
+        ParamSpec("image", images.PLATFORM),
+        ParamSpec("hostname", "kubeflow.example.com",
+                  "public hostname the certificate and DNS record cover"),
+        ParamSpec("issuer", "platform-ca",
+                  "Issuer the certificate references (iap-ingress "
+                  "`issuer letsencrypt-prod` analogue)"),
+        ParamSpec("issuer_type", "selfSigned", "selfSigned | acme"),
+        ParamSpec("duration_seconds", 90 * 24 * 3600,
+                  "certificate lifetime (letsencrypt-style 90d)"),
+        ParamSpec("renew_before_seconds", 30 * 24 * 3600,
+                  "rotate this long before expiry"),
+        ParamSpec("replicas", 3),
+    ],
+)
+def secure_ingress(namespace: str, image: str, hostname: str, issuer: str,
+                   issuer_type: str, duration_seconds: int,
+                   renew_before_seconds: int, replicas: int) -> list[dict]:
+    name = "secure-gateway"
+    labels = {"app": name, "service": "gateway"}
+    cert_secret = f"{name}-tls"
+    issuer_spec = ({"selfSigned": {"commonName": f"{issuer}.{namespace}"}}
+                   if issuer_type == "selfSigned"
+                   else {"acme": {}})
+    return [
+        {
+            "apiVersion": CERTS_API_VERSION,
+            "kind": "Issuer",
+            "metadata": {"name": issuer, "namespace": namespace},
+            "spec": issuer_spec,
+        },
+        {
+            "apiVersion": CERTS_API_VERSION,
+            "kind": "Certificate",
+            "metadata": {"name": name, "namespace": namespace},
+            "spec": {
+                "secretName": cert_secret,
+                "dnsNames": [hostname],
+                "issuerRef": {"name": issuer},
+                "durationSeconds": duration_seconds,
+                "renewBeforeSeconds": renew_before_seconds,
+            },
+        },
+        {
+            "apiVersion": CERTS_API_VERSION,
+            "kind": "Endpoint",
+            "metadata": {"name": name, "namespace": namespace},
+            "spec": {"hostname": hostname,
+                     "target": f"{name}.{namespace}"},
+        },
+        # The prototype is self-contained: its own SA with route discovery
+        # (services) plus the ACME-challenge ConfigMap read the
+        # --serve-acme-challenges flag needs.
+        k8s.service_account(name, namespace, labels),
+        k8s.cluster_role(
+            name,
+            [
+                k8s.policy_rule([""], ["services"],
+                                ["get", "list", "watch"]),
+                k8s.policy_rule([""], ["configmaps"], ["get"]),
+            ],
+            labels,
+        ),
+        k8s.cluster_role_binding(name, name, name, namespace),
+        k8s.service(
+            name,
+            namespace,
+            selector=labels,
+            ports=[
+                {"name": "https", "port": 443, "targetPort": 8443},
+                {"name": "http", "port": 80, "targetPort": 8080},
+            ],
+            labels=labels,
+            service_type="LoadBalancer",
+        ),
+        k8s.deployment(
+            name,
+            namespace,
+            containers=[
+                k8s.container(
+                    name,
+                    image,
+                    command=["python", "-m", "kubeflow_tpu.gateway"],
+                    args=[
+                        "--port=8443",
+                        "--redirect-port=8080",
+                        "--admin-port=8877",
+                        f"--namespace={namespace}",
+                        "--tls-cert=/etc/tls/tls.crt",
+                        "--tls-key=/etc/tls/tls.key",
+                        "--watch-certs=5",
+                        "--serve-acme-challenges",
+                    ],
+                    ports={"https": 8443, "http": 8080, "admin": 8877},
+                    liveness_probe=k8s.http_probe("/healthz", 8877,
+                                                  initial_delay=30),
+                    readiness_probe=k8s.http_probe("/healthz", 8877),
+                    volume_mounts=[
+                        k8s.volume_mount("tls", "/etc/tls", read_only=True)
+                    ],
+                )
+            ],
+            replicas=replicas,
+            labels=labels,
+            service_account=name,
+            volumes=[k8s.secret_volume("tls", cert_secret)],
+        ),
+    ]
+
+
+@prototype(
+    "cloud-endpoints",
+    "DNS record for a platform hostname via the Endpoint CR "
+    "(cloud-endpoints.jsonnet analogue)",
+    params=[
+        ParamSpec("namespace", DEFAULT_NAMESPACE),
+        ParamSpec("hostname", "kubeflow.example.com"),
+        ParamSpec("target", "gateway.kubeflow",
+                  "service (or address) the hostname resolves to"),
+    ],
+)
+def cloud_endpoints(namespace: str, hostname: str,
+                    target: str) -> list[dict]:
+    return [{
+        "apiVersion": CERTS_API_VERSION,
+        "kind": "Endpoint",
+        "metadata": {"name": hostname.split(".")[0],
+                     "namespace": namespace},
+        "spec": {"hostname": hostname, "target": target},
+    }]

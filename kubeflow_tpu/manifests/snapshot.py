@@ -58,6 +58,15 @@ SNAPSHOT_CASES: dict[str, tuple[str, dict]] = {
         {"name": "bert", "canary_service": "bert-v2.kubeflow:8500",
          "strategy": "epsilon-greedy", "epsilon": 0.2},
     ),
+    "cert-manager": ("cert-manager", {}),
+    "secure-ingress": (
+        "secure-ingress",
+        {"hostname": "kubeflow.example.com", "issuer": "platform-ca"},
+    ),
+    "cloud-endpoints": (
+        "cloud-endpoints",
+        {"hostname": "kubeflow.example.com", "target": "gateway.kubeflow"},
+    ),
 }
 
 

@@ -14,6 +14,11 @@ from kubeflow_tpu.runtime import controller_main
 
 def make_all_controllers(client):
     from kubeflow_tpu.benchmark.controller import BenchmarkJobController
+    from kubeflow_tpu.operators.certificates import (
+        CertificateController,
+        EndpointController,
+        IssuerController,
+    )
     from kubeflow_tpu.operators.jobs import make_job_controllers
     from kubeflow_tpu.operators.notebooks import NotebookController
     from kubeflow_tpu.operators.pipelines import (
@@ -33,6 +38,9 @@ def make_all_controllers(client):
         WorkflowController(client),
         ScheduledWorkflowController(client),
         ApplicationController(client),
+        IssuerController(client),
+        CertificateController(client),
+        EndpointController(client),
     ]
 
 

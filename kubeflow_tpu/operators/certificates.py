@@ -1,0 +1,312 @@
+"""Certificate lifecycle controllers — the cert-manager + cloud-endpoints
+analogue.
+
+The reference's secure entrypoint is its largest single package:
+/root/reference/kubeflow/gcp/iap.libsonnet:1-1041 (envoy ingress + JWT
+checks + backend wiring), prototypes/cert-manager.jsonnet:1-12 (deploys the
+upstream cert-manager with a letsencrypt ACME issuer),
+prototypes/cloud-endpoints.jsonnet:1-11 (Cloud DNS records), and
+components/https-redirect. This module is the platform-native control
+plane for that role:
+
+- :class:`IssuerController` — a ``selfSigned`` Issuer generates a CA into
+  ``<name>-ca`` (status carries the CA cert for clients to trust); an
+  ``acme`` Issuer is marked ready with its directory URL recorded (orders
+  then run the ACME-style state machine below).
+- :class:`CertificateController` — the issuance/rotation state machine.
+  Certificates referencing an acme issuer walk Pending → Validated →
+  Issued through an explicit order with an HTTP-01-style challenge token
+  (published to a ConfigMap the gateway serves at
+  ``/.well-known/acme-challenge/<token>``); selfSigned issuers sign
+  immediately. Renewal re-enters the machine ``renewBeforeSeconds``
+  before expiry and bumps ``status.revision`` — the gateway hot-reloads
+  the rotated secret without dropping connections
+  (:mod:`kubeflow_tpu.gateway`).
+- :class:`EndpointController` — maintains hostname → target records in
+  the ``kubeflow-dns-zone`` ConfigMap (the platform's zone store; the
+  reference writes the equivalent records to Cloud DNS).
+"""
+
+from __future__ import annotations
+
+import time
+
+from kubeflow_tpu.apis.certificates import (
+    CERTIFICATE_KIND,
+    CERTS_API_VERSION,
+    DNS_ZONE_CONFIGMAP,
+    ENDPOINT_KIND,
+    ISSUER_KIND,
+    ORDER_ISSUED,
+    ORDER_PENDING,
+    ORDER_VALIDATED,
+)
+from kubeflow_tpu.auth import pki
+from kubeflow_tpu.k8s import objects as k8s
+from kubeflow_tpu.operators.base import Controller
+
+# Challenge tokens the gateway serves at /.well-known/acme-challenge/.
+ACME_CHALLENGE_CONFIGMAP = "acme-challenges"
+
+_DEFAULT_DURATION = 90 * 24 * 3600       # letsencrypt-style 90 days
+_DEFAULT_RENEW_BEFORE = 30 * 24 * 3600   # renew with 30 days left
+
+
+def _now() -> float:
+    return time.time()
+
+
+class IssuerController(Controller):
+    api_version = CERTS_API_VERSION
+    kind = ISSUER_KIND
+
+    def watched_kinds(self):
+        return [("v1", "Secret")]
+
+    def reconcile(self, issuer: dict) -> None:
+        name = issuer["metadata"]["name"]
+        ns = issuer["metadata"]["namespace"]
+        spec = issuer.get("spec", {})
+        status = dict(issuer.get("status", {}))
+
+        if "selfSigned" in spec:
+            secret_name = f"{name}-ca"
+            existing = self.client.get_or_none("v1", "Secret",
+                                               secret_name, ns)
+            if existing is None:
+                ca = pki.make_ca(
+                    spec["selfSigned"].get("commonName",
+                                           f"{name}.{ns}.kubeflow-tpu")
+                )
+                sec = k8s.secret(secret_name, ns, {
+                    "tls.crt": ca.cert_pem, "tls.key": ca.key_pem,
+                    "ca.crt": ca.ca_pem,
+                }, secret_type="kubernetes.io/tls")
+                sec["metadata"]["ownerReferences"] = [k8s.object_ref(issuer)]
+                self.client.create(sec)
+                ca_pem = ca.cert_pem
+            else:
+                data = existing.get("stringData") or existing.get("data", {})
+                ca_pem = data.get("ca.crt", data.get("tls.crt", ""))
+            status.update({"ready": True, "type": "selfSigned",
+                           "caSecretName": secret_name,
+                           "caCertificate": ca_pem})
+        elif "acme" in spec:
+            # ACME directory reachability is a deploy-time concern; the
+            # issuer is ready as soon as it is configured — orders carry
+            # the per-certificate state machine. Signing uses a platform
+            # CA secret (the in-cluster stand-in for the directory's
+            # finalize call; a zero-egress deployment still gets working
+            # TLS with a distributable trust root).
+            secret_name = f"{name}-ca"
+            if self.client.get_or_none("v1", "Secret",
+                                       secret_name, ns) is None:
+                ca = pki.make_ca(f"acme-{name}.{ns}.kubeflow-tpu")
+                sec = k8s.secret(secret_name, ns, {
+                    "tls.crt": ca.cert_pem, "tls.key": ca.key_pem,
+                    "ca.crt": ca.ca_pem,
+                }, secret_type="kubernetes.io/tls")
+                sec["metadata"]["ownerReferences"] = [k8s.object_ref(issuer)]
+                self.client.create(sec)
+            status.update({"ready": True, "type": "acme",
+                           "url": spec["acme"].get("url", ""),
+                           "caSecretName": secret_name})
+        else:
+            status.update({"ready": False,
+                           "reason": "spec needs selfSigned or acme"})
+
+        if status != issuer.get("status"):
+            issuer["status"] = status
+            self.client.update_status(issuer)
+
+    def ca_for(self, name: str, ns: str) -> pki.KeyCert | None:
+        """Load the Issuer's CA keypair (selfSigned and acme issuers both
+        sign with a platform CA — the acme machine differs in the order
+        walk, not the signer; a real ACME deployment swaps this for the
+        directory's finalize call)."""
+        sec = self.client.get_or_none("v1", "Secret", f"{name}-ca", ns)
+        if sec is None:
+            return None
+        data = sec.get("stringData") or sec.get("data", {})
+        return pki.KeyCert(key_pem=data["tls.key"],
+                           cert_pem=data["tls.crt"],
+                           ca_pem=data.get("ca.crt", data["tls.crt"]))
+
+
+class CertificateController(Controller):
+    """Issuance + rotation state machine for Certificate CRs."""
+
+    api_version = CERTS_API_VERSION
+    kind = CERTIFICATE_KIND
+
+    def __init__(self, client, *, clock=_now):
+        super().__init__(client)
+        self.clock = clock
+
+    def watched_kinds(self):
+        return [("v1", "Secret"), (CERTS_API_VERSION, ISSUER_KIND)]
+
+    # -- state machine ------------------------------------------------------
+
+    def reconcile(self, cert: dict) -> None:
+        name = cert["metadata"]["name"]
+        ns = cert["metadata"]["namespace"]
+        spec = cert.get("spec", {})
+        status = dict(cert.get("status", {}))
+        issuer_name = spec["issuerRef"]["name"]
+        issuer = self.client.get_or_none(CERTS_API_VERSION, ISSUER_KIND,
+                                         issuer_name, ns)
+        if issuer is None or not issuer.get("status", {}).get("ready"):
+            self._set_status(cert, {**status, "ready": False,
+                                    "reason": f"issuer {issuer_name} not "
+                                              "ready"})
+            return
+        acme = issuer["status"].get("type") == "acme"
+
+        secret = self.client.get_or_none("v1", "Secret",
+                                         spec["secretName"], ns)
+        if secret is not None and not self._needs_renewal(spec, status):
+            return  # Issued and fresh — steady state.
+
+        if acme:
+            order = status.get("order", {})
+            state = order.get("state")
+            if not order or state == ORDER_ISSUED:
+                # New order (first issuance or renewal): publish the
+                # HTTP-01 challenge token for the gateway to serve.
+                import secrets as _secrets
+
+                token = _secrets.token_urlsafe(24)
+                self._publish_challenge(ns, name, token)
+                self._set_status(cert, {
+                    **status, "ready": status.get("ready", False),
+                    "order": {"state": ORDER_PENDING, "token": token},
+                })
+                return
+            if state == ORDER_PENDING:
+                # Self-check the challenge is published (the in-platform
+                # stand-in for the ACME server's validation GET).
+                if self._challenge_published(ns, name,
+                                             order.get("token", "")):
+                    self._set_status(cert, {
+                        **status,
+                        "order": {**order, "state": ORDER_VALIDATED},
+                    })
+                return
+            if state != ORDER_VALIDATED:
+                return
+
+        self._issue(cert, issuer_name, ns, spec, status, acme=acme)
+
+    def _issue(self, cert, issuer_name, ns, spec, status, *, acme):
+        issuers = IssuerController(self.client)
+        ca = issuers.ca_for(issuer_name, ns)
+        if ca is None:
+            self._set_status(cert, {**status, "ready": False,
+                                    "reason": "issuer CA secret missing"})
+            return
+        duration = int(spec.get("durationSeconds", _DEFAULT_DURATION))
+        leaf = pki.issue(ca, list(spec["dnsNames"]),
+                         duration_seconds=duration)
+        info = pki.cert_info(leaf.cert_pem)
+        sec = k8s.secret(spec["secretName"], ns, {
+            "tls.crt": leaf.chain_pem, "tls.key": leaf.key_pem,
+            "ca.crt": leaf.ca_pem,
+        }, secret_type="kubernetes.io/tls")
+        sec["metadata"]["ownerReferences"] = [k8s.object_ref(cert)]
+        existing = self.client.get_or_none("v1", "Secret",
+                                           spec["secretName"], ns)
+        if existing is None:
+            self.client.create(sec)
+        else:
+            existing["stringData"] = sec["stringData"]
+            existing["type"] = sec["type"]
+            self.client.update(existing)
+        new_status = {
+            "ready": True,
+            "serial": info["serial"],
+            "notAfter": info["not_after"].isoformat(),
+            "issuedAt": self.clock(),
+            "revision": int(status.get("revision", 0)) + 1,
+            "dnsNames": info["dns_names"],
+        }
+        if acme:
+            new_status["order"] = {**status.get("order", {}),
+                                   "state": ORDER_ISSUED}
+            self._clear_challenge(ns, cert["metadata"]["name"])
+        self._set_status(cert, new_status)
+
+    def _needs_renewal(self, spec: dict, status: dict) -> bool:
+        if not status.get("ready"):
+            return True
+        duration = int(spec.get("durationSeconds", _DEFAULT_DURATION))
+        renew_before = int(spec.get("renewBeforeSeconds",
+                                    min(_DEFAULT_RENEW_BEFORE,
+                                        duration // 3)))
+        issued_at = float(status.get("issuedAt", 0))
+        return self.clock() >= issued_at + duration - renew_before
+
+    # -- helpers ------------------------------------------------------------
+
+    def _set_status(self, cert: dict, status: dict) -> None:
+        if status != cert.get("status"):
+            cert["status"] = status
+            self.client.update_status(cert)
+
+    def _publish_challenge(self, ns: str, name: str, token: str) -> None:
+        cm = self.client.get_or_none("v1", "ConfigMap",
+                                     ACME_CHALLENGE_CONFIGMAP, ns)
+        if cm is None:
+            cm = {"apiVersion": "v1", "kind": "ConfigMap",
+                  "metadata": {"name": ACME_CHALLENGE_CONFIGMAP,
+                               "namespace": ns},
+                  "data": {}}
+            cm["data"][name] = token
+            self.client.create(cm)
+        else:
+            cm.setdefault("data", {})[name] = token
+            self.client.update(cm)
+
+    def _challenge_published(self, ns: str, name: str, token: str) -> bool:
+        cm = self.client.get_or_none("v1", "ConfigMap",
+                                     ACME_CHALLENGE_CONFIGMAP, ns)
+        return bool(cm and cm.get("data", {}).get(name) == token)
+
+    def _clear_challenge(self, ns: str, name: str) -> None:
+        cm = self.client.get_or_none("v1", "ConfigMap",
+                                     ACME_CHALLENGE_CONFIGMAP, ns)
+        if cm and name in cm.get("data", {}):
+            del cm["data"][name]
+            self.client.update(cm)
+
+
+class EndpointController(Controller):
+    """hostname → target records in the platform DNS-zone ConfigMap."""
+
+    api_version = CERTS_API_VERSION
+    kind = ENDPOINT_KIND
+
+    def watched_kinds(self):
+        return [("v1", "ConfigMap")]
+
+    def reconcile(self, ep: dict) -> None:
+        ns = ep["metadata"]["namespace"]
+        spec = ep.get("spec", {})
+        hostname, target = spec.get("hostname"), spec.get("target")
+        if not hostname or not target:
+            return
+        cm = self.client.get_or_none("v1", "ConfigMap",
+                                     DNS_ZONE_CONFIGMAP, ns)
+        if cm is None:
+            self.client.create({
+                "apiVersion": "v1", "kind": "ConfigMap",
+                "metadata": {"name": DNS_ZONE_CONFIGMAP, "namespace": ns},
+                "data": {hostname: target},
+            })
+        elif cm.get("data", {}).get(hostname) != target:
+            cm.setdefault("data", {})[hostname] = target
+            self.client.update(cm)
+        status = {"ready": True, "recordedTarget": target}
+        if status != ep.get("status"):
+            ep["status"] = status
+            self.client.update_status(ep)

