@@ -45,6 +45,25 @@ def workflow_schema() -> dict:
             # retryStrategy surface, argo.libsonnet workflow-controller).
             "retries": {"type": "integer", "minimum": 0},
             "retryBackoffSeconds": {"type": "number", "minimum": 0},
+            # Declared outputs: files/directories the task writes under
+            # its injected KUBEFLOW_ARTIFACT_DIR. On success the
+            # controller indexes each into the durable run record as an
+            # artifact://ns/workflow/task/name URI; a missing declared
+            # output fails the task (the KFP output-artifact contract,
+            # minio.libsonnet + pipeline-persistenceagent.libsonnet).
+            "outputs": {
+                "type": "array",
+                "items": {
+                    "type": "object",
+                    "required": ["name"],
+                    "properties": {
+                        "name": {"type": "string", "minLength": 1},
+                        # Path relative to KUBEFLOW_ARTIFACT_DIR;
+                        # defaults to the output name.
+                        "path": {"type": "string"},
+                    },
+                },
+            },
             # The object this task creates, verbatim (a job CR, a
             # Deployment, ...). Ownership and completion tracking are the
             # controller's job; kind/apiVersion are required here so a

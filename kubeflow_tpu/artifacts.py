@@ -1,0 +1,197 @@
+"""Workflow artifact store: durable run outputs on a shared volume.
+
+The KFP role filled by minio + mysql in the reference
+(/root/reference/kubeflow/pipeline/minio.libsonnet:1-117 object store;
+pipeline-persistenceagent.libsonnet:1-128 persistence): every workflow
+task can declare outputs, the WorkflowController indexes them into the
+durable run record, and later runs (or the dashboard) retrieve them by
+URI. TPU-platform recast: the payload store is a PVC-backed directory
+tree every task pod mounts (`nfs-volume`/`storage` package) — no minio
+deployment to operate — while the run-record index stays in ConfigMaps
+(:mod:`kubeflow_tpu.operators.runstore`). Both deliberately outlive the
+Workflow CR.
+
+Layout: ``<root>/<namespace>/<workflow>/<task>/<output-name>`` (a file or
+a directory — checkpoints are directories). URIs are
+``artifact://<namespace>/<workflow>/<task>/<name>``.
+
+Task contract: the controller injects ``KUBEFLOW_ARTIFACT_DIR`` (this
+run+task's output directory) and ``KUBEFLOW_ARTIFACT_ROOT`` into task
+pods; a task writes its declared outputs under ``KUBEFLOW_ARTIFACT_DIR``
+and downstream tasks resolve inputs with :func:`resolve` /
+``python -m kubeflow_tpu.artifacts get``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from dataclasses import asdict, dataclass
+
+ENV_ROOT = "KUBEFLOW_ARTIFACT_ROOT"
+ENV_DIR = "KUBEFLOW_ARTIFACT_DIR"
+URI_SCHEME = "artifact://"
+DEFAULT_ROOT = "/artifacts"
+
+
+@dataclass(frozen=True)
+class ArtifactRef:
+    namespace: str
+    workflow: str
+    task: str
+    name: str
+
+    @property
+    def uri(self) -> str:
+        return (f"{URI_SCHEME}{self.namespace}/{self.workflow}/"
+                f"{self.task}/{self.name}")
+
+
+def _check_component(part: str) -> str:
+    """Reject separators and dot-segments — every URI/name component maps
+    to exactly one directory entry under the store root (path-traversal
+    hardening: a Workflow author must not be able to read or write
+    outside the store with the controller's privileges)."""
+    if (not part or part in (".", "..") or "/" in part or "\\" in part
+            or "\x00" in part):
+        raise ValueError(f"invalid artifact path component {part!r}")
+    return part
+
+
+def parse_uri(uri: str) -> ArtifactRef:
+    if not uri.startswith(URI_SCHEME):
+        raise ValueError(f"not an artifact URI: {uri!r}")
+    parts = uri[len(URI_SCHEME):].split("/")
+    if len(parts) != 4:
+        raise ValueError(
+            f"artifact URI must be {URI_SCHEME}<ns>/<workflow>/<task>/"
+            f"<name>: {uri!r}"
+        )
+    return ArtifactRef(*(_check_component(p) for p in parts))
+
+
+class ArtifactStore:
+    """File-backed store rooted at a shared (PVC) directory."""
+
+    def __init__(self, root: str | None = None):
+        self.root = root or os.environ.get(ENV_ROOT, DEFAULT_ROOT)
+
+    # -- paths --------------------------------------------------------------
+
+    def task_dir(self, namespace: str, workflow: str, task: str) -> str:
+        for part in (namespace, workflow, task):
+            _check_component(part)
+        return os.path.join(self.root, namespace, workflow, task)
+
+    def path_of(self, ref: ArtifactRef) -> str:
+        return os.path.join(
+            self.task_dir(ref.namespace, ref.workflow, ref.task),
+            _check_component(ref.name),
+        )
+
+    # -- write --------------------------------------------------------------
+
+    def put(self, ref: ArtifactRef, source: str | bytes) -> str:
+        """Store a file, directory (copied), or raw bytes; returns the
+        URI."""
+        dest = self.path_of(ref)
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        if isinstance(source, bytes):
+            with open(dest, "wb") as f:
+                f.write(source)
+        elif os.path.isdir(source):
+            if os.path.exists(dest):
+                shutil.rmtree(dest)
+            shutil.copytree(source, dest)
+        else:
+            shutil.copy2(source, dest)
+        return ref.uri
+
+    # -- read ---------------------------------------------------------------
+
+    def exists(self, ref: ArtifactRef) -> bool:
+        return os.path.exists(self.path_of(ref))
+
+    def resolve(self, uri: str) -> str:
+        """URI → local path on the shared volume (raises if absent)."""
+        ref = parse_uri(uri)
+        path = self.path_of(ref)
+        if not os.path.exists(path):
+            raise FileNotFoundError(f"artifact {uri} not found at {path}")
+        return path
+
+    def read_bytes(self, uri: str) -> bytes:
+        with open(self.resolve(uri), "rb") as f:
+            return f.read()
+
+    # -- index --------------------------------------------------------------
+
+    def describe(self, ref: ArtifactRef) -> dict:
+        path = self.path_of(ref)
+        size = 0
+        if os.path.isdir(path):
+            for dirpath, _dirs, files in os.walk(path):
+                size += sum(
+                    os.path.getsize(os.path.join(dirpath, f))
+                    for f in files
+                )
+            kind = "directory"
+        else:
+            size = os.path.getsize(path)
+            kind = "file"
+        return {**asdict(ref), "uri": ref.uri, "type": kind,
+                "sizeBytes": size}
+
+    def list_run(self, namespace: str, workflow: str) -> list[dict]:
+        """Every artifact a run produced — keyed by run id (the workflow
+        name), listable after the Workflow CR is gone (the payloads live
+        on the volume, not under an ownerReference)."""
+        run_dir = os.path.join(self.root, namespace, workflow)
+        out = []
+        if not os.path.isdir(run_dir):
+            return out
+        for task in sorted(os.listdir(run_dir)):
+            task_dir = os.path.join(run_dir, task)
+            if not os.path.isdir(task_dir):
+                continue
+            for name in sorted(os.listdir(task_dir)):
+                out.append(self.describe(
+                    ArtifactRef(namespace, workflow, task, name)))
+        return out
+
+
+def main(argv=None) -> int:
+    """`python -m kubeflow_tpu.artifacts {put,get,list} ...` — the store
+    CLI task containers use (the `mc`/minio-client analogue)."""
+    import argparse
+    import sys
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--root", default=None,
+                   help=f"store root (default ${ENV_ROOT} or "
+                        f"{DEFAULT_ROOT})")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    put = sub.add_parser("put", help="store a file/directory as an artifact")
+    put.add_argument("uri")
+    put.add_argument("source")
+    get = sub.add_parser("get", help="resolve an artifact URI to a path")
+    get.add_argument("uri")
+    lst = sub.add_parser("list", help="list a run's artifacts as JSON")
+    lst.add_argument("namespace")
+    lst.add_argument("workflow")
+    args = p.parse_args(argv)
+    store = ArtifactStore(args.root)
+    if args.cmd == "put":
+        print(store.put(parse_uri(args.uri), args.source))
+    elif args.cmd == "get":
+        print(store.resolve(args.uri))
+    else:
+        json.dump(store.list_run(args.namespace, args.workflow),
+                  sys.stdout, indent=2)
+        print()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -49,7 +49,8 @@ td,th{{border:1px solid #ccc;padding:4px 10px}}</style></head>
 <h2>Studies</h2><table><tr><th>Name</th><th>Namespace</th><th>State</th>
 <th>Best</th></tr>{studies}</table>
 <h2>Pipeline runs</h2><table><tr><th>Workflow</th><th>Schedule</th>
-<th>Phase</th><th>Started</th><th>Finished</th></tr>{runs}</table>
+<th>Phase</th><th>Started</th><th>Finished</th><th>Artifacts</th></tr>
+{runs}</table>
 <h2>Activity</h2><table><tr><th>Time</th><th>Kind</th><th>Object</th>
 <th>Event</th><th>Message</th></tr>{activity}</table>
 </body></html>
@@ -243,11 +244,16 @@ class Dashboard:
             f"<td>{esc(s['state'])}</td><td>{esc(s['bestObjective'])}</td>"
             "</tr>" for s in ov["studies"]
         )
+        def _arts(r):
+            return "; ".join(a["uri"] for a in r.get("artifacts", [])) \
+                or "—"
+
         runs = "".join(
             f"<tr><td>{esc(r['workflow'])}</td>"
             f"<td>{esc(r.get('scheduledWorkflow', ''))}</td>"
             f"<td>{esc(r['phase'])}</td><td>{esc(r.get('startedAt', ''))}"
-            f"</td><td>{esc(r.get('finishedAt', ''))}</td></tr>"
+            f"</td><td>{esc(r.get('finishedAt', ''))}</td>"
+            f"<td>{esc(_arts(r))}</td></tr>"
             for r in ov["runs"]
         )
         activity = "".join(

@@ -26,15 +26,35 @@ from kubeflow_tpu.version import API_GROUP, DEFAULT_NAMESPACE
     params=[
         ParamSpec("namespace", DEFAULT_NAMESPACE),
         ParamSpec("image", images.PLATFORM),
+        ParamSpec("artifact_claim", "kubeflow-artifacts",
+                  "PVC backing the workflow artifact store (the minio "
+                  "role); mounted into the operator and every task pod"),
+        ParamSpec("artifact_claim_size", "50Gi"),
     ],
 )
-def pipeline_operator(namespace: str, image: str) -> list[dict]:
+def pipeline_operator(namespace: str, image: str, artifact_claim: str,
+                      artifact_claim_size: str) -> list[dict]:
     name = "pipeline-operator"
     labels = {"app": name}
     return [
         workflow_crd(),
         scheduled_workflow_crd(),
         application_crd(),
+        # The artifact store's backing volume (minio.libsonnet's PVC
+        # role): one shared filesystem for the operator (output indexing)
+        # and every task pod (output writing / input resolution).
+        {
+            "apiVersion": "v1",
+            "kind": "PersistentVolumeClaim",
+            "metadata": {"name": artifact_claim, "namespace": namespace,
+                         "labels": labels},
+            "spec": {
+                "accessModes": ["ReadWriteMany"],
+                "resources": {
+                    "requests": {"storage": artifact_claim_size}
+                },
+            },
+        },
         k8s.service_account(name, namespace, labels),
         k8s.cluster_role(
             name,
@@ -76,11 +96,19 @@ def pipeline_operator(namespace: str, image: str) -> list[dict]:
                     name,
                     image,
                     command=["python", "-m", "kubeflow_tpu.operators.pipeline"],
+                    env={"KUBEFLOW_ARTIFACT_ROOT": "/artifacts"},
                     ports={"metrics": 8443},
+                    volume_mounts=[
+                        k8s.volume_mount("kubeflow-artifacts", "/artifacts")
+                    ],
                 )
             ],
             labels=labels,
             service_account=name,
+            volumes=[{
+                "name": "kubeflow-artifacts",
+                "persistentVolumeClaim": {"claimName": artifact_claim},
+            }],
         ),
     ]
 

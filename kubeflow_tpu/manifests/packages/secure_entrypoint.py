@@ -65,7 +65,8 @@ def cert_manager(namespace: str, image: str, acme_url: str,
                 k8s.container(
                     name,
                     image,
-                    command=["python", "-m", "kubeflow_tpu.operators"],
+                    command=["python", "-m",
+                             "kubeflow_tpu.operators.certificate"],
                     args=[f"--namespace={namespace}"],
                     env={"ACME_DIRECTORY_URL": acme_url,
                          "ACME_EMAIL": acme_email},

@@ -1,0 +1,29 @@
+"""Cert-manager entrypoint: `python -m kubeflow_tpu.operators.certificate`
+(the cert-manager controller Deployment analogue,
+/root/reference/kubeflow/gcp/prototypes/cert-manager.jsonnet:1-12) —
+runs ONLY the certificate-lifecycle controllers, matching the
+per-controller RBAC the cert-manager prototype grants."""
+
+from __future__ import annotations
+
+from kubeflow_tpu.runtime import controller_main
+
+
+def main(argv=None) -> int:
+    from kubeflow_tpu.operators.certificates import (
+        CertificateController,
+        EndpointController,
+        IssuerController,
+    )
+
+    return controller_main(
+        argv,
+        lambda client: [IssuerController(client),
+                        CertificateController(client),
+                        EndpointController(client)],
+        "kubeflow-tpu certificate (issuer/certificate/endpoint) controller",
+    )
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

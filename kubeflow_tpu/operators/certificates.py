@@ -281,7 +281,15 @@ class CertificateController(Controller):
 
 
 class EndpointController(Controller):
-    """hostname → target records in the platform DNS-zone ConfigMap."""
+    """hostname → target records in the platform DNS-zone ConfigMap.
+
+    Level-triggered zone sync: each reconcile rebuilds the namespace's
+    desired record set from ALL Endpoint CRs, so deleted or renamed
+    endpoints drop out of the zone instead of leaving stale records (the
+    reference's cloud-endpoints keeps Cloud DNS in sync with the declared
+    records the same way). Known edge: deleting the namespace's LAST
+    endpoint leaves its record until any endpoint reconciles there
+    again — the zone is only rebuilt from a live primary."""
 
     api_version = CERTS_API_VERSION
     kind = ENDPOINT_KIND
@@ -291,21 +299,28 @@ class EndpointController(Controller):
 
     def reconcile(self, ep: dict) -> None:
         ns = ep["metadata"]["namespace"]
-        spec = ep.get("spec", {})
-        hostname, target = spec.get("hostname"), spec.get("target")
-        if not hostname or not target:
-            return
+        desired: dict[str, str] = {}
+        for other in self.client.list(CERTS_API_VERSION, ENDPOINT_KIND,
+                                      ns):
+            spec = other.get("spec", {})
+            if spec.get("hostname") and spec.get("target"):
+                desired[spec["hostname"]] = spec["target"]
         cm = self.client.get_or_none("v1", "ConfigMap",
                                      DNS_ZONE_CONFIGMAP, ns)
         if cm is None:
-            self.client.create({
-                "apiVersion": "v1", "kind": "ConfigMap",
-                "metadata": {"name": DNS_ZONE_CONFIGMAP, "namespace": ns},
-                "data": {hostname: target},
-            })
-        elif cm.get("data", {}).get(hostname) != target:
-            cm.setdefault("data", {})[hostname] = target
+            if desired:
+                self.client.create({
+                    "apiVersion": "v1", "kind": "ConfigMap",
+                    "metadata": {"name": DNS_ZONE_CONFIGMAP,
+                                 "namespace": ns},
+                    "data": desired,
+                })
+        elif cm.get("data", {}) != desired:
+            cm["data"] = desired
             self.client.update(cm)
+        target = ep.get("spec", {}).get("target")
+        if not target:
+            return
         status = {"ready": True, "recordedTarget": target}
         if status != ep.get("status"):
             ep["status"] = status

@@ -16,8 +16,15 @@ from __future__ import annotations
 
 import copy
 import datetime
+import os
 
 from kubeflow_tpu.apis import jobs as jobs_api
+from kubeflow_tpu.artifacts import (
+    ENV_DIR as ARTIFACT_ENV_DIR,
+    ENV_ROOT as ARTIFACT_ENV_ROOT,
+    ArtifactRef,
+    ArtifactStore,
+)
 from kubeflow_tpu.apis.pipelines import (
     APPLICATION_KIND,
     PHASE_FAILED,
@@ -96,9 +103,17 @@ class WorkflowController(Controller):
     # Run-record retention for Workflows with no owning schedule.
     adhoc_history_limit = 50
 
-    def __init__(self, client, now_fn=None):
+    def __init__(self, client, now_fn=None, artifact_root=None,
+                 artifact_claim: str = "kubeflow-artifacts"):
         super().__init__(client)
         self.runs = RunStore(client)
+        self.artifacts = ArtifactStore(artifact_root)
+        # PVC backing the store: mounted into every task pod at the store
+        # root (and into the operator itself by the pipeline-operator
+        # manifest) so controller and tasks see one filesystem. Empty
+        # disables volume injection (single-host test kubelets share the
+        # host filesystem already).
+        self.artifact_claim = artifact_claim
         self._now = now_fn or _utcnow
 
     def watched_kinds(self):
@@ -169,6 +184,11 @@ class WorkflowController(Controller):
             if phase == PHASE_FAILED and self._schedule_retry(wf, t, ts,
                                                               live):
                 continue
+            if phase == PHASE_SUCCEEDED and t.get("outputs"):
+                # Index declared outputs into the run record (the KFP
+                # output-artifact contract): a missing declared output is
+                # a task failure, not a silent absence.
+                phase, message = self._index_outputs(wf, t, ts, message)
             ts.update(phase=phase, message=message,
                       resourceName=live["metadata"]["name"],
                       resourceKind=live.get("kind", ""))
@@ -243,7 +263,88 @@ class WorkflowController(Controller):
                   message=f"retry {restarts + 1}/{retries} launching")
         return True
 
+    def _index_outputs(self, wf: dict, task: dict, ts: dict,
+                       message: str) -> tuple[str, str]:
+        """Record the task's declared outputs as artifacts. Outputs whose
+        ``path`` differs from ``name`` are copied into place under the
+        artifact name. Returns the (phase, message) the task lands on."""
+        ns = wf["metadata"]["namespace"]
+        wf_name = wf["metadata"]["name"]
+        task_dir = os.path.realpath(
+            self.artifacts.task_dir(ns, wf_name, task["name"])
+        )
+        recorded, missing = [], []
+        for out in task["outputs"]:
+            path = out.get("path", out["name"])
+            src = os.path.realpath(os.path.join(task_dir, path))
+            # A declared path must stay inside the task's own artifact
+            # directory — otherwise a Workflow author could exfiltrate
+            # arbitrary controller-readable files into the store.
+            if src != task_dir and not src.startswith(task_dir + os.sep):
+                return (PHASE_FAILED,
+                        f"output {out['name']!r} path escapes the "
+                        "artifact directory")
+            try:
+                ref = ArtifactRef(ns, wf_name, task["name"], out["name"])
+                if not os.path.exists(src):
+                    missing.append(out["name"])
+                    continue
+                if path != out["name"]:
+                    self.artifacts.put(ref, src)
+                recorded.append(self.artifacts.describe(ref))
+            except ValueError as e:  # separator/dot-segment in the name
+                return PHASE_FAILED, f"invalid output: {e}"
+        if missing:
+            return (PHASE_FAILED,
+                    f"declared output(s) missing: {', '.join(missing)}")
+        ts["artifacts"] = recorded
+        return PHASE_SUCCEEDED, message
+
     # ------------------------------------------------------------------
+
+    def _inject_artifact_env(self, resource: dict, ns: str, wf_name: str,
+                             task_name: str) -> None:
+        """Give every container of a pod-bearing task resource the
+        artifact-store contract: the env (root + this task's output dir)
+        AND the backing PVC mounted at the store root — without the
+        volume, controller and task pods would write to different
+        filesystems on a real cluster."""
+        env = [
+            {"name": ARTIFACT_ENV_ROOT, "value": self.artifacts.root},
+            {"name": ARTIFACT_ENV_DIR,
+             "value": self.artifacts.task_dir(ns, wf_name, task_name)},
+        ]
+        kind = resource.get("kind", "")
+        pod_specs = []
+        if kind == "Pod":
+            pod_specs = [resource.get("spec", {})]
+        elif "template" in resource.get("spec", {}):  # Job, Deployment, …
+            pod_specs = [resource["spec"]["template"].get("spec", {})]
+        elif "replicaSpecs" in resource.get("spec", {}):  # platform jobs
+            pod_specs = [
+                rs.get("template", {}).get("spec", {})
+                for rs in resource["spec"]["replicaSpecs"].values()
+            ]
+        volume = {"name": "kubeflow-artifacts",
+                  "persistentVolumeClaim":
+                      {"claimName": self.artifact_claim}}
+        mount = {"name": "kubeflow-artifacts",
+                 "mountPath": self.artifacts.root}
+        for spec in pod_specs:
+            for container in spec.get("containers", []):
+                have = {e.get("name") for e in container.get("env", [])}
+                container.setdefault("env", []).extend(
+                    e for e in env if e["name"] not in have
+                )
+                if self.artifact_claim and not any(
+                        m.get("name") == mount["name"]
+                        for m in container.get("volumeMounts", [])):
+                    container.setdefault("volumeMounts", []).append(
+                        dict(mount))
+            if self.artifact_claim and not any(
+                    v.get("name") == volume["name"]
+                    for v in spec.get("volumes", [])):
+                spec.setdefault("volumes", []).append(dict(volume))
 
     def _ensure_resource(self, wf: dict, task: dict,
                          create: bool = True) -> dict | None:
@@ -258,6 +359,8 @@ class WorkflowController(Controller):
         labels[LABEL_WORKFLOW] = wf["metadata"]["name"]
         labels[LABEL_TASK] = task["name"]
         meta["ownerReferences"] = [k8s.object_ref(wf)]
+        self._inject_artifact_env(resource, ns, wf["metadata"]["name"],
+                                  task["name"])
         live = self.client.get_or_none(
             resource.get("apiVersion", "v1"), resource.get("kind", ""),
             meta["name"], meta["namespace"],

@@ -44,6 +44,14 @@ class RunStore:
             "startedAt": status.get("startedAt", ""),
             "finishedAt": status.get("finishedAt", ""),
             "tasks": status.get("tasks", {}),
+            # Flattened output-artifact index (the minio/KFP artifact
+            # listing): URIs stay resolvable through the artifact store
+            # after the Workflow CR is deleted.
+            "artifacts": [
+                art
+                for ts in status.get("tasks", {}).values()
+                for art in ts.get("artifacts", [])
+            ],
         }
         cm = {
             "apiVersion": "v1",

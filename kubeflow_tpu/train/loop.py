@@ -189,10 +189,18 @@ def publish_metrics(result: dict, *, client=None, environ=None, log=print):
 
 def main(argv=None) -> int:
     """`python -m kubeflow_tpu.train.loop '<json run config>'`"""
+    import os
+
     argv = sys.argv[1:] if argv is None else argv
     overrides = json.loads(argv[0]) if argv else {}
     mesh_cfg = MeshConfig(**overrides.pop("mesh", {}))
     opt_cfg = OptimizerConfig(**overrides.pop("optimizer", {}))
+    # Path fields honor env references ($KUBEFLOW_ARTIFACT_DIR & co.),
+    # so a workflow task can target its injected artifact directory
+    # without knowing the store root at authoring time.
+    for key in ("checkpoint_dir", "data_path", "profile_dir"):
+        if overrides.get(key):
+            overrides[key] = os.path.expandvars(overrides[key])
     cfg = RunConfig(mesh=mesh_cfg, optimizer=opt_cfg, **overrides)
     result = run(cfg)
     print(json.dumps(result))

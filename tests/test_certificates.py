@@ -335,3 +335,32 @@ def test_secure_entrypoint_prototypes_admitted(cert_env):
     EndpointController(api).reconcile_all()
     assert api.get("v1", "ConfigMap", DNS_ZONE_CONFIGMAP, NS)["data"][
         "kf.example.com"] == "secure-gateway.kubeflow"
+
+
+def test_endpoint_deletion_drops_zone_record(cert_env):
+    """Renames/deletes must not leave stale DNS records: the zone is
+    rebuilt from the live Endpoint set on every reconcile."""
+    api = cert_env
+    for i, host in enumerate(["a.example.com", "b.example.com"]):
+        api.create({
+            "apiVersion": CERTS_API_VERSION, "kind": "Endpoint",
+            "metadata": {"name": f"ep{i}", "namespace": NS},
+            "spec": {"hostname": host, "target": f"svc{i}.kubeflow"},
+        })
+    ctrl = EndpointController(api)
+    ctrl.reconcile_all()
+    assert set(api.get("v1", "ConfigMap", DNS_ZONE_CONFIGMAP,
+                       NS)["data"]) == {"a.example.com", "b.example.com"}
+
+    api.delete(CERTS_API_VERSION, "Endpoint", "ep0", NS)
+    ctrl.reconcile_all()
+    cm = api.get("v1", "ConfigMap", DNS_ZONE_CONFIGMAP, NS)
+    assert cm["data"] == {"b.example.com": "svc1.kubeflow"}
+
+    # Rename: the old hostname is dropped, the new one recorded.
+    ep = api.get(CERTS_API_VERSION, "Endpoint", "ep1", NS)
+    ep["spec"]["hostname"] = "c.example.com"
+    api.update(ep)
+    ctrl.reconcile_all()
+    cm = api.get("v1", "ConfigMap", DNS_ZONE_CONFIGMAP, NS)
+    assert cm["data"] == {"c.example.com": "svc1.kubeflow"}

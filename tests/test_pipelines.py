@@ -472,3 +472,186 @@ def test_workflow_retry_exhaustion_fails(env):
         ctrl.reconcile_all()
     wf = api.get(PIPELINES_API_VERSION, "Workflow", "wf", "kubeflow")
     assert wf["status"]["phase"] == "Failed"
+
+
+# ---------------------------------------------------------------------------
+# Artifact store (the minio/KFP output-artifact tier, VERDICT r3 #6)
+# ---------------------------------------------------------------------------
+
+
+def test_artifact_store_roundtrip(tmp_path):
+    from kubeflow_tpu.artifacts import ArtifactRef, ArtifactStore, parse_uri
+
+    store = ArtifactStore(str(tmp_path))
+    ref = ArtifactRef("kubeflow", "wf", "train", "metrics.json")
+    uri = store.put(ref, b'{"loss": 1.0}')
+    assert uri == "artifact://kubeflow/wf/train/metrics.json"
+    assert parse_uri(uri) == ref
+    assert store.read_bytes(uri) == b'{"loss": 1.0}'
+    # Directories (checkpoints) round-trip too.
+    src = tmp_path / "ck"
+    (src / "0").mkdir(parents=True)
+    (src / "0" / "state").write_bytes(b"x" * 10)
+    dref = ArtifactRef("kubeflow", "wf", "train", "checkpoint")
+    store.put(dref, str(src))
+    listing = store.list_run("kubeflow", "wf")
+    assert [(a["name"], a["type"]) for a in listing] == [
+        ("checkpoint", "directory"), ("metrics.json", "file")]
+    assert listing[0]["sizeBytes"] == 10
+    with pytest.raises(ValueError):
+        parse_uri("s3://nope")
+    with pytest.raises(ValueError):
+        store.task_dir("a/b", "wf", "t")
+
+
+def test_workflow_indexes_declared_outputs(env, tmp_path):
+    """A task that declares outputs gets the artifact env injected, its
+    outputs indexed into status + the durable run record, and the record
+    (and payloads) survive Workflow CR deletion."""
+    from kubeflow_tpu.operators.pipelines import WorkflowController
+    from kubeflow_tpu.operators.runstore import RunStore
+
+    api, _ = env
+    ctrl = WorkflowController(api, artifact_root=str(tmp_path))
+    task = job_task("train")
+    task["outputs"] = [{"name": "checkpoint", "path": "ckpt"}]
+    api.create(make_workflow([task]))
+    ctrl.reconcile_all()
+
+    # The artifact env contract landed in the created job's containers.
+    job = api.get(jobs_api.JOBS_API_VERSION, "JaxJob", "wf-train",
+                  "kubeflow")
+    env_vars = {e["name"]: e["value"] for e in
+                job["spec"]["replicaSpecs"]["Worker"]["template"]["spec"]
+                ["containers"][0]["env"]}
+    task_dir = env_vars["KUBEFLOW_ARTIFACT_DIR"]
+    assert task_dir == str(tmp_path / "kubeflow" / "wf" / "train")
+    assert env_vars["KUBEFLOW_ARTIFACT_ROOT"] == str(tmp_path)
+
+    # The "job" writes its checkpoint, then succeeds.
+    ckpt = tmp_path / "kubeflow" / "wf" / "train" / "ckpt"
+    ckpt.mkdir(parents=True)
+    (ckpt / "state").write_bytes(b"weights")
+    set_job_state(api, "wf-train", "Succeeded")
+    ctrl.reconcile_all()
+
+    wf = api.get(PIPELINES_API_VERSION, "Workflow", "wf", "kubeflow")
+    arts = wf["status"]["tasks"]["train"]["artifacts"]
+    assert arts[0]["uri"] == "artifact://kubeflow/wf/train/checkpoint"
+    assert wf["status"]["phase"] == "Succeeded"
+
+    # Run record carries the flattened index; both it and the payloads
+    # outlive the CR.
+    api.delete(PIPELINES_API_VERSION, "Workflow", "wf", "kubeflow")
+    runs = RunStore(api).list_runs("kubeflow")
+    assert runs[0]["artifacts"][0]["uri"] == \
+        "artifact://kubeflow/wf/train/checkpoint"
+    assert ctrl.artifacts.list_run("kubeflow", "wf")[0]["name"] == \
+        "checkpoint"
+    assert ctrl.artifacts.resolve(
+        "artifact://kubeflow/wf/train/checkpoint")
+
+
+def test_workflow_fails_on_missing_declared_output(env, tmp_path):
+    from kubeflow_tpu.operators.pipelines import WorkflowController
+
+    api, _ = env
+    ctrl = WorkflowController(api, artifact_root=str(tmp_path))
+    task = job_task("train")
+    task["outputs"] = [{"name": "checkpoint"}]
+    api.create(make_workflow([task], name="wf2"))
+    ctrl.reconcile_all()
+    set_job_state(api, "wf2-train", "Succeeded")
+    ctrl.reconcile_all()
+    wf = api.get(PIPELINES_API_VERSION, "Workflow", "wf2", "kubeflow")
+    ts = wf["status"]["tasks"]["train"]
+    assert ts["phase"] == "Failed"
+    assert "checkpoint" in ts["message"]
+
+
+@pytest.mark.slow
+def test_train_to_serve_through_artifact_store_e2e(api, tmp_path):
+    """The KFP contract end to end under the FakeKubelet: a train task
+    checkpoints into its injected artifact directory, the controller
+    indexes it, and the serve task loads that checkpoint into a real
+    InferenceEngine by resolving the artifact URI — then the Workflow CR
+    is deleted and both the run record and the payloads remain."""
+    import json as jsonlib
+
+    from kubeflow_tpu.k8s.kubelet import FakeKubelet
+    from kubeflow_tpu.operators.pipelines import WorkflowController
+    from kubeflow_tpu.operators.runstore import RunStore
+
+    api.apply(workflow_crd())
+    ctrl = WorkflowController(api, artifact_root=str(tmp_path))
+    train_cfg = {
+        "model": "lm-test-tiny", "steps": 4, "log_every": 2,
+        "batch_size": 2, "seq_len": 16,
+        "checkpoint_dir": "$KUBEFLOW_ARTIFACT_DIR/ckpt",
+        "checkpoint_every": 100,
+    }
+    serve_src = (
+        "from kubeflow_tpu.artifacts import ArtifactStore\n"
+        "from kubeflow_tpu.serving.engine import EngineConfig, "
+        "InferenceEngine\n"
+        "p = ArtifactStore().resolve("
+        "'artifact://kubeflow/ts/train/checkpoint')\n"
+        "e = InferenceEngine(EngineConfig(model='lm-test-tiny', "
+        "checkpoint_dir=p, max_seq_len=16))\n"
+        "out = e.predict_batch([{'tokens': [1, 2, 3]}])\n"
+        "assert len(out) == 1 and 'logits' in out[0]\n"
+        "print('served-from', p)\n"
+    )
+    api.create({
+        "apiVersion": PIPELINES_API_VERSION, "kind": "Workflow",
+        "metadata": {"name": "ts", "namespace": "kubeflow"},
+        "spec": {"tasks": [
+            {
+                "name": "train",
+                "outputs": [{"name": "checkpoint", "path": "ckpt"}],
+                "resource": {
+                    "apiVersion": "v1", "kind": "Pod",
+                    "spec": {"containers": [{
+                        "name": "main",
+                        "command": ["python", "-m",
+                                    "kubeflow_tpu.train.loop",
+                                    jsonlib.dumps(train_cfg)],
+                    }]},
+                },
+            },
+            {
+                "name": "serve",
+                "dependencies": ["train"],
+                "resource": {
+                    "apiVersion": "v1", "kind": "Pod",
+                    "spec": {"containers": [{
+                        "name": "main",
+                        "command": ["python", "-c", serve_src],
+                        "env": [{"name": "KUBEFLOW_ARTIFACT_ROOT",
+                                 "value": str(tmp_path)}],
+                    }]},
+                },
+            },
+        ]},
+    })
+    kubelet = FakeKubelet(api, cpu_devices_per_pod=1, timeout=240)
+    try:
+        kubelet.run_until_idle(reconcile=ctrl.reconcile_all, deadline=240)
+    finally:
+        kubelet.shutdown()
+    ctrl.reconcile_all()
+
+    wf = api.get(PIPELINES_API_VERSION, "Workflow", "ts", "kubeflow")
+    assert wf["status"]["phase"] == "Succeeded", wf["status"]
+    serve_log = api.get("v1", "Pod", "ts-serve",
+                        "kubeflow")["status"]["log"]
+    assert "served-from" in serve_log
+    assert str(tmp_path) in serve_log  # loaded via the store resolution
+
+    api.delete(PIPELINES_API_VERSION, "Workflow", "ts", "kubeflow")
+    record = [r for r in RunStore(api).list_runs("kubeflow")
+              if r["workflow"] == "ts"][0]
+    assert record["artifacts"][0]["uri"] == \
+        "artifact://kubeflow/ts/train/checkpoint"
+    assert ctrl.artifacts.list_run("kubeflow", "ts")[0]["type"] == \
+        "directory"
