@@ -69,6 +69,12 @@ class Route:
     # Shadow/mirror target: every request is also sent fire-and-forget to
     # this backend; its response is discarded and its failures invisible.
     shadow: str = ""
+    # Outlier detection (seldon outlier-detector-v1alpha2 surface): score
+    # each prediction request's feature against a running window;
+    # |z| > threshold tags the response and counts into the outlier rate.
+    # 0 disables.
+    outlier_threshold: float = 0.0
+    outlier_window: int = 100
 
     def pick_service(self, rng) -> str:
         if not self.backends:
@@ -86,6 +92,240 @@ class Route:
                 + rest.lstrip("/"))
 
 
+class OutlierStats:
+    """Route-attached anomaly scoring — the seldon outlier-detector
+    variant (/root/reference/kubeflow/seldon/prototypes/
+    outlier-detector-v1alpha2.jsonnet:1-128 attaches a Mahalanobis
+    scorer to a model route). Platform recast: a running z-score over a
+    scalar feature of each prediction request (mean |value| of the
+    instances payload), maintained per route over a sliding window.
+    Requests scoring beyond the route's threshold are tagged
+    (X-Outlier/X-Outlier-Score response headers — the streamed relay
+    never buffers bodies, so tagging rides headers) and counted into the
+    outlier-rate metric."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # route -> (window deque, outliers, scored)
+        self._windows: dict[str, object] = {}
+        self._counts: dict[str, list[int]] = {}
+
+    @staticmethod
+    def feature(body: bytes | None) -> float | None:
+        """Scalar feature of a prediction request: mean |x| over every
+        numeric leaf of "instances". None = not scoreable (no/bad JSON,
+        no numerics) — never an error, scoring must not break proxying."""
+        if not body:
+            return None
+        try:
+            payload = json.loads(body)
+        except (ValueError, UnicodeDecodeError):
+            return None
+        total, n = 0.0, 0
+        stack = [payload.get("instances")
+                 if isinstance(payload, dict) else payload]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, bool):
+                continue
+            if isinstance(node, (int, float)):
+                total += abs(float(node))
+                n += 1
+            elif isinstance(node, list):
+                stack.extend(node)
+            elif isinstance(node, dict):
+                stack.extend(node.values())
+        return total / n if n else None
+
+    # Baseline points required before anything is flagged: a 2-sample
+    # window's std is noise, and normal jitter would score "infinite".
+    WARMUP = 10
+
+    def score(self, route: str, value: float, *, window: int,
+              threshold: float) -> tuple[float, bool]:
+        """Running z-score of ``value`` against the route's window
+        (scored BEFORE insertion, so one huge request can't mask
+        itself); returns (score, is_outlier). Warmup requests build the
+        baseline and are never flagged."""
+        import collections
+        import math
+
+        with self._lock:
+            win = self._windows.setdefault(
+                route, collections.deque(maxlen=max(window, 2))
+            )
+            counts = self._counts.setdefault(route, [0, 0])
+            if win.maxlen != max(window, 2):
+                # Window reconfigured (annotation re-applied): carry the
+                # most recent baseline into the new size.
+                win = collections.deque(win, maxlen=max(window, 2))
+                self._windows[route] = win
+            warm = len(win) >= min(self.WARMUP, win.maxlen)
+            if len(win) >= 2:
+                mean = sum(win) / len(win)
+                var = sum((v - mean) ** 2 for v in win) / len(win)
+                std = math.sqrt(var)
+                z = abs(value - mean) / std if std > 1e-12 else (
+                    0.0 if abs(value - mean) < 1e-12 else float("inf")
+                )
+            else:
+                z = 0.0
+            outlier = warm and z > threshold
+            counts[1] += 1
+            if outlier:
+                counts[0] += 1
+            else:
+                # Outliers are excluded from the baseline, or a burst of
+                # them would normalize itself into "normal".
+                win.append(value)
+            return (round(z, 4) if z != float("inf") else z, outlier)
+
+    def snapshot(self, route: str) -> dict:
+        with self._lock:
+            outliers, scored = self._counts.get(route, (0, 0))
+            return {"outliers": outliers, "scored": scored,
+                    "rate": round(outliers / scored, 4) if scored else 0.0}
+
+    def totals(self) -> tuple[int, int]:
+        with self._lock:
+            return (sum(c[0] for c in self._counts.values()),
+                    sum(c[1] for c in self._counts.values()))
+
+
+class UpstreamHealth:
+    """Per-backend health with circuit breaking (the envoy outlier-
+    detection role ambassador delegates to envoy; this platform's front
+    door implements it natively):
+
+    - passive observation: every proxied request records success/failure
+      (connect errors and 5xx); ``failure_threshold`` consecutive
+      failures EJECT the backend from every route's pick set for
+      ``ejection_seconds``;
+    - half-open recovery: after the ejection window one trial request is
+      let through — success closes the circuit, failure re-ejects with
+      doubled backoff (capped 10×);
+    - active probes: a prober thread TCP-connects each known backend
+      every ``probe_interval`` seconds so an upstream that died between
+      requests is ejected (and a recovered one readmitted) without
+      client traffic paying for the discovery.
+    """
+
+    def __init__(self, *, failure_threshold: int = 3,
+                 ejection_seconds: float = 10.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.failure_threshold = failure_threshold
+        self.ejection_seconds = ejection_seconds
+        self.clock = clock
+        self._lock = threading.Lock()
+        # service -> {fails, ejected_until, ejections, state-extras}
+        self._state: dict[str, dict] = {}
+
+    def _cell(self, service: str) -> dict:
+        return self._state.setdefault(service, {
+            "consecutive_failures": 0, "ejected_until": 0.0,
+            "ejections": 0, "half_open_inflight": False,
+            "trial_started": 0.0, "last_change": self.clock(),
+        })
+
+    def record_success(self, service: str) -> None:
+        with self._lock:
+            cell = self._cell(service)
+            recovered = (cell["consecutive_failures"]
+                         >= self.failure_threshold)
+            cell.update(consecutive_failures=0, ejected_until=0.0,
+                        half_open_inflight=False)
+            if recovered:
+                cell.update(ejections=0, last_change=self.clock())
+
+    # A half-open trial that never reported back (e.g. the request rode
+    # an upgrade tunnel, which doesn't record outcomes) expires so the
+    # backend isn't stuck "trial in flight" forever.
+    TRIAL_TIMEOUT = 30.0
+
+    def record_failure(self, service: str) -> None:
+        with self._lock:
+            cell = self._cell(service)
+            cell["consecutive_failures"] += 1
+            cell["half_open_inflight"] = False
+            if cell["consecutive_failures"] >= self.failure_threshold:
+                # Re-eject with doubled backoff per consecutive ejection
+                # (half-open trial failed), capped at 10x — exponent
+                # clamped so a long-dead backend can't grow a bigint.
+                backoff = self.ejection_seconds * min(
+                    2 ** min(cell["ejections"], 4), 10
+                )
+                cell["ejected_until"] = self.clock() + backoff
+                cell["ejections"] += 1
+                cell["last_change"] = self.clock()
+
+    def _eligible_locked(self, cell: dict | None) -> bool:
+        if cell is None or cell["consecutive_failures"] \
+                < self.failure_threshold:
+            return True
+        if self.clock() < cell["ejected_until"]:
+            return False
+        if cell["half_open_inflight"] and (
+                self.clock() - cell["trial_started"] < self.TRIAL_TIMEOUT):
+            return False
+        return True  # window elapsed: a trial may begin
+
+    def admits(self, service: str) -> bool:
+        """Side-effect-free eligibility: healthy, or ejection window
+        elapsed with no trial in flight."""
+        with self._lock:
+            return self._eligible_locked(self._state.get(service))
+
+    def begin_trial(self, service: str) -> None:
+        """Mark the half-open trial as in flight for the backend a
+        request was ACTUALLY routed to (never during pick-set filtering —
+        an unpicked backend must not have its one trial consumed)."""
+        with self._lock:
+            cell = self._state.get(service)
+            if (cell is not None
+                    and cell["consecutive_failures"]
+                    >= self.failure_threshold
+                    and self.clock() >= cell["ejected_until"]):
+                cell["half_open_inflight"] = True
+                cell["trial_started"] = self.clock()
+
+    def filter_healthy(self, services: list[str]) -> list[str]:
+        """The pick set: ejected backends drop out; if EVERYTHING is
+        ejected, fail open with the full set (a wrong 502 beats
+        blackholing when the health data itself is suspect)."""
+        healthy = [s for s in services if self.admits(s)]
+        return healthy or list(services)
+
+    def probe(self, services: list[str],
+              resolve: Callable[[str], str]) -> None:
+        """Active TCP-connect probe of every service (cheap, protocol-
+        agnostic — the readiness signal is 'something is listening')."""
+        for service in services:
+            addr = resolve(service)
+            host, _, port_s = addr.partition(":")
+            try:
+                with socket.create_connection(
+                        (host, int(port_s or 80)), timeout=2.0):
+                    pass
+                self.record_success(service)
+            except OSError:
+                self.record_failure(service)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            now = self.clock()
+            return {
+                svc: {
+                    "healthy": cell["consecutive_failures"]
+                    < self.failure_threshold,
+                    "consecutive_failures": cell["consecutive_failures"],
+                    "ejected_for_seconds": round(
+                        max(0.0, cell["ejected_until"] - now), 2),
+                    "ejections": cell["ejections"],
+                }
+                for svc, cell in self._state.items()
+            }
+
+
 class BanditStats:
     """Per-(route, backend) reward averages for epsilon-greedy routes."""
 
@@ -99,11 +339,14 @@ class BanditStats:
             cell[0] += reward
             cell[1] += 1
 
-    def pick(self, route: Route, rng) -> str:
+    def pick(self, route: Route, rng, services: list[str] | None = None
+             ) -> str:
         """Explore uniformly with prob epsilon; otherwise exploit the best
         mean reward. Untried backends are optimistic (mean 1.0), so every
-        variant gets traffic before exploitation locks in."""
-        services = [b[0] for b in route.backends]
+        variant gets traffic before exploitation locks in. ``services``
+        restricts the arms (the health layer's ejection filter)."""
+        if services is None:
+            services = [b[0] for b in route.backends]
         if rng.random() < route.epsilon:
             return rng.choice(services)
         with self._lock:
@@ -161,11 +404,20 @@ def routes_from_service(svc: dict) -> list[Route]:
             epsilon = float(spec.get("epsilon", 0.1))
             if not 0.0 <= epsilon <= 1.0:
                 raise ValueError("epsilon must be in [0, 1]")
+            outlier = spec.get("outlier", {}) or {}
+            outlier_threshold = float(outlier.get("threshold", 0.0))
+            outlier_window = int(outlier.get("window", 100))
+            if outlier_threshold < 0:
+                raise ValueError("outlier threshold must be >= 0")
+            if outlier_window < 2:
+                raise ValueError("outlier window must be >= 2")
             routes.append(Route(
                 name=spec["name"], prefix=spec["prefix"],
                 service=service, rewrite=spec.get("rewrite", "/"),
                 backends=backends, strategy=strategy, epsilon=epsilon,
                 shadow=spec.get("shadow", ""),
+                outlier_threshold=outlier_threshold,
+                outlier_window=outlier_window,
             ))
         except (KeyError, TypeError, ValueError) as e:
             log.warning("bad route spec in %s: %s",
@@ -241,6 +493,9 @@ class Gateway:
         redirect_target_port: int | None = None,
         challenge_lookup: Callable[[str], str | None] | None = None,
         upstream_timeout: float = 60.0,
+        health: UpstreamHealth | None = None,
+        probe_interval: float = 2.0,
+        retry_budget: float = 0.2,
         rng=None,
     ):
         self.table = table
@@ -275,6 +530,19 @@ class Gateway:
         self.rng = rng or random.Random()
         # Reward averages for epsilon-greedy (bandit) routes.
         self.bandit = BanditStats()
+        # Per-route anomaly scoring (seldon outlier-detector surface).
+        self.outliers = OutlierStats()
+        # Upstream health/circuit breaking: passive per-request
+        # observations + an active prober thread (probe_interval; 0
+        # disables the prober, passive observation still applies).
+        self.health = health or UpstreamHealth()
+        self.probe_interval = probe_interval
+        # Idempotent-retry budget (envoy-style): GET/HEAD requests that
+        # hit a dead backend may retry ONCE against a different healthy
+        # backend, as long as retries stay under this fraction of
+        # requests — a hard cap so retries can't amplify an outage.
+        self.retry_budget = retry_budget
+        self.retries_total = 0
         self.requests_total = 0
         self.errors_total = 0
         self.tunnels_total = 0
@@ -284,6 +552,11 @@ class Gateway:
         self._redirect: ThreadingHTTPServer | None = None
         self._ssl_ctx = None
         self._cert_watch_stop = threading.Event()
+
+    def _retry_allowed(self) -> bool:
+        return (self.retries_total + 1) <= self.retry_budget * max(
+            self.requests_total, 1
+        )
 
     # -- auth ---------------------------------------------------------------
 
@@ -356,10 +629,7 @@ class Gateway:
                                          "login": "/login"}).encode(),
                     )
                     return
-                if route.strategy == "epsilon-greedy" and route.backends:
-                    service = gw.bandit.pick(route, gw.rng)
-                else:
-                    service = route.pick_service(gw.rng)  # weighted draw
+                service = self._pick_backend(route)
                 target = route.target_for(self.path, service)
                 # Re-point at the resolved backend address.
                 target = target.replace(service, gw.resolve(service), 1)
@@ -374,6 +644,31 @@ class Gateway:
                 self._proxy_http(route, parts.hostname, parts.port,
                                  backend_path, service)
 
+            def _pick_backend(self, route, exclude: str | None = None
+                              ) -> str:
+                """Choose a backend with ejected upstreams filtered out of
+                the pick set (weighted draws AND bandit arms); ``exclude``
+                additionally drops the backend a retry just failed on."""
+                if not route.backends:
+                    return route.service  # nowhere else to go
+                services = gw.health.filter_healthy(
+                    [b[0] for b in route.backends]
+                )
+                if exclude and len(services) > 1:
+                    services = [s for s in services if s != exclude]
+                if route.strategy == "epsilon-greedy":
+                    picked = gw.bandit.pick(route, gw.rng, services)
+                else:
+                    weights = {b[0]: b[1] for b in route.backends}
+                    draw = [weights[s] for s in services]
+                    if not any(draw):  # only zero-weight backends left
+                        draw = [1.0] * len(services)
+                    picked = gw.rng.choices(services, weights=draw)[0]
+                # Consume the half-open trial only on the backend that
+                # actually takes the request.
+                gw.health.begin_trial(picked)
+                return picked
+
             def _is_upgrade(self) -> bool:
                 conn_tokens = [
                     t.strip().lower()
@@ -384,8 +679,12 @@ class Gateway:
 
             # -- plain HTTP: streamed relay -----------------------------
 
-            def _proxy_http(self, route, host, port, path, service=None):
-                length = int(self.headers.get("Content-Length", 0))
+            def _proxy_http(self, route, host, port, path, service=None,
+                            is_retry=False):
+                # On a retry the request body stream is already consumed —
+                # only bodyless idempotent methods reach here retrying.
+                length = (0 if is_retry
+                          else int(self.headers.get("Content-Length", 0)))
                 body = self.rfile.read(length) if length else None
                 # The forwarded prefix is gateway-asserted — a client-
                 # supplied copy must never reach the backend (spoofing).
@@ -395,8 +694,21 @@ class Gateway:
                     and k.lower() != "x-forwarded-prefix"
                 }
                 headers["X-Forwarded-Prefix"] = route.prefix
-                if route.shadow:
+                if route.shadow and not is_retry:
                     self._mirror(route, path, body, dict(headers))
+                tag_headers = {}
+                if route.outlier_threshold > 0 and not is_retry:
+                    value = OutlierStats.feature(body)
+                    if value is not None:
+                        z, is_out = gw.outliers.score(
+                            route.name, value,
+                            window=route.outlier_window,
+                            threshold=route.outlier_threshold,
+                        )
+                        tag_headers = {
+                            "X-Outlier": "true" if is_out else "false",
+                            "X-Outlier-Score": str(z),
+                        }
                 bandit = (route.strategy == "epsilon-greedy"
                           and service is not None)
                 conn = HTTPConnection(host, port,
@@ -410,6 +722,32 @@ class Gateway:
                     except OSError as e:
                         if bandit:
                             gw.bandit.record(route.name, service, 0.0)
+                        if service is not None:
+                            gw.health.record_failure(service)
+                        # Idempotent-GET retry: one shot at a DIFFERENT
+                        # healthy backend, under the retry budget (a
+                        # connect failure never duplicated a request).
+                        if (self.command in ("GET", "HEAD")
+                                and not is_retry
+                                and route.backends
+                                and service is not None
+                                and gw._retry_allowed()):
+                            retry_to = self._pick_backend(
+                                route, exclude=service)
+                            if retry_to != service:
+                                gw.retries_total += 1
+                                r_target = route.target_for(
+                                    self.path, retry_to)
+                                r_target = r_target.replace(
+                                    retry_to, gw.resolve(retry_to), 1)
+                                p = urllib.parse.urlsplit(r_target)
+                                self._proxy_http(
+                                    route, p.hostname, p.port,
+                                    p.path + ("?" + p.query
+                                              if p.query else ""),
+                                    retry_to, is_retry=True,
+                                )
+                                return
                         gw.errors_total += 1
                         self._respond(
                             502,
@@ -422,7 +760,14 @@ class Gateway:
                         # Implicit reward: server errors are failures.
                         gw.bandit.record(route.name, service,
                                          0.0 if resp.status >= 500 else 1.0)
-                    self._relay_response(resp)
+                    if service is not None:
+                        # Passive health observation: 5xx counts against
+                        # the upstream; anything else closes its circuit.
+                        if resp.status >= 500:
+                            gw.health.record_failure(service)
+                        else:
+                            gw.health.record_success(service)
+                    self._relay_response(resp, tag_headers)
                 finally:
                     conn.close()
 
@@ -464,12 +809,14 @@ class Gateway:
                     time.sleep(0.1)
                     conn.connect()
 
-            def _relay_response(self, resp):
+            def _relay_response(self, resp, extra_headers=None):
                 try:
                     self.send_response(resp.status)
                     for k, v in resp.getheaders():
                         if k.lower() not in _HOP_HEADERS:
                             self.send_header(k, v)
+                    for k, v in (extra_headers or {}).items():
+                        self.send_header(k, v)
                     upstream_len = resp.getheader("Content-Length")
                     bodyless = (self.command == "HEAD"
                                 or resp.status in (204, 304)
@@ -620,7 +967,14 @@ class Gateway:
                     for r in routes:
                         if r.get("strategy") == "epsilon-greedy":
                             r["bandit"] = gw.bandit.snapshot(r["name"])
+                        if r.get("outlier_threshold"):
+                            r["outliers"] = gw.outliers.snapshot(r["name"])
                     body = json.dumps(routes).encode()
+                    ctype = "application/json"
+                elif self.path == "/upstreams":
+                    # Upstream health + circuit state, per backend (the
+                    # envoy clusters/outlier admin surface).
+                    body = json.dumps(gw.health.snapshot()).encode()
                     ctype = "application/json"
                 elif self.path == "/metrics":
                     body = (
@@ -632,6 +986,13 @@ class Gateway:
                         f"gateway_upgrade_tunnels_total {gw.tunnels_total}\n"
                         "# TYPE gateway_shadow_requests_total counter\n"
                         f"gateway_shadow_requests_total {gw.shadow_total}\n"
+                        "# TYPE gateway_retries_total counter\n"
+                        f"gateway_retries_total {gw.retries_total}\n"
+                        "# TYPE gateway_outliers_total counter\n"
+                        f"gateway_outliers_total {gw.outliers.totals()[0]}\n"
+                        "# TYPE gateway_outlier_scored_total counter\n"
+                        "gateway_outlier_scored_total "
+                        f"{gw.outliers.totals()[1]}\n"
                     ).encode()
                     ctype = "text/plain"
                 elif self.path in ("/healthz", "/readyz"):
@@ -701,6 +1062,21 @@ class Gateway:
                 self.wfile.write(body)
 
         return Handler
+
+    def _probe_upstreams(self) -> None:
+        """Active prober loop: every route backend (split variants AND
+        single-backend services) gets a liveness probe per interval, so
+        a dead upstream is ejected — and a recovered one readmitted via
+        the half-open walk — without client traffic discovering it."""
+        while not self._cert_watch_stop.wait(self.probe_interval):
+            services: set[str] = set()
+            for r in self.table.snapshot():
+                services.add(r["service"])
+                services.update(b[0] for b in r.get("backends", ()))
+            try:
+                self.health.probe(sorted(services), self.resolve)
+            except Exception:  # pragma: no cover — probe must never die
+                log.exception("upstream probe pass failed")
 
     def _watch_certs(self) -> None:
         """Poll the cert/key files; on change, reload them into the SAME
@@ -791,6 +1167,9 @@ class Gateway:
                 ("0.0.0.0", self.admin_port), self._make_admin_handler()
             )
             threading.Thread(target=self._admin.serve_forever,
+                             daemon=True).start()
+        if self.probe_interval > 0:
+            threading.Thread(target=self._probe_upstreams,
                              daemon=True).start()
 
     def stop(self) -> None:

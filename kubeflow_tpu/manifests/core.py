@@ -149,7 +149,8 @@ GATEWAY_ROUTE_ANNOTATION = "kubeflow-tpu.org/gateway-route"
 
 def gateway_route(name: str, prefix: str, service: str, rewrite: str = "/",
                   backends: list | None = None, shadow: str = "",
-                  strategy: str = "", epsilon: float | None = None) -> dict:
+                  strategy: str = "", epsilon: float | None = None,
+                  outlier: dict | None = None) -> dict:
     """Gateway route annotation for a Service — the platform-wide analogue of
     the `getambassador.io/config` annotations the reference attaches to every
     web-app Service (kubeflow/common/ambassador.libsonnet route pattern). The
@@ -172,6 +173,10 @@ def gateway_route(name: str, prefix: str, service: str, rewrite: str = "/",
         spec["strategy"] = strategy
     if epsilon is not None:
         spec["epsilon"] = epsilon
+    if outlier:
+        # {threshold, window}: running z-score anomaly tagging (the
+        # seldon outlier-detector-v1alpha2 surface).
+        spec["outlier"] = outlier
     return {
         GATEWAY_ROUTE_ANNOTATION: yaml.safe_dump(spec, sort_keys=True)
     }

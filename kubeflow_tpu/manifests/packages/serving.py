@@ -192,6 +192,12 @@ def batch_predict(
                   "(multi-armed bandit over the variants)"),
         ParamSpec("epsilon", 0.1,
                   "bandit exploration rate (epsilon-greedy only)"),
+        ParamSpec("outlier_threshold", 0.0,
+                  "z-score beyond which a prediction request is tagged "
+                  "an outlier (seldon outlier-detector surface); 0 "
+                  "disables"),
+        ParamSpec("outlier_window", 100,
+                  "sliding baseline window for the outlier score"),
     ],
 )
 def serving_route(
@@ -204,6 +210,8 @@ def serving_route(
     shadow_service: str,
     strategy: str,
     epsilon: float,
+    outlier_threshold: float,
+    outlier_window: int,
 ) -> list[dict]:
     prefix = prefix or f"/models/{name}/"
     primary = primary_service or f"{name}.{namespace}:{REST_PORT}"
@@ -221,11 +229,20 @@ def serving_route(
             {"service": primary, "weight": 100 - int(canary_weight)},
             {"service": canary_service, "weight": int(canary_weight)},
         ]
+    if float(outlier_threshold) < 0:
+        raise ValueError("outlier_threshold must be >= 0")
+    if float(outlier_threshold) > 0 and int(outlier_window) < 2:
+        # The gateway would reject (and silently drop) the whole route at
+        # refresh time — fail at render instead.
+        raise ValueError("outlier_window must be >= 2")
     route = gateway_route(
         f"{name}-route", prefix, primary,
         backends=backends, shadow=shadow_service or "",
         strategy=strategy if strategy != "weighted" else "",
         epsilon=float(epsilon) if strategy == "epsilon-greedy" else None,
+        outlier=({"threshold": float(outlier_threshold),
+                  "window": int(outlier_window)}
+                 if float(outlier_threshold) > 0 else None),
     )
     # Selector-less carrier Service: exists only to hold the route
     # annotation the gateway discovers (the variants are full Services of

@@ -58,6 +58,10 @@ SNAPSHOT_CASES: dict[str, tuple[str, dict]] = {
         {"name": "bert", "canary_service": "bert-v2.kubeflow:8500",
          "strategy": "epsilon-greedy", "epsilon": 0.2},
     ),
+    "serving-route-outlier": (
+        "serving-route",
+        {"name": "bert", "outlier_threshold": 3.0, "outlier_window": 50},
+    ),
     "cert-manager": ("cert-manager", {}),
     "secure-ingress": (
         "secure-ingress",
