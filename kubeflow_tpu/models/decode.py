@@ -305,7 +305,6 @@ def _decode_step_body(state, params, cfg: TransformerConfig, top_k: int,
     parked ON DEVICE (active cleared, write position parked at ``total``
     like :func:`retire_row`) so a fused multi-step loop needs no host
     round-trip per token to stop at EOS."""
-    b = state["length"].shape[0]
     total = state["cache"]["k"].shape[2]
     emit = state["active"]
     key, sub = jax.random.split(state["key"])

@@ -19,6 +19,15 @@ Checks (each maps to a flake8 family):
 - E722 bare ``except:``
 - D100 missing module docstring (the boilerplate-check analogue: every
   module must say what it is)
+- F821 undefined names (any Load of a name never bound anywhere in the
+  file, an import, a builtin, or a module dunder — the typo catcher;
+  deliberately file-flat rather than scope-exact, so it under-reports
+  scope leaks but never false-positives on conditional definitions)
+- F841 unused local variables (assigned in a function, never read in
+  that function or its nested scopes; ``_``-prefixed and tuple-unpacked
+  names exempt)
+- A001 shadowed builtins (a function/class/argument/assignment binding
+  that hides a Python builtin)
 """
 
 from __future__ import annotations
@@ -157,6 +166,175 @@ def _check_ast(path: str, source: str, noqa: set[int]) -> list[Violation]:
                 and node.lineno not in noqa):
             out.append(Violation(path, node.lineno, "E722",
                                  "bare 'except:'"))
+    out.extend(_check_undefined(path, tree, noqa))
+    out.extend(_check_unused_locals(path, tree, noqa))
+    out.extend(_check_shadowed_builtins(path, tree, noqa))
+    return out
+
+
+_MODULE_DUNDERS = {
+    "__name__", "__file__", "__doc__", "__package__", "__spec__",
+    "__loader__", "__builtins__", "__debug__", "__annotations__",
+    "__dict__", "__class__", "__path__",
+}
+
+# The full builtin namespace: F821's known-name floor, and (non-dunder
+# members) the A001 shadowing set — `id`/`input`/`type` ARE flagged,
+# they are the classic shadowing bugs.
+_BUILTIN_NAMES = set(dir(__import__("builtins")))
+
+
+def _bound_names(tree: ast.AST) -> set[str]:
+    """Every name bound anywhere in the file, in any scope: imports,
+    assignments, defs, args, loop/with/except/comprehension targets,
+    globals, walrus, match captures."""
+    bound: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                if alias.name == "*":
+                    bound.add("*")  # star import: F821 bails on the file
+                else:
+                    bound.add(alias.asname
+                              or alias.name.split(".")[0])
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            bound.add(node.name)
+        elif isinstance(node, ast.Name) and isinstance(
+                node.ctx, (ast.Store, ast.Del)):
+            bound.add(node.id)
+        elif isinstance(node, ast.arg):
+            bound.add(node.arg)
+        elif isinstance(node, ast.ExceptHandler) and node.name:
+            bound.add(node.name)
+        elif isinstance(node, (ast.Global, ast.Nonlocal)):
+            bound.update(node.names)
+        elif isinstance(node, ast.MatchAs) and node.name:
+            bound.add(node.name)
+        elif isinstance(node, ast.MatchStar) and node.name:
+            bound.add(node.name)
+        elif isinstance(node, ast.MatchMapping) and node.rest:
+            bound.add(node.rest)
+    return bound
+
+
+def _check_undefined(path: str, tree: ast.AST,
+                     noqa: set[int]) -> list[Violation]:
+    bound = _bound_names(tree)
+    if "*" in bound:  # star import makes the name universe unknowable
+        return []
+    known = bound | _BUILTIN_NAMES | _MODULE_DUNDERS
+    out = []
+    seen: set[tuple[str, int]] = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load)
+                and node.id not in known
+                and node.lineno not in noqa
+                and (node.id, node.lineno) not in seen):
+            seen.add((node.id, node.lineno))
+            out.append(Violation(path, node.lineno, "F821",
+                                 f"undefined name '{node.id}'"))
+    return out
+
+
+def _own_scope_nodes(fn: ast.AST):
+    """Walk a function's OWN scope: descend everywhere except into
+    nested function/class definitions (their bindings are theirs)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _check_unused_locals(path: str, tree: ast.AST,
+                         noqa: set[int]) -> list[Violation]:
+    out = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        assigned: dict[str, int] = {}
+        # Bindings belong to the function's own scope (class attributes
+        # and nested defs' locals are not this function's locals)...
+        for node in _own_scope_nodes(fn):
+            if isinstance(node, ast.Assign):
+                # flake8 parity: only simple single-target assignments
+                # count (tuple unpacking often carries intentional
+                # discards).
+                if (len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)):
+                    name = node.targets[0].id
+                    if not name.startswith("_"):
+                        assigned.setdefault(name, node.lineno)
+        # ...but reads anywhere inside (closures included) count as use.
+        loaded: set[str] = set()
+        escaping: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and isinstance(
+                    node.ctx, ast.Load):
+                loaded.add(node.id)
+            elif isinstance(node, (ast.Global, ast.Nonlocal)):
+                escaping.update(node.names)
+        for name, line in assigned.items():
+            if (name not in loaded and name not in escaping
+                    and line not in noqa):
+                out.append(Violation(
+                    path, line, "F841",
+                    f"local variable '{name}' is assigned to but never "
+                    "used"))
+    return out
+
+
+def _check_shadowed_builtins(path: str, tree: ast.AST,
+                             noqa: set[int]) -> list[Violation]:
+    """A001: builtin shadowing in NAME scopes (module globals, function
+    locals, arguments, def/class names). Class attributes and methods
+    are exempt — they live behind ``self.``/``cls.`` and shadow nothing
+    (the A003 family, which flake8-builtins users near-universally
+    disable)."""
+    out = []
+
+    def flag(name: str, line: int, what: str) -> None:
+        if (name in _BUILTIN_NAMES and not name.startswith("_")
+                and line not in noqa):
+            out.append(Violation(path, line, "A001",
+                                 f"{what} '{name}' shadows a builtin"))
+
+    def visit(node: ast.AST, in_class_body: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                if not in_class_body:  # methods are class attributes
+                    flag(child.name, child.lineno, "function")
+                args = child.args
+                for a in (args.posonlyargs + args.args + args.kwonlyargs
+                          + ([args.vararg] if args.vararg else [])
+                          + ([args.kwarg] if args.kwarg else [])):
+                    if a.arg not in ("self", "cls"):
+                        flag(a.arg, a.lineno, "argument")
+                visit(child, in_class_body=False)
+            elif isinstance(child, ast.ClassDef):
+                if not in_class_body:
+                    flag(child.name, child.lineno, "class")
+                visit(child, in_class_body=True)
+            elif (isinstance(child, ast.Name)
+                  and isinstance(child.ctx, ast.Store)
+                  and not in_class_body):
+                flag(child.id, child.lineno, "assignment to")
+                visit(child, in_class_body)
+            elif isinstance(child, ast.Lambda):
+                for a in child.args.args:
+                    flag(a.arg, a.lineno, "argument")
+                visit(child, in_class_body=False)
+            else:
+                # Expressions/statements keep the surrounding binding
+                # context (a class-body `x = ...` RHS may contain
+                # comprehensions whose targets are still exempt enough).
+                visit(child, in_class_body)
+
+    visit(tree, in_class_body=False)
     return out
 
 

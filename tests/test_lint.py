@@ -58,3 +58,45 @@ def test_linter_exemptions(tmp_path):
     assert "E501" not in _lint_source(
         tmp_path,
         '"""doc."""\n# see https://example.com/%s\n' % ("a" * 120))
+
+
+def test_linter_catches_round4_classes(tmp_path):
+    # F821: a typo'd/undefined name.
+    assert "F821" in _lint_source(
+        tmp_path, '"""doc."""\nx = 1\nprint(xy)\n')
+    # F841: assigned, never read.
+    assert "F841" in _lint_source(
+        tmp_path,
+        '"""doc."""\ndef f():\n    unused = 3\n    return 1\n')
+    # A001: builtin shadowed in a name scope.
+    assert "A001" in _lint_source(
+        tmp_path, '"""doc."""\ndef f(list):\n    return list\n')
+    assert "A001" in _lint_source(
+        tmp_path, '"""doc."""\ndef f():\n    id = 3\n    return id\n')
+
+
+def test_round4_exemptions(tmp_path):
+    # F821 never fires on conditionally-bound, builtin, dunder, or
+    # star-imported names.
+    assert "F821" not in _lint_source(
+        tmp_path,
+        '"""doc."""\nimport os\nif os.sep:\n    maybe = 1\n'
+        "print(maybe, __name__, len([]))\n")
+    assert "F821" not in _lint_source(
+        tmp_path, '"""doc."""\nfrom os.path import *\nprint(join)\n')
+    # F841 skips _-prefixed, tuple unpacking, and closure-read locals.
+    assert "F841" not in _lint_source(
+        tmp_path,
+        '"""doc."""\ndef f():\n    _scratch = 3\n    a, b = 1, 2\n'
+        "    used = 5\n    def g():\n        return used\n    return g\n")
+    # A001 exempts class attributes and methods (self.-scoped, the A003
+    # family) and self/cls.
+    assert "A001" not in _lint_source(
+        tmp_path,
+        '"""doc."""\nclass C:\n    type = "x"\n'
+        "    def list(self):\n        return self.type\n")
+    # Class-body assignment inside a factory fn is not the fn's local.
+    assert "F841" not in _lint_source(
+        tmp_path,
+        '"""doc."""\ndef make():\n    class H:\n        version = 1\n'
+        "    return H\n")
