@@ -1,0 +1,124 @@
+"""Third-party operator package: host an external operator + its CRD.
+
+The reference bundles manifest sets for ecosystem operators — most
+prominently spark-operator (/root/reference/kubeflow/spark/
+build/spark-operator.yaml: CRD + Deployment + RBAC surface, with
+prototypes/spark-operator.jsonnet params). Rather than one hand-written
+package per product, the platform hosts ANY such operator through one
+generic prototype: its CRD (schema preserved), scoped RBAC, the operator
+Deployment, and an Application CR grouping the pieces so the platform's
+application tracking reports the operator's readiness like any native
+component.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.apis.pipelines import PIPELINES_API_VERSION
+from kubeflow_tpu.k8s import objects as k8s
+from kubeflow_tpu.manifests.core import ParamSpec, prototype
+from kubeflow_tpu.version import DEFAULT_NAMESPACE
+
+
+@prototype(
+    "third-party-operator",
+    "Host an external operator: CRD + RBAC + Deployment + Application "
+    "tracking (the spark-operator package surface, generalized)",
+    params=[
+        ParamSpec("name", "REQUIRED", "operator name (e.g. spark-operator)"),
+        ParamSpec("namespace", DEFAULT_NAMESPACE),
+        ParamSpec("image", "REQUIRED", "operator image"),
+        ParamSpec("crd_group", "REQUIRED",
+                  "API group of the operator's CRD (e.g. "
+                  "sparkoperator.k8s.io)"),
+        ParamSpec("crd_kind", "REQUIRED", "CRD kind (e.g. SparkApplication)"),
+        ParamSpec("crd_plural", None,
+                  "CRD plural; default = kind lowercased + 's'"),
+        ParamSpec("crd_version", "v1",
+                  "served CRD version — match the operator's API "
+                  "(spark-operator: v1beta2)"),
+        ParamSpec("command", None, "container command override (list)"),
+        ParamSpec("args", None, "container args (list)"),
+        ParamSpec("metrics_port", 0, "prometheus port (0 = none)"),
+    ],
+)
+def third_party_operator(
+    name: str,
+    namespace: str,
+    image: str,
+    crd_group: str,
+    crd_kind: str,
+    crd_plural: str | None,
+    crd_version: str,
+    command,
+    args,
+    metrics_port: int,
+) -> list[dict]:
+    labels = {"app": name, "app.kubernetes.io/name": name}
+    plural = crd_plural or crd_kind.lower() + "s"
+    # The external CRD: schema is the operator's own business — admit
+    # anything under spec/status (exactly how the reference carries
+    # spark-operator's CRD, build/spark-operator.yaml); the served
+    # version must match the hosted operator's informers.
+    crd = k8s.crd(
+        group=crd_group,
+        kind=crd_kind,
+        plural=plural,
+        categories=["kubeflow-tpu"],
+        versions=[k8s.crd_version(
+            crd_version,
+            schema={"type": "object",
+                    "x-kubernetes-preserve-unknown-fields": True},
+            storage=True,
+        )],
+    )
+    annotations = None
+    if metrics_port:
+        annotations = {"prometheus.io/scrape": "true",
+                       "prometheus.io/port": str(metrics_port)}
+    return [
+        crd,
+        k8s.service_account(name, namespace, labels),
+        k8s.cluster_role(
+            name,
+            [
+                # The operator owns its group; everything else is the
+                # standard workload surface external operators drive.
+                k8s.policy_rule([crd_group], ["*"], ["*"]),
+                k8s.policy_rule([""], ["pods", "services", "configmaps",
+                                       "events"], ["*"]),
+                k8s.policy_rule(["apps"], ["deployments", "statefulsets"],
+                                ["*"]),
+            ],
+            labels,
+        ),
+        k8s.cluster_role_binding(name, name, name, namespace),
+        k8s.deployment(
+            name,
+            namespace,
+            containers=[k8s.container(
+                name,
+                image,
+                command=list(command) if command else None,
+                args=[str(a) for a in args] if args else None,
+                ports={"metrics": metrics_port} if metrics_port else None,
+            )],
+            labels=labels,
+            pod_annotations=annotations,
+            service_account=name,
+        ),
+        # Application CR: the platform's component tracking reports the
+        # hosted operator's readiness (application.libsonnet role).
+        {
+            "apiVersion": PIPELINES_API_VERSION,
+            "kind": "Application",
+            "metadata": {"name": name, "namespace": namespace,
+                         "labels": labels},
+            "spec": {
+                "selector": {"matchLabels": {"app": name}},
+                "componentKinds": [{"group": "apps", "kind": "Deployment"}],
+                "descriptor": {"type": "third-party-operator",
+                               "description": f"hosted operator for "
+                                              f"{crd_group}/{crd_kind}"},
+            },
+        },
+    ]

@@ -62,6 +62,16 @@ SNAPSHOT_CASES: dict[str, tuple[str, dict]] = {
         "serving-route",
         {"name": "bert", "outlier_threshold": 3.0, "outlier_window": 50},
     ),
+    "spark-operator": (
+        "third-party-operator",
+        {"name": "spark-operator",
+         "image": "ghcr.io/kubeflow/spark-operator:v1beta2-1.3.8-3.1.1",
+         "crd_group": "sparkoperator.k8s.io",
+         "crd_kind": "SparkApplication",
+         "crd_version": "v1beta2",
+         "args": ["-logtostderr", "-enable-metrics=true"],
+         "metrics_port": 10254},
+    ),
     "cert-manager": ("cert-manager", {}),
     "secure-ingress": (
         "secure-ingress",

@@ -238,15 +238,18 @@ def _check_undefined(path: str, tree: ast.AST,
 
 
 def _own_scope_nodes(fn: ast.AST):
-    """Walk a function's OWN scope: descend everywhere except into
-    nested function/class definitions (their bindings are theirs)."""
-    stack = list(ast.iter_child_nodes(fn))
-    while stack:
-        node = stack.pop()
+    """Walk a function's OWN scope in source order (F841 reports the
+    FIRST assignment line): descend everywhere except into nested
+    function/class definitions (their bindings are theirs)."""
+    import collections
+
+    queue = collections.deque(ast.iter_child_nodes(fn))
+    while queue:
+        node = queue.popleft()
         yield node
         if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
                                  ast.ClassDef, ast.Lambda)):
-            stack.extend(ast.iter_child_nodes(node))
+            queue.extend(ast.iter_child_nodes(node))
 
 
 def _check_unused_locals(path: str, tree: ast.AST,
@@ -323,6 +326,18 @@ def _check_shadowed_builtins(path: str, tree: ast.AST,
                   and isinstance(child.ctx, ast.Store)
                   and not in_class_body):
                 flag(child.id, child.lineno, "assignment to")
+                visit(child, in_class_body)
+            elif isinstance(child, (ast.Import, ast.ImportFrom)):
+                for alias in child.names:
+                    bound = alias.asname or (
+                        alias.name.split(".")[0]
+                        if isinstance(child, ast.Import) else alias.name
+                    )
+                    if bound != "*":
+                        flag(bound, child.lineno, "import binding")
+            elif isinstance(child, ast.ExceptHandler):
+                if child.name:
+                    flag(child.name, child.lineno, "except binding")
                 visit(child, in_class_body)
             elif isinstance(child, ast.Lambda):
                 for a in child.args.args:

@@ -100,3 +100,19 @@ def test_round4_exemptions(tmp_path):
         tmp_path,
         '"""doc."""\ndef make():\n    class H:\n        version = 1\n'
         "    return H\n")
+
+
+def test_a001_catches_import_and_except_bindings(tmp_path):
+    assert "A001" in _lint_source(
+        tmp_path, '"""doc."""\nimport functools as list\nprint(list)\n')
+    assert "A001" in _lint_source(
+        tmp_path,
+        '"""doc."""\ntry:\n    pass\n'
+        "except Exception as list:\n    print(list)\n")
+
+
+def test_f841_reports_first_assignment_line(tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text('"""doc."""\ndef f():\n    x = 1\n    x = 2\n')
+    v = [v for v in lint.lint_file(f) if v.code == "F841"]
+    assert v and v[0].line == 3  # the FIRST binding, not the last
