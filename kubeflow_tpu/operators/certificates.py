@@ -43,6 +43,7 @@ from kubeflow_tpu.apis.certificates import (
 )
 from kubeflow_tpu.auth import pki
 from kubeflow_tpu.k8s import objects as k8s
+from kubeflow_tpu.k8s.client import ApiError
 from kubeflow_tpu.operators.base import Controller
 
 # Challenge tokens the gateway serves at /.well-known/acme-challenge/.
@@ -287,9 +288,9 @@ class EndpointController(Controller):
     desired record set from ALL Endpoint CRs, so deleted or renamed
     endpoints drop out of the zone instead of leaving stale records (the
     reference's cloud-endpoints keeps Cloud DNS in sync with the declared
-    records the same way). Known edge: deleting the namespace's LAST
-    endpoint leaves its record until any endpoint reconciles there
-    again — the zone is only rebuilt from a live primary."""
+    records the same way). ``reconcile_all`` additionally garbage-collects
+    zones whose namespace no longer has ANY endpoint (the case no live
+    primary would trigger)."""
 
     api_version = CERTS_API_VERSION
     kind = ENDPOINT_KIND
@@ -297,8 +298,42 @@ class EndpointController(Controller):
     def watched_kinds(self):
         return [("v1", "ConfigMap")]
 
+    def __init__(self, client):
+        super().__init__(client)
+        # Namespaces this controller has written a zone into — the GC
+        # probe set (bounded, no cluster-wide ConfigMap scans).
+        self._zone_namespaces: set[str] = set()
+
+    def reconcile_all(self) -> int:
+        n = super().reconcile_all()
+        # Zone GC: a namespace whose last Endpoint was deleted has no
+        # primary left to rebuild its zone — empty it here. Per-zone
+        # errors (lost update races, deleted namespaces) must not kill
+        # the controller loop; the next resync retries.
+        try:
+            live = {ep["metadata"]["namespace"]
+                    for ep in self.client.list(CERTS_API_VERSION,
+                                               ENDPOINT_KIND)}
+        except ApiError:
+            return n
+        for ns in sorted(self._zone_namespaces - live):
+            try:
+                cm = self.client.get_or_none("v1", "ConfigMap",
+                                             DNS_ZONE_CONFIGMAP, ns)
+                if cm is None:
+                    self._zone_namespaces.discard(ns)
+                elif cm.get("data"):
+                    cm["data"] = {}
+                    self.client.update(cm)
+                else:
+                    self._zone_namespaces.discard(ns)
+            except ApiError:
+                continue  # transient: retried next resync
+        return n
+
     def reconcile(self, ep: dict) -> None:
         ns = ep["metadata"]["namespace"]
+        self._zone_namespaces.add(ns)
         desired: dict[str, str] = {}
         for other in self.client.list(CERTS_API_VERSION, ENDPOINT_KIND,
                                       ns):

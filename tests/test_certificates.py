@@ -364,3 +364,10 @@ def test_endpoint_deletion_drops_zone_record(cert_env):
     ctrl.reconcile_all()
     cm = api.get("v1", "ConfigMap", DNS_ZONE_CONFIGMAP, NS)
     assert cm["data"] == {"c.example.com": "svc1.kubeflow"}
+
+    # Deleting the namespace's LAST endpoint empties the zone too (the
+    # reconcile_all GC pass — no live primary exists to trigger it).
+    api.delete(CERTS_API_VERSION, "Endpoint", "ep1", NS)
+    ctrl.reconcile_all()
+    cm = api.get("v1", "ConfigMap", DNS_ZONE_CONFIGMAP, NS)
+    assert cm["data"] == {}
