@@ -32,15 +32,21 @@ CORE_COMPONENTS = [
     "application",
 ]
 
-# Extra components for cloud deployments.
+# Extra components for cloud deployments. cert-manager matches the
+# reference's GCP variants always deploying certificate machinery
+# (kfctl_gcp_iap-style configs); secure-ingress/cloud-endpoints stay
+# opt-in because they need a real hostname parameter.
 GCP_COMPONENTS = [
     "admission-webhook",
+    "cert-manager",
 ]
 
 # Deliberately optional (match reference opt-ins: spartakus, echo-server).
 OPTIONAL_COMPONENTS = [
     "usage-reporter",
     "echo-server",
+    "secure-ingress",
+    "cloud-endpoints",
 ]
 
 

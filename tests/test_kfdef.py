@@ -80,6 +80,14 @@ def test_gcp_platform_gets_webhook():
     comps = [c.name for c in defaults.default_components("gcp-tpu")]
     assert "admission-webhook" in comps
     assert "training-operator" in comps
+    # Cloud deployments carry the certificate machinery (the reference's
+    # GCP variants always deploy cert-manager); every default component
+    # must actually render with default params.
+    assert "cert-manager" in comps
+    from kubeflow_tpu.manifests.core import generate
+
+    for name in comps:
+        assert generate(name, {}), name
 
 
 def test_tpu_block_camel_case_accepted():
