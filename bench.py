@@ -34,7 +34,7 @@ PEAK_BF16 = 197e12
 
 def run_training(model_name: str, batch_size: int, seq_len: int,
                  steps: int, opt_name: str, *, grad_dtype=None,
-                 trace_dir=None) -> dict:
+                 trace_dir=None, overrides=None) -> dict:
     """Train ``steps`` steps; returns tok/s-per-chip, MFU and final loss."""
     from kubeflow_tpu.models.registry import get_model
     from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
@@ -42,7 +42,7 @@ def run_training(model_name: str, batch_size: int, seq_len: int,
     from kubeflow_tpu.train.optimizers import OptimizerConfig
     from kubeflow_tpu.train.trainer import build_train_step, init_state
 
-    model = get_model(model_name)
+    model = get_model(model_name, **(overrides or {}))
     n_devices = len(jax.devices())
     mesh = build_mesh(MeshConfig(data=n_devices))
     opt = OptimizerConfig(name=opt_name, warmup_steps=2,
@@ -72,11 +72,8 @@ def run_training(model_name: str, batch_size: int, seq_len: int,
 
     tokens_per_sec = steps * batch_size * seq_len / dt
     per_chip = tokens_per_sec / n_devices
-    # Release this run's buffers and executables before the next config
-    # compiles: configs are sized to the HBM cliff (BASELINE.md), and
-    # residue from a previous run's allocator state measurably thrashes
-    # the next one (observed: 60.5% standalone vs 16.6% after three
-    # prior runs in-process).
+    # Release this run's buffers and executables before anything else
+    # compiles in this process.
     del state, batch, step_fn, metrics
     import gc
     gc.collect()
@@ -89,6 +86,42 @@ def run_training(model_name: str, batch_size: int, seq_len: int,
         "config": f"{model_name} bs{batch_size} seq{seq_len} {opt_name} "
                   f"bf16 x{n_devices}chip",
     }
+
+
+def run_training_isolated(*args, **kwargs) -> dict:
+    """``run_training`` in a FRESH subprocess. Configs are sized to the
+    HBM cliff (BASELINE.md): allocator residue from a previous config in
+    the same process measurably thrashes the next (observed 60.5%
+    standalone vs 16.6% after three in-process runs; clear_caches alone
+    did not save the tightest config). One process per config makes each
+    measurement order-independent."""
+    import pickle
+    import subprocess
+    import sys
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(suffix=".pkl") as out:
+        payload = pickle.dumps((args, kwargs, out.name))
+        code = (
+            "import pickle, sys\n"
+            "args, kwargs, out = pickle.loads(sys.stdin.buffer.read())\n"
+            "from bench import run_training\n"
+            "result = run_training(*args, **kwargs)\n"
+            "pickle.dump(result, open(out, 'wb'))\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            input=payload,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"bench subprocess failed: "
+                f"{proc.stderr.decode(errors='replace')[-2000:]}"
+            )
+        with open(out.name, "rb") as f:
+            return pickle.load(f)
 
 
 def main() -> int:
@@ -108,22 +141,35 @@ def main() -> int:
                                 trace_dir=args.trace_dir)
         deep = deep512 = None
     else:
-        # adafactor: factored slots buy model width (= MFU).
-        flagship = run_training("flagship-1b", 4, 2048, args.steps,
-                                "adafactor", trace_dir=args.trace_dir)
+        # adafactor: factored slots buy model width (= MFU). Each config
+        # runs in its own process (see run_training_isolated).
+        flagship = run_training_isolated("flagship-1b", 4, 2048,
+                                         args.steps, "adafactor",
+                                         trace_dir=args.trace_dir)
         deep = deep512 = deep1024 = deep2048 = None
         if not args.skip_deep:
             # Deep steps are ~4× faster than flagship steps; run more so
             # per-step dispatch noise amortizes out of the measurement.
             deep_steps = max(args.steps, 30)
-            deep = run_training("flagship-deep", 32, 256, deep_steps,
-                                "adafactor", grad_dtype="bfloat16")
-            deep512 = run_training("flagship-deep", 16, 512, deep_steps,
-                                   "adafactor", grad_dtype="bfloat16")
-            deep1024 = run_training("flagship-deep", 8, 1024, deep_steps,
-                                    "adafactor", grad_dtype="bfloat16")
-            deep2048 = run_training("flagship-deep", 4, 2048, deep_steps,
-                                    "adafactor", grad_dtype="bfloat16")
+            deep = run_training_isolated(
+                "flagship-deep", 32, 256, deep_steps, "adafactor",
+                grad_dtype="bfloat16")
+            deep512 = run_training_isolated(
+                "flagship-deep", 16, 512, deep_steps, "adafactor",
+                grad_dtype="bfloat16")
+            # Long-context runs save the splash kernel's residuals
+            # ("llm_res" — the backward skips the forward-kernel rerun):
+            # +0.5-0.9 MFU pts at seq1024/2048 where attention dominates
+            # the remat bill; at seq256 the saved bytes cost more than
+            # the rerun (measured −11 pts), so short runs keep "llm".
+            deep1024 = run_training_isolated(
+                "flagship-deep", 8, 1024, deep_steps, "adafactor",
+                grad_dtype="bfloat16",
+                overrides={"remat_policy": "llm_res"})
+            deep2048 = run_training_isolated(
+                "flagship-deep", 4, 2048, deep_steps, "adafactor",
+                grad_dtype="bfloat16",
+                overrides={"remat_policy": "llm_res"})
 
     mfu = flagship["mfu"]
     # Frozen round-1 record (25,008 tok/s on a 509M model = 38.8% MFU);
