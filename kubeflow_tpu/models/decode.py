@@ -34,6 +34,26 @@ def init_cache(cfg: TransformerConfig, batch: int, total_len: int):
     return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
 
 
+def _gqa_attention(q, k_cache, v_cache, mask, cfg):
+    """Grouped-query attention over a KV cache, GQA-native: the query-
+    head group rides its own einsum axis, so K/V are read at kv-head
+    width — never repeated to H_q width (a 2-8x cut in decode cache
+    traffic, the decode-step bandwidth bill). q: [B, S, H, hd]; cache:
+    [B, T, Hkv, hd]; mask broadcastable to [B, Hkv, G, S, T]. Returns
+    [B, S, H*hd]."""
+    b, s, _h, hd = q.shape
+    group = cfg.n_heads // cfg.n_kv_heads
+    qg = q.reshape(b, s, cfg.n_kv_heads, group, hd)
+    scores = jnp.einsum(
+        "bskgd,btkd->bkgst", qg.astype(jnp.float32),
+        k_cache.astype(jnp.float32)
+    ) * (hd ** -0.5)
+    scores = jnp.where(mask, scores, _NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+    return jnp.einsum("bkgst,btkd->bskgd", p, v_cache).reshape(
+        b, s, cfg.n_heads * hd)
+
+
 def _cached_attention(x, layer, cfg, rope_bt, k_cache, v_cache, pos, valid):
     """x: [B, S, D] at cache slots pos..pos+S; attends over the full cache
     masked by ``valid`` [B, total]. Returns (out, k_cache, v_cache)."""
@@ -48,12 +68,6 @@ def _cached_attention(x, layer, cfg, rope_bt, k_cache, v_cache, pos, valid):
     k_cache = lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
     v_cache = lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
 
-    reps = cfg.n_heads // cfg.n_kv_heads
-    kk = jnp.repeat(k_cache, reps, axis=2)  # [B, total, H, hd]
-    vv = jnp.repeat(v_cache, reps, axis=2)
-    scores = jnp.einsum(
-        "bshd,bthd->bhst", q.astype(jnp.float32), kk.astype(jnp.float32)
-    ) * (hd ** -0.5)
     total = k_cache.shape[1]
     # Causality within the new block: query at slot pos+i sees key slot j
     # iff j <= pos+i; prompt padding and unwritten slots are masked by
@@ -61,10 +75,7 @@ def _cached_attention(x, layer, cfg, rope_bt, k_cache, v_cache, pos, valid):
     j_idx = jnp.arange(total)[None, None, :]
     i_idx = pos + jnp.arange(s)[None, :, None]
     mask = (j_idx <= i_idx) & valid[:, None, :]
-    scores = jnp.where(mask[:, None], scores, _NEG_INF)
-    p = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
-    out = jnp.einsum("bhst,bthd->bshd", p, vv)
-    out = out.reshape(b, s, cfg.n_heads * hd)
+    out = _gqa_attention(q, k_cache, v_cache, mask[:, None, None], cfg)
     return out @ layer["wo"].astype(cfg.dtype), k_cache, v_cache
 
 
@@ -213,15 +224,8 @@ def _ragged_attention(x, layer, cfg, rope_bt, k_cache, v_cache, pos_b, valid):
     # scatter semantics — retired rows write nowhere.
     k_cache = k_cache.at[rows, pos_b].set(k[:, 0])
     v_cache = v_cache.at[rows, pos_b].set(v[:, 0])
-    reps = cfg.n_heads // cfg.n_kv_heads
-    kk = jnp.repeat(k_cache, reps, axis=2)
-    vv = jnp.repeat(v_cache, reps, axis=2)
-    scores = jnp.einsum(
-        "bshd,bthd->bhst", q.astype(jnp.float32), kk.astype(jnp.float32)
-    ) * (hd ** -0.5)
-    scores = jnp.where(valid[:, None, None, :], scores, _NEG_INF)
-    p = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
-    out = jnp.einsum("bhst,bthd->bshd", p, vv).reshape(b, s, cfg.n_heads * hd)
+    out = _gqa_attention(q, k_cache, v_cache,
+                         valid[:, None, None, None, :], cfg)
     return out @ layer["wo"].astype(cfg.dtype), k_cache, v_cache
 
 
