@@ -12,6 +12,7 @@ dict so callers can always reach in and set exotic fields directly.
 
 from __future__ import annotations
 
+import base64
 from typing import Any, Mapping, Sequence
 
 # ---------------------------------------------------------------------------
@@ -300,6 +301,30 @@ def secret(
         "type": secret_type,
         "stringData": {k: str(v) for k, v in string_data.items()},
     }
+
+
+def secret_data(sec: Mapping) -> dict[str, str]:
+    """Decode a Secret's payload to plain strings.
+
+    A real apiserver never returns ``stringData`` (it is write-only) and
+    base64-encodes ``data``; the in-process fake stores ``stringData``
+    verbatim. Controllers must read through this helper so they behave
+    identically against both.
+    """
+    out: dict[str, str] = dict(sec.get("stringData") or {})
+    for k, v in (sec.get("data") or {}).items():
+        if k in out:
+            continue
+        try:
+            out[k] = base64.b64decode(v, validate=True).decode("utf-8")
+        except (ValueError, TypeError, UnicodeDecodeError):
+            # ``data`` is strictly base64-of-UTF-8 here (real apiserver
+            # semantics; fakes/tests write ``stringData``). Anything else
+            # — binary payloads like a .p12 keystore, corrupt values — is
+            # omitted rather than handed to a distant parser as garbage
+            # text; a caller that needs the key gets a clear KeyError.
+            continue
+    return out
 
 
 def namespace_obj(name: str, labels: Mapping[str, str] | None = None) -> dict:

@@ -73,10 +73,11 @@ class TransformerConfig:
     pipeline_microbatches: int = 4
     # Attention implementation: None (auto = blockwise flash), "plain",
     # "xla" (kubeflow_tpu.ops.flash_attention's implementation arg) and the
-    # kv block width — block_k == seq_len collapses the flash scan to one
-    # fused block, the measured-fastest config on v5e (+14% step throughput).
+    # kv block width — None picks the per-path measured-best (2048 on the
+    # XLA scan, where block_k == seq_len collapses it to one fused block,
+    # +14% step throughput on v5e; 1024 tiles on the TPU kernels).
     attn_impl: str | None = None
-    attn_block_k: int = 2048
+    attn_block_k: int | None = None
     # jax.checkpoint policy when remat=True: "dots" saves matmul outputs
     # (recompute only elementwise), "none" saves nothing (full recompute,
     # minimum HBM traffic), "dots_batched" additionally saves batched dots,

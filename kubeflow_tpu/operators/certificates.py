@@ -87,7 +87,7 @@ class IssuerController(Controller):
                 self.client.create(sec)
                 ca_pem = ca.cert_pem
             else:
-                data = existing.get("stringData") or existing.get("data", {})
+                data = k8s.secret_data(existing)
                 ca_pem = data.get("ca.crt", data.get("tls.crt", ""))
             status.update({"ready": True, "type": "selfSigned",
                            "caSecretName": secret_name,
@@ -128,7 +128,7 @@ class IssuerController(Controller):
         sec = self.client.get_or_none("v1", "Secret", f"{name}-ca", ns)
         if sec is None:
             return None
-        data = sec.get("stringData") or sec.get("data", {})
+        data = k8s.secret_data(sec)
         return pki.KeyCert(key_pem=data["tls.key"],
                            cert_pem=data["tls.crt"],
                            ca_pem=data.get("ca.crt", data["tls.crt"]))

@@ -34,11 +34,15 @@ import jax.numpy as jnp
 from jax import lax
 
 _NEG_INF = -1e30
-# Default kv block width for the blockwise paths. Callers with known-static
-# sequence lengths should pass block_k == seq_len (single block — measured
-# fastest on v5e); the default keeps memory O(T·2048) for long sequences.
+# Default kv block widths when the caller leaves block_k=None: the XLA
+# blockwise path takes DEFAULT_BLOCK_K (callers with known-static sequence
+# lengths should pass block_k == seq_len — single block, measured fastest
+# on v5e; 2048 keeps memory O(T·2048) for long sequences), the TPU kernels
+# take DEFAULT_KERNEL_BLOCK_K (1024-wide tiles measured faster than 2048
+# at seq≥2048, and VMEM-safe).
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 2048
+DEFAULT_KERNEL_BLOCK_K = 1024
 
 
 def _causal_mask(q_start, k_start, bq, bk):
@@ -314,7 +318,7 @@ def flash_attention(
     scale: float | None = None,
     kv_mask=None,
     block_q: int = DEFAULT_BLOCK_Q,
-    block_k: int = DEFAULT_BLOCK_K,
+    block_k: int | None = None,
     implementation: str | None = None,
 ):
     """Multi-head / grouped-query flash attention.
@@ -344,17 +348,21 @@ def flash_attention(
     group = hq // hkv
     scale = (d**-0.5) if scale is None else scale
 
-    if implementation is None and t >= 512 and _pallas_supported(
-            q, k, kv_mask):
+    pallas_ok = _pallas_supported(q, k, kv_mask)
+    if implementation is None and t >= 512 and pallas_ok:
         implementation = "splash"
-        if block_k == DEFAULT_BLOCK_K:  # untouched → measured-best width
-            block_k = 1024
-    if implementation == "pallas" and _pallas_supported(q, k, kv_mask):
-        return _pallas_flash(q, k, v, causal=causal, scale=scale,
-                             block=block_k)
-    if implementation == "splash" and _pallas_supported(q, k, kv_mask):
+    if implementation in ("splash", "pallas") and pallas_ok:
+        # block_k=None → per-path measured-best default: 1024-wide tiles
+        # here (2048 is slower at seq≥2048 and a VMEM risk), 2048 on the
+        # XLA fallback below. An explicit block_k is honored as given.
+        kernel_block = DEFAULT_KERNEL_BLOCK_K if block_k is None else block_k
+        if implementation == "pallas":
+            return _pallas_flash(q, k, v, causal=causal, scale=scale,
+                                 block=kernel_block)
         return _splash_flash(q, k, v, causal=causal, scale=scale,
-                             block=block_k)
+                             block=kernel_block)
+    if block_k is None:
+        block_k = DEFAULT_BLOCK_K
 
     if kv_mask is None:
         kvm = jnp.ones((b, s_len), jnp.float32)

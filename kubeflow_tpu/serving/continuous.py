@@ -134,6 +134,7 @@ class ContinuousDecoder:
         self.dispatches = 0  # device round-trips (the tunnel-cost metric)
         self.ttft_sum = 0.0
         self.ttft_count = 0
+        self._ramp_streak = 0  # consecutive un-fused admission rounds
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -228,6 +229,9 @@ class ContinuousDecoder:
             with self._cv:
                 while (not self._stopped and not self._pending
                        and self._active_count == 0):
+                    # Idle: the streak cap must not outlive the burst that
+                    # set it — the next admission deserves its ramp round.
+                    self._ramp_streak = 0
                     self._cv.wait(timeout=0.5)
                 if self._stopped:
                     return
@@ -245,14 +249,23 @@ class ContinuousDecoder:
                 # TTFT ramp: a round that just admitted requests runs one
                 # un-fused step so their first token ships after ~1 RTT
                 # instead of waiting out a full K-step chunk; steady-state
-                # rounds use the fused chunk.
-                if self.chunk_size > 1 and not pending:
+                # rounds use the fused chunk. The streak cap keeps chunking
+                # engaged under sustained arrivals (pending non-empty nearly
+                # every round must not degrade to 1 dispatch per token):
+                # at most one consecutive ramp round, then a fused chunk
+                # runs regardless of new admissions.
+                # (want==0 admissions are pure prefills answered in _admit
+                # — they gain nothing from an early step, so don't ramp.)
+                ramp = (any(req.want for req, _ in pending)
+                        and self._ramp_streak < 1)
+                if self.chunk_size > 1 and not ramp:
                     self._state, toks, emitted = decode_chunk(
                         self._state, self.params, self.cfg,
                         self.chunk_size, self.top_k, self.eos_id,
                     )
                     self.steps += self.chunk_size
                     self.dispatches += 1
+                    self._ramp_streak = 0
                     toks, emitted = np.asarray(toks), np.asarray(emitted)
                     for k in range(self.chunk_size):
                         self._dispatch(toks[k], emitted[k])
@@ -263,6 +276,7 @@ class ContinuousDecoder:
                     )
                     self.steps += 1
                     self.dispatches += 1
+                    self._ramp_streak = self._ramp_streak + 1 if ramp else 0
                     self._dispatch(np.asarray(toks), np.asarray(emitted))
             except Exception as e:
                 # A failed prefill/decode_step may have invalidated

@@ -112,6 +112,36 @@ def test_selfsigned_issuer_creates_ca(cert_env):
         "-----BEGIN CERTIFICATE")
 
 
+def test_issuer_reads_base64_secret_like_real_apiserver(cert_env):
+    """A real apiserver never returns stringData and base64-encodes data;
+    the controllers must decode it (ADVICE r4). Store the CA secret that
+    way, then reconcile + issue through it."""
+    import base64
+
+    api = cert_env
+    ca = pki.make_ca("b64 root")
+    api.create({
+        "apiVersion": "v1", "kind": "Secret",
+        "metadata": {"name": "ca-ca", "namespace": NS},
+        "type": "kubernetes.io/tls",
+        "data": {k: base64.b64encode(v.encode()).decode()
+                 for k, v in {"tls.crt": ca.cert_pem, "tls.key": ca.key_pem,
+                              "ca.crt": ca.ca_pem}.items()},
+    })
+    api.create(_issuer())
+    api.create(_certificate(durationSeconds=3600))
+    issuers = IssuerController(api)
+    issuers.reconcile_all()
+    issuer = api.get(CERTS_API_VERSION, "Issuer", "ca", NS)
+    assert issuer["status"]["caCertificate"].startswith(
+        "-----BEGIN CERTIFICATE")
+    kc = issuers.ca_for("ca", NS)
+    assert kc.key_pem.startswith("-----BEGIN")
+    CertificateController(api).reconcile_all()
+    assert api.get(CERTS_API_VERSION, "Certificate", "web",
+                   NS)["status"]["ready"] is True
+
+
 def test_certificate_issued_into_secret(cert_env):
     api = cert_env
     api.create(_issuer())
