@@ -1,14 +1,23 @@
 """Gatekeeper: `python -m kubeflow_tpu.auth.gatekeeper --port=8085`.
 
-The basic-auth gateway (components/gatekeeper/auth/AuthServer.go:32-210):
-a login form POSTs credentials checked against the mounted login secret; on
-success an HMAC-signed session cookie is set. The gateway forward-auths every
-request against ``/auth`` (200 = session valid). Routes:
+The basic-auth gateway (components/gatekeeper/auth/AuthServer.go:32-210)
+PLUS the platform's identity-token issuer — the half of IAP the envoy
+`jwt-auth` filter consumes (kubeflow/gcp/iap.libsonnet:589-600): signed
+short-lived ES256 id-tokens for users and service accounts, published
+verification keys, zero-downtime key rotation. Routes:
 
 - ``GET  /login``   login form
 - ``POST /login``   form {username, password} → Set-Cookie + redirect
 - ``GET  /auth``    forward-auth check: 200 if the session cookie verifies
 - ``GET  /logout``  clears the session
+- ``POST /token``   id-token grant: Basic credentials, a valid session
+  cookie, or a JSON ``{service_account, key}`` pair (the platform's
+  service-account flow — the reference's prober exchanges an IAM SA key
+  for a Google id-token the same way, kubeflow-readiness.py:21-37);
+  body/query may carry ``audience`` and ``ttl_seconds``
+- ``GET  /.well-known/jwks.json``  verification keys (RFC 7517)
+- ``POST /rotate``  activate a fresh signing key (credentialed); retired
+  keys stay published until every token they signed has expired
 - ``GET  /healthz``
 """
 
@@ -25,10 +34,14 @@ import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from kubeflow_tpu.auth.tokens import SigningKeyRing
 from kubeflow_tpu.runtime import strip_glog_args
 
 COOKIE_NAME = "kubeflow-tpu-auth"
 DEFAULT_SECRET_PATH = os.environ.get("LOGIN_SECRET_PATH", "/etc/login")
+DEFAULT_ISSUER = "https://gatekeeper.kubeflow-tpu"
+DEFAULT_AUDIENCE = "kubeflow-tpu"
+DEFAULT_TOKEN_TTL = 3600
 
 _LOGIN_FORM = """<!doctype html>
 <html><head><title>kubeflow-tpu login</title></head>
@@ -47,16 +60,21 @@ class AuthService:
 
     def __init__(self, username: str, password_hash: str,
                  *, session_seconds: float = 24 * 3600.0,
-                 signing_key: bytes | None = None):
+                 signing_key: bytes | None = None,
+                 service_accounts: dict[str, str] | None = None):
         self.username = username
         self.password_hash = password_hash  # sha256 hexdigest
         self.session_seconds = session_seconds
         self._key = signing_key or secrets.token_bytes(32)
+        # name -> key (the mounted SA credential; comparison is
+        # constant-time). The platform's stand-in for IAM SA keys.
+        self.service_accounts = dict(service_accounts or {})
 
     @classmethod
     def from_secret_dir(cls, path: str) -> "AuthService":
         """Load the mounted login Secret: files `username` and either
-        `passwordhash` (sha256 hex) or `password` (plaintext, hashed here)."""
+        `passwordhash` (sha256 hex) or `password` (plaintext, hashed
+        here); every `sa-<name>` file is a service-account key."""
         def read(name: str) -> str | None:
             fp = os.path.join(path, name)
             if os.path.exists(fp):
@@ -73,12 +91,22 @@ class AuthService:
                     f"no password/passwordhash under {path}"
                 )
             pwhash = hashlib.sha256(pw.encode()).hexdigest()
-        return cls(username, pwhash)
+        sas = {}
+        for fn in sorted(os.listdir(path)) if os.path.isdir(path) else []:
+            # An empty key file (provisioning half-done) must not create
+            # an account mintable with key "" — skip it.
+            if fn.startswith("sa-") and read(fn):
+                sas[fn[3:]] = read(fn)
+        return cls(username, pwhash, service_accounts=sas)
 
     def check_login(self, username: str, password: str) -> bool:
         got = hashlib.sha256(password.encode()).hexdigest()
         return (hmac.compare_digest(username, self.username)
                 and hmac.compare_digest(got, self.password_hash))
+
+    def check_service_account(self, name: str, key: str) -> bool:
+        want = self.service_accounts.get(name)
+        return bool(want) and bool(key) and hmac.compare_digest(key, want)
 
     def issue_cookie(self, now: float | None = None) -> str:
         expires = int((now or time.time()) + self.session_seconds)
@@ -110,7 +138,23 @@ def _cookie_from_header(header: str | None) -> str | None:
     return None
 
 
-def make_server(auth: AuthService, port: int) -> ThreadingHTTPServer:
+def _basic_credentials(header: str | None) -> tuple[str, str] | None:
+    if not header or not header.startswith("Basic "):
+        return None
+    import base64
+
+    try:
+        decoded = base64.b64decode(header[6:], validate=True).decode("utf-8")
+    except (ValueError, UnicodeDecodeError):
+        return None
+    user, sep, password = decoded.partition(":")
+    return (user, password) if sep else None
+
+
+def make_server(auth: AuthService, port: int, *,
+                ring: SigningKeyRing | None = None,
+                audience: str = DEFAULT_AUDIENCE,
+                token_ttl: int = DEFAULT_TOKEN_TTL) -> ThreadingHTTPServer:
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):
             pass
@@ -128,6 +172,9 @@ def make_server(auth: AuthService, port: int) -> ThreadingHTTPServer:
         def do_GET(self):
             if self.path in ("/healthz", "/readyz"):
                 self._send(200, b'{"status":"ok"}', "application/json")
+            elif self.path == "/.well-known/jwks.json" and ring is not None:
+                self._send(200, json.dumps(ring.jwks()).encode(),
+                           "application/json")
             elif self.path.startswith("/login"):
                 self._send(200, _LOGIN_FORM.format(message="").encode())
             elif self.path == "/auth":
@@ -148,11 +195,94 @@ def make_server(auth: AuthService, port: int) -> ThreadingHTTPServer:
             else:
                 self._send(404, b"not found", "text/plain")
 
+        def _grant_subject(self, payload: dict) -> str | None:
+            """Which identity may have a token: Basic credentials, a
+            valid session cookie, or a service-account key. None = no
+            acceptable credential presented."""
+            creds = _basic_credentials(self.headers.get("Authorization"))
+            if creds and auth.check_login(*creds):
+                return creds[0]
+            sa, key = payload.get("service_account"), payload.get("key")
+            if (isinstance(sa, str) and isinstance(key, str)
+                    and auth.check_service_account(sa, key)):
+                return f"system:serviceaccount:{sa}"
+            cookie = _cookie_from_header(self.headers.get("Cookie"))
+            if cookie and auth.verify_cookie(cookie):
+                return auth.username
+            username = payload.get("username")
+            password = payload.get("password")
+            if (isinstance(username, str) and isinstance(password, str)
+                    and auth.check_login(username, password)):
+                return username
+            return None
+
+        def _content_length(self) -> int:
+            try:
+                return max(0, int(self.headers.get("Content-Length", 0)))
+            except (TypeError, ValueError):
+                return 0  # garbage header: treat as no body, don't crash
+
+        def _read_json(self) -> dict:
+            length = self._content_length()
+            raw = self.rfile.read(length) if length else b""
+            try:
+                payload = json.loads(raw) if raw else {}
+            except ValueError:
+                return {}
+            return payload if isinstance(payload, dict) else {}
+
+        def _token(self) -> None:
+            if ring is None:
+                self._send(404, b'{"error":"no token issuer"}',
+                           "application/json")
+                return
+            payload = self._read_json()
+            subject = self._grant_subject(payload)
+            if subject is None:
+                self._send(401, b'{"error":"invalid credentials"}',
+                           "application/json")
+                return
+            try:
+                ttl = int(payload.get("ttl_seconds", token_ttl)
+                          or token_ttl)
+            except (TypeError, ValueError):
+                self._send(400, b'{"error":"bad ttl_seconds"}',
+                           "application/json")
+                return
+            ttl = max(1, min(ttl, token_ttl))
+            aud = str(payload.get("audience") or audience)
+            token = ring.issue(subject, aud, ttl_seconds=ttl)
+            self._send(200, json.dumps({
+                "id_token": token, "token_type": "Bearer",
+                "expires_in": ttl, "subject": subject,
+            }).encode(), "application/json")
+
+        def _rotate(self) -> None:
+            if ring is None:
+                self._send(404, b'{"error":"no token issuer"}',
+                           "application/json")
+                return
+            if self._grant_subject(self._read_json()) is None:
+                self._send(401, b'{"error":"invalid credentials"}',
+                           "application/json")
+                return
+            kid = ring.rotate()
+            pruned = ring.prune()
+            self._send(200, json.dumps(
+                {"active_kid": kid, "pruned": pruned}).encode(),
+                "application/json")
+
         def do_POST(self):
+            if self.path == "/token":
+                self._token()
+                return
+            if self.path == "/rotate":
+                self._rotate()
+                return
             if self.path != "/login":
                 self._send(404, b"not found", "text/plain")
                 return
-            length = int(self.headers.get("Content-Length", 0))
+            length = self._content_length()
             form = urllib.parse.parse_qs(
                 self.rfile.read(length).decode("utf-8", "replace")
             )
@@ -184,12 +314,35 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(description="gatekeeper auth server")
     p.add_argument("--port", type=int, default=8085)
     p.add_argument("--secret-path", default=DEFAULT_SECRET_PATH)
+    p.add_argument("--issuer", default=DEFAULT_ISSUER,
+                   help="iss claim on issued id-tokens")
+    p.add_argument("--audience", default=DEFAULT_AUDIENCE,
+                   help="default aud claim on issued id-tokens")
+    p.add_argument("--token-ttl", type=int, default=DEFAULT_TOKEN_TTL,
+                   help="max id-token lifetime in seconds")
+    p.add_argument("--rotate-seconds", type=float, default=0.0,
+                   help="rotate the signing key on this interval "
+                        "(0 = only via POST /rotate); retired keys stay "
+                        "in the JWKS until their tokens expire")
     args = p.parse_args(argv)
 
     auth = AuthService.from_secret_dir(args.secret_path)
-    httpd = make_server(auth, args.port)
+    ring = SigningKeyRing(args.issuer)
+    if args.rotate_seconds > 0:
+        import threading
+
+        def rotate_loop():
+            while True:
+                time.sleep(args.rotate_seconds)
+                ring.rotate()
+                ring.prune()
+
+        threading.Thread(target=rotate_loop, daemon=True).start()
+    httpd = make_server(auth, args.port, ring=ring,
+                        audience=args.audience, token_ttl=args.token_ttl)
     print(json.dumps({"msg": "gatekeeper up", "port": args.port,
-                      "user": auth.username}))
+                      "user": auth.username, "issuer": args.issuer,
+                      "kid": ring.active_kid}))
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:

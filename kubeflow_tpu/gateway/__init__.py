@@ -75,6 +75,10 @@ class Route:
     # 0 disables.
     outlier_threshold: float = 0.0
     outlier_window: int = 100
+    # Identity-token policy for this route: "" = the gateway default
+    # (verify when a JwtVerifier is configured), "off" = this route is
+    # exempt (the per-route face of iap.libsonnet:600's bypass_jwt).
+    jwt: str = ""
 
     def pick_service(self, rng) -> str:
         if not self.backends:
@@ -411,7 +415,12 @@ def routes_from_service(svc: dict) -> list[Route]:
                 raise ValueError("outlier threshold must be >= 0")
             if outlier_window < 2:
                 raise ValueError("outlier window must be >= 2")
+            jwt = str(spec.get("jwt", ""))
+            if jwt not in ("", "off", "required"):
+                raise ValueError(f"jwt must be 'off' or 'required', "
+                                 f"got {jwt!r}")
             routes.append(Route(
+                jwt=jwt,
                 name=spec["name"], prefix=spec["prefix"],
                 service=service, rewrite=spec.get("rewrite", "/"),
                 backends=backends, strategy=strategy, epsilon=epsilon,
@@ -496,6 +505,7 @@ class Gateway:
         health: UpstreamHealth | None = None,
         probe_interval: float = 2.0,
         retry_budget: float = 0.2,
+        jwt_verifier=None,
         rng=None,
     ):
         self.table = table
@@ -542,6 +552,13 @@ class Gateway:
         # backend, as long as retries stay under this fraction of
         # requests — a hard cap so retries can't amplify an outage.
         self.retry_budget = retry_budget
+        # Identity-token verification (gateway/jwt_auth.JwtVerifier) —
+        # the envoy jwt-auth filter role (iap.libsonnet:589-600). None =
+        # no bearer-token requirement. When BOTH a verifier and a
+        # forward-auth URL are configured, a request passes with EITHER a
+        # valid token OR a valid session (IAP's browser-login + SA
+        # id-token duality).
+        self.jwt_verifier = jwt_verifier
         self.retries_total = 0
         self.requests_total = 0
         self.errors_total = 0
@@ -623,7 +640,41 @@ class Gateway:
                         .encode(),
                     )
                     return
-                if not gw._authorized(self):
+                self._identity = None
+                if route.jwt == "required" and gw.jwt_verifier is None:
+                    # Fail CLOSED: an operator demanded token checks on
+                    # this route but the gateway has no verifier — a
+                    # misconfiguration must not silently serve open.
+                    gw.errors_total += 1
+                    self._respond(503, json.dumps(
+                        {"error": "route requires jwt but the gateway "
+                                  "has no verifier configured"}).encode())
+                    return
+                if gw.jwt_verifier is not None and route.jwt != "off":
+                    claims, reason = gw.jwt_verifier.check(
+                        self.command, self.path, self.headers
+                    )
+                    if claims is None:
+                        # Browser sessions may still pass through
+                        # forward-auth when it is configured (IAP serves
+                        # both logins and SA id-tokens) — unless the
+                        # route pins jwt: "required", which accepts
+                        # nothing but a valid bearer token.
+                        session_ok = (route.jwt != "required"
+                                      and gw.auth_url
+                                      and gw._authorized(self))
+                        if not session_ok:
+                            self._respond(401, json.dumps(
+                                {"error": "unauthorized", "reason": reason}
+                            ).encode(), {
+                                "WWW-Authenticate":
+                                    f'Bearer error="{reason}"',
+                                "Content-Type": "application/json",
+                            })
+                            return
+                    elif claims.get("sub"):
+                        self._identity = str(claims["sub"])
+                elif not gw._authorized(self):
                     self._respond(
                         401, json.dumps({"error": "unauthorized",
                                          "login": "/login"}).encode(),
@@ -686,14 +737,19 @@ class Gateway:
                 length = (0 if is_retry
                           else int(self.headers.get("Content-Length", 0)))
                 body = self.rfile.read(length) if length else None
-                # The forwarded prefix is gateway-asserted — a client-
-                # supplied copy must never reach the backend (spoofing).
+                # Forwarded prefix and authenticated identity are
+                # gateway-asserted — client-supplied copies must never
+                # reach the backend (spoofing).
                 headers = {
                     k: v for k, v in self.headers.items()
                     if k.lower() not in _HOP_HEADERS
-                    and k.lower() != "x-forwarded-prefix"
+                    and k.lower() not in ("x-forwarded-prefix",
+                                          "x-auth-identity")
                 }
                 headers["X-Forwarded-Prefix"] = route.prefix
+                if getattr(self, "_identity", None):
+                    # The x-goog-authenticated-user-email analogue.
+                    headers["X-Auth-Identity"] = self._identity
                 if route.shadow and not is_retry:
                     self._mirror(route, path, body, dict(headers))
                 tag_headers = {}
@@ -901,12 +957,15 @@ class Gateway:
                 lines = [f"{self.command} {path} HTTP/1.1",
                          f"Host: {host}:{port}",
                          f"X-Forwarded-Prefix: {route.prefix}"]
+                if getattr(self, "_identity", None):
+                    lines.append(f"X-Auth-Identity: {self._identity}")
                 # Hop-by-hop headers are the handshake here — forward
-                # everything except Host (rewritten above) and any
-                # client-supplied forwarded-prefix (gateway-asserted).
+                # everything except Host (rewritten above) and the
+                # gateway-asserted headers (spoofing).
                 lines += [
                     f"{k}: {v}" for k, v in self.headers.items()
-                    if k.lower() not in ("host", "x-forwarded-prefix")
+                    if k.lower() not in ("host", "x-forwarded-prefix",
+                                         "x-auth-identity")
                 ]
                 try:
                     backend.sendall(
@@ -993,6 +1052,12 @@ class Gateway:
                         "# TYPE gateway_outlier_scored_total counter\n"
                         "gateway_outlier_scored_total "
                         f"{gw.outliers.totals()[1]}\n"
+                        "# TYPE gateway_jwt_verified_total counter\n"
+                        "gateway_jwt_verified_total "
+                        f"{getattr(gw.jwt_verifier, 'verified_total', 0)}\n"
+                        "# TYPE gateway_jwt_rejected_total counter\n"
+                        "gateway_jwt_rejected_total "
+                        f"{getattr(gw.jwt_verifier, 'rejected_total', 0)}\n"
                     ).encode()
                     ctype = "text/plain"
                 elif self.path in ("/healthz", "/readyz"):

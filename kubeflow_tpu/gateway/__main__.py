@@ -43,6 +43,20 @@ def main(argv=None) -> int:
     p.add_argument("--serve-acme-challenges", action="store_true",
                    help="serve /.well-known/acme-challenge/<token> from "
                         "the certificate controller's published tokens")
+    p.add_argument("--jwt-issuer", default="",
+                   help="require bearer id-tokens with this iss claim "
+                        "(the envoy jwt-auth filter role); empty = no "
+                        "token requirement")
+    p.add_argument("--jwt-audience", default="kubeflow-tpu",
+                   help="required aud claim on bearer tokens")
+    p.add_argument("--jwks-uri", default="",
+                   help="where to fetch verification keys (the "
+                        "gatekeeper's /.well-known/jwks.json)")
+    p.add_argument("--jwt-bypass", default="",
+                   help="JSON bypass list, e.g. "
+                        '[{"http_method":"GET","path_exact":"/healthz"}]')
+    p.add_argument("--jwt-skew", type=float, default=60.0,
+                   help="clock-skew allowance in seconds")
     args = p.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
@@ -71,13 +85,29 @@ def main(argv=None) -> int:
             return token if token in (cm.get("data") or {}).values() \
                 else None
 
+    jwt_verifier = None
+    if args.jwt_issuer:
+        if not args.jwks_uri:
+            p.error("--jwt-issuer requires --jwks-uri")
+        from kubeflow_tpu.gateway.jwt_auth import (
+            JwtVerifier,
+            bypass_from_specs,
+        )
+
+        jwt_verifier = JwtVerifier(
+            args.jwks_uri, issuer=args.jwt_issuer,
+            audience=args.jwt_audience,
+            bypass=bypass_from_specs(args.jwt_bypass),
+            skew_seconds=args.jwt_skew,
+        )
     gw = Gateway(table, port=args.port, admin_port=args.admin_port,
                  auth_url=args.auth_url, certfile=args.tls_cert,
                  keyfile=args.tls_key,
                  cert_reload_seconds=args.watch_certs,
                  redirect_port=args.redirect_port,
                  redirect_target_port=args.redirect_target_port,
-                 challenge_lookup=challenge_lookup)
+                 challenge_lookup=challenge_lookup,
+                 jwt_verifier=jwt_verifier)
     gw.start()
     log.info("gateway on :%d (admin :%d)", args.port, args.admin_port)
     try:

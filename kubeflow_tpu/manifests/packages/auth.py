@@ -17,16 +17,28 @@ from kubeflow_tpu.version import DEFAULT_NAMESPACE
 
 @prototype(
     "gatekeeper",
-    "Basic-auth gateway: /login form + cookie sessions "
-    "(components/gatekeeper AuthServer analogue)",
+    "Auth server: /login form + cookie sessions, id-token issuance with "
+    "a JWKS endpoint and key rotation (components/gatekeeper AuthServer "
+    "+ the token-issuing half of IAP, iap.libsonnet:589-600)",
     params=[
         ParamSpec("namespace", DEFAULT_NAMESPACE),
         ParamSpec("image", images.PLATFORM),
         ParamSpec("username", "admin"),
         ParamSpec("password_hash", "", "bcrypt/sha256 hash; empty disables login"),
+        ParamSpec("issuer", "https://gatekeeper.kubeflow-tpu",
+                  "iss claim on issued id-tokens"),
+        ParamSpec("audience", "kubeflow-tpu",
+                  "default aud claim on issued id-tokens"),
+        ParamSpec("token_ttl", 3600, "max id-token lifetime, seconds"),
+        ParamSpec("rotate_seconds", 24 * 3600,
+                  "signing-key rotation interval; retired keys stay in "
+                  "the JWKS until their tokens expire (0 = manual "
+                  "rotation via POST /rotate)"),
     ],
 )
-def gatekeeper(namespace: str, image: str, username: str, password_hash: str) -> list[dict]:
+def gatekeeper(namespace: str, image: str, username: str,
+               password_hash: str, issuer: str, audience: str,
+               token_ttl: int, rotate_seconds: int) -> list[dict]:
     name = "gatekeeper"
     labels = {"app": name}
     return [
@@ -50,7 +62,13 @@ def gatekeeper(namespace: str, image: str, username: str, password_hash: str) ->
                     name,
                     image,
                     command=["python", "-m", "kubeflow_tpu.auth.gatekeeper"],
-                    args=["--port=8085"],
+                    args=[
+                        "--port=8085",
+                        f"--issuer={issuer}",
+                        f"--audience={audience}",
+                        f"--token-ttl={token_ttl}",
+                        f"--rotate-seconds={rotate_seconds}",
+                    ],
                     env={"LOGIN_SECRET_PATH": "/etc/login"},
                     ports={"http": 8085},
                     volume_mounts=[k8s.volume_mount("login", "/etc/login", read_only=True)],

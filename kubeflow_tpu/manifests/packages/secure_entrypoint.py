@@ -10,7 +10,9 @@ single package:
   kubeflow/gcp/iap.libsonnet:1-1041 (envoy config, backend/cert wiring)
   + components/https-redirect: a gateway terminating TLS with a
   controller-managed certificate (hot-reloaded on rotation), an HTTP
-  listener 301ing to HTTPS, and the ACME challenge route.
+  listener 301ing to HTTPS, the ACME challenge route, and bearer
+  identity-token verification (the envoy jwt-auth filter,
+  iap.libsonnet:589-600: issuer/audience/jwks_uri/bypass_jwt).
 - ``cloud-endpoints`` ↔ prototypes/cloud-endpoints.jsonnet:1-11 (DNS
   records for <name>.endpoints.<project>.cloud.goog): an Endpoint CR the
   controller records into the platform DNS-zone ConfigMap.
@@ -97,14 +99,37 @@ def cert_manager(namespace: str, image: str, acme_url: str,
         ParamSpec("renew_before_seconds", 30 * 24 * 3600,
                   "rotate this long before expiry"),
         ParamSpec("replicas", 3),
+        ParamSpec("jwt_issuer", "https://gatekeeper.kubeflow-tpu",
+                  "iss claim required on bearer id-tokens (the envoy "
+                  "jwt-auth filter, iap.libsonnet:589-600)"),
+        ParamSpec("jwt_audience", "kubeflow-tpu",
+                  "aud claim required on bearer id-tokens "
+                  "({{JWT_AUDIENCE}} analogue)"),
+        ParamSpec("jwks_uri", "http://gatekeeper:8085/.well-known/jwks.json",
+                  "verification-key endpoint (jwks_uri analogue)"),
+        ParamSpec("jwt_bypass",
+                  '[{"http_method":"GET","path_exact":"/healthz"}]',
+                  "JSON method+path list exempt from token checks "
+                  "(bypass_jwt analogue)"),
+        ParamSpec("disable_jwt_checking", False,
+                  "serve without identity-token verification "
+                  "(disableJwtChecking param analogue)"),
     ],
 )
 def secure_ingress(namespace: str, image: str, hostname: str, issuer: str,
                    issuer_type: str, duration_seconds: int,
-                   renew_before_seconds: int, replicas: int) -> list[dict]:
+                   renew_before_seconds: int, replicas: int,
+                   jwt_issuer: str, jwt_audience: str, jwks_uri: str,
+                   jwt_bypass: str, disable_jwt_checking: bool) -> list[dict]:
     name = "secure-gateway"
     labels = {"app": name, "service": "gateway"}
     cert_secret = f"{name}-tls"
+    jwt_args = [] if disable_jwt_checking else [
+        f"--jwt-issuer={jwt_issuer}",
+        f"--jwt-audience={jwt_audience}",
+        f"--jwks-uri={jwks_uri}",
+        f"--jwt-bypass={jwt_bypass}",
+    ]
     issuer_spec = ({"selfSigned": {"commonName": f"{issuer}.{namespace}"}}
                    if issuer_type == "selfSigned"
                    else {"acme": {}})
@@ -176,6 +201,7 @@ def secure_ingress(namespace: str, image: str, hostname: str, issuer: str,
                         "--tls-key=/etc/tls/tls.key",
                         "--watch-certs=5",
                         "--serve-acme-challenges",
+                        *jwt_args,
                     ],
                     ports={"https": 8443, "http": 8080, "admin": 8877},
                     liveness_probe=k8s.http_probe("/healthz", 8877,

@@ -73,6 +73,7 @@ SNAPSHOT_CASES: dict[str, tuple[str, dict]] = {
          "metrics_port": 10254},
     ),
     "cert-manager": ("cert-manager", {}),
+    "gatekeeper": ("gatekeeper", {"password_hash": "0" * 64}),
     "secure-ingress": (
         "secure-ingress",
         {"hostname": "kubeflow.example.com", "issuer": "platform-ca"},

@@ -3,15 +3,22 @@
 Probes a platform endpoint on an interval and exports the
 `kubeflow_availability` prometheus gauge on :8000 — the metric-collector
 contract (metric-collector/service-readiness/kubeflow-readiness.py:21-37,
-deployed by kubeflow/gcp/prototypes/metric-collector.jsonnet).
+deployed by kubeflow/gcp/prototypes/metric-collector.jsonnet). Like the
+reference prober — which exchanges a service-account key for a Google
+id-token and probes *through* IAP — this prober can exchange a platform
+service-account key at the gatekeeper's /token endpoint and send the
+resulting Bearer id-token, so it measures availability of the
+authenticated front door, not of an auth bypass.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import logging
 import sys
 import threading
+import time
 import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -21,23 +28,91 @@ from kubeflow_tpu.runtime import strip_glog_args
 log = logging.getLogger(__name__)
 
 
+class TokenClient:
+    """Service-account id-token supply for the prober.
+
+    Exchanges ``{service_account, key}`` at the gatekeeper's /token
+    endpoint; tokens are cached and refreshed ``refresh_margin`` seconds
+    before expiry (kubeflow-readiness.py:21-37's
+    get_google_open_id_connect_token role).
+    """
+
+    def __init__(self, token_url: str, service_account: str, key: str, *,
+                 audience: str = "", timeout: float = 10.0,
+                 refresh_margin: float = 60.0):
+        self.token_url = token_url
+        self.service_account = service_account
+        self.key = key
+        self.audience = audience
+        self.timeout = timeout
+        self.refresh_margin = refresh_margin
+        self._token = ""
+        self._expires_at = 0.0
+        self._lock = threading.Lock()
+
+    def invalidate(self) -> None:
+        with self._lock:
+            self._expires_at = 0.0
+
+    def token(self) -> str:
+        """Current id-token, fetching/refreshing as needed. Raises
+        OSError/ValueError on exchange failure (a probe through a broken
+        token path must count as DOWN, not silently go unauthenticated)."""
+        with self._lock:
+            if self._token and time.time() < (self._expires_at
+                                              - self.refresh_margin):
+                return self._token
+            body = {"service_account": self.service_account,
+                    "key": self.key}
+            if self.audience:
+                body["audience"] = self.audience
+            req = urllib.request.Request(
+                self.token_url, method="POST",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                grant = json.loads(resp.read())
+            self._token = grant["id_token"]
+            self._expires_at = time.time() + float(
+                grant.get("expires_in", 3600))
+            return self._token
+
+
 class AvailabilityProber:
     def __init__(self, target_url: str, interval: float = 30.0,
-                 timeout: float = 10.0):
+                 timeout: float = 10.0,
+                 token_client: TokenClient | None = None):
         self.target_url = target_url
         self.interval = interval
         self.timeout = timeout
+        self.token_client = token_client
         self.available = 0
         self.probes_total = 0
         self.failures_total = 0
         self._stop = threading.Event()
 
+    def _fetch(self) -> bool:
+        req = urllib.request.Request(self.target_url, method="GET")
+        if self.token_client is not None:
+            req.add_header("Authorization",
+                           f"Bearer {self.token_client.token()}")
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return 200 <= resp.status < 400
+
     def probe_once(self) -> bool:
         self.probes_total += 1
         try:
-            with urllib.request.urlopen(self.target_url,
-                                        timeout=self.timeout) as resp:
-                ok = 200 <= resp.status < 400
+            ok = self._fetch()
+        except urllib.error.HTTPError as e:
+            ok = False
+            if e.code == 401 and self.token_client is not None:
+                # Key may have rotated under us: one fresh-token retry.
+                self.token_client.invalidate()
+                try:
+                    ok = self._fetch()
+                except (urllib.error.URLError, OSError, ValueError):
+                    ok = False
         except (urllib.error.URLError, OSError, ValueError):
             ok = False
         self.available = int(ok)
@@ -97,10 +172,30 @@ def main(argv=None) -> int:
     p.add_argument("--port", type=int, default=8000)
     p.add_argument("--once", action="store_true",
                    help="probe once, print the gauge, exit 0/1")
+    p.add_argument("--token-url", default="",
+                   help="gatekeeper /token endpoint; set with "
+                        "--service-account to probe through the "
+                        "authenticated front door")
+    p.add_argument("--service-account", default="",
+                   help="platform service-account name for the id-token "
+                        "grant")
+    p.add_argument("--sa-key-file", default="",
+                   help="file holding the service-account key")
+    p.add_argument("--audience", default="",
+                   help="aud claim to request (default: issuer default)")
     args = p.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
-    prober = AvailabilityProber(args.target_url, args.interval)
+    token_client = None
+    if args.token_url and args.service_account:
+        key = ""
+        if args.sa_key_file:
+            with open(args.sa_key_file) as f:
+                key = f.read().strip()
+        token_client = TokenClient(args.token_url, args.service_account,
+                                   key, audience=args.audience)
+    prober = AvailabilityProber(args.target_url, args.interval,
+                                token_client=token_client)
     if args.once:
         ok = prober.probe_once()
         print(prober.render_metrics(), end="")

@@ -1,0 +1,557 @@
+"""Identity-token layer tests: ES256 JWT issue/verify, JWKS rotation,
+the gatekeeper token endpoint, per-route gateway enforcement, and the
+authenticated availability prober — the IAP identity function
+(/root/reference/kubeflow/gcp/iap.libsonnet:589-600 jwt-auth filter;
+metric-collector/service-readiness/kubeflow-readiness.py:21-37 prober).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kubeflow_tpu.auth import tokens
+from kubeflow_tpu.auth.gatekeeper import (
+    AuthService,
+    make_server as make_auth_server,
+)
+from kubeflow_tpu.auth.tokens import SigningKeyRing, TokenError
+from kubeflow_tpu.gateway import Gateway, Route, RouteTable
+from kubeflow_tpu.gateway.jwt_auth import (
+    ASSERTION_HEADER,
+    BypassRule,
+    JwksCache,
+    JwtVerifier,
+    bypass_from_specs,
+)
+
+ISS = "https://gatekeeper.test"
+AUD = "kubeflow-tpu"
+
+
+# ---------------------------------------------------------------------------
+# Token core
+# ---------------------------------------------------------------------------
+
+
+def test_issue_verify_roundtrip():
+    ring = SigningKeyRing(ISS)
+    tok = ring.issue("alice", AUD, ttl_seconds=60,
+                     claims={"email": "alice@example.com"})
+    claims = tokens.verify(tok, ring.jwks(), issuer=ISS, audience=AUD)
+    assert claims["sub"] == "alice"
+    assert claims["email"] == "alice@example.com"
+    assert claims["iss"] == ISS
+
+
+def test_expired_token_rejected_with_skew():
+    now = [1000.0]
+    ring = SigningKeyRing(ISS, clock=lambda: now[0])
+    tok = ring.issue("a", AUD, ttl_seconds=100)
+    # Inside skew: still valid.
+    tokens.verify(tok, ring.jwks(), issuer=ISS, audience=AUD,
+                  now=1100 + 30, skew_seconds=60)
+    with pytest.raises(TokenError, match="expired"):
+        tokens.verify(tok, ring.jwks(), issuer=ISS, audience=AUD,
+                      now=1100 + 61, skew_seconds=60)
+
+
+def test_wrong_audience_and_issuer_rejected():
+    ring = SigningKeyRing(ISS)
+    tok = ring.issue("a", AUD, ttl_seconds=60)
+    with pytest.raises(TokenError, match="bad-audience"):
+        tokens.verify(tok, ring.jwks(), issuer=ISS, audience="other")
+    with pytest.raises(TokenError, match="bad-issuer"):
+        tokens.verify(tok, ring.jwks(), issuer="https://evil", audience=AUD)
+
+
+def test_audience_list_membership():
+    ring = SigningKeyRing(ISS)
+    tok = ring.issue("a", ["other", AUD], ttl_seconds=60)
+    claims = tokens.verify(tok, ring.jwks(), issuer=ISS, audience=AUD)
+    assert AUD in claims["aud"]
+    with pytest.raises(TokenError, match="bad-audience"):
+        tokens.verify(tok, ring.jwks(), issuer=ISS, audience="absent")
+
+
+def test_alg_none_downgrade_rejected():
+    ring = SigningKeyRing(ISS)
+    tok = ring.issue("a", AUD, ttl_seconds=60)
+    header = {"alg": "none", "typ": "JWT", "kid": ring.active_kid}
+    h = base64.urlsafe_b64encode(
+        json.dumps(header).encode()).rstrip(b"=").decode()
+    forged = h + "." + tok.split(".")[1] + "."
+    with pytest.raises(TokenError, match="bad-alg"):
+        tokens.verify(forged, ring.jwks(), issuer=ISS, audience=AUD)
+
+
+def test_tampered_payload_rejected():
+    ring = SigningKeyRing(ISS)
+    tok = ring.issue("a", AUD, ttl_seconds=60)
+    h, p, s = tok.split(".")
+    payload = json.loads(base64.urlsafe_b64decode(p + "=="))
+    payload["sub"] = "admin"
+    p2 = base64.urlsafe_b64encode(
+        json.dumps(payload).encode()).rstrip(b"=").decode()
+    with pytest.raises(TokenError, match="bad-signature"):
+        tokens.verify(f"{h}.{p2}.{s}", ring.jwks(), issuer=ISS,
+                      audience=AUD)
+
+
+def test_unknown_kid_and_malformed():
+    ring = SigningKeyRing(ISS)
+    other = SigningKeyRing(ISS)
+    tok = other.issue("a", AUD, ttl_seconds=60)
+    with pytest.raises(TokenError, match="unknown-kid"):
+        tokens.verify(tok, ring.jwks(), issuer=ISS, audience=AUD)
+    for bad in ("", "abc", "a.b", "a.b.c.d", "!!.??.!!"):
+        with pytest.raises(TokenError):
+            tokens.verify(bad, ring.jwks(), issuer=ISS, audience=AUD)
+
+
+def test_rotation_keeps_old_tokens_valid_until_pruned():
+    now = [1000.0]
+    ring = SigningKeyRing(ISS, clock=lambda: now[0])
+    old_tok = ring.issue("a", AUD, ttl_seconds=3600)
+    old_kid = ring.active_kid
+    new_kid = ring.rotate()
+    assert new_kid != old_kid
+    kids = {k["kid"] for k in ring.jwks()["keys"]}
+    assert kids == {old_kid, new_kid}  # retired key still published
+    tokens.verify(old_tok, ring.jwks(), issuer=ISS, audience=AUD,
+                  now=now[0])
+    assert ring.prune() == []  # too fresh to prune
+    now[0] += tokens.MAX_TTL_SECONDS + 1
+    assert ring.prune() == [old_kid]
+    with pytest.raises(TokenError, match="unknown-kid"):
+        tokens.verify(old_tok, ring.jwks(), issuer=ISS, audience=AUD,
+                      now=1500.0)
+
+
+# ---------------------------------------------------------------------------
+# JWKS cache + verifier policy
+# ---------------------------------------------------------------------------
+
+
+def test_bypass_rules():
+    rules = bypass_from_specs(
+        '[{"http_method":"GET","path_exact":"/healthz"},'
+        ' {"http_method":"GET","path_prefix":"/public/"}]')
+    v = JwtVerifier(lambda: {"keys": []}, issuer=ISS, audience=AUD,
+                    bypass=rules)
+    assert v.bypassed("GET", "/healthz")
+    assert not v.bypassed("POST", "/healthz")
+    assert v.bypassed("GET", "/public/doc")
+    assert not v.bypassed("GET", "/private")
+    claims, reason = v.check("GET", "/healthz", {})
+    assert claims == {} and reason == ""
+
+
+def test_unknown_kid_triggers_single_refetch():
+    ring = SigningKeyRing(ISS)
+    now = [0.0]
+    cache = JwksCache(ring.jwks, min_refresh_seconds=1.0,
+                      clock=lambda: now[0])
+    v = JwtVerifier(cache, issuer=ISS, audience=AUD)
+    tok = ring.issue("a", AUD, ttl_seconds=60)
+    now[0] = 10.0
+    claims, reason = v.check("GET", "/x", {"Authorization": f"Bearer {tok}"})
+    assert claims is not None and claims["sub"] == "a", reason
+    fetches = cache.fetches
+    # Rotation: a token from the new key misses the cache → one refetch.
+    ring.rotate()
+    tok2 = ring.issue("b", AUD, ttl_seconds=60)
+    now[0] = 20.0
+    claims, _ = v.check("GET", "/x", {"Authorization": f"Bearer {tok2}"})
+    assert claims is not None and claims["sub"] == "b"
+    assert cache.fetches == fetches + 1
+    # A garbage kid gets exactly one miss-fetch, then is remembered:
+    # replaying it inside the window can't hammer the issuer.
+    bad = SigningKeyRing(ISS).issue("x", AUD, ttl_seconds=60)
+    before = cache.fetches
+    claims, reason = v.check("GET", "/x",
+                             {"Authorization": f"Bearer {bad}"})
+    assert claims is None and reason == "unknown-kid"
+    assert cache.fetches == before + 1
+    claims, _ = v.check("GET", "/x", {"Authorization": f"Bearer {bad}"})
+    assert cache.fetches == before + 1  # remembered miss: rate-limited
+    # After the window the same kid may trigger another fetch.
+    now[0] += 5.0
+    v.check("GET", "/x", {"Authorization": f"Bearer {bad}"})
+    assert cache.fetches == before + 2
+
+
+def test_verifier_missing_token_and_assertion_header():
+    ring = SigningKeyRing(ISS)
+    v = JwtVerifier(ring.jwks, issuer=ISS, audience=AUD)
+    claims, reason = v.check("GET", "/x", {})
+    assert claims is None and reason == "missing-token"
+    tok = ring.issue("svc", AUD, ttl_seconds=60)
+    claims, _ = v.check("GET", "/x", {ASSERTION_HEADER: tok})
+    assert claims["sub"] == "svc"
+    assert v.verified_total == 1 and v.rejected_total == 1
+
+
+def test_garbage_signature_is_token_error_not_crash():
+    ring = SigningKeyRing(ISS)
+    tok = ring.issue("a", AUD, ttl_seconds=60)
+    h, p, _s = tok.split(".")
+    # base64 length % 4 == 1 trips a decode error distinct from a bad
+    # signature — it must still surface as TokenError (remote input).
+    with pytest.raises(TokenError, match="bad-signature"):
+        tokens.verify(f"{h}.{p}.a", ring.jwks(), issuer=ISS, audience=AUD)
+
+
+def test_empty_sa_key_never_mints(tmp_path):
+    import hashlib
+
+    (tmp_path / "username").write_text("admin")
+    (tmp_path / "password").write_text("pw")
+    (tmp_path / "sa-broken").write_text("")   # half-provisioned SA
+    (tmp_path / "sa-good").write_text("k1")
+    auth = AuthService.from_secret_dir(str(tmp_path))
+    assert "broken" not in auth.service_accounts
+    assert not auth.check_service_account("broken", "")
+    assert auth.check_service_account("good", "k1")
+    direct = AuthService("u", hashlib.sha256(b"x").hexdigest(),
+                         service_accounts={"svc": ""})
+    assert not direct.check_service_account("svc", "")
+
+
+def test_bypass_ignores_query_string():
+    rules = bypass_from_specs(
+        '[{"http_method":"GET","path_exact":"/healthz"}]')
+    v = JwtVerifier(lambda: {"keys": []}, issuer=ISS, audience=AUD,
+                    bypass=rules)
+    assert v.bypassed("GET", "/healthz?verbose=1")
+    assert not v.bypassed("GET", "/healthzX?x=/healthz")
+
+
+def test_jwks_fetch_failure_backoff_on_stale_path():
+    """A dead issuer is retried at most once per min_refresh window on
+    the staleness path — requests must not serialize behind timeouts."""
+    calls = [0]
+
+    def source():
+        calls[0] += 1
+        raise OSError("issuer down")
+
+    now = [0.0]
+    cache = JwksCache(source, refresh_seconds=10.0,
+                      min_refresh_seconds=1.0, clock=lambda: now[0])
+    now[0] = 100.0
+    cache.jwks()
+    cache.jwks()
+    cache.jwks()
+    assert calls[0] == 1  # two follow-ups inside the backoff window
+    now[0] = 102.0
+    cache.jwks()
+    assert calls[0] == 2
+
+
+def test_jwks_cache_survives_fetch_errors():
+    ring = SigningKeyRing(ISS)
+    fail = [False]
+
+    def source():
+        if fail[0]:
+            raise OSError("issuer down")
+        return ring.jwks()
+
+    now = [0.0]
+    cache = JwksCache(source, refresh_seconds=5.0, clock=lambda: now[0])
+    tok = ring.issue("a", AUD, ttl_seconds=60)
+    v = JwtVerifier(cache, issuer=ISS, audience=AUD)
+    assert v.check("GET", "/x", {ASSERTION_HEADER: tok})[0] is not None
+    fail[0] = True
+    now[0] = 100.0  # cache stale, refresh fails → keep serving old keys
+    assert v.check("GET", "/x", {ASSERTION_HEADER: tok})[0] is not None
+    assert cache.fetch_errors >= 1
+
+
+# ---------------------------------------------------------------------------
+# Gatekeeper token endpoint (real HTTP)
+# ---------------------------------------------------------------------------
+
+
+def _post_json(url, payload, headers=None):
+    req = urllib.request.Request(
+        url, method="POST", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    with urllib.request.urlopen(req) as r:
+        return r.status, json.loads(r.read())
+
+
+@pytest.fixture()
+def gatekeeper():
+    import hashlib
+
+    auth = AuthService(
+        "admin", hashlib.sha256(b"hunter2").hexdigest(),
+        service_accounts={"prober": "sa-key-123"},
+    )
+    ring = SigningKeyRing(ISS)
+    httpd = make_auth_server(auth, 0, ring=ring, audience=AUD)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    yield base, ring
+    httpd.shutdown()
+
+
+def test_token_endpoint_basic_and_sa_grants(gatekeeper):
+    base, ring = gatekeeper
+    basic = base64.b64encode(b"admin:hunter2").decode()
+    code, grant = _post_json(f"{base}/token", {},
+                             {"Authorization": f"Basic {basic}"})
+    assert code == 200 and grant["token_type"] == "Bearer"
+    claims = tokens.verify(grant["id_token"], ring.jwks(),
+                           issuer=ISS, audience=AUD)
+    assert claims["sub"] == "admin"
+
+    code, grant = _post_json(
+        f"{base}/token",
+        {"service_account": "prober", "key": "sa-key-123",
+         "ttl_seconds": 120})
+    assert code == 200 and grant["expires_in"] == 120
+    claims = tokens.verify(grant["id_token"], ring.jwks(),
+                           issuer=ISS, audience=AUD)
+    assert claims["sub"] == "system:serviceaccount:prober"
+
+
+def test_token_endpoint_rejects_bad_credentials(gatekeeper):
+    base, _ring = gatekeeper
+    for payload, headers in (
+        ({}, None),
+        ({"service_account": "prober", "key": "wrong"}, None),
+        ({"username": "admin", "password": "wrong"}, None),
+        ({}, {"Authorization": "Basic " + base64.b64encode(
+            b"admin:wrong").decode()}),
+    ):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post_json(f"{base}/token", payload, headers)
+        assert e.value.code == 401
+
+
+def test_jwks_endpoint_and_credentialed_rotation(gatekeeper):
+    base, ring = gatekeeper
+    with urllib.request.urlopen(f"{base}/.well-known/jwks.json") as r:
+        jwks = json.loads(r.read())
+    assert [k["kid"] for k in jwks["keys"]] == [ring.active_kid]
+
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post_json(f"{base}/rotate", {})
+    assert e.value.code == 401
+
+    basic = base64.b64encode(b"admin:hunter2").decode()
+    code, out = _post_json(f"{base}/rotate", {},
+                           {"Authorization": f"Basic {basic}"})
+    assert code == 200 and out["active_kid"] == ring.active_kid
+    with urllib.request.urlopen(f"{base}/.well-known/jwks.json") as r:
+        jwks = json.loads(r.read())
+    assert len(jwks["keys"]) == 2  # retired key still served
+
+
+# ---------------------------------------------------------------------------
+# Gateway enforcement E2E (real sockets end to end)
+# ---------------------------------------------------------------------------
+
+
+def _echo_backend():
+    """Backend that echoes selected request headers as JSON."""
+    class Echo(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = json.dumps({
+                "path": self.path,
+                "identity": self.headers.get("X-Auth-Identity", ""),
+            }).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Echo)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd
+
+
+@pytest.fixture()
+def secured_gateway(gatekeeper):
+    base, ring = gatekeeper
+    backend = _echo_backend()
+    bport = backend.server_address[1]
+    table = RouteTable()
+    table.set_routes([
+        Route(name="app", prefix="/app/", service="app.kubeflow:80"),
+        Route(name="open", prefix="/open/", service="app.kubeflow:80",
+              jwt="off"),
+    ])
+    verifier = JwtVerifier(
+        f"{base}/.well-known/jwks.json", issuer=ISS, audience=AUD,
+        bypass=(BypassRule(http_method="GET", path_exact="/app/status"),),
+    )
+    gw = Gateway(table, port=0, admin_port=0, probe_interval=0,
+                 resolve=lambda addr: f"127.0.0.1:{bport}",
+                 jwt_verifier=verifier)
+    gw.start()
+    gw_base = f"http://127.0.0.1:{gw._proxy.server_address[1]}"
+    yield gw_base, base, ring
+    gw.stop()
+    backend.shutdown()
+
+
+def _get(url, headers=None):
+    req = urllib.request.Request(url, headers=headers or {})
+    with urllib.request.urlopen(req) as r:
+        return r.status, json.loads(r.read() or b"{}"), r.headers
+
+
+def test_gateway_requires_token(secured_gateway):
+    gw_base, *_ = secured_gateway
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(f"{gw_base}/app/data")
+    assert e.value.code == 401
+    assert "missing-token" in e.value.headers.get("WWW-Authenticate", "")
+
+
+def test_gateway_passes_valid_token_and_asserts_identity(secured_gateway):
+    gw_base, gk_base, _ring = secured_gateway
+    basic = base64.b64encode(b"admin:hunter2").decode()
+    _, grant = _post_json(f"{gk_base}/token", {},
+                          {"Authorization": f"Basic {basic}"})
+    code, out, _ = _get(
+        f"{gw_base}/app/data",
+        # A spoofed identity header must be stripped in favor of the
+        # gateway-asserted one (x-goog-authenticated-user-email role).
+        {"Authorization": f"Bearer {grant['id_token']}",
+         "X-Auth-Identity": "spoofed"},
+    )
+    assert code == 200
+    assert out["identity"] == "admin"
+
+
+def test_gateway_rejects_wrong_audience(secured_gateway):
+    gw_base, _gk, ring = secured_gateway
+    wrong_aud = ring.issue("a", "other-audience", ttl_seconds=60)
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(f"{gw_base}/app/data",
+             {"Authorization": f"Bearer {wrong_aud}"})
+    assert e.value.code == 401
+    assert "bad-audience" in e.value.headers.get("WWW-Authenticate", "")
+
+
+def test_gateway_bypass_path_and_jwt_off_route(secured_gateway):
+    gw_base, *_ = secured_gateway
+    code, _, _ = _get(f"{gw_base}/app/status")  # bypass_jwt analogue
+    assert code == 200
+    code, _, _ = _get(f"{gw_base}/open/anything")  # route-level opt-out
+    assert code == 200
+
+
+def test_key_rotation_without_downtime_through_gateway(secured_gateway):
+    """Old tokens keep working after a rotation; tokens from the fresh
+    key are admitted via the unknown-kid JWKS refetch — no 401 window."""
+    gw_base, gk_base, ring = secured_gateway
+    basic = base64.b64encode(b"admin:hunter2").decode()
+    _, old = _post_json(f"{gk_base}/token", {},
+                        {"Authorization": f"Basic {basic}"})
+    _post_json(f"{gk_base}/rotate", {},
+               {"Authorization": f"Basic {basic}"})
+    _, new = _post_json(f"{gk_base}/token", {},
+                        {"Authorization": f"Basic {basic}"})
+    for grant in (old, new):
+        code, _, _ = _get(
+            f"{gw_base}/app/data",
+            {"Authorization": f"Bearer {grant['id_token']}"})
+        assert code == 200
+
+
+def test_required_route_fails_closed_without_verifier():
+    """jwt: 'required' on a gateway with no verifier must 503, not serve
+    open (fail-closed on misconfiguration)."""
+    backend = _echo_backend()
+    bport = backend.server_address[1]
+    table = RouteTable()
+    table.set_routes([
+        Route(name="locked", prefix="/locked/", service="s.kubeflow:80",
+              jwt="required"),
+    ])
+    gw = Gateway(table, port=0, admin_port=0, probe_interval=0,
+                 resolve=lambda addr: f"127.0.0.1:{bport}")
+    gw.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(f"http://127.0.0.1:{gw._proxy.server_address[1]}"
+                 "/locked/x")
+        assert e.value.code == 503
+    finally:
+        gw.stop()
+        backend.shutdown()
+
+
+def test_token_endpoint_bad_ttl_and_garbage_content_length(gatekeeper):
+    base, _ring = gatekeeper
+    basic = base64.b64encode(b"admin:hunter2").decode()
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post_json(f"{base}/token", {"ttl_seconds": "oops"},
+                   {"Authorization": f"Basic {basic}"})
+    assert e.value.code == 400
+    # Garbage Content-Length must produce a clean 401 (no credentials in
+    # the unread body), not a dropped connection.
+    import http.client
+
+    host, port = base.replace("http://", "").split(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=5)
+    conn.putrequest("POST", "/token")
+    conn.putheader("Content-Length", "abc")
+    conn.endheaders()
+    resp = conn.getresponse()
+    assert resp.status == 401
+    conn.close()
+
+
+def test_prober_authenticates_through_gateway(secured_gateway):
+    """The kubeflow-readiness analogue: the prober exchanges its SA key
+    for an id-token and probes through the authenticated front door."""
+    from kubeflow_tpu.observability.collector import (
+        AvailabilityProber,
+        TokenClient,
+    )
+
+    gw_base, gk_base, _ring = secured_gateway
+    unauth = AvailabilityProber(f"{gw_base}/app/data", interval=1)
+    assert unauth.probe_once() is False  # 401 counts as DOWN
+
+    tc = TokenClient(f"{gk_base}/token", "prober", "sa-key-123")
+    prober = AvailabilityProber(f"{gw_base}/app/data", interval=1,
+                                token_client=tc)
+    assert prober.probe_once() is True
+    assert prober.available == 1
+    # Token is cached across probes (one exchange, many probes).
+    assert prober.probe_once() is True
+    # A rotation invalidating nothing: cached token still verifies.
+    assert "kubeflow_availability 1" in prober.render_metrics()
+
+
+def test_prober_bad_sa_key_counts_down(secured_gateway):
+    from kubeflow_tpu.observability.collector import (
+        AvailabilityProber,
+        TokenClient,
+    )
+
+    gw_base, gk_base, _ring = secured_gateway
+    tc = TokenClient(f"{gk_base}/token", "prober", "wrong-key")
+    prober = AvailabilityProber(f"{gw_base}/app/data", interval=1,
+                                token_client=tc)
+    assert prober.probe_once() is False
+    assert prober.failures_total == 1
