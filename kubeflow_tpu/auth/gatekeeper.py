@@ -83,7 +83,10 @@ class AuthService:
             return None
 
         username = read("username") or "admin"
-        pwhash = read("passwordhash")
+        # The login Secret (manifests/packages/auth.py) mounts the key
+        # as the file `passwordHash`; accept the all-lowercase spelling
+        # too for hand-made secrets.
+        pwhash = read("passwordHash") or read("passwordhash")
         if pwhash is None:
             pw = read("password")
             if pw is None:
@@ -195,15 +198,20 @@ def make_server(auth: AuthService, port: int, *,
             else:
                 self._send(404, b"not found", "text/plain")
 
-        def _grant_subject(self, payload: dict) -> str | None:
+        def _grant_subject(self, payload: dict, *,
+                           allow_service_account: bool = True
+                           ) -> str | None:
             """Which identity may have a token: Basic credentials, a
             valid session cookie, or a service-account key. None = no
-            acceptable credential presented."""
+            acceptable credential presented. Admin operations pass
+            ``allow_service_account=False`` — an SA key is a token-grant
+            credential, not an operator credential."""
             creds = _basic_credentials(self.headers.get("Authorization"))
             if creds and auth.check_login(*creds):
                 return creds[0]
             sa, key = payload.get("service_account"), payload.get("key")
-            if (isinstance(sa, str) and isinstance(key, str)
+            if (allow_service_account
+                    and isinstance(sa, str) and isinstance(key, str)
                     and auth.check_service_account(sa, key)):
                 return f"system:serviceaccount:{sa}"
             cookie = _cookie_from_header(self.headers.get("Cookie"))
@@ -262,7 +270,8 @@ def make_server(auth: AuthService, port: int, *,
                 self._send(404, b'{"error":"no token issuer"}',
                            "application/json")
                 return
-            if self._grant_subject(self._read_json()) is None:
+            if self._grant_subject(self._read_json(),
+                                   allow_service_account=False) is None:
                 self._send(401, b'{"error":"invalid credentials"}',
                            "application/json")
                 return

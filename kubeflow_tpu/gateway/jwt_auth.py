@@ -20,7 +20,7 @@ import urllib.request
 from dataclasses import dataclass
 from typing import Callable, Mapping
 
-from kubeflow_tpu.auth.tokens import TokenError, verify
+from kubeflow_tpu.auth.tokens import TokenError, decode_unverified, verify
 
 # The x-goog-iap-jwt-assertion analogue (iap.libsonnet:597): callers
 # that need Authorization for the upstream put the platform token here.
@@ -48,12 +48,16 @@ class JwksCache:
 
     ``source`` is either a URL (the gatekeeper's /.well-known/jwks.json)
     or a zero-arg callable returning the key-set dict (in-process tests,
-    custom transports). A kid the cached set doesn't know always gets one
+    custom transports). A kid the cached set doesn't know gets an
     immediate re-fetch — a token signed by a freshly-rotated key must
     never see a 401 window — but each still-unknown kid is then remembered
-    for ``min_refresh_seconds`` so a replayed garbage token cannot hammer
-    the issuer (the envoy jwks cache-duration behavior).
+    for ``min_refresh_seconds``, and miss-triggered fetches draw from a
+    small per-window budget, so neither a replayed garbage token nor a
+    flood of random kids can hammer the issuer (the envoy jwks
+    cache-duration behavior).
     """
+
+    MISS_FETCH_BUDGET = 5  # miss-triggered fetches per refresh window
 
     def __init__(self, source: str | Callable[[], Mapping], *,
                  refresh_seconds: float = 300.0,
@@ -70,6 +74,8 @@ class JwksCache:
         self._attempted_at = float("-inf")  # last attempt, incl. failures
         self._inflight = False
         self._miss_at: dict[str, float] = {}  # kid -> last miss-fetch time
+        self._miss_window_start = float("-inf")
+        self._miss_budget = self.MISS_FETCH_BUDGET
         self.fetches = 0
         self.fetch_errors = 0
 
@@ -97,12 +103,23 @@ class JwksCache:
                      > self.min_refresh_seconds)
             missing = want_kid is not None and not self._has_kid(want_kid)
             if missing:
-                # Per-kid miss memory: the first sighting of a kid always
+                # Per-kid miss memory: the first sighting of a kid
                 # re-fetches (zero-downtime rotation); a repeat of a kid
-                # the issuer doesn't know waits out the window.
+                # the issuer doesn't know waits out the window, and the
+                # per-window budget caps what a flood of RANDOM kids can
+                # trigger (a real rotation needs exactly one).
                 last = self._miss_at.get(want_kid, float("-inf"))
                 if now - last <= self.min_refresh_seconds:
                     missing = False
+                else:
+                    if (now - self._miss_window_start
+                            > self.min_refresh_seconds):
+                        self._miss_window_start = now
+                        self._miss_budget = self.MISS_FETCH_BUDGET
+                    if self._miss_budget <= 0:
+                        missing = False
+                    else:
+                        self._miss_budget -= 1
             if (not stale and not missing) or self._inflight:
                 return self._jwks
             self._inflight = True
@@ -177,8 +194,6 @@ class JwtVerifier:
         # Route on the (unverified) kid so a fresh key triggers exactly
         # one JWKS re-fetch; verification then runs on the cached set.
         try:
-            from kubeflow_tpu.auth.tokens import decode_unverified
-
             kid = decode_unverified(token)[0].get("kid")
         except TokenError:
             kid = None

@@ -211,31 +211,42 @@ class FakeKubelet:
         return len(self._running)
 
     def evict(self, name: str, namespace: str = "kubeflow",
-              reason: str = "Preempted") -> bool:
-        """Node-pressure eviction: kill the pod's process mid-run and mark
-        it Failed with ``reason`` — exactly what a real kubelet reports
-        when the node is reclaimed, and the signal the JobController's
-        gang logic keys preemption handling on (restart without burning
-        backoffLimit).
+              reason: str = "Preempted",
+              grace_seconds: float = 10.0) -> bool:
+        """Node-pressure eviction, delivered the way a real kubelet does:
+        SIGTERM first, up to ``grace_seconds`` for the workload to finish
+        its in-flight step and save (the train loop's graceful-shutdown
+        path), then SIGKILL. The pod is marked Failed with ``reason`` —
+        the signal the JobController's gang logic keys preemption
+        handling on (restart without burning backoffLimit) — regardless
+        of how the process exited, matching the phase a reclaimed node
+        reports.
 
         Returns False without killing anything if the pod is not actively
         running (already finished or never started): fabricating a
         preemption on a completed pod would make the controller restart a
         job that succeeded. A finished-but-unreaped process is left for
         ``step()`` to reap with its real exit status."""
+        import subprocess
+
         key = (namespace, name)
         run = self._running.get(key)
         if run is None or run.proc.poll() is not None:
             return False
         del self._running[key]
-        run.proc.kill()
-        run.proc.wait()
+        run.proc.terminate()  # SIGTERM: the grace window starts
+        try:
+            rc = run.proc.wait(timeout=max(0.0, grace_seconds))
+        except subprocess.TimeoutExpired:
+            run.proc.kill()
+            run.proc.wait()
+            rc = 137
         log = self._read_tail(run)  # always drain+close the spool
         try:
             pod = self.client.get(POD_API, "Pod", name, namespace)
         except ApiError:
             return True  # evicted; pod object deleted concurrently
-        self._set_phase(pod, "Failed", exit_code=137, log=log,
+        self._set_phase(pod, "Failed", exit_code=rc, log=log,
                         reason=reason)
         return True
 

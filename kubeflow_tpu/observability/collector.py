@@ -73,9 +73,16 @@ class TokenClient:
             )
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 grant = json.loads(resp.read())
-            self._token = grant["id_token"]
-            self._expires_at = time.time() + float(
-                grant.get("expires_in", 3600))
+            token = grant.get("id_token") if isinstance(grant, dict) \
+                else None
+            if not token:
+                raise ValueError("token response missing id_token")
+            self._token = token
+            try:
+                ttl = float(grant.get("expires_in", 3600))
+            except (TypeError, ValueError):
+                ttl = 3600.0
+            self._expires_at = time.time() + ttl
             return self._token
 
 

@@ -3,9 +3,12 @@
 The reference delegates checkpointing entirely to workloads and cloud storage
 (models read from GCS/S3/PVC — SURVEY.md §5.4); job restart just reruns the
 container. Here restart-from-checkpoint is a framework capability: the train
-loop saves sharded TrainState periodically and on preemption, and resumes from
-the latest step found. Multi-host safe — every process participates in the
-save (orbax handles the per-shard writes + atomic commit)."""
+loop saves sharded TrainState periodically (ASYNC — the device keeps
+training while orbax commits in the background, so checkpoint cadence
+doesn't trade against MFU) and a final synchronous save on preemption, and
+resumes from the latest step found. Multi-host safe — every process
+participates in the save (orbax handles the per-shard writes + atomic
+commit)."""
 
 from __future__ import annotations
 
@@ -15,13 +18,55 @@ from typing import Any
 import orbax.checkpoint as ocp
 
 
-def _manager(ckpt_dir: str, max_to_keep: int = 3) -> ocp.CheckpointManager:
+def _manager(ckpt_dir: str, max_to_keep: int = 3, *,
+             async_saves: bool = False) -> ocp.CheckpointManager:
     return ocp.CheckpointManager(
         os.path.abspath(ckpt_dir),
         options=ocp.CheckpointManagerOptions(
-            max_to_keep=max_to_keep, create=True
+            max_to_keep=max_to_keep, create=True,
+            enable_async_checkpointing=async_saves,
         ),
     )
+
+
+class Checkpointer:
+    """One persistent manager for a training run.
+
+    ``save`` returns as soon as the on-device state is snapshotted;
+    serialization and the atomic commit run on orbax's background thread
+    (enable_async_checkpointing). ``wait`` blocks until every pending
+    save is durable — call it before exiting (and on the preemption
+    path, where the final save must land inside the grace window).
+    """
+
+    def __init__(self, ckpt_dir: str, *, max_to_keep: int = 3,
+                 async_saves: bool = True):
+        self.ckpt_dir = os.path.abspath(ckpt_dir)
+        self._mgr = _manager(ckpt_dir, max_to_keep,
+                             async_saves=async_saves)
+
+    def save(self, step: int, state: Any, *, force: bool = False) -> None:
+        self._mgr.save(step, args=ocp.args.StandardSave(state),
+                       force=force)
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore_latest(self, abstract_state: Any
+                       ) -> tuple[Any, int] | None:
+        step = self._mgr.latest_step()
+        if step is None:
+            return None
+        state = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(abstract_state))
+        return state, step
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()
 
 
 def save(ckpt_dir: str, step: int, state: Any, *, force: bool = False) -> None:

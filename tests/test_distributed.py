@@ -452,9 +452,19 @@ def test_preemption_resume_e2e_continues_loss_trajectory(api, tmp_path):
             time_mod.sleep(0.02)
         else:
             pytest.fail("first checkpoint never appeared")
-        assert kubelet.evict(victim, "kubeflow"), (
+        assert kubelet.evict(victim, "kubeflow", grace_seconds=60), (
             "job finished before the eviction window — preemption was "
             "not mid-training")
+        # Graceful preemption: the worker spent its grace window saving a
+        # final checkpoint at the EVICTION step (not the last periodic
+        # one) — capture its log before the controller replaces the pod.
+        evicted_log = api.get("v1", "Pod", victim,
+                              "kubeflow")["status"]["log"]
+        assert "preempted: checkpoint saved at step" in evicted_log
+        preempt_step = int(
+            evicted_log.split("preempted: checkpoint saved at step")[1]
+            .split()[0])
+        assert preempt_step > 10  # strictly past the periodic checkpoint
 
         kubelet.run_until_idle(reconcile=ctrl.reconcile_all, deadline=300)
     finally:
@@ -473,7 +483,9 @@ def test_preemption_resume_e2e_continues_loss_trajectory(api, tmp_path):
                   "kubeflow")["status"]["log"]
     assert "resumed from checkpoint step" in log
     resume_step = int(log.split("resumed from checkpoint step")[1].split()[0])
-    assert resume_step >= 10
+    # SURVEY §5.3 completed: the resumed run continues from the step the
+    # eviction interrupted — zero completed steps were discarded.
+    assert resume_step == preempt_step
 
     resumed = _losses_from_log(log)
     compared = 0

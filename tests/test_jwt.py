@@ -255,6 +255,97 @@ def test_jwks_fetch_failure_backoff_on_stale_path():
     assert calls[0] == 2
 
 
+def test_random_kid_flood_capped_by_miss_budget():
+    """Unique random kids must not translate 1:1 into issuer fetches —
+    the per-window miss budget bounds them."""
+    ring = SigningKeyRing(ISS)
+    now = [0.0]
+    cache = JwksCache(ring.jwks, min_refresh_seconds=1.0,
+                      clock=lambda: now[0])
+    v = JwtVerifier(cache, issuer=ISS, audience=AUD)
+    now[0] = 10.0
+    baseline = None
+    for i in range(20):  # 20 distinct unknown kids in one window
+        bad = SigningKeyRing(ISS).issue(f"x{i}", AUD, ttl_seconds=60)
+        v.check("GET", "/x", {"Authorization": f"Bearer {bad}"})
+        if baseline is None:
+            baseline = cache.fetches
+    assert cache.fetches - baseline < JwksCache.MISS_FETCH_BUDGET
+    # A rotation in the NEXT window still gets its refetch.
+    now[0] = 12.0
+    ring.rotate()
+    tok = ring.issue("a", AUD, ttl_seconds=60)
+    claims, reason = v.check("GET", "/x",
+                             {"Authorization": f"Bearer {tok}"})
+    assert claims is not None, reason
+
+
+def test_rotate_rejects_service_account_credential(gatekeeper):
+    """An SA key is a token-grant credential, not an operator one —
+    it must not be able to churn the platform signing key."""
+    base, _ring = gatekeeper
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post_json(f"{base}/rotate",
+                   {"service_account": "prober", "key": "sa-key-123"})
+    assert e.value.code == 401
+    # ...while the same credential still gets tokens.
+    code, _ = _post_json(f"{base}/token",
+                         {"service_account": "prober",
+                          "key": "sa-key-123"})
+    assert code == 200
+
+
+def test_login_secret_password_hash_casing(tmp_path):
+    """The manifest mounts the key as `passwordHash` — the loader must
+    read that spelling (a crashlooping gatekeeper kills the whole
+    identity layer)."""
+    import hashlib
+
+    (tmp_path / "username").write_text("admin")
+    (tmp_path / "passwordHash").write_text(
+        hashlib.sha256(b"pw").hexdigest())
+    auth = AuthService.from_secret_dir(str(tmp_path))
+    assert auth.check_login("admin", "pw")
+
+
+def test_token_client_bad_grant_body_counts_down():
+    """A 200 token response without id_token must surface as a failed
+    probe, not a crashed probe thread."""
+    import threading as _threading
+    from http.server import (
+        BaseHTTPRequestHandler as _H,
+        ThreadingHTTPServer as _S,
+    )
+
+    from kubeflow_tpu.observability.collector import (
+        AvailabilityProber,
+        TokenClient,
+    )
+
+    class BadIssuer(_H):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            self.send_response(200)
+            self.send_header("Content-Length", "2")
+            self.end_headers()
+            self.wfile.write(b"{}")
+
+    httpd = _S(("127.0.0.1", 0), BadIssuer)
+    _threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        tc = TokenClient(
+            f"http://127.0.0.1:{httpd.server_address[1]}/token",
+            "prober", "k")
+        prober = AvailabilityProber("http://127.0.0.1:1/never",
+                                    interval=1, token_client=tc)
+        assert prober.probe_once() is False
+        assert prober.failures_total == 1
+    finally:
+        httpd.shutdown()
+
+
 def test_jwks_cache_survives_fetch_errors():
     ring = SigningKeyRing(ISS)
     fail = [False]

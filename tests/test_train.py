@@ -82,6 +82,9 @@ def test_checkpoint_roundtrip_and_resume(tmp_path):
 
 
 def test_run_loop_end_to_end(tmp_path, capsys):
+    import signal
+
+    before = signal.getsignal(signal.SIGTERM)
     cfg = RunConfig(
         model="lm-test-tiny",
         mesh=MeshConfig(data=4, fsdp=2),
@@ -97,10 +100,134 @@ def test_run_loop_end_to_end(tmp_path, capsys):
     assert result["step"] == 6
     assert np.isfinite(result["loss"])
     assert result["samples_per_sec"] > 0
+    # The graceful-shutdown handler is restored on exit — a finished run
+    # must not leave the process ignoring SIGTERM.
+    assert signal.getsignal(signal.SIGTERM) == before
     # Final checkpoint written; rerun resumes and exits immediately.
     assert ckpt_lib.latest_step(cfg.checkpoint_dir) == 6
     result2 = run(cfg)
     assert result2["step"] == 6
+
+
+def test_async_checkpointer_roundtrip(tmp_path):
+    """Checkpointer saves asynchronously (the step loop keeps going) and
+    wait() makes every save durable; restore sees the LAST save even
+    when the step donated/overwrote the live state after save()."""
+    model = get_model("lm-test-tiny")
+    mesh = build_mesh(MeshConfig(data=4, fsdp=2))
+    state = init_state(jax.random.PRNGKey(0), model, OPT, mesh)
+    step_fn = build_train_step(model, OPT, mesh)
+    batch = place_batch(synthetic_batch(model, 8, 16), mesh, model)
+
+    ckpt = ckpt_lib.Checkpointer(str(tmp_path / "ck"), async_saves=True)
+    saved_norm = None
+    for step in range(1, 4):
+        state, _ = step_fn(state, batch)
+        saved_norm = np.asarray(state.params["final_norm"])
+        ckpt.save(step, state)  # returns before the commit finishes
+    ckpt.wait()
+    assert ckpt.latest_step() == 3
+
+    abstract = jax.eval_shape(lambda: state)
+    abstract = jax.tree.map(
+        lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+        abstract, state_shardings(abstract, mesh, model),
+    )
+    restored, step = ckpt.restore_latest(abstract)
+    assert step == 3
+    np.testing.assert_allclose(
+        np.asarray(restored.params["final_norm"]), saved_norm)
+    ckpt.close()
+
+
+def test_async_save_returns_before_commit(tmp_path):
+    """Checkpoint cadence must not trade against step time: the async
+    save() call returns after the device-to-host snapshot, while the
+    serialization/commit runs in the background — measurably faster than
+    a full synchronous save of the same state (the r4 'saves are
+    synchronous' weakness). The training loop keeps stepping during the
+    committed tail; wait() is where durability is paid."""
+    import time
+
+    model = get_model("lm-test-tiny", n_layers=4, d_model=512, d_ff=1024)
+    mesh = build_mesh(MeshConfig(data=4, fsdp=2))
+    step_fn = build_train_step(model, OPT, mesh)
+    batch = place_batch(synthetic_batch(model, 8, 32), mesh, model)
+    state = init_state(jax.random.PRNGKey(0), model, OPT, mesh)
+    state, _ = step_fn(state, batch)
+
+    sync = ckpt_lib.Checkpointer(str(tmp_path / "s"), async_saves=False)
+    t0 = time.perf_counter()
+    sync.save(1, state)
+    sync.wait()
+    t_sync = time.perf_counter() - t0
+    sync.close()
+
+    a = ckpt_lib.Checkpointer(str(tmp_path / "a"), async_saves=True)
+    t0 = time.perf_counter()
+    a.save(1, state)
+    t_call = time.perf_counter() - t0
+    # The loop can run steps while the commit is in flight.
+    state2, m = step_fn(state, batch)
+    jax.block_until_ready(m["loss"])
+    a.wait()
+    assert a.latest_step() == 1
+    a.close()
+    assert t_call < t_sync / 2, (t_call, t_sync)
+
+
+def test_sigterm_saves_final_checkpoint_and_resumes(tmp_path):
+    """Graceful preemption in a real process: SIGTERM mid-training makes
+    the loop save at the interrupted step; a rerun resumes exactly
+    there (VERDICT r4 #3's done-criterion at the loop level)."""
+    import json as json_mod
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    ck = str(tmp_path / "ck")
+    cfg = {"model": "lm-test-tiny", "batch_size": 4, "seq_len": 32,
+           "steps": 2000, "log_every": 1, "checkpoint_dir": ck,
+           "checkpoint_every": 100000, "seed": 3}
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               PYTHONPATH=os.pathsep.join(sys.path))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubeflow_tpu.train.loop",
+         json_mod.dumps(cfg)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env,
+    )
+    # Wait for real training progress, then evict.
+    deadline = time.monotonic() + 240
+    lines = []
+    for line in proc.stdout:
+        lines.append(line)
+        if line.startswith("step=5 "):
+            break
+        assert time.monotonic() < deadline
+    proc.send_signal(signal.SIGTERM)
+    out, _ = proc.communicate(timeout=120)
+    lines.append(out)
+    assert proc.returncode == 0, out
+    full = "".join(lines)
+    assert "preempted: checkpoint saved at step" in full, full
+    saved = int(full.split("preempted: checkpoint saved at step")[1]
+                .split()[0])
+    assert saved >= 5
+    assert ckpt_lib.latest_step(ck) == saved
+    # The rerun resumes from the eviction step, not a periodic one
+    # (checkpoint_every is far larger than any step reached).
+    cfg2 = dict(cfg, steps=saved + 2)
+    out2 = subprocess.run(
+        [sys.executable, "-m", "kubeflow_tpu.train.loop",
+         json_mod.dumps(cfg2)],
+        capture_output=True, text=True, timeout=240, env=env,
+    )
+    assert out2.returncode == 0, out2.stdout + out2.stderr
+    assert f"resumed from checkpoint step {saved}" in out2.stdout
 
 
 def test_place_batch_shards_batch_dim():
