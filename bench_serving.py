@@ -52,9 +52,11 @@ def _bench_predict(args, model) -> dict:
         with grpc.insecure_channel(f"127.0.0.1:{server.grpc_port}",
                                    options=channel_opts) as chan:
             predict, _ = client_stubs(chan)
-            # Warmup (compile both the singleton and the full batch shape).
-            predict(model, [instance])
-            predict(model, [instance] * 8)
+            # Warmup (compile both the singleton and the full batch
+            # shape); first-compile on TPU can exceed the default 30s
+            # RPC deadline, so give it room.
+            predict(model, [instance], 600.0)
+            predict(model, [instance] * 8, 600.0)
 
             lat = []
             for _ in range(args.requests):
@@ -120,8 +122,14 @@ def _bench_generate(args, model) -> dict:
             with grpc.insecure_channel(f"127.0.0.1:{server.grpc_port}",
                                        options=channel_opts) as chan:
                 predict, _ = client_stubs(chan)
-                predict(model, [instance])  # warmup/compile
-                predict(model, [instance] * 8)
+                # Warmup/compile (first TPU compile can blow the 30s
+                # default deadline). Continuous admission buckets batch
+                # sizes to powers of two — warm every bucket so the
+                # concurrent phase measures steady state, not compiles.
+                predict(model, [instance], 600.0)
+                predict(model, [instance] * 2, 600.0)
+                predict(model, [instance] * 4, 600.0)
+                predict(model, [instance] * 8, 600.0)
 
                 lat = []
                 for _ in range(args.requests):

@@ -199,12 +199,15 @@ def generate(params, prompt_tokens, prompt_lengths, cfg: TransformerConfig,
 #
 # The lockstep ``generate`` above compiles prefill+decode into one call — the
 # right shape for offline batches, the wrong one for a server: every request
-# waits for the slowest peer. The continuous path splits the work into three
+# waits for the slowest peer. The continuous path splits the work into
 # fixed-shape executables so the scheduler can retire/admit rows between
-# steps: ``prefill`` (per request), ``insert_row`` (copy a prefilled row into
-# the persistent state), and ``decode_step`` (one token for ALL slots).
-# Unlike ``generate``'s shared scalar ``pos``, rows here sit at *different*
-# sequence positions, so the cache write and attention mask are per-row.
+# steps: ``admit_rows_and_step`` (prefill a round's admissions, scatter them
+# into the persistent state, and take one decode step — one dispatch) and
+# ``decode_step``/``decode_chunk`` (one token / K fused tokens for ALL
+# slots). ``prefill`` + ``insert_row`` remain as the unfused admission
+# pieces (callers that need the row cache itself). Unlike ``generate``'s
+# shared scalar ``pos``, rows here sit at *different* sequence positions,
+# so the cache write and attention mask are per-row.
 
 
 def _ragged_attention(x, layer, cfg, rope_bt, k_cache, v_cache, pos_b, valid):
@@ -288,6 +291,58 @@ def insert_row(state, slot, row_cache, last_logits, length, remaining,
         "last_logits": state["last_logits"].at[slot].set(last_logits[0]),
         "key": state["key"],
     }
+
+
+def _admit_rows_body(state, params, cfg: TransformerConfig, slots,
+                     prompt_tokens, prompt_lengths, remaining, temperature):
+    total_len = state["cache"]["k"].shape[2]
+    b, t0 = prompt_tokens.shape
+    cache = init_cache(cfg, b, total_len)
+    prompt_lengths = jnp.maximum(prompt_lengths, 1)
+    valid = jnp.arange(total_len)[None, :] < prompt_lengths[:, None]
+    positions = jnp.broadcast_to(jnp.arange(t0)[None], (b, t0))
+    logits, cache = forward_cached(
+        params, prompt_tokens, cfg, cache, 0, positions, valid,
+        token_valid=positions < prompt_lengths[:, None],
+    )
+    last = jnp.take_along_axis(
+        logits, (prompt_lengths - 1)[:, None, None], axis=1
+    )[:, 0]
+    return {
+        "cache": {
+            "k": state["cache"]["k"].at[:, slots].set(cache["k"]),
+            "v": state["cache"]["v"].at[:, slots].set(cache["v"]),
+        },
+        "length": state["length"].at[slots].set(prompt_lengths),
+        "remaining": state["remaining"].at[slots].set(remaining),
+        "active": state["active"].at[slots].set(remaining > 0),
+        "temperature": state["temperature"].at[slots].set(temperature),
+        "last_logits": state["last_logits"].at[slots].set(last),
+        "key": state["key"],
+    }, last
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "top_k", "eos_id"),
+                   donate_argnames=("state",))
+def admit_rows_and_step(state, params, cfg: TransformerConfig, slots,
+                        prompt_tokens, prompt_lengths, remaining,
+                        temperature, top_k: int = 0,
+                        eos_id: int | None = None):
+    """Fused admission: prefill ``[K, T0]`` prompts, scatter them into
+    rows ``slots`` of the persistent state, AND run one decode step for
+    every active row — a single dispatch, so the new requests' first
+    token ships on the admission round-trip itself (2 RTTs prompt→token
+    where a prefill/insert/step pipeline pays 4), and peer rows advance
+    exactly as a separate ramp step would have advanced them. ``slots``
+    may repeat indices only as bucket padding that duplicates a real
+    admission verbatim (identical data per duplicate index keeps the
+    scatter deterministic). Returns (state, prefill last-logits [K, V],
+    sampled token [slots], emitted mask [slots])."""
+    state, last = _admit_rows_body(state, params, cfg, slots,
+                                   prompt_tokens, prompt_lengths,
+                                   remaining, temperature)
+    state, tok, emit = _decode_step_body(state, params, cfg, top_k, eos_id)
+    return state, last, tok, emit
 
 
 @functools.partial(jax.jit, donate_argnames=("state",))

@@ -123,7 +123,9 @@ class ModelServer:
         preds = []
         for kind, inst, h in handles:
             if kind == "gen":
-                preds.append(self._gen_prediction(inst, h.result()))
+                preds.append(self._gen_prediction(inst, h.result(
+                    with_logits=bool(inst.get("return_logits")) or None,
+                )))
             else:
                 preds.append(self.batcher.collect(h))
         return {"predictions": preds}
