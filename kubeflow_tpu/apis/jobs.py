@@ -21,9 +21,14 @@ from dataclasses import dataclass
 from typing import Mapping, Sequence
 
 from kubeflow_tpu.k8s import objects as k8s
-from kubeflow_tpu.version import API_GROUP
+from kubeflow_tpu.version import API_GROUP, DEFAULT_NAMESPACE
 
 JOBS_API_VERSION = f"{API_GROUP}/v1"
+# Deprecated-but-served compatibility version: replicaSpecs is a LIST of
+# {replicaType, ...} entries (the reference's earlier training API shape).
+# Storage stays at v1; the apiserver converts on read/write both ways
+# (tf-job-operator.libsonnet:52-97's store-one/serve-both model).
+JOBS_API_V1BETA1 = f"{API_GROUP}/v1beta1"
 
 # ---------------------------------------------------------------------------
 # Replica types per job kind (reference CRD validation properties, e.g.
@@ -152,14 +157,41 @@ def _replica_spec_schema(replica_types: Sequence[str]) -> dict:
     return {"type": "object", "properties": props}
 
 
-def job_schema(kind: str) -> dict:
+def _replica_list_schema(replica_types: Sequence[str]) -> dict:
+    """The v1beta1 shape: replicaSpecs as a LIST of entries carrying
+    ``replicaType`` — the reference's early training API
+    (tf-job-operator.libsonnet:52-97 serves the old list shape alongside
+    the newer map while storing one of them)."""
+    return {
+        "type": "array",
+        "items": {
+            "type": "object",
+            "required": ["replicaType"],
+            "properties": {
+                "replicaType": {"type": "string",
+                                "enum": list(replica_types)},
+                "replicas": {"type": "integer", "minimum": 0},
+                "restartPolicy": {"type": "string",
+                                  "enum": list(RESTART_POLICIES)},
+                "template": {"type": "object",
+                             "x-kubernetes-preserve-unknown-fields": True},
+            },
+        },
+    }
+
+
+def job_schema(kind: str, *, api_version: str | None = None) -> dict:
+    list_shape = api_version == JOBS_API_V1BETA1
     return {
         "type": "object",
         "properties": {
             "spec": {
                 "type": "object",
                 "properties": {
-                    "replicaSpecs": _replica_spec_schema(REPLICA_TYPES[kind]),
+                    "replicaSpecs": (
+                        _replica_list_schema(REPLICA_TYPES[kind])
+                        if list_shape
+                        else _replica_spec_schema(REPLICA_TYPES[kind])),
                     "tpu": {
                         "type": "object",
                         "properties": {
@@ -190,7 +222,31 @@ def job_schema(kind: str) -> dict:
 
 def job_crd(kind: str) -> dict:
     """CRD for one job kind, with the reference's printer-column surface
-    (tf-job-operator.libsonnet:70-81: State + Age columns)."""
+    (tf-job-operator.libsonnet:70-81: State + Age columns) and its
+    multi-version story (ibid:52-97): ``v1`` is served AND stored;
+    ``v1beta1`` (the list-shaped replicaSpecs of the earlier API) stays
+    served-but-deprecated so existing clients keep working while the
+    platform evolves the schema."""
+    def printer_columns() -> list[dict]:
+        # Fresh dicts per version — shared objects render as YAML
+        # anchors/aliases in the deployable manifest.
+        return [
+            k8s.printer_column("State", ".status.state"),
+            k8s.printer_column("Age", ".metadata.creationTimestamp",
+                               "date"),
+        ]
+
+    v1beta1 = k8s.crd_version(
+        "v1beta1",
+        schema=job_schema(kind, api_version=JOBS_API_V1BETA1),
+        served=True,
+        storage=False,
+        printer_columns=printer_columns(),
+    )
+    v1beta1["deprecated"] = True
+    v1beta1["deprecationWarning"] = (
+        f"{API_GROUP}/v1beta1 {kind} is deprecated; use {JOBS_API_VERSION}"
+    )
     return k8s.crd(
         group=API_GROUP,
         kind=kind,
@@ -203,17 +259,82 @@ def job_crd(kind: str) -> dict:
                 schema=job_schema(kind),
                 served=True,
                 storage=True,
-                printer_columns=[
-                    k8s.printer_column("State", ".status.state"),
-                    k8s.printer_column("Age", ".metadata.creationTimestamp", "date"),
-                ],
-            )
+                printer_columns=printer_columns(),
+            ),
+            v1beta1,
         ],
+        # A real apiserver needs the webhook to convert between the two
+        # shapes; the platform's webhook serves /convert with the same
+        # convert_job registered below (the fake converts in-process).
+        conversion=k8s.crd_conversion_webhook(
+            "admission-webhook", DEFAULT_NAMESPACE),
     )
 
 
 def all_job_crds() -> list[dict]:
     return [job_crd(kind) for kind in ALL_JOB_KINDS]
+
+
+# ---------------------------------------------------------------------------
+# Version conversion (the apiserver's store-v1/serve-both machinery)
+# ---------------------------------------------------------------------------
+
+
+def convert_job(job: dict, to_api_version: str) -> dict:
+    """Convert a job between ``v1`` (replicaSpecs as a map keyed by
+    replica type) and ``v1beta1`` (a list of entries carrying
+    ``replicaType``). Lossless both ways; every other field — tpu,
+    runPolicy, status — passes through unchanged."""
+    import copy
+
+    if job.get("apiVersion") == to_api_version:
+        return job
+    out = copy.deepcopy(job)
+    out["apiVersion"] = to_api_version
+    spec = out.get("spec")
+    if not isinstance(spec, dict):
+        return out
+    rs = spec.get("replicaSpecs")
+    if to_api_version == JOBS_API_VERSION and isinstance(rs, list):
+        bad = [e for e in rs
+               if not (isinstance(e, dict) and "replicaType" in e)]
+        if bad:
+            # Dropping a malformed entry would store less than the
+            # client wrote — fail the conversion loudly, like the
+            # duplicate check below.
+            from kubeflow_tpu.k8s.client import ApiError
+
+            raise ApiError.invalid(
+                f"{job.get('kind')}: replicaSpecs entries must be "
+                f"objects with a replicaType")
+        entries = rs
+        types = [e["replicaType"] for e in entries]
+        if len(set(types)) != len(types):
+            # Silently keeping the last duplicate would store something
+            # the client never wrote — fail the conversion loudly.
+            from kubeflow_tpu.k8s.client import ApiError
+
+            raise ApiError.invalid(
+                f"{job.get('kind')}: duplicate replicaType entries "
+                f"{sorted(t for t in types if types.count(t) > 1)}")
+        spec["replicaSpecs"] = {
+            e["replicaType"]: {k: v for k, v in e.items()
+                               if k != "replicaType"}
+            for e in entries
+        }
+    elif to_api_version == JOBS_API_V1BETA1 and isinstance(rs, dict):
+        spec["replicaSpecs"] = [
+            {"replicaType": rt, **r} for rt, r in sorted(rs.items())
+        ]
+    return out
+
+
+# Self-register with the client layer so any apiserver (fake or HTTP
+# frontend) that sees these kinds converts with the real schema mapping.
+from kubeflow_tpu.k8s.client import register_converter as _register  # noqa: E402
+
+for _kind in ALL_JOB_KINDS:
+    _register(_kind, convert_job)
 
 
 # ---------------------------------------------------------------------------

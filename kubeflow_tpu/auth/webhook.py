@@ -1,11 +1,15 @@
-"""Mutating admission webhook: `python -m kubeflow_tpu.auth.webhook`.
+"""Mutating admission + CRD conversion webhook:
+`python -m kubeflow_tpu.auth.webhook`.
 
 The gcp-admission-webhook analogue (components/gcp-admission-webhook/
 main.go:131-158, patch ops :51-53): pods labeled
 `kubeflow-tpu.org/cred-secret=<name>` get that Secret mounted plus
 GOOGLE_APPLICATION_CREDENTIALS pointed at it (the credentials-pod-preset
 surface); TPU-requesting containers get safe env defaults. Speaks the
-AdmissionReview v1 protocol on POST /mutate.
+AdmissionReview v1 protocol on POST /mutate, and the ConversionReview
+v1 protocol on POST /convert — the structural converter a REAL
+apiserver calls for the job CRDs' multi-version story (the fake
+apiserver converts in-process with the same registered functions).
 """
 
 from __future__ import annotations
@@ -104,6 +108,37 @@ def review_response(review: dict) -> dict:
     }
 
 
+def convert_response(review: dict) -> dict:
+    """ConversionReview request → response, via the converters the API
+    packages register with the client layer (apis/jobs.convert_job)."""
+    # Importing the API packages registers their converters.
+    from kubeflow_tpu.apis import jobs as _jobs  # noqa: F401
+    from kubeflow_tpu.k8s.client import ApiError, KindRegistry
+
+    request = review.get("request", {})
+    uid = request.get("uid", "")
+    desired = request.get("desiredAPIVersion", "")
+    converted, failure = [], None
+    for obj in request.get("objects", []):
+        try:
+            converted.append(KindRegistry.convert(obj, desired))
+        except ApiError as e:
+            failure = e.message or str(e)
+            break
+    response: dict = {"uid": uid}
+    if failure is None:
+        response["result"] = {"status": "Success"}
+        response["convertedObjects"] = converted
+    else:
+        response["result"] = {"status": "Failed", "message": failure}
+    return {
+        "apiVersion": review.get("apiVersion",
+                                 "apiextensions.k8s.io/v1"),
+        "kind": "ConversionReview",
+        "response": response,
+    }
+
+
 def make_server(port: int, *, certfile: str = "",
                 keyfile: str = "") -> ThreadingHTTPServer:
     class Handler(BaseHTTPRequestHandler):
@@ -125,13 +160,15 @@ def make_server(port: int, *, certfile: str = "",
                 self._send(404, {"error": "not found"})
 
         def do_POST(self):
-            if self.path != "/mutate":
+            if self.path not in ("/mutate", "/convert"):
                 self._send(404, {"error": "not found"})
                 return
             try:
                 length = int(self.headers.get("Content-Length", 0))
                 review = json.loads(self.rfile.read(length) or b"{}")
-                self._send(200, review_response(review))
+                handler = (review_response if self.path == "/mutate"
+                           else convert_response)
+                self._send(200, handler(review))
             except (ValueError, KeyError) as e:
                 self._send(400, {"error": str(e)})
 

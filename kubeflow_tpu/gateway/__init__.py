@@ -19,464 +19,29 @@ the connection to a transparent bidirectional TCP tunnel.
 
 from __future__ import annotations
 
-import json
 import logging
 import random
-import socket
 import threading
-import time
 import urllib.error
-import urllib.parse
 import urllib.request
-from dataclasses import dataclass
-from http.client import HTTPConnection
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable
 
-import yaml
+from kubeflow_tpu.gateway.admin import make_admin_handler
+from kubeflow_tpu.gateway.proxy import make_proxy_handler
+from kubeflow_tpu.gateway.resilience import (
+    BanditStats,
+    OutlierStats,
+    UpstreamHealth,
+)
+from kubeflow_tpu.gateway.routing import Route, RouteTable, routes_from_service
 
-from kubeflow_tpu.k8s.client import K8sClient
-from kubeflow_tpu.manifests.core import GATEWAY_ROUTE_ANNOTATION
+__all__ = [
+    "BanditStats", "Gateway", "OutlierStats", "Route", "RouteTable",
+    "UpstreamHealth", "routes_from_service",
+]
 
 log = logging.getLogger(__name__)
-
-# Hop-by-hop headers never forwarded (RFC 7230 §6.1).
-_HOP_HEADERS = {
-    "connection", "keep-alive", "proxy-authenticate",
-    "proxy-authorization", "te", "trailers", "transfer-encoding", "upgrade",
-    "host", "content-length",
-}
-
-
-@dataclass(frozen=True)
-class Route:
-    name: str
-    prefix: str
-    service: str  # host:port (the primary backend)
-    rewrite: str = "/"
-    # Traffic splitting (the seldon abtest/mab/canary surface,
-    # /root/reference/kubeflow/seldon/prototypes, core.libsonnet:305):
-    # weighted variants — each request is routed to one backend drawn by
-    # weight. Empty = all traffic to `service`.
-    backends: tuple = ()  # ((host:port, weight), ...)
-    # "weighted": static draw by weight. "epsilon-greedy": the seldon
-    # multi-armed-bandit router (epsilon-greedy prototype) — explore a
-    # random variant with probability epsilon, otherwise exploit the
-    # best observed reward; rewards come from response status (5xx/
-    # connect-fail = 0) or the admin feedback endpoint.
-    strategy: str = "weighted"
-    epsilon: float = 0.1
-    # Shadow/mirror target: every request is also sent fire-and-forget to
-    # this backend; its response is discarded and its failures invisible.
-    shadow: str = ""
-    # Outlier detection (seldon outlier-detector-v1alpha2 surface): score
-    # each prediction request's feature against a running window;
-    # |z| > threshold tags the response and counts into the outlier rate.
-    # 0 disables.
-    outlier_threshold: float = 0.0
-    outlier_window: int = 100
-    # Identity-token policy for this route: "" = the gateway default
-    # (verify when a JwtVerifier is configured), "off" = this route is
-    # exempt (the per-route face of iap.libsonnet:600's bypass_jwt).
-    jwt: str = ""
-
-    def pick_service(self, rng) -> str:
-        if not self.backends:
-            return self.service
-        services = [b[0] for b in self.backends]
-        weights = [b[1] for b in self.backends]
-        return rng.choices(services, weights=weights)[0]
-
-    def target_for(self, path: str, service: str | None = None) -> str:
-        """Rewrite `path` (which startswith prefix) onto the backend."""
-        rest = path[len(self.prefix):]
-        base = (self.rewrite if self.rewrite.endswith("/")
-                else self.rewrite + "/")
-        return ("http://" + (service or self.service) + base
-                + rest.lstrip("/"))
-
-
-class OutlierStats:
-    """Route-attached anomaly scoring — the seldon outlier-detector
-    variant (/root/reference/kubeflow/seldon/prototypes/
-    outlier-detector-v1alpha2.jsonnet:1-128 attaches a Mahalanobis
-    scorer to a model route). Platform recast: a running z-score over a
-    scalar feature of each prediction request (mean |value| of the
-    instances payload), maintained per route over a sliding window.
-    Requests scoring beyond the route's threshold are tagged
-    (X-Outlier/X-Outlier-Score response headers — the streamed relay
-    never buffers bodies, so tagging rides headers) and counted into the
-    outlier-rate metric."""
-
-    def __init__(self) -> None:
-        self._lock = threading.Lock()
-        # route -> (window deque, outliers, scored)
-        self._windows: dict[str, object] = {}
-        self._counts: dict[str, list[int]] = {}
-
-    @staticmethod
-    def feature(body: bytes | None) -> float | None:
-        """Scalar feature of a prediction request: mean |x| over every
-        numeric leaf of "instances". None = not scoreable (no/bad JSON,
-        no numerics) — never an error, scoring must not break proxying."""
-        if not body:
-            return None
-        try:
-            payload = json.loads(body)
-        except (ValueError, UnicodeDecodeError):
-            return None
-        total, n = 0.0, 0
-        stack = [payload.get("instances")
-                 if isinstance(payload, dict) else payload]
-        while stack:
-            node = stack.pop()
-            if isinstance(node, bool):
-                continue
-            if isinstance(node, (int, float)):
-                total += abs(float(node))
-                n += 1
-            elif isinstance(node, list):
-                stack.extend(node)
-            elif isinstance(node, dict):
-                stack.extend(node.values())
-        return total / n if n else None
-
-    # Baseline points required before anything is flagged: a 2-sample
-    # window's std is noise, and normal jitter would score "infinite".
-    WARMUP = 10
-
-    def score(self, route: str, value: float, *, window: int,
-              threshold: float) -> tuple[float, bool]:
-        """Running z-score of ``value`` against the route's window
-        (scored BEFORE insertion, so one huge request can't mask
-        itself); returns (score, is_outlier). Warmup requests build the
-        baseline and are never flagged."""
-        import collections
-        import math
-
-        with self._lock:
-            win = self._windows.setdefault(
-                route, collections.deque(maxlen=max(window, 2))
-            )
-            counts = self._counts.setdefault(route, [0, 0])
-            if win.maxlen != max(window, 2):
-                # Window reconfigured (annotation re-applied): carry the
-                # most recent baseline into the new size.
-                win = collections.deque(win, maxlen=max(window, 2))
-                self._windows[route] = win
-            warm = len(win) >= min(self.WARMUP, win.maxlen)
-            if len(win) >= 2:
-                mean = sum(win) / len(win)
-                var = sum((v - mean) ** 2 for v in win) / len(win)
-                std = math.sqrt(var)
-                z = abs(value - mean) / std if std > 1e-12 else (
-                    0.0 if abs(value - mean) < 1e-12 else float("inf")
-                )
-            else:
-                z = 0.0
-            outlier = warm and z > threshold
-            counts[1] += 1
-            if outlier:
-                counts[0] += 1
-            else:
-                # Outliers are excluded from the baseline, or a burst of
-                # them would normalize itself into "normal".
-                win.append(value)
-            return (round(z, 4) if z != float("inf") else z, outlier)
-
-    def snapshot(self, route: str) -> dict:
-        with self._lock:
-            outliers, scored = self._counts.get(route, (0, 0))
-            return {"outliers": outliers, "scored": scored,
-                    "rate": round(outliers / scored, 4) if scored else 0.0}
-
-    def totals(self) -> tuple[int, int]:
-        with self._lock:
-            return (sum(c[0] for c in self._counts.values()),
-                    sum(c[1] for c in self._counts.values()))
-
-
-class UpstreamHealth:
-    """Per-backend health with circuit breaking (the envoy outlier-
-    detection role ambassador delegates to envoy; this platform's front
-    door implements it natively):
-
-    - passive observation: every proxied request records success/failure
-      (connect errors and 5xx); ``failure_threshold`` consecutive
-      failures EJECT the backend from every route's pick set for
-      ``ejection_seconds``;
-    - half-open recovery: after the ejection window one trial request is
-      let through — success closes the circuit, failure re-ejects with
-      doubled backoff (capped 10×);
-    - active probes: a prober thread TCP-connects each known backend
-      every ``probe_interval`` seconds so an upstream that died between
-      requests is ejected (and a recovered one readmitted) without
-      client traffic paying for the discovery.
-    """
-
-    def __init__(self, *, failure_threshold: int = 3,
-                 ejection_seconds: float = 10.0,
-                 clock: Callable[[], float] = time.monotonic):
-        self.failure_threshold = failure_threshold
-        self.ejection_seconds = ejection_seconds
-        self.clock = clock
-        self._lock = threading.Lock()
-        # service -> {fails, ejected_until, ejections, state-extras}
-        self._state: dict[str, dict] = {}
-
-    def _cell(self, service: str) -> dict:
-        return self._state.setdefault(service, {
-            "consecutive_failures": 0, "ejected_until": 0.0,
-            "ejections": 0, "half_open_inflight": False,
-            "trial_started": 0.0, "last_change": self.clock(),
-        })
-
-    def record_success(self, service: str) -> None:
-        with self._lock:
-            cell = self._cell(service)
-            recovered = (cell["consecutive_failures"]
-                         >= self.failure_threshold)
-            cell.update(consecutive_failures=0, ejected_until=0.0,
-                        half_open_inflight=False)
-            if recovered:
-                cell.update(ejections=0, last_change=self.clock())
-
-    # A half-open trial that never reported back (e.g. the request rode
-    # an upgrade tunnel, which doesn't record outcomes) expires so the
-    # backend isn't stuck "trial in flight" forever.
-    TRIAL_TIMEOUT = 30.0
-
-    def record_failure(self, service: str) -> None:
-        with self._lock:
-            cell = self._cell(service)
-            cell["consecutive_failures"] += 1
-            cell["half_open_inflight"] = False
-            if cell["consecutive_failures"] >= self.failure_threshold:
-                # Re-eject with doubled backoff per consecutive ejection
-                # (half-open trial failed), capped at 10x — exponent
-                # clamped so a long-dead backend can't grow a bigint.
-                backoff = self.ejection_seconds * min(
-                    2 ** min(cell["ejections"], 4), 10
-                )
-                cell["ejected_until"] = self.clock() + backoff
-                cell["ejections"] += 1
-                cell["last_change"] = self.clock()
-
-    def _eligible_locked(self, cell: dict | None) -> bool:
-        if cell is None or cell["consecutive_failures"] \
-                < self.failure_threshold:
-            return True
-        if self.clock() < cell["ejected_until"]:
-            return False
-        if cell["half_open_inflight"] and (
-                self.clock() - cell["trial_started"] < self.TRIAL_TIMEOUT):
-            return False
-        return True  # window elapsed: a trial may begin
-
-    def admits(self, service: str) -> bool:
-        """Side-effect-free eligibility: healthy, or ejection window
-        elapsed with no trial in flight."""
-        with self._lock:
-            return self._eligible_locked(self._state.get(service))
-
-    def begin_trial(self, service: str) -> None:
-        """Mark the half-open trial as in flight for the backend a
-        request was ACTUALLY routed to (never during pick-set filtering —
-        an unpicked backend must not have its one trial consumed)."""
-        with self._lock:
-            cell = self._state.get(service)
-            if (cell is not None
-                    and cell["consecutive_failures"]
-                    >= self.failure_threshold
-                    and self.clock() >= cell["ejected_until"]):
-                cell["half_open_inflight"] = True
-                cell["trial_started"] = self.clock()
-
-    def filter_healthy(self, services: list[str]) -> list[str]:
-        """The pick set: ejected backends drop out; if EVERYTHING is
-        ejected, fail open with the full set (a wrong 502 beats
-        blackholing when the health data itself is suspect)."""
-        healthy = [s for s in services if self.admits(s)]
-        return healthy or list(services)
-
-    def probe(self, services: list[str],
-              resolve: Callable[[str], str]) -> None:
-        """Active TCP-connect probe of every service (cheap, protocol-
-        agnostic — the readiness signal is 'something is listening')."""
-        for service in services:
-            addr = resolve(service)
-            host, _, port_s = addr.partition(":")
-            try:
-                with socket.create_connection(
-                        (host, int(port_s or 80)), timeout=2.0):
-                    pass
-                self.record_success(service)
-            except OSError:
-                self.record_failure(service)
-
-    def snapshot(self) -> dict:
-        with self._lock:
-            now = self.clock()
-            return {
-                svc: {
-                    "healthy": cell["consecutive_failures"]
-                    < self.failure_threshold,
-                    "consecutive_failures": cell["consecutive_failures"],
-                    "ejected_for_seconds": round(
-                        max(0.0, cell["ejected_until"] - now), 2),
-                    "ejections": cell["ejections"],
-                }
-                for svc, cell in self._state.items()
-            }
-
-
-class BanditStats:
-    """Per-(route, backend) reward averages for epsilon-greedy routes."""
-
-    def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._stats: dict[tuple[str, str], list[float]] = {}
-
-    def record(self, route: str, service: str, reward: float) -> None:
-        with self._lock:
-            cell = self._stats.setdefault((route, service), [0.0, 0])
-            cell[0] += reward
-            cell[1] += 1
-
-    def pick(self, route: Route, rng, services: list[str] | None = None
-             ) -> str:
-        """Explore uniformly with prob epsilon; otherwise exploit the best
-        mean reward. Untried backends are optimistic (mean 1.0), so every
-        variant gets traffic before exploitation locks in. ``services``
-        restricts the arms (the health layer's ejection filter)."""
-        if services is None:
-            services = [b[0] for b in route.backends]
-        if rng.random() < route.epsilon:
-            return rng.choice(services)
-        with self._lock:
-            def mean(svc: str) -> float:
-                total, n = self._stats.get((route.name, svc), (0.0, 0))
-                return total / n if n else 1.0
-
-            best = max(mean(s) for s in services)
-            top = [s for s in services if mean(s) == best]
-        return rng.choice(top)
-
-    def snapshot(self, route_name: str) -> dict:
-        with self._lock:
-            return {
-                svc: {"reward_sum": round(total, 4), "trials": n,
-                      "mean": round(total / n, 4) if n else None}
-                for (rname, svc), (total, n) in self._stats.items()
-                if rname == route_name
-            }
-
-
-def routes_from_service(svc: dict) -> list[Route]:
-    raw = svc.get("metadata", {}).get("annotations", {}).get(
-        GATEWAY_ROUTE_ANNOTATION
-    )
-    if not raw:
-        return []
-    try:
-        specs = yaml.safe_load(raw)
-    except yaml.YAMLError:
-        log.warning("bad route annotation on %s",
-                    svc["metadata"].get("name"))
-        return []
-    if isinstance(specs, dict):
-        specs = [specs]
-    routes = []
-    for spec in specs or []:
-        try:
-            backends = tuple(
-                (b["service"], float(b.get("weight", 1)))
-                for b in spec.get("backends", [])
-            )
-            if backends and any(w < 0 for _s, w in backends):
-                raise ValueError("negative backend weight")
-            if backends and not any(w > 0 for _s, w in backends):
-                raise ValueError("all backend weights zero")
-            service = spec.get("service") or (
-                backends[0][0] if backends else None
-            )
-            if not service:
-                raise KeyError("service")
-            strategy = spec.get("strategy", "weighted")
-            if strategy not in ("weighted", "epsilon-greedy"):
-                raise ValueError(f"unknown strategy {strategy!r}")
-            epsilon = float(spec.get("epsilon", 0.1))
-            if not 0.0 <= epsilon <= 1.0:
-                raise ValueError("epsilon must be in [0, 1]")
-            outlier = spec.get("outlier", {}) or {}
-            outlier_threshold = float(outlier.get("threshold", 0.0))
-            outlier_window = int(outlier.get("window", 100))
-            if outlier_threshold < 0:
-                raise ValueError("outlier threshold must be >= 0")
-            if outlier_window < 2:
-                raise ValueError("outlier window must be >= 2")
-            jwt = str(spec.get("jwt", ""))
-            if jwt not in ("", "off", "required"):
-                raise ValueError(f"jwt must be 'off' or 'required', "
-                                 f"got {jwt!r}")
-            routes.append(Route(
-                jwt=jwt,
-                name=spec["name"], prefix=spec["prefix"],
-                service=service, rewrite=spec.get("rewrite", "/"),
-                backends=backends, strategy=strategy, epsilon=epsilon,
-                shadow=spec.get("shadow", ""),
-                outlier_threshold=outlier_threshold,
-                outlier_window=outlier_window,
-            ))
-        except (KeyError, TypeError, ValueError) as e:
-            log.warning("bad route spec in %s: %s",
-                        svc["metadata"].get("name"), e)
-    return routes
-
-
-class RouteTable:
-    """Longest-prefix route lookup, refreshed from Service annotations."""
-
-    def __init__(self) -> None:
-        self._routes: list[Route] = []
-        self._lock = threading.Lock()
-
-    def set_routes(self, routes: list[Route]) -> None:
-        with self._lock:
-            # Longest prefix first; on equal prefixes a split/shadow route
-            # beats a plain one (a serving-route canary for a model must
-            # override the model Service's own direct route, not lose the
-            # tie to listing order), then name for determinism.
-            self._routes = sorted(
-                routes,
-                key=lambda r: (-len(r.prefix),
-                               0 if (r.backends or r.shadow) else 1,
-                               r.name),
-            )
-
-    def refresh(self, client: K8sClient, namespace: str | None = None) -> int:
-        routes = []
-        for svc in client.list("v1", "Service", namespace):
-            routes.extend(routes_from_service(svc))
-        self.set_routes(routes)
-        return len(routes)
-
-    def match(self, path: str) -> Route | None:
-        with self._lock:
-            for r in self._routes:
-                if path.startswith(r.prefix):
-                    return r
-        return None
-
-    def snapshot(self) -> list[dict]:
-        with self._lock:
-            # Copies, not the live __dict__ of the frozen Routes — callers
-            # (the admin handler) annotate these per request.
-            return [dict(vars(r)) for r in self._routes]
-
-    def find(self, name: str) -> Route | None:
-        with self._lock:
-            return next((r for r in self._routes if r.name == name), None)
 
 
 class Gateway:
@@ -597,537 +162,6 @@ class Gateway:
 
     # -- proxy --------------------------------------------------------------
 
-    def _make_proxy_handler(gw: "Gateway"):
-        class Handler(BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
-
-            def log_message(self, *a):
-                pass
-
-            def _respond(self, code: int, body: bytes,
-                         headers: dict | None = None) -> None:
-                self.send_response(code)
-                for k, v in (headers or {}).items():
-                    self.send_header(k, v)
-                if headers is None or "Content-Type" not in headers:
-                    self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                if self.command != "HEAD":  # RFC 7231: HEAD has no body
-                    self.wfile.write(body)
-
-            def _handle(self):
-                gw.requests_total += 1
-                if self.path == "/healthz":
-                    self._respond(200, b'{"status":"ok"}')
-                    return
-                if self.path.startswith("/.well-known/acme-challenge/"):
-                    token = self.path.rsplit("/", 1)[1]
-                    body = (gw.challenge_lookup(token)
-                            if gw.challenge_lookup else None)
-                    if body is None:
-                        self._respond(404, b'{"error":"unknown challenge"}')
-                    else:
-                        self._respond(200, body.encode(),
-                                      {"Content-Type": "text/plain"})
-                    return
-                route = gw.table.match(self.path)
-                if route is None:
-                    gw.errors_total += 1
-                    self._respond(
-                        404,
-                        json.dumps({"error": f"no route for {self.path}"})
-                        .encode(),
-                    )
-                    return
-                self._identity = None
-                if route.jwt == "required" and gw.jwt_verifier is None:
-                    # Fail CLOSED: an operator demanded token checks on
-                    # this route but the gateway has no verifier — a
-                    # misconfiguration must not silently serve open.
-                    gw.errors_total += 1
-                    self._respond(503, json.dumps(
-                        {"error": "route requires jwt but the gateway "
-                                  "has no verifier configured"}).encode())
-                    return
-                if gw.jwt_verifier is not None and route.jwt != "off":
-                    claims, reason = gw.jwt_verifier.check(
-                        self.command, self.path, self.headers
-                    )
-                    if claims is None:
-                        # Browser sessions may still pass through
-                        # forward-auth when it is configured (IAP serves
-                        # both logins and SA id-tokens) — unless the
-                        # route pins jwt: "required", which accepts
-                        # nothing but a valid bearer token.
-                        session_ok = (route.jwt != "required"
-                                      and gw.auth_url
-                                      and gw._authorized(self))
-                        if not session_ok:
-                            self._respond(401, json.dumps(
-                                {"error": "unauthorized", "reason": reason}
-                            ).encode(), {
-                                "WWW-Authenticate":
-                                    f'Bearer error="{reason}"',
-                                "Content-Type": "application/json",
-                            })
-                            return
-                    elif claims.get("sub"):
-                        self._identity = str(claims["sub"])
-                elif not gw._authorized(self):
-                    self._respond(
-                        401, json.dumps({"error": "unauthorized",
-                                         "login": "/login"}).encode(),
-                    )
-                    return
-                service = self._pick_backend(route)
-                target = route.target_for(self.path, service)
-                # Re-point at the resolved backend address.
-                target = target.replace(service, gw.resolve(service), 1)
-                parts = urllib.parse.urlsplit(target)
-                backend_path = parts.path + (
-                    "?" + parts.query if parts.query else ""
-                )
-                if self._is_upgrade():
-                    self._tunnel(route, parts.hostname, parts.port,
-                                 backend_path)
-                    return
-                self._proxy_http(route, parts.hostname, parts.port,
-                                 backend_path, service)
-
-            def _pick_backend(self, route, exclude: str | None = None
-                              ) -> str:
-                """Choose a backend with ejected upstreams filtered out of
-                the pick set (weighted draws AND bandit arms); ``exclude``
-                additionally drops the backend a retry just failed on."""
-                if not route.backends:
-                    return route.service  # nowhere else to go
-                services = gw.health.filter_healthy(
-                    [b[0] for b in route.backends]
-                )
-                if exclude and len(services) > 1:
-                    services = [s for s in services if s != exclude]
-                if route.strategy == "epsilon-greedy":
-                    picked = gw.bandit.pick(route, gw.rng, services)
-                else:
-                    weights = {b[0]: b[1] for b in route.backends}
-                    draw = [weights[s] for s in services]
-                    if not any(draw):  # only zero-weight backends left
-                        draw = [1.0] * len(services)
-                    picked = gw.rng.choices(services, weights=draw)[0]
-                # Consume the half-open trial only on the backend that
-                # actually takes the request.
-                gw.health.begin_trial(picked)
-                return picked
-
-            def _is_upgrade(self) -> bool:
-                conn_tokens = [
-                    t.strip().lower()
-                    for t in self.headers.get("Connection", "").split(",")
-                ]
-                return ("upgrade" in conn_tokens
-                        and bool(self.headers.get("Upgrade")))
-
-            # -- plain HTTP: streamed relay -----------------------------
-
-            def _proxy_http(self, route, host, port, path, service=None,
-                            is_retry=False):
-                # On a retry the request body stream is already consumed —
-                # only bodyless idempotent methods reach here retrying.
-                length = (0 if is_retry
-                          else int(self.headers.get("Content-Length", 0)))
-                body = self.rfile.read(length) if length else None
-                # Forwarded prefix and authenticated identity are
-                # gateway-asserted — client-supplied copies must never
-                # reach the backend (spoofing).
-                headers = {
-                    k: v for k, v in self.headers.items()
-                    if k.lower() not in _HOP_HEADERS
-                    and k.lower() not in ("x-forwarded-prefix",
-                                          "x-auth-identity")
-                }
-                headers["X-Forwarded-Prefix"] = route.prefix
-                if getattr(self, "_identity", None):
-                    # The x-goog-authenticated-user-email analogue.
-                    headers["X-Auth-Identity"] = self._identity
-                if route.shadow and not is_retry:
-                    self._mirror(route, path, body, dict(headers))
-                tag_headers = {}
-                if route.outlier_threshold > 0 and not is_retry:
-                    value = OutlierStats.feature(body)
-                    if value is not None:
-                        z, is_out = gw.outliers.score(
-                            route.name, value,
-                            window=route.outlier_window,
-                            threshold=route.outlier_threshold,
-                        )
-                        tag_headers = {
-                            "X-Outlier": "true" if is_out else "false",
-                            "X-Outlier-Score": str(z),
-                        }
-                bandit = (route.strategy == "epsilon-greedy"
-                          and service is not None)
-                conn = HTTPConnection(host, port,
-                                      timeout=gw.upstream_timeout)
-                try:
-                    try:
-                        self._connect_upstream(conn)
-                        conn.request(self.command, path, body=body,
-                                     headers=headers)
-                        resp = conn.getresponse()
-                    except OSError as e:
-                        if bandit:
-                            gw.bandit.record(route.name, service, 0.0)
-                        if service is not None:
-                            gw.health.record_failure(service)
-                        # Idempotent-GET retry: one shot at a DIFFERENT
-                        # healthy backend, under the retry budget (a
-                        # connect failure never duplicated a request).
-                        if (self.command in ("GET", "HEAD")
-                                and not is_retry
-                                and route.backends
-                                and service is not None
-                                and gw._retry_allowed()):
-                            retry_to = self._pick_backend(
-                                route, exclude=service)
-                            if retry_to != service:
-                                gw.retries_total += 1
-                                r_target = route.target_for(
-                                    self.path, retry_to)
-                                r_target = r_target.replace(
-                                    retry_to, gw.resolve(retry_to), 1)
-                                p = urllib.parse.urlsplit(r_target)
-                                self._proxy_http(
-                                    route, p.hostname, p.port,
-                                    p.path + ("?" + p.query
-                                              if p.query else ""),
-                                    retry_to, is_retry=True,
-                                )
-                                return
-                        gw.errors_total += 1
-                        self._respond(
-                            502,
-                            json.dumps(
-                                {"error": f"upstream {host}:{port}: {e}"}
-                            ).encode(),
-                        )
-                        return
-                    if bandit:
-                        # Implicit reward: server errors are failures.
-                        gw.bandit.record(route.name, service,
-                                         0.0 if resp.status >= 500 else 1.0)
-                    if service is not None:
-                        # Passive health observation: 5xx counts against
-                        # the upstream; anything else closes its circuit.
-                        if resp.status >= 500:
-                            gw.health.record_failure(service)
-                        else:
-                            gw.health.record_success(service)
-                    self._relay_response(resp, tag_headers)
-                finally:
-                    conn.close()
-
-            def _mirror(self, route, path, body, headers):
-                """Fire-and-forget request mirror (seldon shadow/outlier
-                surface): the shadow backend sees live traffic, its
-                response is discarded, its failures never touch the
-                client."""
-                addr = gw.resolve(route.shadow)
-                host, _, port_s = addr.partition(":")
-                method = self.command
-                headers["X-Shadow"] = "true"
-
-                def send():
-                    gw.shadow_total += 1
-                    try:
-                        conn = HTTPConnection(
-                            host, int(port_s or 80),
-                            timeout=gw.upstream_timeout,
-                        )
-                        conn.request(method, path, body=body,
-                                     headers=headers)
-                        conn.getresponse().read()
-                        conn.close()
-                    except (OSError, ValueError):
-                        pass
-
-                threading.Thread(target=send, daemon=True).start()
-
-            def _connect_upstream(self, conn):
-                """Connect with one retry — connect-phase only, so an
-                in-flight request is never duplicated against a slow but
-                alive upstream (ksonnet.go:147-168's retry role at the
-                connection level)."""
-                try:
-                    conn.connect()
-                except OSError:
-                    conn.close()
-                    time.sleep(0.1)
-                    conn.connect()
-
-            def _relay_response(self, resp, extra_headers=None):
-                try:
-                    self.send_response(resp.status)
-                    for k, v in resp.getheaders():
-                        if k.lower() not in _HOP_HEADERS:
-                            self.send_header(k, v)
-                    for k, v in (extra_headers or {}).items():
-                        self.send_header(k, v)
-                    upstream_len = resp.getheader("Content-Length")
-                    bodyless = (self.command == "HEAD"
-                                or resp.status in (204, 304)
-                                or 100 <= resp.status < 200)
-                    if bodyless or upstream_len is not None:
-                        if upstream_len is not None:
-                            self.send_header("Content-Length", upstream_len)
-                        self.end_headers()
-                        if not bodyless:
-                            self._relay_known_length(resp,
-                                                     int(upstream_len))
-                    else:
-                        self._relay_stream(resp)
-                    self.wfile.flush()
-                except OSError:
-                    # Mid-stream failure: the status line is already gone;
-                    # drop the connection rather than corrupt the body.
-                    gw.errors_total += 1
-                    self.close_connection = True
-
-            def _relay_known_length(self, resp, remaining: int) -> None:
-                while remaining > 0:
-                    data = resp.read(min(65536, remaining))
-                    if not data:
-                        # Upstream died short of its advertised length;
-                        # the client was promised more bytes — drop the
-                        # connection so it can't desync on a reuse.
-                        gw.errors_total += 1
-                        self.close_connection = True
-                        return
-                    self.wfile.write(data)
-                    remaining -= len(data)
-
-            def _relay_stream(self, resp) -> None:
-                """Unknown upstream length (chunked/EOF-delimited):
-                re-chunk and flush as data arrives so streaming bodies
-                (SSE, token streams) are never buffered. HTTP/1.0 clients
-                can't parse chunked — stream raw and close."""
-                chunked = self.request_version != "HTTP/1.0"
-                if chunked:
-                    self.send_header("Transfer-Encoding", "chunked")
-                else:
-                    self.close_connection = True
-                self.end_headers()
-                while True:
-                    data = resp.read1(65536)
-                    if not data:
-                        break
-                    if chunked:
-                        self.wfile.write(
-                            f"{len(data):x}\r\n".encode() + data + b"\r\n"
-                        )
-                    else:
-                        self.wfile.write(data)
-                    self.wfile.flush()
-                if chunked:
-                    self.wfile.write(b"0\r\n\r\n")
-
-            # -- HTTP/1.1 Upgrade: transparent TCP tunnel ---------------
-
-            def _tunnel(self, route, host, port, path):
-                """Forward the Upgrade handshake verbatim and then pump
-                bytes both ways — the websocket path notebooks need
-                (jupyter.libsonnet:97-106). The gateway never parses
-                frames; after the handshake it is a plain TCP relay, so
-                the backend's 101 (or its refusal) reaches the client
-                unmodified."""
-                try:
-                    backend = socket.create_connection(
-                        (host, port), timeout=gw.upstream_timeout
-                    )
-                except OSError as e:
-                    gw.errors_total += 1
-                    self._respond(
-                        502,
-                        json.dumps(
-                            {"error": f"upstream {host}:{port}: {e}"}
-                        ).encode(),
-                    )
-                    return
-                gw.tunnels_total += 1
-                lines = [f"{self.command} {path} HTTP/1.1",
-                         f"Host: {host}:{port}",
-                         f"X-Forwarded-Prefix: {route.prefix}"]
-                if getattr(self, "_identity", None):
-                    lines.append(f"X-Auth-Identity: {self._identity}")
-                # Hop-by-hop headers are the handshake here — forward
-                # everything except Host (rewritten above) and the
-                # gateway-asserted headers (spoofing).
-                lines += [
-                    f"{k}: {v}" for k, v in self.headers.items()
-                    if k.lower() not in ("host", "x-forwarded-prefix",
-                                         "x-auth-identity")
-                ]
-                try:
-                    backend.sendall(
-                        ("\r\n".join(lines) + "\r\n\r\n").encode()
-                    )
-                    # Tunnel sockets outlive the 60s request timeout.
-                    backend.settimeout(None)
-                    self.connection.settimeout(None)
-                    done = threading.Event()
-
-                    def pump(read, write):
-                        try:
-                            while True:
-                                data = read(65536)
-                                if not data:
-                                    break
-                                write(data)
-                        except (OSError, ValueError):
-                            pass
-                        finally:
-                            done.set()
-
-                    def write_client(data):
-                        self.wfile.write(data)
-                        self.wfile.flush()
-
-                    for read, write in (
-                        (self.rfile.read1, backend.sendall),
-                        (backend.recv, write_client),
-                    ):
-                        threading.Thread(target=pump, args=(read, write),
-                                         daemon=True).start()
-                    # First direction to close ends the tunnel; the
-                    # shutdown below unblocks the other pump.
-                    done.wait()
-                finally:
-                    for s in (backend, self.connection):
-                        try:
-                            s.shutdown(socket.SHUT_RDWR)
-                        except OSError:
-                            pass
-                    backend.close()
-                    self.close_connection = True
-
-            do_GET = do_POST = do_PUT = do_PATCH = do_DELETE = _handle
-            do_HEAD = do_OPTIONS = _handle
-
-        return Handler
-
-    def _make_admin_handler(gw: "Gateway"):
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *a):
-                pass
-
-            def do_GET(self):
-                if self.path == "/routes":
-                    routes = gw.table.snapshot()
-                    for r in routes:
-                        if r.get("strategy") == "epsilon-greedy":
-                            r["bandit"] = gw.bandit.snapshot(r["name"])
-                        if r.get("outlier_threshold"):
-                            r["outliers"] = gw.outliers.snapshot(r["name"])
-                    body = json.dumps(routes).encode()
-                    ctype = "application/json"
-                elif self.path == "/upstreams":
-                    # Upstream health + circuit state, per backend (the
-                    # envoy clusters/outlier admin surface).
-                    body = json.dumps(gw.health.snapshot()).encode()
-                    ctype = "application/json"
-                elif self.path == "/metrics":
-                    body = (
-                        "# TYPE gateway_requests_total counter\n"
-                        f"gateway_requests_total {gw.requests_total}\n"
-                        "# TYPE gateway_errors_total counter\n"
-                        f"gateway_errors_total {gw.errors_total}\n"
-                        "# TYPE gateway_upgrade_tunnels_total counter\n"
-                        f"gateway_upgrade_tunnels_total {gw.tunnels_total}\n"
-                        "# TYPE gateway_shadow_requests_total counter\n"
-                        f"gateway_shadow_requests_total {gw.shadow_total}\n"
-                        "# TYPE gateway_retries_total counter\n"
-                        f"gateway_retries_total {gw.retries_total}\n"
-                        "# TYPE gateway_outliers_total counter\n"
-                        f"gateway_outliers_total {gw.outliers.totals()[0]}\n"
-                        "# TYPE gateway_outlier_scored_total counter\n"
-                        "gateway_outlier_scored_total "
-                        f"{gw.outliers.totals()[1]}\n"
-                        "# TYPE gateway_jwt_verified_total counter\n"
-                        "gateway_jwt_verified_total "
-                        f"{getattr(gw.jwt_verifier, 'verified_total', 0)}\n"
-                        "# TYPE gateway_jwt_rejected_total counter\n"
-                        "gateway_jwt_rejected_total "
-                        f"{getattr(gw.jwt_verifier, 'rejected_total', 0)}\n"
-                    ).encode()
-                    ctype = "text/plain"
-                elif self.path in ("/healthz", "/readyz"):
-                    body, ctype = b'{"status":"ok"}', "application/json"
-                else:
-                    self.send_response(404)
-                    self.end_headers()
-                    return
-                self.send_response(200)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-            def do_POST(self):
-                """POST /routes/<name>/feedback {"service", "reward"} —
-                the seldon /send-feedback analogue: callers grade a
-                variant's answer (0..1) after the fact, steering the
-                epsilon-greedy router beyond what status codes reveal."""
-                parts = self.path.strip("/").split("/")
-                if (len(parts) != 3 or parts[0] != "routes"
-                        or parts[2] != "feedback"):
-                    self.send_response(404)
-                    self.end_headers()
-                    return
-                route = gw.table.find(parts[1])
-                if route is None:
-                    body = json.dumps(
-                        {"error": f"no route {parts[1]!r}"}).encode()
-                    self.send_response(404)
-                    self.send_header("Content-Type", "application/json")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
-                    return
-                try:
-                    length = int(self.headers.get("Content-Length", 0))
-                    payload = json.loads(self.rfile.read(length))
-                    service = payload["service"]
-                    reward = float(payload["reward"])
-                    if not 0.0 <= reward <= 1.0:
-                        raise ValueError("reward must be in [0, 1]")
-                    # Only the route's real variants are gradeable — a
-                    # typo'd service must not 200-and-steer-nothing, and
-                    # validation bounds the stats table to routes×backends.
-                    variants = {b[0] for b in route.backends}
-                    if service not in variants:
-                        raise ValueError(
-                            f"service {service!r} is not a variant of "
-                            f"route {parts[1]!r}")
-                except (ValueError, KeyError, TypeError) as e:
-                    body = json.dumps({"error": str(e)}).encode()
-                    self.send_response(400)
-                    self.send_header("Content-Type", "application/json")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
-                    return
-                gw.bandit.record(parts[1], service, reward)
-                body = json.dumps(
-                    {"ok": True,
-                     "stats": gw.bandit.snapshot(parts[1])}).encode()
-                self.send_response(200)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-        return Handler
-
     def _probe_upstreams(self) -> None:
         """Active prober loop: every route backend (split variants AND
         single-backend services) gets a liveness probe per interval, so
@@ -1202,7 +236,7 @@ class Gateway:
 
     def start(self) -> None:
         self._proxy = ThreadingHTTPServer(
-            ("0.0.0.0", self.port), self._make_proxy_handler()
+            ("0.0.0.0", self.port), make_proxy_handler(self)
         )
         self.port = self._proxy.server_address[1]  # resolve port 0
         if self.certfile:
@@ -1229,7 +263,7 @@ class Gateway:
                              daemon=True).start()
         if self.admin_port:
             self._admin = ThreadingHTTPServer(
-                ("0.0.0.0", self.admin_port), self._make_admin_handler()
+                ("0.0.0.0", self.admin_port), make_admin_handler(self)
             )
             threading.Thread(target=self._admin.serve_forever,
                              daemon=True).start()

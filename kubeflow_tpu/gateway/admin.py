@@ -1,0 +1,124 @@
+"""The admin/ops surface: /routes, /upstreams, /metrics, and the seldon
+send-feedback analogue (POST /routes/<name>/feedback) steering
+epsilon-greedy routes.
+"""
+
+from __future__ import annotations
+
+import json
+from http.server import BaseHTTPRequestHandler
+
+
+def make_admin_handler(gw):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            if self.path == "/routes":
+                routes = gw.table.snapshot()
+                for r in routes:
+                    if r.get("strategy") == "epsilon-greedy":
+                        r["bandit"] = gw.bandit.snapshot(r["name"])
+                    if r.get("outlier_threshold"):
+                        r["outliers"] = gw.outliers.snapshot(r["name"])
+                body = json.dumps(routes).encode()
+                ctype = "application/json"
+            elif self.path == "/upstreams":
+                # Upstream health + circuit state, per backend (the
+                # envoy clusters/outlier admin surface).
+                body = json.dumps(gw.health.snapshot()).encode()
+                ctype = "application/json"
+            elif self.path == "/metrics":
+                body = (
+                    "# TYPE gateway_requests_total counter\n"
+                    f"gateway_requests_total {gw.requests_total}\n"
+                    "# TYPE gateway_errors_total counter\n"
+                    f"gateway_errors_total {gw.errors_total}\n"
+                    "# TYPE gateway_upgrade_tunnels_total counter\n"
+                    f"gateway_upgrade_tunnels_total {gw.tunnels_total}\n"
+                    "# TYPE gateway_shadow_requests_total counter\n"
+                    f"gateway_shadow_requests_total {gw.shadow_total}\n"
+                    "# TYPE gateway_retries_total counter\n"
+                    f"gateway_retries_total {gw.retries_total}\n"
+                    "# TYPE gateway_outliers_total counter\n"
+                    f"gateway_outliers_total {gw.outliers.totals()[0]}\n"
+                    "# TYPE gateway_outlier_scored_total counter\n"
+                    "gateway_outlier_scored_total "
+                    f"{gw.outliers.totals()[1]}\n"
+                    "# TYPE gateway_jwt_verified_total counter\n"
+                    "gateway_jwt_verified_total "
+                    f"{getattr(gw.jwt_verifier, 'verified_total', 0)}\n"
+                    "# TYPE gateway_jwt_rejected_total counter\n"
+                    "gateway_jwt_rejected_total "
+                    f"{getattr(gw.jwt_verifier, 'rejected_total', 0)}\n"
+                ).encode()
+                ctype = "text/plain"
+            elif self.path in ("/healthz", "/readyz"):
+                body, ctype = b'{"status":"ok"}', "application/json"
+            else:
+                self.send_response(404)
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):
+            """POST /routes/<name>/feedback {"service", "reward"} —
+            the seldon /send-feedback analogue: callers grade a
+            variant's answer (0..1) after the fact, steering the
+            epsilon-greedy router beyond what status codes reveal."""
+            parts = self.path.strip("/").split("/")
+            if (len(parts) != 3 or parts[0] != "routes"
+                    or parts[2] != "feedback"):
+                self.send_response(404)
+                self.end_headers()
+                return
+            route = gw.table.find(parts[1])
+            if route is None:
+                body = json.dumps(
+                    {"error": f"no route {parts[1]!r}"}).encode()
+                self.send_response(404)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(length))
+                service = payload["service"]
+                reward = float(payload["reward"])
+                if not 0.0 <= reward <= 1.0:
+                    raise ValueError("reward must be in [0, 1]")
+                # Only the route's real variants are gradeable — a
+                # typo'd service must not 200-and-steer-nothing, and
+                # validation bounds the stats table to routes×backends.
+                variants = {b[0] for b in route.backends}
+                if service not in variants:
+                    raise ValueError(
+                        f"service {service!r} is not a variant of "
+                        f"route {parts[1]!r}")
+            except (ValueError, KeyError, TypeError) as e:
+                body = json.dumps({"error": str(e)}).encode()
+                self.send_response(400)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            gw.bandit.record(parts[1], service, reward)
+            body = json.dumps(
+                {"ok": True,
+                 "stats": gw.bandit.snapshot(parts[1])}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    return Handler
+

@@ -1,0 +1,449 @@
+"""The data-path request handler: routing, auth enforcement, backend
+picks, streamed relay, retries, shadow mirroring, and upgrade tunnels.
+
+Factored from the gateway module so each concern stays reviewable; the
+behavior contract is the 15-test gateway E2E suite, unchanged across the
+split. ``make_proxy_handler(gw)`` builds the BaseHTTPRequestHandler class
+bound to one :class:`kubeflow_tpu.gateway.Gateway`. Streamed relay
+(chunked re-encoding, SSE-safe flushing) and the HTTP/1.1 Upgrade TCP
+tunnel live on the handler itself.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+import urllib.parse
+from http.client import HTTPConnection
+from http.server import BaseHTTPRequestHandler
+
+from kubeflow_tpu.gateway.resilience import OutlierStats
+
+# Hop-by-hop headers never forwarded (RFC 7230 §6.1).
+_HOP_HEADERS = {
+    "connection", "keep-alive", "proxy-authenticate",
+    "proxy-authorization", "te", "trailers", "transfer-encoding", "upgrade",
+    "host", "content-length",
+}
+
+
+def make_proxy_handler(gw):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def _respond(self, code: int, body: bytes,
+                     headers: dict | None = None) -> None:
+            self.send_response(code)
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            if headers is None or "Content-Type" not in headers:
+                self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            if self.command != "HEAD":  # RFC 7231: HEAD has no body
+                self.wfile.write(body)
+
+        def _handle(self):
+            gw.requests_total += 1
+            if self.path == "/healthz":
+                self._respond(200, b'{"status":"ok"}')
+                return
+            if self.path.startswith("/.well-known/acme-challenge/"):
+                token = self.path.rsplit("/", 1)[1]
+                body = (gw.challenge_lookup(token)
+                        if gw.challenge_lookup else None)
+                if body is None:
+                    self._respond(404, b'{"error":"unknown challenge"}')
+                else:
+                    self._respond(200, body.encode(),
+                                  {"Content-Type": "text/plain"})
+                return
+            route = gw.table.match(self.path)
+            if route is None:
+                gw.errors_total += 1
+                self._respond(
+                    404,
+                    json.dumps({"error": f"no route for {self.path}"})
+                    .encode(),
+                )
+                return
+            self._identity = None
+            if route.jwt == "required" and gw.jwt_verifier is None:
+                # Fail CLOSED: an operator demanded token checks on
+                # this route but the gateway has no verifier — a
+                # misconfiguration must not silently serve open.
+                gw.errors_total += 1
+                self._respond(503, json.dumps(
+                    {"error": "route requires jwt but the gateway "
+                              "has no verifier configured"}).encode())
+                return
+            if gw.jwt_verifier is not None and route.jwt != "off":
+                claims, reason = gw.jwt_verifier.check(
+                    self.command, self.path, self.headers
+                )
+                if claims is None:
+                    # Browser sessions may still pass through
+                    # forward-auth when it is configured (IAP serves
+                    # both logins and SA id-tokens) — unless the
+                    # route pins jwt: "required", which accepts
+                    # nothing but a valid bearer token.
+                    session_ok = (route.jwt != "required"
+                                  and gw.auth_url
+                                  and gw._authorized(self))
+                    if not session_ok:
+                        self._respond(401, json.dumps(
+                            {"error": "unauthorized", "reason": reason}
+                        ).encode(), {
+                            "WWW-Authenticate":
+                                f'Bearer error="{reason}"',
+                            "Content-Type": "application/json",
+                        })
+                        return
+                elif claims.get("sub"):
+                    self._identity = str(claims["sub"])
+            elif not gw._authorized(self):
+                self._respond(
+                    401, json.dumps({"error": "unauthorized",
+                                     "login": "/login"}).encode(),
+                )
+                return
+            service = self._pick_backend(route)
+            target = route.target_for(self.path, service)
+            # Re-point at the resolved backend address.
+            target = target.replace(service, gw.resolve(service), 1)
+            parts = urllib.parse.urlsplit(target)
+            backend_path = parts.path + (
+                "?" + parts.query if parts.query else ""
+            )
+            if self._is_upgrade():
+                self._tunnel(route, parts.hostname, parts.port,
+                             backend_path)
+                return
+            self._proxy_http(route, parts.hostname, parts.port,
+                             backend_path, service)
+
+        def _pick_backend(self, route, exclude: str | None = None
+                          ) -> str:
+            """Choose a backend with ejected upstreams filtered out of
+            the pick set (weighted draws AND bandit arms); ``exclude``
+            additionally drops the backend a retry just failed on."""
+            if not route.backends:
+                return route.service  # nowhere else to go
+            services = gw.health.filter_healthy(
+                [b[0] for b in route.backends]
+            )
+            if exclude and len(services) > 1:
+                services = [s for s in services if s != exclude]
+            if route.strategy == "epsilon-greedy":
+                picked = gw.bandit.pick(route, gw.rng, services)
+            else:
+                weights = {b[0]: b[1] for b in route.backends}
+                draw = [weights[s] for s in services]
+                if not any(draw):  # only zero-weight backends left
+                    draw = [1.0] * len(services)
+                picked = gw.rng.choices(services, weights=draw)[0]
+            # Consume the half-open trial only on the backend that
+            # actually takes the request.
+            gw.health.begin_trial(picked)
+            return picked
+
+        def _is_upgrade(self) -> bool:
+            conn_tokens = [
+                t.strip().lower()
+                for t in self.headers.get("Connection", "").split(",")
+            ]
+            return ("upgrade" in conn_tokens
+                    and bool(self.headers.get("Upgrade")))
+
+        # -- plain HTTP: streamed relay -----------------------------
+
+        def _proxy_http(self, route, host, port, path, service=None,
+                        is_retry=False):
+            # On a retry the request body stream is already consumed —
+            # only bodyless idempotent methods reach here retrying.
+            length = (0 if is_retry
+                      else int(self.headers.get("Content-Length", 0)))
+            body = self.rfile.read(length) if length else None
+            # Forwarded prefix and authenticated identity are
+            # gateway-asserted — client-supplied copies must never
+            # reach the backend (spoofing).
+            headers = {
+                k: v for k, v in self.headers.items()
+                if k.lower() not in _HOP_HEADERS
+                and k.lower() not in ("x-forwarded-prefix",
+                                      "x-auth-identity")
+            }
+            headers["X-Forwarded-Prefix"] = route.prefix
+            if getattr(self, "_identity", None):
+                # The x-goog-authenticated-user-email analogue.
+                headers["X-Auth-Identity"] = self._identity
+            if route.shadow and not is_retry:
+                self._mirror(route, path, body, dict(headers))
+            tag_headers = {}
+            if route.outlier_threshold > 0 and not is_retry:
+                value = OutlierStats.feature(body)
+                if value is not None:
+                    z, is_out = gw.outliers.score(
+                        route.name, value,
+                        window=route.outlier_window,
+                        threshold=route.outlier_threshold,
+                    )
+                    tag_headers = {
+                        "X-Outlier": "true" if is_out else "false",
+                        "X-Outlier-Score": str(z),
+                    }
+            bandit = (route.strategy == "epsilon-greedy"
+                      and service is not None)
+            conn = HTTPConnection(host, port,
+                                  timeout=gw.upstream_timeout)
+            try:
+                try:
+                    self._connect_upstream(conn)
+                    conn.request(self.command, path, body=body,
+                                 headers=headers)
+                    resp = conn.getresponse()
+                except OSError as e:
+                    if bandit:
+                        gw.bandit.record(route.name, service, 0.0)
+                    if service is not None:
+                        gw.health.record_failure(service)
+                    # Idempotent-GET retry: one shot at a DIFFERENT
+                    # healthy backend, under the retry budget (a
+                    # connect failure never duplicated a request).
+                    if (self.command in ("GET", "HEAD")
+                            and not is_retry
+                            and route.backends
+                            and service is not None
+                            and gw._retry_allowed()):
+                        retry_to = self._pick_backend(
+                            route, exclude=service)
+                        if retry_to != service:
+                            gw.retries_total += 1
+                            r_target = route.target_for(
+                                self.path, retry_to)
+                            r_target = r_target.replace(
+                                retry_to, gw.resolve(retry_to), 1)
+                            p = urllib.parse.urlsplit(r_target)
+                            self._proxy_http(
+                                route, p.hostname, p.port,
+                                p.path + ("?" + p.query
+                                          if p.query else ""),
+                                retry_to, is_retry=True,
+                            )
+                            return
+                    gw.errors_total += 1
+                    self._respond(
+                        502,
+                        json.dumps(
+                            {"error": f"upstream {host}:{port}: {e}"}
+                        ).encode(),
+                    )
+                    return
+                if bandit:
+                    # Implicit reward: server errors are failures.
+                    gw.bandit.record(route.name, service,
+                                     0.0 if resp.status >= 500 else 1.0)
+                if service is not None:
+                    # Passive health observation: 5xx counts against
+                    # the upstream; anything else closes its circuit.
+                    if resp.status >= 500:
+                        gw.health.record_failure(service)
+                    else:
+                        gw.health.record_success(service)
+                self._relay_response(resp, tag_headers)
+            finally:
+                conn.close()
+
+        def _mirror(self, route, path, body, headers):
+            """Fire-and-forget request mirror (seldon shadow/outlier
+            surface): the shadow backend sees live traffic, its
+            response is discarded, its failures never touch the
+            client."""
+            addr = gw.resolve(route.shadow)
+            host, _, port_s = addr.partition(":")
+            method = self.command
+            headers["X-Shadow"] = "true"
+
+            def send():
+                gw.shadow_total += 1
+                try:
+                    conn = HTTPConnection(
+                        host, int(port_s or 80),
+                        timeout=gw.upstream_timeout,
+                    )
+                    conn.request(method, path, body=body,
+                                 headers=headers)
+                    conn.getresponse().read()
+                    conn.close()
+                except (OSError, ValueError):
+                    pass
+
+            threading.Thread(target=send, daemon=True).start()
+
+        def _connect_upstream(self, conn):
+            """Connect with one retry — connect-phase only, so an
+            in-flight request is never duplicated against a slow but
+            alive upstream (ksonnet.go:147-168's retry role at the
+            connection level)."""
+            try:
+                conn.connect()
+            except OSError:
+                conn.close()
+                time.sleep(0.1)
+                conn.connect()
+
+        def _relay_response(self, resp, extra_headers=None):
+            try:
+                self.send_response(resp.status)
+                for k, v in resp.getheaders():
+                    if k.lower() not in _HOP_HEADERS:
+                        self.send_header(k, v)
+                for k, v in (extra_headers or {}).items():
+                    self.send_header(k, v)
+                upstream_len = resp.getheader("Content-Length")
+                bodyless = (self.command == "HEAD"
+                            or resp.status in (204, 304)
+                            or 100 <= resp.status < 200)
+                if bodyless or upstream_len is not None:
+                    if upstream_len is not None:
+                        self.send_header("Content-Length", upstream_len)
+                    self.end_headers()
+                    if not bodyless:
+                        self._relay_known_length(resp,
+                                                 int(upstream_len))
+                else:
+                    self._relay_stream(resp)
+                self.wfile.flush()
+            except OSError:
+                # Mid-stream failure: the status line is already gone;
+                # drop the connection rather than corrupt the body.
+                gw.errors_total += 1
+                self.close_connection = True
+
+        def _relay_known_length(self, resp, remaining: int) -> None:
+            while remaining > 0:
+                data = resp.read(min(65536, remaining))
+                if not data:
+                    # Upstream died short of its advertised length;
+                    # the client was promised more bytes — drop the
+                    # connection so it can't desync on a reuse.
+                    gw.errors_total += 1
+                    self.close_connection = True
+                    return
+                self.wfile.write(data)
+                remaining -= len(data)
+
+        def _relay_stream(self, resp) -> None:
+            """Unknown upstream length (chunked/EOF-delimited):
+            re-chunk and flush as data arrives so streaming bodies
+            (SSE, token streams) are never buffered. HTTP/1.0 clients
+            can't parse chunked — stream raw and close."""
+            chunked = self.request_version != "HTTP/1.0"
+            if chunked:
+                self.send_header("Transfer-Encoding", "chunked")
+            else:
+                self.close_connection = True
+            self.end_headers()
+            while True:
+                data = resp.read1(65536)
+                if not data:
+                    break
+                if chunked:
+                    self.wfile.write(
+                        f"{len(data):x}\r\n".encode() + data + b"\r\n"
+                    )
+                else:
+                    self.wfile.write(data)
+                self.wfile.flush()
+            if chunked:
+                self.wfile.write(b"0\r\n\r\n")
+
+        # -- HTTP/1.1 Upgrade: transparent TCP tunnel ---------------
+
+        def _tunnel(self, route, host, port, path):
+            """Forward the Upgrade handshake verbatim and then pump
+            bytes both ways — the websocket path notebooks need
+            (jupyter.libsonnet:97-106). The gateway never parses
+            frames; after the handshake it is a plain TCP relay, so
+            the backend's 101 (or its refusal) reaches the client
+            unmodified."""
+            try:
+                backend = socket.create_connection(
+                    (host, port), timeout=gw.upstream_timeout
+                )
+            except OSError as e:
+                gw.errors_total += 1
+                self._respond(
+                    502,
+                    json.dumps(
+                        {"error": f"upstream {host}:{port}: {e}"}
+                    ).encode(),
+                )
+                return
+            gw.tunnels_total += 1
+            lines = [f"{self.command} {path} HTTP/1.1",
+                     f"Host: {host}:{port}",
+                     f"X-Forwarded-Prefix: {route.prefix}"]
+            if getattr(self, "_identity", None):
+                lines.append(f"X-Auth-Identity: {self._identity}")
+            # Hop-by-hop headers are the handshake here — forward
+            # everything except Host (rewritten above) and the
+            # gateway-asserted headers (spoofing).
+            lines += [
+                f"{k}: {v}" for k, v in self.headers.items()
+                if k.lower() not in ("host", "x-forwarded-prefix",
+                                     "x-auth-identity")
+            ]
+            try:
+                backend.sendall(
+                    ("\r\n".join(lines) + "\r\n\r\n").encode()
+                )
+                # Tunnel sockets outlive the 60s request timeout.
+                backend.settimeout(None)
+                self.connection.settimeout(None)
+                done = threading.Event()
+
+                def pump(read, write):
+                    try:
+                        while True:
+                            data = read(65536)
+                            if not data:
+                                break
+                            write(data)
+                    except (OSError, ValueError):
+                        pass
+                    finally:
+                        done.set()
+
+                def write_client(data):
+                    self.wfile.write(data)
+                    self.wfile.flush()
+
+                for read, write in (
+                    (self.rfile.read1, backend.sendall),
+                    (backend.recv, write_client),
+                ):
+                    threading.Thread(target=pump, args=(read, write),
+                                     daemon=True).start()
+                # First direction to close ends the tunnel; the
+                # shutdown below unblocks the other pump.
+                done.wait()
+            finally:
+                for s in (backend, self.connection):
+                    try:
+                        s.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                backend.close()
+                self.close_connection = True
+
+        do_GET = do_POST = do_PUT = do_PATCH = do_DELETE = _handle
+        do_HEAD = do_OPTIONS = _handle
+
+    return Handler
+

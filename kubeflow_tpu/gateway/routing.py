@@ -1,0 +1,177 @@
+"""Route model + annotation-discovered route table.
+
+The ambassador mapping layer (kubeflow/common/ambassador.libsonnet:7-226):
+every platform Service that wants routing carries a
+`kubeflow-tpu.org/gateway-route` annotation (the `getambassador.io/config`
+pattern — route spec {name, prefix, service, rewrite}); the gateway
+watches Services and keeps a longest-prefix route table.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass
+
+import yaml
+
+from kubeflow_tpu.k8s.client import K8sClient
+from kubeflow_tpu.manifests.core import GATEWAY_ROUTE_ANNOTATION
+
+log = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class Route:
+    name: str
+    prefix: str
+    service: str  # host:port (the primary backend)
+    rewrite: str = "/"
+    # Traffic splitting (the seldon abtest/mab/canary surface,
+    # /root/reference/kubeflow/seldon/prototypes, core.libsonnet:305):
+    # weighted variants — each request is routed to one backend drawn by
+    # weight. Empty = all traffic to `service`.
+    backends: tuple = ()  # ((host:port, weight), ...)
+    # "weighted": static draw by weight. "epsilon-greedy": the seldon
+    # multi-armed-bandit router (epsilon-greedy prototype) — explore a
+    # random variant with probability epsilon, otherwise exploit the
+    # best observed reward; rewards come from response status (5xx/
+    # connect-fail = 0) or the admin feedback endpoint.
+    strategy: str = "weighted"
+    epsilon: float = 0.1
+    # Shadow/mirror target: every request is also sent fire-and-forget to
+    # this backend; its response is discarded and its failures invisible.
+    shadow: str = ""
+    # Outlier detection (seldon outlier-detector-v1alpha2 surface): score
+    # each prediction request's feature against a running window;
+    # |z| > threshold tags the response and counts into the outlier rate.
+    # 0 disables.
+    outlier_threshold: float = 0.0
+    outlier_window: int = 100
+    # Identity-token policy for this route: "" = the gateway default
+    # (verify when a JwtVerifier is configured), "off" = this route is
+    # exempt (the per-route face of iap.libsonnet:600's bypass_jwt),
+    # "required" = bearer token only, no session fallback.
+    jwt: str = ""
+
+    def pick_service(self, rng) -> str:
+        if not self.backends:
+            return self.service
+        services = [b[0] for b in self.backends]
+        weights = [b[1] for b in self.backends]
+        return rng.choices(services, weights=weights)[0]
+
+    def target_for(self, path: str, service: str | None = None) -> str:
+        """Rewrite `path` (which startswith prefix) onto the backend."""
+        rest = path[len(self.prefix):]
+        base = (self.rewrite if self.rewrite.endswith("/")
+                else self.rewrite + "/")
+        return ("http://" + (service or self.service) + base
+                + rest.lstrip("/"))
+
+
+def routes_from_service(svc: dict) -> list[Route]:
+    raw = svc.get("metadata", {}).get("annotations", {}).get(
+        GATEWAY_ROUTE_ANNOTATION
+    )
+    if not raw:
+        return []
+    try:
+        specs = yaml.safe_load(raw)
+    except yaml.YAMLError:
+        log.warning("bad route annotation on %s",
+                    svc["metadata"].get("name"))
+        return []
+    if isinstance(specs, dict):
+        specs = [specs]
+    routes = []
+    for spec in specs or []:
+        try:
+            backends = tuple(
+                (b["service"], float(b.get("weight", 1)))
+                for b in spec.get("backends", [])
+            )
+            if backends and any(w < 0 for _s, w in backends):
+                raise ValueError("negative backend weight")
+            if backends and not any(w > 0 for _s, w in backends):
+                raise ValueError("all backend weights zero")
+            service = spec.get("service") or (
+                backends[0][0] if backends else None
+            )
+            if not service:
+                raise KeyError("service")
+            strategy = spec.get("strategy", "weighted")
+            if strategy not in ("weighted", "epsilon-greedy"):
+                raise ValueError(f"unknown strategy {strategy!r}")
+            epsilon = float(spec.get("epsilon", 0.1))
+            if not 0.0 <= epsilon <= 1.0:
+                raise ValueError("epsilon must be in [0, 1]")
+            outlier = spec.get("outlier", {}) or {}
+            outlier_threshold = float(outlier.get("threshold", 0.0))
+            outlier_window = int(outlier.get("window", 100))
+            if outlier_threshold < 0:
+                raise ValueError("outlier threshold must be >= 0")
+            if outlier_window < 2:
+                raise ValueError("outlier window must be >= 2")
+            jwt = str(spec.get("jwt", ""))
+            if jwt not in ("", "off", "required"):
+                raise ValueError(f"jwt must be 'off' or 'required', "
+                                 f"got {jwt!r}")
+            routes.append(Route(
+                jwt=jwt,
+                name=spec["name"], prefix=spec["prefix"],
+                service=service, rewrite=spec.get("rewrite", "/"),
+                backends=backends, strategy=strategy, epsilon=epsilon,
+                shadow=spec.get("shadow", ""),
+                outlier_threshold=outlier_threshold,
+                outlier_window=outlier_window,
+            ))
+        except (KeyError, TypeError, ValueError) as e:
+            log.warning("bad route spec in %s: %s",
+                        svc["metadata"].get("name"), e)
+    return routes
+
+
+class RouteTable:
+    """Longest-prefix route lookup, refreshed from Service annotations."""
+
+    def __init__(self) -> None:
+        self._routes: list[Route] = []
+        self._lock = threading.Lock()
+
+    def set_routes(self, routes: list[Route]) -> None:
+        with self._lock:
+            # Longest prefix first; on equal prefixes a split/shadow route
+            # beats a plain one (a serving-route canary for a model must
+            # override the model Service's own direct route, not lose the
+            # tie to listing order), then name for determinism.
+            self._routes = sorted(
+                routes,
+                key=lambda r: (-len(r.prefix),
+                               0 if (r.backends or r.shadow) else 1,
+                               r.name),
+            )
+
+    def refresh(self, client: K8sClient, namespace: str | None = None) -> int:
+        routes = []
+        for svc in client.list("v1", "Service", namespace):
+            routes.extend(routes_from_service(svc))
+        self.set_routes(routes)
+        return len(routes)
+
+    def match(self, path: str) -> Route | None:
+        with self._lock:
+            for r in self._routes:
+                if path.startswith(r.prefix):
+                    return r
+        return None
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            # Copies, not the live __dict__ of the frozen Routes — callers
+            # (the admin handler) annotate these per request.
+            return [dict(vars(r)) for r in self._routes]
+
+    def find(self, name: str) -> Route | None:
+        with self._lock:
+            return next((r for r in self._routes if r.name == name), None)

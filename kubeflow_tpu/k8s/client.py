@@ -82,11 +82,30 @@ _BUILTIN_KINDS: dict[str, tuple[str, bool]] = {
 }
 
 
+# kind -> convert(obj, to_api_version) for kinds whose CRD serves multiple
+# versions with DIFFERENT schemas. API packages self-register at import
+# (apis/jobs.py registers the job-kind converter); kinds without an entry
+# convert by apiVersion rewrite alone (the k8s `conversion: None` strategy
+# for identical schemas).
+_CONVERTERS: dict[str, Callable[[dict, str], dict]] = {}
+
+
+def register_converter(kind: str,
+                       fn: Callable[[dict, str], dict]) -> None:
+    _CONVERTERS[kind] = fn
+
+
 class KindRegistry:
-    """Resolves kind → REST plural/scope; extended when CRDs are applied."""
+    """Resolves kind → REST plural/scope and the served/storage version
+    set; extended when CRDs are applied. The storage machinery (the fake
+    apiserver) keys every object at the STORAGE version and converts to
+    whatever served version a reader asks for — the
+    tf-job-operator.libsonnet:52-97 store-v1beta1/serve-v1beta2 model."""
 
     def __init__(self) -> None:
         self._kinds = dict(_BUILTIN_KINDS)
+        # kind -> (group, {version: served}, storage_version)
+        self._versions: dict[str, tuple[str, dict[str, bool], str]] = {}
         self._lock = threading.Lock()
 
     def register_crd(self, crd_obj: Mapping[str, Any]) -> None:
@@ -94,8 +113,47 @@ class KindRegistry:
         kind = spec["names"]["kind"]
         plural = spec["names"]["plural"]
         namespaced = spec.get("scope", "Namespaced") == "Namespaced"
+        group = spec.get("group", "")
+        served: dict[str, bool] = {}
+        storage = ""
+        for v in spec.get("versions", []):
+            served[v["name"]] = bool(v.get("served", True))
+            if v.get("storage"):
+                storage = v["name"]
         with self._lock:
             self._kinds[kind] = (plural, namespaced)
+            if group and storage:
+                self._versions[kind] = (group, served, storage)
+
+    def storage_api_version(self, kind: str) -> str | None:
+        """`group/version` the cluster stores this kind at; None for
+        builtins and single-version kinds registered without a CRD."""
+        with self._lock:
+            info = self._versions.get(kind)
+        return f"{info[0]}/{info[2]}" if info else None
+
+    def served(self, kind: str, api_version: str) -> bool:
+        with self._lock:
+            info = self._versions.get(kind)
+        if info is None:
+            return True  # no version metadata: accept as before
+        group, versions, _storage = info
+        g, _, v = api_version.rpartition("/")
+        return g == group and versions.get(v, False)
+
+    @staticmethod
+    def convert(obj: dict, to_api_version: str) -> dict:
+        """Convert ``obj`` to ``to_api_version`` (deep-copying); identity
+        when already there. Kinds without a registered converter get the
+        apiVersion rewritten (identical-schema versions)."""
+        if obj.get("apiVersion") == to_api_version:
+            return obj
+        fn = _CONVERTERS.get(obj.get("kind", ""))
+        if fn is not None:
+            return fn(obj, to_api_version)
+        out = copy.deepcopy(obj)
+        out["apiVersion"] = to_api_version
+        return out
 
     def plural(self, kind: str) -> str:
         try:

@@ -60,10 +60,23 @@ class FakeApiServer(K8sClient):
     # internals
     # ------------------------------------------------------------------
 
+    def _storage_av(self, api_version: str, kind: str) -> str:
+        """The apiVersion objects of ``kind`` are stored at. A request at
+        a served non-storage version is normalized here (and converted at
+        the read/write boundary); an unserved version is rejected the way
+        a real apiserver 404s it."""
+        storage = self._registry.storage_api_version(kind)
+        if storage is None or api_version == storage:
+            return api_version
+        if not self._registry.served(kind, api_version):
+            raise ApiError.not_found(
+                f"{kind} is not served at {api_version}")
+        return storage
+
     def _key(self, api_version: str, kind: str, namespace: str | None,
              name: str) -> tuple[str, str, str, str]:
         ns = namespace or "" if self._registry.namespaced(kind) else ""
-        return (api_version, kind, ns, name)
+        return (self._storage_av(api_version, kind), kind, ns, name)
 
     def _next_rv(self) -> str:
         self._rv += 1
@@ -75,7 +88,31 @@ class FakeApiServer(K8sClient):
         scopes = (ns, "") if ns else ("",)
         for scope in scopes:
             for stream in self._watchers.get((api_version, kind, scope), []):
-                stream.push(WatchEvent(event_type, copy.deepcopy(obj)))
+                # Streams opened at a served non-storage version see
+                # events converted to the version they asked for.
+                requested = getattr(stream, "requested_api_version",
+                                    api_version)
+                stream.push(WatchEvent(event_type, self._registry.convert(
+                    copy.deepcopy(obj), requested)))
+
+    def _register_crd_locked(self, crd: dict) -> None:
+        """Register (or re-register) a CRD; if its storage version moved,
+        migrate existing objects to the new storage key — a real
+        apiserver keeps serving pre-existing objects across a
+        storage-version flip, so the fake must not strand them under the
+        old key."""
+        kind = crd["spec"]["names"]["kind"]
+        old = self._registry.storage_api_version(kind)
+        self._registry.register_crd(crd)
+        new = self._registry.storage_api_version(kind)
+        if not old or not new or old == new:
+            return
+        moved = [(k, o) for k, o in self._store.items()
+                 if k[1] == kind and k[0] == old]
+        for key, obj in moved:
+            del self._store[key]
+            converted = self._registry.convert(obj, new)
+            self._store[(new, kind, key[2], key[3])] = converted
 
     def _check_namespace(self, obj: Mapping[str, Any]) -> None:
         kind = obj["kind"]
@@ -98,26 +135,30 @@ class FakeApiServer(K8sClient):
             m["name"] = m["generateName"] + uuid.uuid4().hex[:6]
         with self._lock:
             self._check_namespace(obj)
-            key = self._key(obj["apiVersion"], obj["kind"], m.get("namespace"), m["name"])
+            requested_av = obj["apiVersion"]
+            key = self._key(requested_av, obj["kind"], m.get("namespace"), m["name"])
+            obj = self._registry.convert(obj, key[0])  # to storage version
             if key in self._store:
                 raise ApiError.already_exists(
                     f"{obj['kind']} {m.get('namespace', '')}/{m['name']} already exists"
                 )
+            m = obj["metadata"]
             m["uid"] = str(uuid.uuid4())
             m["resourceVersion"] = self._next_rv()
             m["creationTimestamp"] = _now()
             self._store[key] = obj
             if obj["kind"] == "CustomResourceDefinition":
-                self._registry.register_crd(obj)
+                self._register_crd_locked(obj)
             self._notify("ADDED", obj)
-            return copy.deepcopy(obj)
+            return self._registry.convert(copy.deepcopy(obj), requested_av)
 
     def get(self, api_version: str, kind: str, name: str, namespace: str | None = None) -> dict:
         with self._lock:
             key = self._key(api_version, kind, namespace, name)
             if key not in self._store:
                 raise ApiError.not_found(f"{kind} {namespace or ''}/{name} not found")
-            return copy.deepcopy(self._store[key])
+            return self._registry.convert(
+                copy.deepcopy(self._store[key]), api_version)
 
     def list(
         self,
@@ -127,22 +168,27 @@ class FakeApiServer(K8sClient):
         label_selector: Mapping[str, str] | None = None,
     ) -> list[dict]:
         with self._lock:
+            storage_av = self._storage_av(api_version, kind)
             out = []
             for (av, k, ns, _), obj in self._store.items():
-                if av != api_version or k != kind:
+                if av != storage_av or k != kind:
                     continue
                 if namespace and ns != namespace:
                     continue
                 if match_labels(obj, label_selector):
-                    out.append(copy.deepcopy(obj))
+                    out.append(self._registry.convert(
+                        copy.deepcopy(obj), api_version))
             out.sort(key=lambda o: (o["metadata"].get("namespace", ""), o["metadata"]["name"]))
             return out
 
     def _update(self, obj: dict, subresource: str | None) -> dict:
         obj = copy.deepcopy(obj)
         m = obj["metadata"]
+        requested_av = obj["apiVersion"]
         with self._lock:
-            key = self._key(obj["apiVersion"], obj["kind"], m.get("namespace"), m["name"])
+            key = self._key(requested_av, obj["kind"], m.get("namespace"), m["name"])
+            obj = self._registry.convert(obj, key[0])  # to storage version
+            m = obj["metadata"]
             existing = self._store.get(key)
             if existing is None:
                 raise ApiError.not_found(
@@ -168,9 +214,9 @@ class FakeApiServer(K8sClient):
             new["metadata"]["resourceVersion"] = self._next_rv()
             self._store[key] = new
             if new["kind"] == "CustomResourceDefinition":
-                self._registry.register_crd(new)
+                self._register_crd_locked(new)
             self._notify("MODIFIED", new)
-            return copy.deepcopy(new)
+            return self._registry.convert(copy.deepcopy(new), requested_av)
 
     def update(self, obj: dict) -> dict:
         return self._update(obj, subresource=None)
@@ -239,8 +285,12 @@ class FakeApiServer(K8sClient):
     # ------------------------------------------------------------------
 
     def watch(self, api_version: str, kind: str, namespace: str | None = None) -> WatchStream:
+        # Unknown kinds fail loudly (a watch opened before its CRD is
+        # applied would otherwise be keyed at the wrong version and hang
+        # silently empty after registration).
+        self._registry.namespaced(kind)
         scope = namespace or ""
-        key = (api_version, kind, scope)
+        key = (self._storage_av(api_version, kind), kind, scope)
 
         def _on_stop() -> None:
             with self._lock:
@@ -249,6 +299,7 @@ class FakeApiServer(K8sClient):
                     streams.remove(stream)
 
         stream = WatchStream(on_stop=_on_stop)
+        stream.requested_api_version = api_version
         with self._lock:
             self._watchers.setdefault(key, []).append(stream)
             # replay current state as ADDED events (informer initial-list)

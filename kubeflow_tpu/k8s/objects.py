@@ -430,6 +430,7 @@ def crd(
     scope: str = "Namespaced",
     short_names: Sequence[str] | None = None,
     categories: Sequence[str] | None = None,
+    conversion: dict | None = None,
 ) -> dict:
     """A CustomResourceDefinition (apiextensions v1).
 
@@ -455,8 +456,29 @@ def crd(
                     }
                 ),
                 "versions": list(versions),
+                "conversion": conversion,
             }
         ),
+    }
+
+
+def crd_conversion_webhook(service_name: str, namespace: str,
+                           path: str = "/convert",
+                           ca_bundle: str = "") -> dict:
+    """spec.conversion stanza calling a conversion webhook — what a REAL
+    apiserver needs to convert between served versions with different
+    schemas (strategy None only rewrites apiVersion)."""
+    client_config: dict = {"service": {"name": service_name,
+                                       "namespace": namespace,
+                                       "path": path}}
+    if ca_bundle:
+        client_config["caBundle"] = ca_bundle
+    return {
+        "strategy": "Webhook",
+        "webhook": {
+            "clientConfig": client_config,
+            "conversionReviewVersions": ["v1"],
+        },
     }
 
 

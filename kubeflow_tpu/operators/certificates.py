@@ -299,11 +299,41 @@ class EndpointController(Controller):
     api_version = CERTS_API_VERSION
     kind = ENDPOINT_KIND
 
+    def __init__(self, client):
+        super().__init__(client)
+        self._legacy_zones_swept = False
+
     def watched_kinds(self):
         return [("v1", "ConfigMap")]
 
+    def _sweep_legacy_zones(self) -> bool:
+        """One full ConfigMap scan: zone CMs created before the GC label
+        existed get labeled so the steady-state label-selected GC sees
+        them. Returns True only when every zone is labeled — a partial
+        sweep (update conflicts) must run again next resync or the
+        skipped zone stays invisible to GC forever."""
+        ok = True
+        for cm in self.client.list("v1", "ConfigMap"):
+            if cm["metadata"]["name"] != DNS_ZONE_CONFIGMAP:
+                continue
+            labels = cm["metadata"].setdefault("labels", {})
+            if all(labels.get(k) == v
+                   for k, v in ZONE_CONFIGMAP_LABELS.items()):
+                continue
+            labels.update(ZONE_CONFIGMAP_LABELS)
+            try:
+                self.client.update(cm)
+            except ApiError:
+                ok = False  # retried on the next (still-unswept) pass
+        return ok
+
     def reconcile_all(self) -> int:
         n = super().reconcile_all()
+        if not self._legacy_zones_swept:
+            try:
+                self._legacy_zones_swept = self._sweep_legacy_zones()
+            except ApiError:
+                pass  # transient: retry next resync
         # Zone GC: a namespace whose last Endpoint was deleted has no
         # primary left to rebuild its zone — empty it here. The zone set
         # is enumerated FROM THE CLUSTER (every ConfigMap bearing the

@@ -142,6 +142,22 @@ def test_issuer_reads_base64_secret_like_real_apiserver(cert_env):
                    NS)["status"]["ready"] is True
 
 
+def test_zone_gc_sweeps_unlabeled_legacy_zones(cert_env):
+    """A zone ConfigMap created before the GC label existed (or by hand)
+    is labeled by the one-time legacy sweep, so a restarted controller
+    still garbage-collects it when its namespace empties."""
+    api = cert_env
+    api.ensure_namespace("legacy-ns")
+    api.create({  # pre-label-era zone, no Endpoints exist for it
+        "apiVersion": "v1", "kind": "ConfigMap",
+        "metadata": {"name": DNS_ZONE_CONFIGMAP, "namespace": "legacy-ns"},
+        "data": {"old.example.com": "gw.legacy"},
+    })
+    EndpointController(api).reconcile_all()  # fresh controller: sweeps+GCs
+    cm = api.get("v1", "ConfigMap", DNS_ZONE_CONFIGMAP, "legacy-ns")
+    assert cm["data"] == {}  # orphan emptied despite missing label
+
+
 def test_zone_gc_survives_controller_restart(cert_env):
     """Delete a namespace's last Endpoint, then RESTART the controller
     (fresh instance, empty memory) — the orphaned DNS zone must still be
