@@ -2,11 +2,18 @@
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 
-Headline metric is **MFU** (model FLOPs utilization: params × 6 × tokens/s ÷
-peak bf16 FLOP/s) — the config-independent measure of how well the framework
-maps onto the MXU, reported alongside raw tokens/s/chip. The reference
-publishes no numbers (BASELINE.md — machinery only), so ``vs_baseline``
-compares against this repo's frozen round-1 record in BENCH_BASELINE.json.
+Headline metric is **MFU** (model FLOPs utilization) with the standard
+PaLM-appendix-B / MaxText accounting: per-token model FLOPs are
+``6·N + 12·L·T_causal·W`` — parameter FLOPs plus the causal
+self-attention matmuls (T_causal = (T+1)/2 average attended length,
+W = attention width). The attention term is real delivered compute that
+a params-only 6·N formula silently drops; at Llama-class context
+(seq2048, 16 layers) it is ~6.6% of the work, so excluding it
+misrepresents long-context utilization. The reference publishes no
+numbers (BASELINE.md — machinery only), so ``vs_baseline`` compares
+against this repo's frozen round-1 record in BENCH_BASELINE.json
+(shallow seq128, where the attention term is ~0.1% — the comparison is
+formula-insensitive).
 
 Two training workloads run on TPU (VERDICT r2 #1 — report both the shallow
 flagship and a realistic-depth model):
@@ -72,6 +79,15 @@ def run_training(model_name: str, batch_size: int, seq_len: int,
 
     tokens_per_sec = steps * batch_size * seq_len / dt
     per_chip = tokens_per_sec / n_devices
+    # Standard MFU accounting (PaLM appendix B / MaxText): parameter
+    # FLOPs (6N fwd+bwd) PLUS the causal self-attention matmuls —
+    # 12 · layers · avg-attended-length · attention-width per token
+    # (qk^T + att·V, forward 4·T_avg·W, training ≈ 3× forward).
+    mcfg = model.config
+    attn_width = getattr(mcfg, "n_heads", 0) * getattr(mcfg, "head_dim", 0)
+    t_causal = (seq_len + 1) / 2
+    flops_per_token = (6.0 * n_params
+                       + 12.0 * mcfg.n_layers * t_causal * attn_width)
     # Release this run's buffers and executables before anything else
     # compiles in this process.
     del state, batch, step_fn, metrics
@@ -79,9 +95,10 @@ def run_training(model_name: str, batch_size: int, seq_len: int,
     gc.collect()
     jax.clear_caches()
     return {
-        "mfu": 6.0 * n_params * per_chip / PEAK_BF16,
+        "mfu": flops_per_token * per_chip / PEAK_BF16,
         "tokens_per_sec_per_chip": per_chip,
         "params_m": n_params / 1e6,
+        "model_tflops_per_token": flops_per_token / 1e12,
         "final_loss": loss,
         "config": f"{model_name} bs{batch_size} seq{seq_len} {opt_name} "
                   f"bf16 x{n_devices}chip",
@@ -222,7 +239,7 @@ def main() -> int:
             flagship["tokens_per_sec_per_chip"], 1),
         "params_m": round(flagship["params_m"], 1),
         "model_tflops_per_sec_per_chip": round(
-            6e-12 * flagship["params_m"] * 1e6
+            flagship["model_tflops_per_token"]
             * flagship["tokens_per_sec_per_chip"], 1),
         "final_loss": round(flagship["final_loss"], 4),
         "config": flagship["config"],

@@ -220,7 +220,8 @@ def job_schema(kind: str, *, api_version: str | None = None) -> dict:
     }
 
 
-def job_crd(kind: str) -> dict:
+def job_crd(kind: str, *, conversion_namespace: str = DEFAULT_NAMESPACE,
+            conversion_ca_bundle: str = "") -> dict:
     """CRD for one job kind, with the reference's printer-column surface
     (tf-job-operator.libsonnet:70-81: State + Age columns) and its
     multi-version story (ibid:52-97): ``v1`` is served AND stored;
@@ -266,13 +267,22 @@ def job_crd(kind: str) -> dict:
         # A real apiserver needs the webhook to convert between the two
         # shapes; the platform's webhook serves /convert with the same
         # convert_job registered below (the fake converts in-process).
+        # ``conversion_ca_bundle`` carries the trust root for the
+        # webhook's serving cert — deployments render it from the
+        # platform Issuer's status.caCertificate (the Certificate CR
+        # issues the webhook cert); empty is only valid for the
+        # in-process fake, which never dials the webhook.
         conversion=k8s.crd_conversion_webhook(
-            "admission-webhook", DEFAULT_NAMESPACE),
+            "admission-webhook", conversion_namespace,
+            ca_bundle=conversion_ca_bundle),
     )
 
 
-def all_job_crds() -> list[dict]:
-    return [job_crd(kind) for kind in ALL_JOB_KINDS]
+def all_job_crds(*, conversion_namespace: str = DEFAULT_NAMESPACE,
+                 conversion_ca_bundle: str = "") -> list[dict]:
+    return [job_crd(kind, conversion_namespace=conversion_namespace,
+                    conversion_ca_bundle=conversion_ca_bundle)
+            for kind in ALL_JOB_KINDS]
 
 
 # ---------------------------------------------------------------------------

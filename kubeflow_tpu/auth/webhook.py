@@ -115,11 +115,18 @@ def convert_response(review: dict) -> dict:
     from kubeflow_tpu.apis import jobs as _jobs  # noqa: F401
     from kubeflow_tpu.k8s.client import ApiError, KindRegistry
 
-    request = review.get("request", {})
+    request = review.get("request") or {}
+    if not isinstance(request, dict):
+        request = {}
     uid = request.get("uid", "")
     desired = request.get("desiredAPIVersion", "")
     converted, failure = [], None
-    for obj in request.get("objects", []):
+    for obj in request.get("objects") or []:
+        if not isinstance(obj, dict):
+            # Malformed input must produce the protocol's Failed result,
+            # not a handler crash and a dropped connection.
+            failure = "objects entries must be objects"
+            break
         try:
             converted.append(KindRegistry.convert(obj, desired))
         except ApiError as e:

@@ -113,6 +113,14 @@ class FakeApiServer(K8sClient):
             del self._store[key]
             converted = self._registry.convert(obj, new)
             self._store[(new, kind, key[2], key[3])] = converted
+        # Watchers registered under the old storage key must follow, or
+        # pre-flip streams would silently stop receiving events (each
+        # stream still converts to ITS requested version on push).
+        for (av, k, scope), streams in list(self._watchers.items()):
+            if k == kind and av == old:
+                self._watchers.setdefault((new, k, scope),
+                                          []).extend(streams)
+                del self._watchers[(av, k, scope)]
 
     def _check_namespace(self, obj: Mapping[str, Any]) -> None:
         kind = obj["kind"]

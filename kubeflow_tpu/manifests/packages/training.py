@@ -33,6 +33,11 @@ from kubeflow_tpu.version import API_GROUP, DEFAULT_NAMESPACE
         ParamSpec("replicas", 1, "operator replicas (leader-elected)"),
         ParamSpec("default_workload_image", images.JAX_TPU),
         ParamSpec("cluster_scoped", True, "watch all namespaces (RBAC scope)"),
+        ParamSpec("conversion_ca_bundle", "",
+                  "base64 CA for the conversion webhook's serving cert "
+                  "(render from the platform Issuer's caCertificate); a "
+                  "real apiserver requires it to call /convert for the "
+                  "served v1beta1 job API"),
     ],
 )
 def training_operator(
@@ -41,10 +46,13 @@ def training_operator(
     replicas: int,
     default_workload_image: str,
     cluster_scoped: bool,
+    conversion_ca_bundle: str,
 ) -> list[dict]:
     name = "training-operator"
     labels = {"app": name, "app.kubernetes.io/part-of": "kubeflow-tpu"}
-    objs: list[dict] = list(jobs_api.all_job_crds())
+    objs: list[dict] = list(jobs_api.all_job_crds(
+        conversion_namespace=namespace,
+        conversion_ca_bundle=conversion_ca_bundle))
 
     # ConfigMap (the grpcServerFilePath/default-image config analogue,
     # tf-job-operator.libsonnet:180-196), mounted at /etc/config/config.yaml

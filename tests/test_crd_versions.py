@@ -253,6 +253,53 @@ def test_crd_declares_conversion_webhook():
     assert conv["webhook"]["conversionReviewVersions"] == ["v1"]
 
 
+def test_watch_survives_storage_version_flip(jobs_env):
+    """A stream opened before the CRD's storage version moves must keep
+    receiving events after the flip (re-keyed with the store)."""
+    api = jobs_env
+    stream = api.watch(JOBS_API_VERSION, "JaxJob", NS)
+    try:
+        crd = jobs_api.job_crd("JaxJob")
+        for v in crd["spec"]["versions"]:
+            v["storage"] = v["name"] == "v1beta1"
+        api.apply(crd)
+        api.create(_v1beta1_job("postflip"))
+        seen = []
+        for _ in range(5):
+            ev = stream.next(timeout=2)
+            if ev is None:
+                break
+            seen.append(ev)
+        added = [e for e in seen if e.type == "ADDED"
+                 and e.object["metadata"]["name"] == "postflip"]
+        assert added, [e.object["metadata"]["name"] for e in seen]
+        # Delivered at the STREAM's requested version, map-shaped.
+        assert added[0].object["apiVersion"] == JOBS_API_VERSION
+        assert "Worker" in added[0].object["spec"]["replicaSpecs"]
+    finally:
+        stream.stop()
+
+
+def test_convert_endpoint_malformed_objects_fail_cleanly():
+    from kubeflow_tpu.auth.webhook import convert_response
+
+    out = convert_response({"request": {"uid": "u2",
+                                        "desiredAPIVersion": "x/v1",
+                                        "objects": ["not-a-dict"]}})
+    assert out["response"]["result"]["status"] == "Failed"
+    out = convert_response({"request": "garbage"})
+    assert out["response"]["result"]["status"] == "Success"
+    assert out["response"]["convertedObjects"] == []
+
+
+def test_conversion_ca_bundle_renders_into_crd():
+    crd = jobs_api.job_crd("JaxJob", conversion_namespace="prod",
+                           conversion_ca_bundle="Q0FDRVJU")
+    cc = crd["spec"]["conversion"]["webhook"]["clientConfig"]
+    assert cc["caBundle"] == "Q0FDRVJU"
+    assert cc["service"]["namespace"] == "prod"
+
+
 def test_crd_declares_both_versions():
     crd = jobs_api.job_crd("JaxJob")
     versions = {v["name"]: v for v in crd["spec"]["versions"]}
