@@ -17,6 +17,7 @@ from __future__ import annotations
 import argparse
 import base64
 import json
+import os
 import ssl
 import sys
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -187,19 +188,149 @@ def make_server(port: int, *, certfile: str = "",
     return httpd
 
 
+def self_sign(namespace: str, service: str = "admission-webhook"):
+    """Generate a webhook serving CA + leaf for the Service DNS names.
+    Returns (KeyCert leaf, base64 CA bundle)."""
+    from kubeflow_tpu.auth import pki
+
+    ca = pki.make_ca(f"{service}-ca.{namespace}")
+    leaf = pki.issue(ca, [
+        f"{service}.{namespace}.svc",
+        f"{service}.{namespace}.svc.cluster.local",
+        service,
+    ], duration_seconds=365 * 24 * 3600)
+    bundle = base64.b64encode(ca.cert_pem.encode()).decode()
+    return leaf, bundle
+
+
+def patch_ca_bundles(client, ca_bundle_b64: str,
+                     webhook_name: str = "admission-webhook"
+                     ) -> tuple[int, int]:
+    """Write the serving CA into every in-cluster clientConfig that dials
+    this webhook: the MutatingWebhookConfiguration AND each job CRD's
+    conversion stanza — the cert-manager-CA-injector role, done by the
+    webhook itself (the manifest's `ca_bundle` param may stay empty).
+    Returns (patched, failed); the caller retries while failed > 0 —
+    CRD conversion has no failurePolicy escape, so a stale bundle must
+    converge, not wait for a lucky restart. Network errors count as
+    failures (requests exceptions are OSErrors), never crashes."""
+    from kubeflow_tpu.apis.jobs import API_GROUP, PLURALS
+    from kubeflow_tpu.k8s.client import ApiError
+
+    patched, failed = 0, 0
+    try:
+        mwc = client.get_or_none(
+            "admissionregistration.k8s.io/v1",
+            "MutatingWebhookConfiguration", webhook_name)
+        if mwc is not None:
+            changed = False
+            for wh in mwc.get("webhooks", []):
+                cc = wh.setdefault("clientConfig", {})
+                if cc.get("caBundle") != ca_bundle_b64:
+                    cc["caBundle"] = ca_bundle_b64
+                    changed = True
+            if changed:
+                client.update(mwc)
+                patched += 1
+    except (ApiError, OSError):
+        failed += 1
+    for plural in PLURALS.values():
+        try:
+            crd = client.get_or_none(
+                "apiextensions.k8s.io/v1", "CustomResourceDefinition",
+                f"{plural}.{API_GROUP}")
+            if crd is None:
+                continue
+            webhook = (crd.get("spec", {}).get("conversion", {})
+                       .get("webhook"))
+            if webhook is None:
+                continue
+            cc = webhook.setdefault("clientConfig", {})
+            if cc.get("caBundle") != ca_bundle_b64:
+                cc["caBundle"] = ca_bundle_b64
+                client.update(crd)
+                patched += 1
+        except (ApiError, OSError):
+            failed += 1
+    return patched, failed
+
+
 def main(argv=None) -> int:
     argv = strip_glog_args(list(sys.argv[1:] if argv is None else argv))
     p = argparse.ArgumentParser(description="mutating admission webhook")
     p.add_argument("--port", type=int, default=8443)
     p.add_argument("--tls-cert", default="",
-                   help="TLS cert path (with --tls-key; plain HTTP if unset)")
+                   help="TLS cert path (with --tls-key; plain HTTP if "
+                        "unset and --self-sign absent)")
     p.add_argument("--tls-key", default="")
+    p.add_argument("--self-sign", action="store_true",
+                   help="generate a serving CA + leaf at startup and "
+                        "serve TLS with it")
+    p.add_argument("--patch-ca", action="store_true",
+                   help="write the serving CA into the in-cluster "
+                        "MutatingWebhookConfiguration and job-CRD "
+                        "conversion clientConfigs (requires --self-sign)")
+    p.add_argument("--pod-namespace",
+                   default=os.environ.get("POD_NAMESPACE", ""),
+                   help="namespace for self-signed Service DNS names "
+                        "(default: POD_NAMESPACE env, else --namespace)")
+    p.add_argument("--patch-retry-seconds", type=float, default=30.0,
+                   help="retry cadence while any caBundle patch is "
+                        "failing (CRD conversion has no failurePolicy "
+                        "escape — the bundle must converge)")
+    from kubeflow_tpu.runtime import add_client_args, client_from_args
+
+    add_client_args(p)  # --apiserver/--token-path/--namespace (in-cluster aware)
     args = p.parse_args(argv)
 
-    httpd = make_server(args.port, certfile=args.tls_cert,
-                        keyfile=args.tls_key)
+    certfile, keyfile = args.tls_cert, args.tls_key
+    bundle = ""
+    if args.self_sign:
+        import tempfile
+
+        leaf, bundle = self_sign(args.pod_namespace or args.namespace)
+        cert_f = tempfile.NamedTemporaryFile("w", suffix=".pem",
+                                             delete=False)
+        cert_f.write(leaf.chain_pem)
+        cert_f.close()
+        key_f = tempfile.NamedTemporaryFile("w", suffix=".pem",
+                                            delete=False)
+        key_f.write(leaf.key_pem)
+        key_f.close()
+        certfile, keyfile = cert_f.name, key_f.name
+
+    httpd = make_server(args.port, certfile=certfile, keyfile=keyfile)
+    if args.self_sign:
+        # The SSLContext holds the loaded chain; don't leave key
+        # material on disk for the container lifetime.
+        for path in (certfile, keyfile):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+    patched = failed = 0
+    if args.patch_ca and bundle:
+        client = client_from_args(args)
+        patched, failed = patch_ca_bundles(client, bundle)
+        if failed:
+            import threading
+
+            def retry_loop():
+                while True:
+                    import time as _time
+
+                    _time.sleep(args.patch_retry_seconds)
+                    _p, f = patch_ca_bundles(client, bundle)
+                    if f == 0:
+                        return
+
+            threading.Thread(target=retry_loop, daemon=True).start()
+
     print(json.dumps({"msg": "admission webhook up", "port": args.port,
-                      "tls": bool(args.tls_cert)}))
+                      "tls": bool(certfile),
+                      "self_signed": args.self_sign,
+                      "ca_bundles_patched": patched,
+                      "ca_patches_failed": failed}), flush=True)
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:

@@ -98,8 +98,28 @@ def gatekeeper(namespace: str, image: str, username: str,
 def admission_webhook(namespace: str, image: str, ca_bundle: str) -> list[dict]:
     name = "admission-webhook"
     labels = {"app": name}
+    # With no pre-issued bundle, the server self-signs at startup and
+    # patches its CA into the in-cluster clientConfigs (webhook + job-CRD
+    # conversion stanzas) — which needs update RBAC on those objects.
+    self_sign = not ca_bundle
+    args = ["--port=8443"]
+    rbac: list[dict] = []
+    if self_sign:
+        args += ["--self-sign", "--patch-ca", f"--namespace={namespace}"]
+        rbac = [
+            k8s.cluster_role(name, [
+                k8s.policy_rule(["admissionregistration.k8s.io"],
+                                ["mutatingwebhookconfigurations"],
+                                ["get", "update"]),
+                k8s.policy_rule(["apiextensions.k8s.io"],
+                                ["customresourcedefinitions"],
+                                ["get", "update"]),
+            ], labels),
+            k8s.cluster_role_binding(name, name, name, namespace),
+        ]
     return [
         k8s.service_account(name, namespace, labels),
+        *rbac,
         k8s.service(
             name,
             namespace,
@@ -115,7 +135,7 @@ def admission_webhook(namespace: str, image: str, ca_bundle: str) -> list[dict]:
                     name,
                     image,
                     command=["python", "-m", "kubeflow_tpu.auth.webhook"],
-                    args=["--port=8443"],
+                    args=args,
                     ports={"https": 8443},
                 )
             ],

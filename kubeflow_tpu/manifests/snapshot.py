@@ -74,6 +74,7 @@ SNAPSHOT_CASES: dict[str, tuple[str, dict]] = {
     ),
     "cert-manager": ("cert-manager", {}),
     "gatekeeper": ("gatekeeper", {"password_hash": "0" * 64}),
+    "admission-webhook": ("admission-webhook", {}),
     "secure-ingress": (
         "secure-ingress",
         {"hostname": "kubeflow.example.com", "issuer": "platform-ca"},

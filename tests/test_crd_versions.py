@@ -244,6 +244,97 @@ def test_storage_version_flip_migrates_existing_objects(jobs_env):
     assert len(api.list(JOBS_API_VERSION, "JaxJob", NS)) == 1
 
 
+def test_webhook_self_sign_serves_tls_and_patches_bundles(jobs_env):
+    """The deployed flow for an empty ca_bundle: the webhook self-signs,
+    serves HTTPS with the generated leaf, and writes its CA into the
+    MutatingWebhookConfiguration and every job CRD's conversion stanza
+    (the cert-manager CA-injector role)."""
+    import base64 as b64
+    import json as json_mod
+    import ssl
+    import tempfile
+    import threading
+    import urllib.request
+
+    from kubeflow_tpu.auth.webhook import (
+        make_server,
+        patch_ca_bundles,
+        self_sign,
+    )
+
+    api = jobs_env
+    api.create({
+        "apiVersion": "admissionregistration.k8s.io/v1",
+        "kind": "MutatingWebhookConfiguration",
+        "metadata": {"name": "admission-webhook"},
+        "webhooks": [{"name": "admission-webhook.kubeflow-tpu.org",
+                      "clientConfig": {"service": {"name": "x"}}}],
+    })
+
+    leaf, bundle = self_sign("kubeflow")
+    patched, failed = patch_ca_bundles(api, bundle)
+    # 1 MutatingWebhookConfiguration + 6 job CRDs
+    assert (patched, failed) == (7, 0)
+    mwc = api.get("admissionregistration.k8s.io/v1",
+                  "MutatingWebhookConfiguration", "admission-webhook")
+    assert mwc["webhooks"][0]["clientConfig"]["caBundle"] == bundle
+    crd = api.get("apiextensions.k8s.io/v1", "CustomResourceDefinition",
+                  "jaxjobs.kubeflow-tpu.org")
+    assert (crd["spec"]["conversion"]["webhook"]["clientConfig"]
+            ["caBundle"] == bundle)
+    # Idempotent: a second pass patches nothing.
+    assert patch_ca_bundles(api, bundle) == (0, 0)
+
+    # A client whose apiserver is down reports failures, not a crash
+    # (the retry loop keys off this).
+    class Down:
+        def get_or_none(self, *a, **k):
+            raise OSError("connection refused")
+
+    patched, failed = patch_ca_bundles(Down(), bundle)
+    assert patched == 0 and failed >= 1
+
+    # Serve HTTPS with the generated leaf; a client trusting the CA
+    # converts through it.
+    with tempfile.NamedTemporaryFile("w", suffix=".pem") as cf, \
+            tempfile.NamedTemporaryFile("w", suffix=".pem") as kf, \
+            tempfile.NamedTemporaryFile("w", suffix=".pem") as caf:
+        cf.write(leaf.chain_pem); cf.flush()
+        kf.write(leaf.key_pem); kf.flush()
+        caf.write(b64.b64decode(bundle).decode()); caf.flush()
+        httpd = make_server(0, certfile=cf.name, keyfile=kf.name)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            ctx = ssl.create_default_context(cafile=caf.name)
+            review = {"request": {"uid": "u3",
+                                  "desiredAPIVersion": JOBS_API_VERSION,
+                                  "objects": [_v1beta1_job("tls")]}}
+            req = urllib.request.Request(
+                f"https://admission-webhook:{httpd.server_address[1]}"
+                "/convert",
+                method="POST", data=json_mod.dumps(review).encode(),
+                headers={"Content-Type": "application/json"})
+            # Resolve the SAN name to loopback for the test dial.
+            import socket
+
+            real = socket.getaddrinfo
+
+            def fake(host, *a, **k):
+                if host == "admission-webhook":
+                    return real("127.0.0.1", *a, **k)
+                return real(host, *a, **k)
+
+            socket.getaddrinfo = fake
+            try:
+                out = json_mod.loads(urllib.request.urlopen(
+                    req, timeout=10, context=ctx).read())
+            finally:
+                socket.getaddrinfo = real
+            assert out["response"]["result"]["status"] == "Success"
+        finally:
+            httpd.shutdown()
+
+
 def test_crd_declares_conversion_webhook():
     crd = jobs_api.job_crd("JaxJob")
     conv = crd["spec"]["conversion"]
