@@ -41,6 +41,19 @@ _ADDRESS_ENV = (
     "CHAINERMN_MASTER_ADDR",
 )
 
+# Env vars holding a bare rendezvous port. On real pod IPs every gang can
+# bind the same well-known port; mapped onto ONE loopback host they
+# collide across concurrently-running (or TIME_WAIT-lingering) gangs, so
+# the kubelet remaps each gang's ports to free ones — consistently for
+# every pod of the gang, and consistently with the ports embedded in the
+# _ADDRESS_ENV values.
+_PORT_ENV = (
+    "JAX_COORDINATOR_PORT",
+    "MASTER_PORT",
+    "DMLC_PS_ROOT_PORT",
+    "CHAINERMN_MASTER_PORT",
+)
+
 
 def _loopback(value: str) -> str:
     """``host[:port]`` → ``127.0.0.1[:port]`` (host part dropped)."""
@@ -63,6 +76,8 @@ class _Running:
     # than the ~64KB pipe buffer would otherwise block on write until the
     # kubelet timeout kills it (verbose-but-healthy workloads would fail).
     out_file: object = None
+    # (namespace, owning job) — keys the gang's remapped rendezvous ports.
+    gang: tuple | None = None
     started: float = field(default_factory=time.monotonic)
 
 
@@ -87,7 +102,45 @@ class FakeKubelet:
         self.cpu_devices_per_pod = cpu_devices_per_pod
         self.timeout = timeout
         self._running: dict[tuple[str, str], _Running] = {}
+        # (namespace, owning-job, original-port) -> remapped free port.
+        self._gang_ports: dict[tuple[str, str, str], int] = {}
         self._stop = threading.Event()
+
+    @staticmethod
+    def _gang_key(pod: dict) -> tuple[str, str]:
+        refs = pod["metadata"].get("ownerReferences") or []
+        owner = refs[0]["name"] if refs else pod["metadata"]["name"]
+        return (pod["metadata"].get("namespace", ""), owner)
+
+    def _gang_port(self, pod: dict, orig: str) -> int:
+        """A free local port for this gang's ``orig`` rendezvous port,
+        stable across every pod sharing the owning job (one generation;
+        entries are pruned when the gang's last pod is reaped, so a
+        restarted gang gets fresh ports instead of inheriting a slot
+        something else may hold by now)."""
+        key = (*self._gang_key(pod), orig)
+        port = self._gang_ports.get(key)
+        if port is None:
+            import socket
+
+            issued = set(self._gang_ports.values())
+            while True:
+                with socket.socket() as s:
+                    s.bind(("127.0.0.1", 0))
+                    port = s.getsockname()[1]
+                if port not in issued:
+                    break  # never hand two gangs the same port
+            self._gang_ports[key] = port
+        return port
+
+    def _prune_gang_ports(self, gang: tuple[str, str] | None) -> None:
+        """Drop a gang's port mappings once none of its pods run."""
+        if gang is None:
+            return
+        if any(r.gang == gang for r in self._running.values()):
+            return
+        self._gang_ports = {k: v for k, v in self._gang_ports.items()
+                            if k[:2] != gang}
 
     # ------------------------------------------------------------------
     # scheduling
@@ -109,6 +162,11 @@ class FakeKubelet:
             name, value = item["name"], str(item.get("value", ""))
             if name in _ADDRESS_ENV:
                 value = _loopback(value)
+                host, sep, port = value.partition(":")
+                if sep and port.isdigit():
+                    value = f"{host}:{self._gang_port(pod, port)}"
+            elif name in _PORT_ENV and value.isdigit():
+                value = str(self._gang_port(pod, value))
             env[name] = value
         env.update(self.extra_env)
         return env
@@ -136,7 +194,9 @@ class FakeKubelet:
             self._set_phase(pod, "Failed", exit_code=127, log=str(e))
             return
         key = (pod["metadata"]["namespace"], pod["metadata"]["name"])
-        self._running[key] = _Running(proc, key[1], key[0], out_file=out_file)
+        self._running[key] = _Running(proc, key[1], key[0],
+                                      out_file=out_file,
+                                      gang=self._gang_key(pod))
         self._set_phase(pod, "Running")
 
     def _set_phase(self, pod: dict, phase: str,
@@ -207,7 +267,9 @@ class FakeKubelet:
                     pod, "Succeeded" if rc == 0 else "Failed",
                     exit_code=rc, log=out,
                 )
+            gang = run.gang
             del self._running[key]
+            self._prune_gang_ports(gang)
         return len(self._running)
 
     def evict(self, name: str, namespace: str = "kubeflow",
@@ -234,6 +296,7 @@ class FakeKubelet:
         if run is None or run.proc.poll() is not None:
             return False
         del self._running[key]
+        self._prune_gang_ports(run.gang)
         run.proc.terminate()  # SIGTERM: the grace window starts
         try:
             rc = run.proc.wait(timeout=max(0.0, grace_seconds))
