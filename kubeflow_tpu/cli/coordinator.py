@@ -264,8 +264,9 @@ class Coordinator:
                 return
             except ApiError as e:
                 last_err = e
-                # 4xx (other than 409 conflict races) won't heal by retrying
-                if 400 <= e.code < 500 and e.code != 409:
+                # 4xx (other than 409 conflict races and transient
+                # 429/408 load-shedding) won't heal by retrying
+                if 400 <= e.code < 500 and e.code != 409 and not e.transient:
                     break
                 logger.warning("apply %s attempt %d failed: %s", key, attempt + 1, e)
                 if self._backoff:

@@ -1,0 +1,277 @@
+"""Fault-injection decorator over any :class:`~kubeflow_tpu.k8s.client.K8sClient`.
+
+The chaos-mesh/toxiproxy analogue for the platform's control plane: wraps a
+backend (usually :class:`~kubeflow_tpu.k8s.fake.FakeApiServer`) and injects
+deterministic, seeded faults so controller hardening — workqueue backoff,
+conflict retry, watch reconnect + relist, create idempotency — is *proved*
+by tests instead of assumed:
+
+- transient API errors (429 TooManyRequests / 500 InternalError /
+  503 ServiceUnavailable) on any verb, at per-verb rates;
+- added latency;
+- extra optimistic-concurrency conflicts on update/update_status (the write
+  does NOT land — the caller must refetch and reapply);
+- "error after success" on create: the object IS created but the caller
+  sees a 500 — the nastiest real-world case, where a blind retry produces a
+  duplicate unless the controller tolerates 409 AlreadyExists;
+- watch-stream drops: a fated stream dies after a seeded number of events,
+  exactly as a severed apiserver connection would.
+
+Every injected fault and every operation that reached the inner backend is
+recorded in a journal for assertions (did the controller create this pod
+twice? how many faults did it absorb?).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Mapping
+
+from kubeflow_tpu.k8s.client import (
+    ApiError,
+    K8sClient,
+    WatchStream,
+)
+
+# Transient statuses a well-behaved client must retry (client-go's
+# IsTooManyRequests / IsInternalError / IsServiceUnavailable family).
+TRANSIENT_ERRORS = (
+    (429, "TooManyRequests"),
+    (500, "InternalError"),
+    (503, "ServiceUnavailable"),
+)
+
+
+@dataclass
+class FaultRecord:
+    """One journal entry: an API call and what chaos did to it."""
+
+    verb: str
+    kind: str
+    name: str
+    namespace: str
+    fault: str | None  # None = passed through untouched
+    code: int = 0      # HTTP code of the outcome (0 = success)
+    landed: bool = False  # the inner backend actually executed the op
+
+
+@dataclass
+class ChaosRates:
+    """Per-call fault probabilities. ``per_verb_error`` overrides
+    ``error_rate`` for specific verbs (create/get/list/update/
+    update_status/patch/delete/watch)."""
+
+    error_rate: float = 0.0
+    conflict_rate: float = 0.0        # update/update_status only
+    error_after_create_rate: float = 0.0
+    watch_drop_rate: float = 0.0      # probability a new stream is drop-fated
+    latency_seconds: float = 0.0      # max added latency per call
+    per_verb_error: Mapping[str, float] = field(default_factory=dict)
+
+    def error_for(self, verb: str) -> float:
+        return float(self.per_verb_error.get(verb, self.error_rate))
+
+
+class ChaosK8sClient(K8sClient):
+    """Decorates an inner client with seeded fault injection.
+
+    Same seed + same single-threaded call sequence → same fault sequence,
+    so soak failures reproduce. The journal records every call; helpers
+    :meth:`faults` and :meth:`landed` slice it for assertions.
+    """
+
+    def __init__(self, inner: K8sClient, *, seed: int = 0,
+                 rates: ChaosRates | None = None, **rate_kwargs):
+        self.inner = inner
+        self.rates = rates or ChaosRates(**rate_kwargs)
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self.journal: list[FaultRecord] = []
+        self._streams: list[tuple[WatchStream, WatchStream]] = []
+
+    # -- configuration / inspection ------------------------------------
+
+    def set_rates(self, **kwargs) -> None:
+        """Adjust fault rates mid-test (e.g. turn the apiserver hostile
+        only after a controller is healthy)."""
+        with self._lock:
+            for key, value in kwargs.items():
+                if not hasattr(self.rates, key):
+                    raise TypeError(f"unknown chaos rate {key!r}")
+                setattr(self.rates, key, value)
+
+    def faults(self, verb: str | None = None) -> list[FaultRecord]:
+        with self._lock:
+            return [r for r in self.journal
+                    if r.fault and (verb is None or r.verb == verb)]
+
+    def landed(self, verb: str | None = None,
+               kind: str | None = None) -> list[FaultRecord]:
+        """Journal entries whose operation actually executed on the inner
+        backend (including create-then-error faults)."""
+        with self._lock:
+            return [r for r in self.journal
+                    if r.landed and (verb is None or r.verb == verb)
+                    and (kind is None or r.kind == kind)]
+
+    def drop_watches(self) -> int:
+        """Sever every live watch stream now (apiserver restart). Returns
+        the number of streams dropped."""
+        with self._lock:
+            streams, self._streams = self._streams, []
+        for inner_stream, outer in streams:
+            self._record("watch", "", "", "", "drop", 0, False)
+            inner_stream.stop()
+            outer.stop()
+        return len(streams)
+
+    # -- fault machinery -----------------------------------------------
+
+    def _record(self, verb, kind, name, namespace, fault, code, landed):
+        rec = FaultRecord(verb, kind, name or "", namespace or "",
+                          fault, code, landed)
+        with self._lock:
+            self.journal.append(rec)
+        return rec
+
+    def _roll(self, p: float) -> bool:
+        with self._lock:
+            return p > 0 and self._rng.random() < p
+
+    def _pre_fault(self, verb: str, kind: str, name: str,
+                   namespace: str) -> None:
+        """Latency + transient error + injected conflict, before the inner
+        call — none of these let the operation land."""
+        rates = self.rates
+        if rates.latency_seconds > 0:
+            with self._lock:
+                delay = self._rng.uniform(0, rates.latency_seconds)
+            time.sleep(delay)
+        if self._roll(rates.error_for(verb)):
+            with self._lock:
+                code, reason = self._rng.choice(TRANSIENT_ERRORS)
+            self._record(verb, kind, name, namespace, reason, code, False)
+            raise ApiError(code, reason,
+                           f"chaos: injected {reason} on {verb} {kind}")
+        if verb in ("update", "update_status") and self._roll(
+                rates.conflict_rate):
+            self._record(verb, kind, name, namespace, "Conflict", 409, False)
+            raise ApiError.conflict(
+                f"chaos: injected conflict on {verb} {kind} {name}")
+
+    def _call(self, verb, kind, name, namespace, op):
+        self._pre_fault(verb, kind, name, namespace)
+        try:
+            result = op()
+        except ApiError as e:
+            # Real backend error (404/409/...): journal it as landed=False
+            # so duplicate-side-effect assertions only count true writes.
+            self._record(verb, kind, name, namespace, None, e.code, False)
+            raise
+        self._record(verb, kind, name, namespace, None, 0, True)
+        return result
+
+    # -- CRUD ----------------------------------------------------------
+
+    def create(self, obj: dict) -> dict:
+        kind = obj.get("kind", "")
+        m = obj.get("metadata", {})
+        name, ns = m.get("name", ""), m.get("namespace", "")
+        self._pre_fault("create", kind, name, ns)
+        try:
+            created = self.inner.create(obj)
+        except ApiError as e:
+            self._record("create", kind, name, ns, None, e.code, False)
+            raise
+        if self._roll(self.rates.error_after_create_rate):
+            # The write landed but the response was lost — the retry will
+            # see 409 AlreadyExists and must treat it as success.
+            self._record("create", kind, name, ns,
+                         "ErrorAfterSuccess", 500, True)
+            raise ApiError(500, "InternalError",
+                           f"chaos: response lost after create of "
+                           f"{kind} {name} (object exists)")
+        self._record("create", kind, name, ns, None, 0, True)
+        return created
+
+    def get(self, api_version, kind, name, namespace=None):
+        return self._call("get", kind, name, namespace,
+                          lambda: self.inner.get(api_version, kind, name,
+                                                 namespace))
+
+    def list(self, api_version, kind, namespace=None, label_selector=None):
+        return self._call("list", kind, "", namespace,
+                          lambda: self.inner.list(api_version, kind,
+                                                  namespace, label_selector))
+
+    def update(self, obj: dict) -> dict:
+        m = obj.get("metadata", {})
+        return self._call("update", obj.get("kind", ""), m.get("name", ""),
+                          m.get("namespace"), lambda: self.inner.update(obj))
+
+    def update_status(self, obj: dict) -> dict:
+        m = obj.get("metadata", {})
+        return self._call("update_status", obj.get("kind", ""),
+                          m.get("name", ""), m.get("namespace"),
+                          lambda: self.inner.update_status(obj))
+
+    def patch(self, api_version, kind, name, patch, namespace=None):
+        return self._call("patch", kind, name, namespace,
+                          lambda: self.inner.patch(api_version, kind, name,
+                                                   patch, namespace))
+
+    def delete(self, api_version, kind, name, namespace=None):
+        return self._call("delete", kind, name, namespace,
+                          lambda: self.inner.delete(api_version, kind, name,
+                                                    namespace))
+
+    # -- watch ---------------------------------------------------------
+
+    def watch(self, api_version, kind, namespace=None) -> WatchStream:
+        self._pre_fault("watch", kind, "", namespace)
+        inner_stream = self.inner.watch(api_version, kind, namespace)
+        drop_after: int | None = None
+        if self._roll(self.rates.watch_drop_rate):
+            with self._lock:
+                drop_after = self._rng.randint(1, 20)
+        outer = WatchStream(on_stop=inner_stream.stop)
+        entry = (inner_stream, outer)
+        with self._lock:
+            self._streams.append(entry)
+        self._record("watch", kind, "", namespace, None, 0, True)
+
+        def _forward() -> None:
+            n = 0
+            for event in inner_stream:
+                outer.push(event)
+                n += 1
+                if drop_after is not None and n >= drop_after:
+                    self._record("watch", kind, "", namespace,
+                                 "drop", 0, False)
+                    inner_stream.stop()
+                    break
+            with self._lock:
+                if entry in self._streams:
+                    self._streams.remove(entry)
+            outer.stop()
+
+        threading.Thread(target=_forward, daemon=True).start()
+        return outer
+
+    # -- passthrough ---------------------------------------------------
+
+    @property
+    def registry(self):
+        return self.inner.registry
+
+    def __getattr__(self, attr):
+        # Test helpers (ensure_namespace, all_objects, ...) reach the
+        # backend untouched — chaos only applies to the client surface.
+        return getattr(self.inner, attr)
+
+
+# The name the chaos soak reads naturally: ChaosApiServer(FakeApiServer()).
+ChaosApiServer = ChaosK8sClient

@@ -19,6 +19,7 @@ import copy
 import json
 import logging
 import queue
+import random
 import threading
 from dataclasses import dataclass
 from typing import Any, Callable, Iterator, Mapping
@@ -48,6 +49,14 @@ class ApiError(Exception):
     @classmethod
     def invalid(cls, what: str) -> "ApiError":
         return cls(422, "Invalid", what)
+
+    @property
+    def transient(self) -> bool:
+        """True for errors a well-behaved client retries (the client-go
+        IsTooManyRequests / IsServerTimeout / IsInternalError family):
+        apiserver load-shedding (429), request timeouts (408) and 5xx —
+        never schema rejections, which retrying cannot heal."""
+        return self.code in (408, 429) or self.code >= 500
 
 
 @dataclass(frozen=True)
@@ -274,6 +283,15 @@ class WatchStream:
                 self._on_stop()
             self._q.put(None)
 
+    @property
+    def stopped(self) -> bool:
+        """True once stop() ran — consumers distinguish a deliberate stop
+        from a dropped connection (the stream ending without stop)."""
+        return self._stopped.is_set()
+
+    def wait_stopped(self, timeout: float | None = None) -> bool:
+        return self._stopped.wait(timeout)
+
     def __iter__(self) -> Iterator[WatchEvent]:
         while True:
             item = self._q.get()
@@ -312,6 +330,26 @@ def merge_patch(base: dict, patch: Mapping[str, Any]) -> dict:
 
     _merge(out, patch)
     return out
+
+
+def retry_on_conflict(client: K8sClient, fn: Callable[[K8sClient], Any],
+                      attempts: int = 5) -> Any:
+    """Run ``fn(client)`` and retry it on 409 Conflict — the client-go
+    ``retry.RetryOnConflict`` analogue.
+
+    ``fn`` must be a refetch-and-reapply closure: read the LATEST object
+    inside the call, apply the change, write. A closure that reuses a
+    captured stale object would conflict forever; refetching inside makes
+    every attempt race against fresh state, so a lost optimistic-concurrency
+    race costs one extra round-trip instead of parking the object until the
+    next resync.
+    """
+    for attempt in range(attempts):
+        try:
+            return fn(client)
+        except ApiError as e:
+            if e.code != 409 or attempt == attempts - 1:
+                raise
 
 
 # ---------------------------------------------------------------------------
@@ -441,7 +479,18 @@ class HttpK8sClient(K8sClient):
     def delete(self, api_version: str, kind: str, name: str, namespace: str | None = None) -> None:
         self._request("DELETE", self._path(api_version, kind, namespace, name))
 
+    # Reconnect tuning for dropped watch streams.
+    watch_backoff_base = 0.1
+    watch_backoff_max = 5.0
+
     def watch(self, api_version: str, kind: str, namespace: str | None = None) -> WatchStream:
+        """Watch with auto-reconnect: a dropped connection (apiserver
+        restart, LB idle-timeout, transient 5xx) is retried with jittered
+        exponential backoff, and every reconnect pushes a synthetic relist
+        (current objects as ADDED events) so level-triggered consumers
+        re-observe anything that changed while the stream was down — the
+        client-go reflector ListAndWatch loop. The stream only ends when
+        the caller stops it."""
         path = self._path(api_version, kind, namespace)
         url = self._cfg.host + path
         holder: dict = {}
@@ -458,22 +507,47 @@ class HttpK8sClient(K8sClient):
 
         stream = WatchStream(on_stop=_on_stop)
 
+        def _relist() -> None:
+            for obj in self.list(api_version, kind, namespace):
+                stream.push(WatchEvent("ADDED", obj))
+
         def _run() -> None:
+            backoff = self.watch_backoff_base
+            connected_before = False
             try:
-                resp = self._session.get(url, params={"watch": "true"}, stream=True, timeout=3600)
-                holder["resp"] = resp
-                if resp.status_code >= 400:
-                    logging.warning("watch %s failed: HTTP %s %s",
-                                    path, resp.status_code,
-                                    resp.text[:200])
-                    return
-                for line in resp.iter_lines():
-                    if not line:
-                        continue
-                    evt = json.loads(line)
-                    stream.push(WatchEvent(evt["type"], evt["object"]))
-            except Exception as e:
-                logging.warning("watch %s aborted: %s", path, e)
+                while not stream.stopped:
+                    try:
+                        resp = self._session.get(
+                            url, params={"watch": "true"}, stream=True,
+                            timeout=3600,
+                        )
+                        holder["resp"] = resp
+                        if resp.status_code >= 400:
+                            raise ApiError(resp.status_code, "WatchFailed",
+                                           resp.text[:200])
+                        if connected_before:
+                            # Events between drop and reconnect are gone; a
+                            # fresh watch starts at "now", so replay current
+                            # state for the consumer to reconcile against.
+                            _relist()
+                        connected_before = True
+                        for line in resp.iter_lines():
+                            if stream.stopped:
+                                return
+                            if not line:
+                                continue
+                            evt = json.loads(line)
+                            stream.push(WatchEvent(evt["type"], evt["object"]))
+                            backoff = self.watch_backoff_base
+                    except Exception as e:
+                        if stream.stopped:
+                            return
+                        logging.warning("watch %s dropped: %s; reconnecting",
+                                        path, e)
+                    if stream.stopped:
+                        return
+                    stream.wait_stopped(backoff * (0.5 + random.random()))
+                    backoff = min(backoff * 2, self.watch_backoff_max)
             finally:
                 stream.stop()
 

@@ -262,10 +262,15 @@ class FakeApiServer(K8sClient):
 
     def _cascade_delete(self, owner: dict) -> None:
         owner_uid = owner["metadata"]["uid"]
-        ns = owner["metadata"].get("namespace", "")
+        owner_ns = owner["metadata"].get("namespace", "")
         doomed = []
         for key, obj in self._store.items():
-            if obj["metadata"].get("namespace", "") != ns:
+            # Real GC scoping: a namespaced owner only cascades within its
+            # own namespace (ownerReferences never cross namespaces, and a
+            # namespaced owner cannot own cluster-scoped objects); a
+            # cluster-scoped owner cascades to children in EVERY namespace.
+            child_ns = obj["metadata"].get("namespace", "")
+            if owner_ns and child_ns != owner_ns:
                 continue
             for ref in obj["metadata"].get("ownerReferences", []):
                 if ref.get("uid") == owner_uid or (

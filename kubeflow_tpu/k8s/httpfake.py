@@ -43,6 +43,11 @@ class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     server_version = "kubeflow-tpu-fake-apiserver"
     fake: FakeApiServer  # set by make_handler
+    # A real apiserver closes watch connections after --min-request-timeout
+    # (watches must survive that); None = streams live until the client
+    # hangs up. Tests set this on the handler class to exercise the HTTP
+    # client's reconnect + relist path.
+    watch_timeout_seconds: float | None = None
 
     def log_message(self, *args):  # quiet
         pass
@@ -154,14 +159,27 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _stream_watch(self, api_version: str, kind: str,
                       ns: str | None) -> None:
+        import time as _time
+
         stream = self.fake.watch(api_version, kind, ns)
+        deadline = (_time.monotonic() + self.watch_timeout_seconds
+                    if self.watch_timeout_seconds else None)
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
         self.send_header("Transfer-Encoding", "chunked")
         self.end_headers()
         try:
             while True:
-                event = stream.next(timeout=1.0)
+                wait = 1.0
+                if deadline is not None:
+                    wait = deadline - _time.monotonic()
+                    if wait <= 0:
+                        # Server-side stream timeout: drop the connection
+                        # the way a real apiserver / LB idle-timeout would.
+                        self.close_connection = True
+                        return
+                    wait = min(wait, 1.0)
+                event = stream.next(timeout=wait)
                 if event is None:
                     # Idle heartbeat: a bare newline chunk (iter_lines skips
                     # empty lines) so a disconnected client surfaces as a

@@ -16,13 +16,20 @@ themselves live here instead:
   (components/profile-controller port).
 """
 
-from kubeflow_tpu.operators.base import Controller, run_controllers
+from kubeflow_tpu.operators.base import (
+    Controller,
+    RateLimiter,
+    WorkQueue,
+    run_controllers,
+)
 from kubeflow_tpu.operators.jobs import JobController
 from kubeflow_tpu.operators.notebooks import NotebookController
 from kubeflow_tpu.operators.profiles import ProfileController
 
 __all__ = [
     "Controller",
+    "RateLimiter",
+    "WorkQueue",
     "run_controllers",
     "JobController",
     "NotebookController",

@@ -122,7 +122,7 @@ class IssuerController(Controller):
 
         if status != issuer.get("status"):
             issuer["status"] = status
-            self.client.update_status(issuer)
+            self._push_status(issuer)  # refetch-and-reapply on conflict
 
     def ca_for(self, name: str, ns: str) -> pki.KeyCert | None:
         """Load the Issuer's CA keypair (selfSigned and acme issuers both
@@ -256,7 +256,7 @@ class CertificateController(Controller):
     def _set_status(self, cert: dict, status: dict) -> None:
         if status != cert.get("status"):
             cert["status"] = status
-            self.client.update_status(cert)
+            self._push_status(cert)  # refetch-and-reapply on conflict
 
     def _publish_challenge(self, ns: str, name: str, token: str) -> None:
         cm = self.client.get_or_none("v1", "ConfigMap",
@@ -398,4 +398,4 @@ class EndpointController(Controller):
         status = {"ready": True, "recordedTarget": target}
         if status != ep.get("status"):
             ep["status"] = status
-            self.client.update_status(ep)
+            self._push_status(ep)  # refetch-and-reapply on conflict

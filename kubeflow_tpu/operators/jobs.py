@@ -80,8 +80,7 @@ class JobController(Controller):
         state = status.get("state")
 
         if state in ("Succeeded", "Failed"):
-            self._handle_finished(job)
-            return
+            return self._handle_finished(job)
 
         try:
             api.validate_job(job)
@@ -479,13 +478,13 @@ class JobController(Controller):
         self._push_status(job)
         self._clean_pods(job)
 
-    def _handle_finished(self, job: dict) -> None:
+    def _handle_finished(self, job: dict) -> float | None:
         ttl = job["spec"].get("runPolicy", {}).get("ttlSecondsAfterFinished")
         if ttl is None:
-            return
+            return None
         done_at = job["status"].get("completionTime")
         if not done_at:
-            return
+            return None
         age = (
             datetime.datetime.now(datetime.timezone.utc)
             - _parse_time(done_at)
@@ -495,6 +494,10 @@ class JobController(Controller):
                 self.api_version, self.kind, job["metadata"]["name"],
                 job["metadata"]["namespace"],
             )
+            return None
+        # Requeue-after: wake exactly when the TTL lapses instead of
+        # burning resync passes until then.
+        return max(ttl - age, 0.1)
 
     def _clean_pods(self, job: dict) -> None:
         policy = job["spec"].get("runPolicy", {}).get("cleanPodPolicy",
@@ -508,6 +511,10 @@ class JobController(Controller):
                     POD_API, "Pod", pod["metadata"]["name"],
                     pod["metadata"]["namespace"],
                 )
+
+    # Status writes go through Controller._push_status (refetch-and-reapply
+    # on conflict): a reconcile racing the watch-driven requeue must not
+    # park the job until the next resync.
 
     def _set_condition(self, job: dict, ctype: str, reason: str,
                        message: str) -> None:
@@ -529,17 +536,6 @@ class JobController(Controller):
             job["status"].setdefault("state", "Created")
         elif ctype in (api.COND_RUNNING, api.COND_RESTARTING):
             job["status"]["state"] = ctype
-
-    def _push_status(self, job: dict) -> None:
-        current = self.client.get_or_none(
-            self.api_version, self.kind, job["metadata"]["name"],
-            job["metadata"]["namespace"],
-        )
-        if current is None:
-            return
-        current["status"] = job["status"]
-        self.client.update_status(current)
-
 
 def make_job_controllers(client) -> list[JobController]:
     return [JobController(client, kind) for kind in api.ALL_JOB_KINDS]

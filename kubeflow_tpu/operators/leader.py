@@ -18,7 +18,7 @@ import threading
 import time
 import uuid
 
-from kubeflow_tpu.k8s.client import ApiError, K8sClient
+from kubeflow_tpu.k8s.client import ApiError, K8sClient, retry_on_conflict
 
 log = logging.getLogger(__name__)
 
@@ -34,13 +34,23 @@ class LeaderElector:
                  namespace: str = "kubeflow",
                  identity: str | None = None,
                  lease_seconds: float = 15.0,
-                 renew_seconds: float = 5.0):
+                 renew_seconds: float = 5.0,
+                 renew_deadline_seconds: float | None = None):
         self.client = client
         self.name = name
         self.namespace = namespace
         self.identity = identity or f"{name}-{uuid.uuid4().hex[:8]}"
         self.lease_seconds = lease_seconds
         self.renew_seconds = renew_seconds
+        # How long a leader rides out transient renewal failures before
+        # abdicating (client-go's renewDeadline, default 2/3 of the lease:
+        # 10 s for the 15 s default). STRICTLY less than lease_seconds, so
+        # a leader cut off from the apiserver stops reconciling before any
+        # standby can possibly seize the lease — no two-leader window.
+        self.renew_deadline_seconds = (
+            renew_deadline_seconds if renew_deadline_seconds is not None
+            else lease_seconds * 2.0 / 3.0
+        )
         self._stop = threading.Event()
         self._is_leader = threading.Event()
         # Expiry is judged from locally *observed* (holder, renewTime)
@@ -115,17 +125,21 @@ class LeaderElector:
             self._is_leader.clear()
             return False
         except ApiError as e:
-            if e.code == 409:
-                # Lost the update race to another candidate — definitive.
-                self._is_leader.clear()
+            if e.code == 409 and not self._is_leader.is_set():
+                # Lost an acquire race to another candidate — definitive.
                 return False
             log.warning("%s: lease attempt failed: %s", self.name, e)
-            # A transient apiserver error must not demote a leader whose
-            # lease is still valid (client-go retries until the renew
-            # deadline): keep leadership until our own last successful
-            # renew is a full lease duration old.
+            # A transient failure (apiserver 5xx, or a spurious conflict a
+            # flaky proxy injected on our own renewal — the next attempt
+            # refetches the lease and retries with a fresh resourceVersion)
+            # must not demote a leader whose lease is still valid. But only
+            # until the renew DEADLINE: abdicating strictly before the
+            # lease expires guarantees a cut-off leader stops reconciling
+            # before any standby can seize the lease (client-go
+            # renewDeadline semantics — no two-leader window).
             if self._is_leader.is_set() and self._last_renew is not None:
-                if time.monotonic() - self._last_renew <= self.lease_seconds:
+                age = time.monotonic() - self._last_renew
+                if age <= self.renew_deadline_seconds:
                     return True
             self._is_leader.clear()
             return False
@@ -174,19 +188,20 @@ class LeaderElector:
             thread.join(timeout=2 * self.renew_seconds)
         if not self._is_leader.is_set():
             return
-        for _attempt in range(3):  # retry lost-update races
-            try:
-                lease = self.client.get_or_none(
-                    LEASE_API_VERSION, "Lease", self.name, self.namespace
-                )
-                if not lease or lease.get("spec", {}).get(
-                    "holderIdentity"
-                ) != self.identity:
-                    break
-                lease["spec"]["holderIdentity"] = ""
-                self.client.update(lease)
-                break
-            except ApiError as e:
-                if e.code != 409:
-                    break
+
+        def _clear(client: K8sClient) -> None:
+            lease = client.get_or_none(
+                LEASE_API_VERSION, "Lease", self.name, self.namespace
+            )
+            if not lease or lease.get("spec", {}).get(
+                "holderIdentity"
+            ) != self.identity:
+                return
+            lease["spec"]["holderIdentity"] = ""
+            client.update(lease)
+
+        try:
+            retry_on_conflict(self.client, _clear, attempts=3)
+        except ApiError:
+            pass  # best effort — the lease will expire on its own
         self._is_leader.clear()

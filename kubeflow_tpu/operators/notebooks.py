@@ -127,7 +127,6 @@ class NotebookController(Controller):
             cstates = pod.get("status", {}).get("containerStatuses", [])
             if cstates:
                 status["containerState"] = cstates[0].get("state", {})
-        current = self.client.get_or_none(self.api_version, self.kind, name, ns)
-        if current is not None and current.get("status") != status:
-            current["status"] = status
-            self.client.update_status(current)
+        nb = copy.deepcopy(nb)
+        nb["status"] = status
+        self._push_status(nb)  # refetch-and-reapply on conflict

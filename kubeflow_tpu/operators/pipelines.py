@@ -139,7 +139,7 @@ class WorkflowController(Controller):
             toposort_tasks(tasks)
         except ValueError as e:
             status.update(phase=PHASE_FAILED, message=f"invalid DAG: {e}")
-            self.client.update_status(wf)
+            self._push_status(wf)
             return
 
         status.setdefault("phase", PHASE_RUNNING)
@@ -172,8 +172,11 @@ class WorkflowController(Controller):
                                              create=not failed)
             except ApiError as e:
                 # Malformed task resource (bad kind, schema reject): fail
-                # the task visibly instead of log-and-retry forever.
-                if 400 <= e.code < 500 and e.code != 409:
+                # the task visibly instead of log-and-retry forever. A
+                # transient 4xx (429 load-shedding, 408 timeout) is NOT a
+                # rejection — re-raise so the workqueue retries it.
+                if (400 <= e.code < 500 and e.code != 409
+                        and not e.transient):
                     ts.update(phase=PHASE_FAILED,
                               message=f"create failed: {e}")
                     continue
@@ -210,8 +213,10 @@ class WorkflowController(Controller):
             status["finishedAt"] = _stamp(self._now())
         # Only write on change: an unconditional PUT emits MODIFIED, which
         # requeues this object — a self-triggering hot loop under run().
+        # _push_status refetches-and-reapplies on 409, so losing a write
+        # race against another manager costs a round-trip, not a resync.
         if status != before:
-            self.client.update_status(wf)
+            self._push_status(wf)
             # Durable run record (pipeline-persistenceagent role) —
             # mirrors every status transition and survives CR deletion.
             self.runs.record(wf)
@@ -408,7 +413,7 @@ class ScheduledWorkflowController(Controller):
         except ValueError as e:
             status.update(conditions="Invalid", message=str(e))
             if status != before:
-                self.client.update_status(swf)
+                self._push_status(swf)
             return
         if status.get("conditions") == "Invalid":
             # The schedule was fixed; clear the stale condition.
@@ -425,7 +430,7 @@ class ScheduledWorkflowController(Controller):
         if limit:
             self._prune_history(name, ns, limit, stamped)
         if status != before:
-            self.client.update_status(swf)
+            self._push_status(swf)
 
     # ------------------------------------------------------------------
 
@@ -576,4 +581,4 @@ class ApplicationController(Controller):
             else PHASE_PENDING
         )
         if status != before:  # avoid the self-triggering MODIFIED loop
-            self.client.update_status(app)
+            self._push_status(app)

@@ -65,7 +65,6 @@ class ProfileController(Controller):
                 "spec": quota,
             })
 
-        current = self.client.get_or_none(self.api_version, self.kind, name)
-        if current is not None and current.get("status", {}).get("state") != "Ready":
-            current["status"] = {"state": "Ready"}
-            self.client.update_status(current)
+        if profile.get("status", {}).get("state") != "Ready":
+            profile = dict(profile, status={"state": "Ready"})
+            self._push_status(profile)  # refetch-and-reapply on conflict

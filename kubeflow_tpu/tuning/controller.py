@@ -185,11 +185,6 @@ class StudyJobController(Controller):
                 "jobName": meta["name"],
             })
 
-    def _push_status(self, study: dict) -> None:
-        current = self.client.get_or_none(
-            self.api_version, self.kind, study["metadata"]["name"],
-            study["metadata"]["namespace"],
-        )
-        if current is not None and current.get("status") != study["status"]:
-            current["status"] = study["status"]
-            self.client.update_status(current)
+    # Status writes go through Controller._push_status: the trial-spawn
+    # reconcile races pod events requeuing the study, so conflicts are
+    # refetched-and-reapplied instead of parking until resync.

@@ -1,0 +1,676 @@
+"""Chaos tier: controllers proved against a hostile apiserver.
+
+The fault model the platform actually faces (PAPER/SURVEY: TPU-scale
+clusters where preemptions and transient control-plane errors are the
+steady state): :class:`~kubeflow_tpu.k8s.chaos.ChaosApiServer` wraps the
+fake apiserver and injects seeded transient 429/500/503s, spurious
+conflicts, lost create responses, added latency, and watch-stream drops.
+
+Tiers:
+- fast tests (tier-1): workqueue/backoff semantics, conflict retry,
+  watch reconnect + relist, the reconcile_deleted hook;
+- ``-m chaos`` soaks (also marked slow, excluded from tier-1): full
+  JaxJob-gang and Workflow lifecycles reconciling to completion across a
+  seed matrix with no duplicate side effects, and leader-election
+  failover under injected faults. Seeds come from ``CHAOS_SEEDS``
+  (default ``0,1,2``) so CI failures reproduce locally bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import pytest
+
+from kubeflow_tpu.apis import jobs as jobs_api
+from kubeflow_tpu.apis.pipelines import (
+    PIPELINES_API_VERSION,
+    workflow_crd,
+)
+from kubeflow_tpu.k8s.chaos import ChaosApiServer
+from kubeflow_tpu.k8s.client import ApiError, retry_on_conflict
+from kubeflow_tpu.k8s.fake import FakeApiServer
+from kubeflow_tpu.operators.base import Controller, RateLimiter, WorkQueue
+from kubeflow_tpu.operators.jobs import JobController
+from kubeflow_tpu.operators.leader import LeaderElector
+from kubeflow_tpu.operators.pipelines import WorkflowController
+
+NS = "kubeflow"
+
+CHAOS_SEEDS = [int(s) for s in
+               os.environ.get("CHAOS_SEEDS", "0,1,2").split(",")]
+
+
+def _wait_for(predicate, timeout: float = 10.0, interval: float = 0.02,
+              message: str = "condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        result = predicate()
+        if result:
+            return result
+        time.sleep(interval)
+    raise AssertionError(f"timed out after {timeout}s waiting for {message}")
+
+
+def _configmap(name: str, ns: str = NS, data: dict | None = None) -> dict:
+    return {"apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": name, "namespace": ns},
+            "data": data or {}}
+
+
+class _Recorder(Controller):
+    """Minimal primary-kind reconciler recording what it observed."""
+
+    api_version = "v1"
+    kind = "ConfigMap"
+    resync_seconds = 60.0  # effectively off: events must drive everything
+
+    def __init__(self, client):
+        super().__init__(client)
+        self.seen: list[tuple[str, str]] = []
+        self.deleted: list[str] = []
+
+    def reconcile(self, obj):
+        self.seen.append((obj["metadata"]["name"],
+                          obj["metadata"]["resourceVersion"]))
+
+    def reconcile_deleted(self, obj):
+        self.deleted.append(obj["metadata"]["name"])
+
+
+def _run_in_thread(ctrl: Controller) -> threading.Thread:
+    t = threading.Thread(target=ctrl.run, daemon=True)
+    t.start()
+    return t
+
+
+# ---------------------------------------------------------------------------
+# workqueue + rate limiter semantics (fast)
+# ---------------------------------------------------------------------------
+
+
+def test_rate_limiter_grows_exponentially_and_caps():
+    rl = RateLimiter(base=0.01, cap=5.0)
+    delays = [rl.when("k") for _ in range(12)]
+    # Jitter is [0.5, 1.5): compare against the un-jittered envelope.
+    for i, d in enumerate(delays):
+        ideal = min(0.01 * 2 ** i, 5.0)
+        assert ideal * 0.5 <= d < ideal * 1.5, (i, d)
+    assert delays[0] < 0.02  # first failure retries in ~10 ms
+    assert max(delays) <= 5.0 * 1.5
+    rl.forget("k")
+    assert rl.when("k") < 0.02  # success resets the backoff
+
+
+def test_workqueue_dedups_and_respects_delay():
+    q = WorkQueue()
+    q.add("a", delay=0.2)
+    q.add("a", delay=0.05)  # earlier due wins
+    q.add("a", delay=10.0)  # later due is ignored
+    assert len(q) == 1
+    assert q.get(timeout=0.01) is None  # not due yet
+    t0 = time.monotonic()
+    assert q.get(timeout=2.0) == "a"
+    took = time.monotonic() - t0
+    assert took < 0.2, f"dedup kept the later due time ({took:.3f}s)"
+    q.close()
+    assert q.get(timeout=0.01) is None
+
+
+def test_workqueue_orders_by_due_time():
+    q = WorkQueue()
+    q.add("late", delay=0.08)
+    q.add("now")
+    q.add("soon", delay=0.04)
+    got = [q.get(timeout=1.0) for _ in range(3)]
+    assert got == ["now", "soon", "late"]
+
+
+# ---------------------------------------------------------------------------
+# retry_on_conflict (fast)
+# ---------------------------------------------------------------------------
+
+
+def test_retry_on_conflict_refetches_until_write_lands(api):
+    api.create(_configmap("rc", data={"v": "0"}))
+    calls = {"n": 0}
+
+    def bump(client):
+        calls["n"] += 1
+        cm = client.get("v1", "ConfigMap", "rc", NS)
+        if calls["n"] < 3:
+            cm["metadata"]["resourceVersion"] = "0"  # simulate losing a race
+        cm["data"]["v"] = str(int(cm["data"]["v"]) + 1)
+        return client.update(cm)
+
+    updated = retry_on_conflict(api, bump)
+    assert calls["n"] == 3
+    assert updated["data"]["v"] == "1"
+
+
+def test_retry_on_conflict_passes_through_other_errors(api):
+    with pytest.raises(ApiError) as e:
+        retry_on_conflict(api, lambda c: c.get("v1", "ConfigMap", "no", NS))
+    assert e.value.code == 404
+
+
+def test_retry_on_conflict_gives_up_after_attempts(api):
+    calls = {"n": 0}
+
+    def always_conflicts(_client):
+        calls["n"] += 1
+        raise ApiError.conflict("never resolves")
+
+    with pytest.raises(ApiError):
+        retry_on_conflict(api, always_conflicts, attempts=4)
+    assert calls["n"] == 4
+
+
+# ---------------------------------------------------------------------------
+# chaos client semantics (fast)
+# ---------------------------------------------------------------------------
+
+
+def _chaos_call_trace(seed: int) -> list[tuple[str, str | None, int]]:
+    fake = FakeApiServer()
+    fake.ensure_namespace("default")
+    chaos = ChaosApiServer(fake, seed=seed, error_rate=0.3,
+                           conflict_rate=0.3, error_after_create_rate=0.2)
+    for i in range(40):
+        try:
+            chaos.create(_configmap(f"c{i}", ns="default"))
+        except ApiError:
+            pass
+        try:
+            obj = chaos.get("v1", "ConfigMap", f"c{i}", "default")
+            obj["data"]["i"] = str(i)
+            chaos.update(obj)
+        except ApiError:
+            pass
+    return [(r.verb, r.fault, r.code) for r in chaos.journal]
+
+
+def test_chaos_faults_are_seeded_and_deterministic():
+    assert _chaos_call_trace(7) == _chaos_call_trace(7)
+    assert _chaos_call_trace(7) != _chaos_call_trace(8)
+
+
+def test_chaos_injects_transient_errors_with_k8s_codes():
+    fake = FakeApiServer()
+    fake.ensure_namespace("default")
+    chaos = ChaosApiServer(fake, seed=1, error_rate=1.0)
+    with pytest.raises(ApiError) as e:
+        chaos.get("v1", "ConfigMap", "x", "default")
+    assert e.value.code in (429, 500, 503)
+    assert chaos.faults("get")
+
+
+def test_chaos_injected_conflict_does_not_land_the_write():
+    fake = FakeApiServer()
+    fake.ensure_namespace("default")
+    created = fake.create(_configmap("cc", ns="default", data={"v": "0"}))
+    chaos = ChaosApiServer(fake, seed=1, conflict_rate=1.0)
+    created["data"]["v"] = "1"
+    with pytest.raises(ApiError) as e:
+        chaos.update(created)
+    assert e.value.code == 409
+    assert fake.get("v1", "ConfigMap", "cc", "default")["data"]["v"] == "0"
+
+
+def test_chaos_error_after_create_lands_the_object():
+    """The lost-response case: the caller sees a 500 but the object exists —
+    a blind retry must cope with 409 AlreadyExists."""
+    fake = FakeApiServer()
+    fake.ensure_namespace("default")
+    chaos = ChaosApiServer(fake, seed=1, error_after_create_rate=1.0)
+    with pytest.raises(ApiError) as e:
+        chaos.create(_configmap("lost", ns="default"))
+    assert e.value.code == 500
+    assert fake.get("v1", "ConfigMap", "lost", "default")
+    (rec,) = chaos.landed("create")
+    assert rec.fault == "ErrorAfterSuccess"
+
+
+# ---------------------------------------------------------------------------
+# controller runtime: backoff requeue, requeue-after, deletion hook (fast)
+# ---------------------------------------------------------------------------
+
+
+def test_failed_reconcile_requeues_with_backoff_not_resync(api):
+    """Two transient failures retry in tens of milliseconds; the old runtime
+    would have parked the object until the 60 s resync."""
+
+    class Flaky(_Recorder):
+        attempts = 0
+
+        def reconcile(self, obj):
+            Flaky.attempts += 1
+            if Flaky.attempts < 3:
+                raise ApiError(500, "InternalError", "chaos")
+            super().reconcile(obj)
+
+    ctrl = Flaky(api)
+    t = _run_in_thread(ctrl)
+    try:
+        api.create(_configmap("flaky"))
+        _wait_for(lambda: ctrl.seen, timeout=5.0,
+                  message="reconcile to succeed after backoff retries")
+        assert Flaky.attempts >= 3
+    finally:
+        ctrl.stop()
+        t.join(2)
+
+
+def test_conflicted_reconcile_requeues_quickly(api):
+    """A 409 loss requeues under backoff instead of waiting for resync."""
+
+    class Conflicted(_Recorder):
+        conflicts = 0
+
+        def reconcile(self, obj):
+            if Conflicted.conflicts < 2:
+                Conflicted.conflicts += 1
+                raise ApiError.conflict("stale")
+            super().reconcile(obj)
+
+    ctrl = Conflicted(api)
+    t = _run_in_thread(ctrl)
+    try:
+        api.create(_configmap("conf"))
+        t0 = time.monotonic()
+        _wait_for(lambda: ctrl.seen, timeout=5.0,
+                  message="conflicted reconcile to retry")
+        assert time.monotonic() - t0 < ctrl.resync_seconds
+    finally:
+        ctrl.stop()
+        t.join(2)
+
+
+def test_requeue_after_drives_periodic_reconciles(api):
+    class Ticker(_Recorder):
+        def reconcile(self, obj):
+            super().reconcile(obj)
+            return 0.02  # requeue-after
+
+    ctrl = Ticker(api)
+    t = _run_in_thread(ctrl)
+    try:
+        api.create(_configmap("tick"))
+        _wait_for(lambda: len(ctrl.seen) >= 5, timeout=5.0,
+                  message="requeue-after to re-reconcile")
+    finally:
+        ctrl.stop()
+        t.join(2)
+
+
+def test_reconcile_deleted_hook_fires_for_primary_kind(api):
+    ctrl = _Recorder(api)
+    t = _run_in_thread(ctrl)
+    try:
+        api.create(_configmap("doomed"))
+        _wait_for(lambda: ctrl.seen, message="initial reconcile")
+        api.delete("v1", "ConfigMap", "doomed", NS)
+        _wait_for(lambda: "doomed" in ctrl.deleted, timeout=5.0,
+                  message="reconcile_deleted hook")
+    finally:
+        ctrl.stop()
+        t.join(2)
+
+
+# ---------------------------------------------------------------------------
+# watch self-healing (fast)
+# ---------------------------------------------------------------------------
+
+
+def test_severed_watch_reconnects_and_observes_next_change(api):
+    """Acceptance: with resync effectively off (60 s), a controller whose
+    watch is severed observes a subsequent object change within seconds —
+    via reconnect + relist, not resync."""
+    chaos = ChaosApiServer(api, seed=0)  # no random faults; manual sever
+    ctrl = _Recorder(chaos)
+    t = _run_in_thread(ctrl)
+    try:
+        api.create(_configmap("watched", data={"k": "v1"}))
+        _wait_for(lambda: ctrl.seen, message="initial reconcile")
+        assert chaos.drop_watches() >= 1  # every stream severed
+
+        cm = api.get("v1", "ConfigMap", "watched", NS)
+        cm["data"]["k"] = "v2"
+        new_rv = api.update(cm)["metadata"]["resourceVersion"]
+        t0 = time.monotonic()
+        _wait_for(lambda: any(rv == new_rv for _, rv in ctrl.seen),
+                  timeout=5.0, message="post-sever change to be observed")
+        assert time.monotonic() - t0 < ctrl.resync_seconds
+    finally:
+        ctrl.stop()
+        t.join(2)
+
+
+def test_http_watch_reconnects_with_synthetic_relist():
+    """HttpK8sClient.watch survives server-side stream drops: the fake
+    apiserver kills every watch connection after 0.3 s, and events keep
+    arriving across reconnects (plus ADDED relist replays)."""
+    from kubeflow_tpu.k8s.client import ClusterConfig, HttpK8sClient
+    from kubeflow_tpu.k8s.httpfake import serve
+
+    fake = FakeApiServer()
+    fake.ensure_namespace(NS)
+    httpd, port = serve(fake)
+    httpd.RequestHandlerClass.watch_timeout_seconds = 0.3
+    client = HttpK8sClient(ClusterConfig(host=f"http://127.0.0.1:{port}"))
+    stream = client.watch("v1", "ConfigMap", NS)
+    seen: list[tuple[str, str]] = []
+
+    def consume():
+        for event in stream:
+            seen.append((event.type, event.object["metadata"]["name"]))
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    try:
+        fake.create(_configmap("before-drop"))
+        _wait_for(lambda: ("ADDED", "before-drop") in seen,
+                  message="event before the drop")
+        time.sleep(0.6)  # at least one server-side drop + reconnect
+        fake.create(_configmap("after-drop"))
+        _wait_for(lambda: ("ADDED", "after-drop") in seen, timeout=10.0,
+                  message="event after reconnect")
+        # The reconnect replayed current state (synthetic relist), so the
+        # pre-drop object was re-observed too.
+        assert seen.count(("ADDED", "before-drop")) >= 2
+    finally:
+        stream.stop()
+        httpd.shutdown()
+
+
+def test_transient_429_does_not_fail_workflow_task(api):
+    """Regression (found by the soak): a 429 on task-resource creation is
+    apiserver load-shedding, not a schema rejection — the task must be
+    retried, never marked Failed. A true 4xx rejection still fails fast."""
+    api.apply(workflow_crd())
+    chaos = ChaosApiServer(api, seed=0,
+                           per_verb_error={"create": 1.0})
+    ctrl = WorkflowController(chaos)
+    wf = api.create({
+        "apiVersion": PIPELINES_API_VERSION, "kind": "Workflow",
+        "metadata": {"name": "throttled", "namespace": NS},
+        "spec": {"tasks": [{"name": "prep", "resource": {
+            "apiVersion": "v1", "kind": "ConfigMap", "data": {}}}]},
+    })
+    with pytest.raises(ApiError) as e:
+        ctrl.reconcile(wf)
+    assert e.value.transient
+    status = api.get(PIPELINES_API_VERSION, "Workflow", "throttled",
+                     NS).get("status", {})
+    task = status.get("tasks", {}).get("prep", {})
+    assert task.get("phase") != "Failed", task
+    # The throttling stops: the same reconcile now completes the task.
+    chaos.set_rates(per_verb_error={})
+    ctrl.reconcile(api.get(PIPELINES_API_VERSION, "Workflow", "throttled",
+                           NS))
+    status = api.get(PIPELINES_API_VERSION, "Workflow", "throttled",
+                     NS)["status"]
+    assert status["tasks"]["prep"]["phase"] == "Succeeded"
+
+
+# ---------------------------------------------------------------------------
+# chaos soaks (seeded matrix; -m chaos, excluded from tier-1 via slow)
+# ---------------------------------------------------------------------------
+
+
+def _jax_job(name: str, replicas: int = 3) -> dict:
+    return {
+        "apiVersion": jobs_api.JOBS_API_VERSION,
+        "kind": "JaxJob",
+        "metadata": {"name": name, "namespace": NS},
+        "spec": {
+            "replicaSpecs": {
+                "Worker": {
+                    "replicas": replicas,
+                    "restartPolicy": "OnFailure",
+                    "template": {"spec": {"containers": [
+                        {"name": "main", "image": "train:latest"}
+                    ]}},
+                },
+            },
+        },
+    }
+
+
+def _soak_chaos(fake: FakeApiServer, seed: int) -> ChaosApiServer:
+    return ChaosApiServer(
+        fake, seed=seed,
+        error_rate=0.12,           # ≥10% transient 429/500/503 on every verb
+        conflict_rate=0.25,        # extra conflicts on update/update_status
+        error_after_create_rate=0.1,
+        watch_drop_rate=0.5,       # half of all streams are drop-fated
+        latency_seconds=0.002,
+    )
+
+
+def _speed_up(ctrl: Controller) -> None:
+    ctrl.resync_seconds = 0.5
+    ctrl._limiter = RateLimiter(0.01, 0.5)  # cap backoff for test wall-clock
+
+
+def _set_pod_phase(fake, pod_name, phase):
+    pod = fake.get("v1", "Pod", pod_name, NS)
+    pod["status"] = {"phase": phase}
+    fake.update_status(pod)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", CHAOS_SEEDS)
+def test_chaos_soak_jaxjob_gang_converges(seed):
+    """JaxJob gangs run to Succeeded against an apiserver injecting
+    transient errors, conflicts, lost create responses, and watch drops —
+    with every pod created exactly once (idempotency under retry)."""
+    n_jobs, replicas = 4, 3
+    fake = FakeApiServer()
+    fake.ensure_namespace(NS)
+    for crd in jobs_api.all_job_crds():
+        fake.apply(crd)
+    chaos = _soak_chaos(fake, seed)
+    ctrl = JobController(chaos, "JaxJob")
+    _speed_up(ctrl)
+    t = _run_in_thread(ctrl)
+    names = [f"soak{j}" for j in range(n_jobs)]
+    try:
+        for name in names:
+            fake.create(_jax_job(name, replicas=replicas))
+        _wait_for(
+            lambda: len(fake.list("v1", "Pod", NS)) == n_jobs * replicas,
+            timeout=45.0, message=f"gang creation (seed={seed})")
+        for name in names:
+            for i in range(replicas):
+                _set_pod_phase(fake, f"{name}-worker-{i}", "Running")
+        _wait_for(
+            lambda: all(
+                fake.get(jobs_api.JOBS_API_VERSION, "JaxJob", name,
+                         NS).get("status", {}).get("state") == "Running"
+                for name in names),
+            timeout=45.0, message=f"Running state (seed={seed})")
+        for name in names:
+            for i in range(replicas):
+                _set_pod_phase(fake, f"{name}-worker-{i}", "Succeeded")
+        _wait_for(
+            lambda: all(
+                fake.get(jobs_api.JOBS_API_VERSION, "JaxJob", name,
+                         NS).get("status", {}).get("state") == "Succeeded"
+                for name in names),
+            timeout=45.0, message=f"Succeeded state (seed={seed})")
+    finally:
+        ctrl.stop()
+        t.join(3)
+
+    # Idempotency: every pod (and each headless service) landed exactly once.
+    pod_creates = [r.name for r in chaos.landed("create", "Pod")]
+    assert sorted(pod_creates) == sorted(set(pod_creates)), pod_creates
+    assert len(pod_creates) == n_jobs * replicas
+    svc_creates = [r.name for r in chaos.landed("create", "Service")]
+    assert len(svc_creates) == len(set(svc_creates)), svc_creates
+    # No spurious restarts: no gang was ever torn down by chaos.
+    for name in names:
+        job = fake.get(jobs_api.JOBS_API_VERSION, "JaxJob", name, NS)
+        assert job["status"].get("restartCount", 0) == 0, name
+    # The soak actually exercised the fault model.
+    assert len(chaos.faults()) >= 10, "chaos injected too few faults"
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", CHAOS_SEEDS)
+def test_chaos_soak_workflow_converges(seed):
+    """A pipeline Workflow (DAG with a mid-flight Pod task) completes under
+    the same fault model, creating each task resource exactly once."""
+    fake = FakeApiServer()
+    fake.ensure_namespace(NS)
+    fake.apply(workflow_crd())
+    for crd in jobs_api.all_job_crds():
+        fake.apply(crd)
+    chaos = _soak_chaos(fake, seed)
+    ctrl = WorkflowController(chaos)
+    _speed_up(ctrl)
+    t = _run_in_thread(ctrl)
+    try:
+        fake.create({
+            "apiVersion": PIPELINES_API_VERSION,
+            "kind": "Workflow",
+            "metadata": {"name": "cwf", "namespace": NS},
+            "spec": {"tasks": [
+                {"name": "prep",
+                 "resource": {"apiVersion": "v1", "kind": "ConfigMap",
+                              "data": {"stage": "prep"}}},
+                {"name": "train", "dependencies": ["prep"],
+                 "resource": {"apiVersion": "v1", "kind": "Pod",
+                              "spec": {"containers": [
+                                  {"name": "main", "image": "i"}]}}},
+                {"name": "publish", "dependencies": ["train"],
+                 "resource": {"apiVersion": "v1", "kind": "ConfigMap",
+                              "data": {"stage": "publish"}}},
+            ]},
+        })
+        _wait_for(lambda: fake.get_or_none("v1", "Pod", "cwf-train", NS),
+                  timeout=30.0, message=f"train pod creation (seed={seed})")
+        _set_pod_phase(fake, "cwf-train", "Succeeded")
+        _wait_for(
+            lambda: fake.get(PIPELINES_API_VERSION, "Workflow", "cwf",
+                             NS).get("status", {}).get("phase")
+            == "Succeeded",
+            timeout=30.0, message=f"workflow completion (seed={seed})")
+    finally:
+        ctrl.stop()
+        t.join(3)
+
+    wf = fake.get(PIPELINES_API_VERSION, "Workflow", "cwf", NS)
+    assert all(ts["phase"] == "Succeeded"
+               for ts in wf["status"]["tasks"].values())
+    # Each task resource created exactly once despite retries.
+    for kind in ("ConfigMap", "Pod"):
+        creates = [r.name for r in chaos.landed("create", kind)
+                   if r.name.startswith("cwf-")]
+        assert sorted(creates) == sorted(set(creates)), (kind, creates)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_leader_failover_under_injected_faults():
+    """The holder's renewals start failing (injected 500s + conflicts): the
+    standby must take over only after the lease window — and at no sampled
+    instant may both candidates consider themselves leader."""
+    fake = FakeApiServer()
+    fake.ensure_namespace(NS)
+    chaos_a = ChaosApiServer(fake, seed=11)  # healthy until we flip rates
+    a = LeaderElector(chaos_a, name="chaos-mgr", identity="a",
+                      lease_seconds=1.5, renew_seconds=0.25,
+                      renew_deadline_seconds=0.8)
+    b = LeaderElector(fake, name="chaos-mgr", identity="b",
+                      lease_seconds=1.5, renew_seconds=0.25,
+                      renew_deadline_seconds=0.8)
+    assert a.try_acquire()
+    assert not b.try_acquire()
+
+    violations: list[float] = []
+    b_led_at: list[float] = []
+    stop = threading.Event()
+
+    def monitor():
+        while not stop.is_set():
+            a_leads, b_leads = a.is_leader, b.is_leader
+            now = time.monotonic()
+            if a_leads and b_leads:
+                violations.append(now)
+            if b_leads and not b_led_at:
+                b_led_at.append(now)
+            time.sleep(0.002)
+
+    mon = threading.Thread(target=monitor, daemon=True)
+    mon.start()
+    a.start()
+    b.start()
+    try:
+        time.sleep(0.8)  # healthy renewals under a's chaos client (no faults)
+        assert a.is_leader and not b.is_leader
+        # Apiserver turns hostile for a only: every renewal now hits an
+        # injected 500 or a spurious conflict.
+        fault_start = time.monotonic()
+        chaos_a.set_rates(conflict_rate=1.0,
+                          per_verb_error={"update": 0.5})
+        _wait_for(lambda: b.is_leader, timeout=10.0,
+                  message="standby takeover")
+        takeover_delay = b_led_at[0] - fault_start
+        # Takeover happened only after the lease window (modulo the renew
+        # tick that was in flight when the faults started).
+        assert takeover_delay >= a.lease_seconds - a.renew_seconds - 0.05, (
+            f"standby seized a live lease after {takeover_delay:.2f}s")
+        _wait_for(lambda: not a.is_leader, timeout=5.0,
+                  message="deposed leader to demote itself")
+    finally:
+        stop.set()
+        a._stop.set()
+        b._stop.set()
+        mon.join(1)
+    assert not violations, (
+        f"two leaders at {len(violations)} sampled instants")
+
+
+# ---------------------------------------------------------------------------
+# cascade-delete scoping (fast — satellite of the chaos PR)
+# ---------------------------------------------------------------------------
+
+
+def test_cluster_scoped_owner_cascades_to_namespaced_children(api):
+    role = api.create({
+        "apiVersion": "rbac.authorization.k8s.io/v1", "kind": "ClusterRole",
+        "metadata": {"name": "owner-role"},
+        "rules": [],
+    })
+    api.create({
+        "apiVersion": "v1", "kind": "ConfigMap",
+        "metadata": {"name": "child", "namespace": NS,
+                     "ownerReferences": [{
+                         "apiVersion": "rbac.authorization.k8s.io/v1",
+                         "kind": "ClusterRole", "name": "owner-role",
+                         "uid": role["metadata"]["uid"]}]},
+    })
+    api.delete("rbac.authorization.k8s.io/v1", "ClusterRole", "owner-role")
+    assert api.get_or_none("v1", "ConfigMap", "child", NS) is None
+
+
+def test_namespaced_owner_does_not_cascade_across_namespaces(api):
+    owner = api.create(_configmap("owner", ns=NS))
+    api.create({
+        "apiVersion": "v1", "kind": "ConfigMap",
+        "metadata": {"name": "other-ns-child", "namespace": "default",
+                     "ownerReferences": [{
+                         "apiVersion": "v1", "kind": "ConfigMap",
+                         "name": "owner",
+                         "uid": owner["metadata"]["uid"]}]},
+    })
+    api.delete("v1", "ConfigMap", "owner", NS)
+    # ownerReferences never cross namespaces: the same-name/uid object in
+    # another namespace survives.
+    assert api.get_or_none("v1", "ConfigMap", "other-ns-child", "default")
