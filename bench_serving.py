@@ -14,13 +14,21 @@ dispatch (TTFT over the token stream, full-generation p50, decode tok/s
 under mixed-length concurrent load), then the lockstep engine on the same
 shapes.
 
+``--prefix-reuse`` benchmarks the continuous decoder's prefix KV cache:
+N concurrent requests sharing an S-token system prompt, cache on vs off,
+reporting TTFT, prefill token volume / dispatches, and the cache counters
+(`prefix_hits`, `prefix_tokens_reused`); emitted tokens must be identical
+both ways.
+
 Usage: python bench_serving.py [--quick] [--requests N] [--generate]
+       [--prefix-reuse]
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import math
 import time
 from concurrent.futures import ThreadPoolExecutor
 
@@ -28,8 +36,12 @@ import jax
 
 
 def percentile(sorted_vals, p):
-    i = min(int(len(sorted_vals) * p / 100), len(sorted_vals) - 1)
-    return sorted_vals[i]
+    """Nearest-rank percentile over an ascending list: the value at rank
+    ``ceil(p/100 * n)`` (1-based). The previous ``int(n*p/100)`` index
+    read one element high on exact-rank hits — p50 of an even-length
+    list returned the upper middle element."""
+    rank = math.ceil(len(sorted_vals) * p / 100)
+    return sorted_vals[max(rank, 1) - 1]
 
 
 def _bench_predict(args, model) -> dict:
@@ -189,6 +201,79 @@ def _bench_generate(args, model) -> dict:
     return out
 
 
+def _bench_prefix_reuse(args, model) -> dict:
+    """Prefix-reuse scenario: N concurrent requests sharing an S-token
+    system prompt, decoded greedily through the continuous decoder with
+    the prefix cache ON vs OFF. Reports TTFT, prefill dispatch/token
+    volume, and the cache counters; emitted tokens must be identical
+    both ways (``regression`` flags a mismatch or a <2x volume win)."""
+    from kubeflow_tpu.models.registry import get_model
+    from kubeflow_tpu.serving.continuous import ContinuousDecoder
+
+    spec = get_model(model)
+    params = spec.init(jax.random.PRNGKey(0), spec.config)
+    n = 16 if args.quick else max(16, args.requests // 8)
+    gen = min(args.max_new_tokens, 8)
+    system = list(range(3, 3 + args.prefix_len))  # the shared prefix
+    prompts = [system + [200 + i, 17, 11 + (i % 5)] for i in range(n)]
+    prefill_len = max(args.seq_len, args.prefix_len + 8)
+
+    runs = {}
+    for label, cache_slots in (("off", 0), ("on", 8)):
+        d = ContinuousDecoder(
+            params, spec.config, slots=8, prefill_len=prefill_len,
+            max_new_tokens=gen, prefix_cache_slots=cache_slots,
+            prefix_cache_min_len=16, prefill_len_buckets=3)
+        try:
+            if cache_slots:
+                # Preload the shared system prompt (what a production
+                # deployment does at startup) so every request hits.
+                d.prime_prefix(system)
+            # Warm the compiled admission shapes outside the timed burst.
+            d.generate(prompts[0][:4], 1)
+
+            def one(p):
+                h = d.submit(p, gen)
+                res = h.result(timeout=300)
+                return res["tokens"], h.ttft_s * 1e3
+            with ThreadPoolExecutor(args.concurrency) as pool:
+                results = list(pool.map(one, prompts))
+            m = d.metrics()
+        finally:
+            d.stop()
+        runs[label] = {
+            "tokens": [t for t, _ in results],
+            "ttft_p50_ms": round(percentile(
+                sorted(ms for _, ms in results), 50), 2),
+            "prefill_dispatches": m["prefill_dispatches"],
+            "prefill_tokens": m["prefill_tokens"],
+            "prefix_hits": m["prefix_hits"],
+            "prefix_tokens_reused": m["prefix_tokens_reused"],
+        }
+
+    identical = runs["on"]["tokens"] == runs["off"]["tokens"]
+    ratio = runs["off"]["prefill_tokens"] / max(
+        runs["on"]["prefill_tokens"], 1)
+    return {
+        "metric": "serving_prefix_reuse_ttft_p50_ms",
+        "value": runs["on"]["ttft_p50_ms"],
+        "unit": "ms",
+        "vs_baseline": 1.0,
+        "ttft_off_p50_ms": runs["off"]["ttft_p50_ms"],
+        "prefill_tokens_off": runs["off"]["prefill_tokens"],
+        "prefill_tokens_on": runs["on"]["prefill_tokens"],
+        "prefill_volume_ratio": round(ratio, 2),
+        "prefill_dispatches_off": runs["off"]["prefill_dispatches"],
+        "prefill_dispatches": runs["on"]["prefill_dispatches"],
+        "prefix_hits": runs["on"]["prefix_hits"],
+        "prefix_tokens_reused": runs["on"]["prefix_tokens_reused"],
+        "tokens_identical": identical,
+        "regression": (not identical) or ratio < 2.0,
+        "config": f"{model} prefix{args.prefix_len} n{n} gen{gen} "
+                  f"prefill{prefill_len} c{args.concurrency}",
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
@@ -202,10 +287,19 @@ def main() -> int:
     ap.add_argument("--decode-chunk", type=int, default=8,
                     help="decode steps fused per dispatch in the "
                          "continuous-mode measurement")
+    ap.add_argument("--prefix-reuse", action="store_true",
+                    help="benchmark the prefix KV cache: concurrent "
+                         "requests sharing a system prompt, cache on vs "
+                         "off (identical tokens required)")
+    ap.add_argument("--prefix-len", type=int, default=96,
+                    help="shared system-prompt length for --prefix-reuse")
     args = ap.parse_args()
 
     on_tpu = jax.default_backend() == "tpu"
-    if args.generate:
+    if args.prefix_reuse:
+        model = "llama-1b" if on_tpu and not args.quick else "lm-test-tiny"
+        result = _bench_prefix_reuse(args, model)
+    elif args.generate:
         model = "llama-1b" if on_tpu and not args.quick else "lm-test-tiny"
         result = _bench_generate(args, model)
     else:

@@ -1,0 +1,24 @@
+#!/bin/sh
+# CI bench-smoke (ci/pipeline.yaml `bench-smoke` stage): the serving-path
+# perf plumbing must keep emitting valid JSON with no regression marker.
+# Runs on CPU (the tiny presets) — this guards the measurement machinery
+# and the prefix-cache parity/volume invariants, not absolute numbers.
+set -e
+
+check_json() {
+    printf '%s\n' "$1" | python -c '
+import json, sys
+lines = [ln for ln in sys.stdin.read().splitlines() if ln.strip()]
+if not lines:
+    sys.exit("bench emitted no output")
+rec = json.loads(lines[-1])  # non-JSON output fails here
+if rec.get("regression"):
+    sys.exit(f"bench regression marker set: {rec}")
+'
+}
+
+out="$(JAX_PLATFORMS=cpu python bench_serving.py --quick --generate)"
+check_json "$out"
+out="$(JAX_PLATFORMS=cpu python bench_serving.py --quick --prefix-reuse)"
+check_json "$out"
+echo "bench smoke ok"

@@ -35,6 +35,13 @@ REST_PORT = 8500
         ParamSpec("num_tpu_chips", 1, "google.com/tpu chips per replica (0 = CPU)"),
         ParamSpec("batch_size", 8, "max server-side batch size"),
         ParamSpec("batch_timeout_ms", 5, "batching window"),
+        ParamSpec("prefix_cache_slots", 0,
+                  "device prefix-KV pool slots (0 disables prefix reuse)"),
+        ParamSpec("prefix_cache_min_len", 16,
+                  "shortest prompt prefix worth caching"),
+        ParamSpec("prefill_len_buckets", 0,
+                  "power-of-two prefill length buckets below the max "
+                  "sequence length (0 = fixed-length prefill)"),
         ParamSpec("enable_prometheus", True),
         ParamSpec("dtype", "bfloat16"),
     ],
@@ -49,6 +56,9 @@ def tpu_serving(
     num_tpu_chips: int,
     batch_size: int,
     batch_timeout_ms: int,
+    prefix_cache_slots: int,
+    prefix_cache_min_len: int,
+    prefill_len_buckets: int,
     enable_prometheus: bool,
     dtype: str,
 ) -> list[dict]:
@@ -62,6 +72,9 @@ def tpu_serving(
         f"--rest-port={REST_PORT}",
         f"--batch-size={batch_size}",
         f"--batch-timeout-ms={batch_timeout_ms}",
+        f"--prefix-cache-slots={prefix_cache_slots}",
+        f"--prefix-cache-min-len={prefix_cache_min_len}",
+        f"--prefill-len-buckets={prefill_len_buckets}",
         f"--dtype={dtype}",
     ]
     if enable_prometheus:

@@ -345,6 +345,117 @@ def admit_rows_and_step(state, params, cfg: TransformerConfig, slots,
     return state, last, tok, emit
 
 
+# ---------------------------------------------------------------------------
+# Prefix KV pool (serving/prefix_cache.py holds the host-side trie)
+# ---------------------------------------------------------------------------
+#
+# Most production prompts share a long common prefix (system prompt,
+# few-shot template); causality makes its K/V rows depend only on the
+# prefix tokens themselves, so they can be computed once, parked in a
+# fixed-capacity device pool, and gathered into a new request's row at
+# admission — the request then prefills ONLY its suffix. The pool is
+# deliberately functional (no donation): a store never invalidates the
+# array an in-flight admission already captured, so host-side pinning is
+# a logical-consistency guard, not a memory-safety one.
+
+
+def init_prefix_pool(cfg: TransformerConfig, pool_slots: int,
+                     max_prefix_len: int):
+    """Device prefix pool: ``pool_slots`` rows of per-layer K/V for up to
+    ``max_prefix_len`` positions, laid out like the decode cache (layer
+    dim leading) so row gather/scatter is a contiguous copy."""
+    shape = (cfg.n_layers, pool_slots, max_prefix_len, cfg.n_kv_heads,
+             cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+@jax.jit
+def store_prefix_row(pool, pool_slot, state, row):
+    """Publish decode-state row ``row``'s first ``max_prefix_len`` cache
+    positions into pool row ``pool_slot`` (the publish-on-finish path:
+    the prompt region of a finished request's row is its prefix). Both
+    indices are traced — one executable serves every (row, slot) pair."""
+    plen = pool["k"].shape[2]
+    return {
+        "k": pool["k"].at[:, pool_slot].set(state["cache"]["k"][:, row,
+                                                                :plen]),
+        "v": pool["v"].at[:, pool_slot].set(state["cache"]["v"][:, row,
+                                                                :plen]),
+    }
+
+
+@jax.jit
+def store_prefix_cache(pool, pool_slot, cache):
+    """Publish a batch-1 :func:`prefill` cache into pool row ``pool_slot``
+    (the prime path: preload a shared system prompt without touching the
+    decode state or its RNG)."""
+    plen = pool["k"].shape[2]
+    return {
+        "k": pool["k"].at[:, pool_slot].set(cache["k"][:, 0, :plen]),
+        "v": pool["v"].at[:, pool_slot].set(cache["v"][:, 0, :plen]),
+    }
+
+
+def _admit_prefix_body(state, params, cfg: TransformerConfig, slot, pool,
+                       pool_slot, prefix_len, suffix_tokens, prompt_len,
+                       remaining, temperature):
+    total_len = state["cache"]["k"].shape[2]
+    _b, s = suffix_tokens.shape  # batch 1, suffix padded to a length bucket
+    cache = init_cache(cfg, 1, total_len)
+    # Lay the reused prefix rows into cache positions 0..max_prefix_len.
+    # Rows past prefix_len hold the donor's unrelated continuation — the
+    # suffix forward overwrites positions prefix_len..prefix_len+s, and
+    # ``valid`` masks everything beyond prompt_len until decode writes it.
+    k0 = lax.dynamic_update_slice(
+        cache["k"], pool["k"][:, pool_slot][:, None], (0, 0, 0, 0, 0))
+    v0 = lax.dynamic_update_slice(
+        cache["v"], pool["v"][:, pool_slot][:, None], (0, 0, 0, 0, 0))
+    suffix_len = jnp.maximum(prompt_len - prefix_len, 1)
+    positions = prefix_len + jnp.arange(s)[None, :]
+    valid = jnp.arange(total_len)[None, :] < prompt_len
+    logits, cache = forward_cached(
+        params, suffix_tokens, cfg, {"k": k0, "v": v0}, prefix_len,
+        positions, valid,
+        token_valid=jnp.arange(s)[None, :] < suffix_len,
+    )
+    last = jnp.take_along_axis(
+        logits, jnp.reshape(suffix_len - 1, (1, 1, 1)), axis=1
+    )[:, 0]
+    return {
+        "cache": {
+            "k": state["cache"]["k"].at[:, slot].set(cache["k"][:, 0]),
+            "v": state["cache"]["v"].at[:, slot].set(cache["v"][:, 0]),
+        },
+        "length": state["length"].at[slot].set(prompt_len),
+        "remaining": state["remaining"].at[slot].set(remaining),
+        "active": state["active"].at[slot].set(remaining > 0),
+        "temperature": state["temperature"].at[slot].set(temperature),
+        "last_logits": state["last_logits"].at[slot].set(last[0]),
+        "key": state["key"],
+    }, last
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "top_k", "eos_id"),
+                   donate_argnames=("state",))
+def admit_prefix_and_step(state, params, cfg: TransformerConfig, slot, pool,
+                          pool_slot, prefix_len, suffix_tokens, prompt_len,
+                          remaining, temperature, top_k: int = 0,
+                          eos_id: int | None = None):
+    """Prefix-hit admission: gather pool row ``pool_slot``'s first
+    ``prefix_len`` K/V positions into decode-state row ``slot``, prefill
+    ONLY the suffix (``suffix_tokens`` [1, S], padded to a length
+    bucket), and run one fused decode step — the prefix-reuse twin of
+    :func:`admit_rows_and_step`, still a single dispatch. ``prefix_len``
+    and ``prompt_len`` are traced, so one executable per suffix bucket
+    serves every cached prefix length. Returns (state, prefill
+    last-logits [1, V], sampled token [slots], emitted mask [slots])."""
+    state, last = _admit_prefix_body(state, params, cfg, slot, pool,
+                                     pool_slot, prefix_len, suffix_tokens,
+                                     prompt_len, remaining, temperature)
+    state, tok, emit = _decode_step_body(state, params, cfg, top_k, eos_id)
+    return state, last, tok, emit
+
+
 @functools.partial(jax.jit, donate_argnames=("state",))
 def retire_row(state, slot):
     """Host-initiated early stop (EOS): clear ``active`` and park the row's

@@ -28,6 +28,22 @@ from kubeflow_tpu.runtime import strip_glog_args
 log = logging.getLogger(__name__)
 
 
+def render_prometheus(metrics: dict) -> str:
+    """Render name→value pairs in Prometheus exposition format.
+
+    Names ending in ``_total`` are typed ``counter``, everything else
+    ``gauge`` — the shared rendering rule for every hand-rolled exporter
+    in the platform (this prober's /metrics, the model server's decoder
+    gauges), so there is exactly one place that knows the text format.
+    """
+    out = []
+    for name, value in metrics.items():
+        kind = "counter" if name.endswith("_total") else "gauge"
+        text = f"{value:.6f}" if isinstance(value, float) else str(value)
+        out.append(f"# TYPE {name} {kind}\n{name} {text}\n")
+    return "".join(out)
+
+
 class TokenClient:
     """Service-account id-token supply for the prober.
 
@@ -138,14 +154,11 @@ class AvailabilityProber:
         self._stop.set()
 
     def render_metrics(self) -> str:
-        return (
-            "# TYPE kubeflow_availability gauge\n"
-            f"kubeflow_availability {self.available}\n"
-            "# TYPE kubeflow_availability_probes_total counter\n"
-            f"kubeflow_availability_probes_total {self.probes_total}\n"
-            "# TYPE kubeflow_availability_failures_total counter\n"
-            f"kubeflow_availability_failures_total {self.failures_total}\n"
-        )
+        return render_prometheus({
+            "kubeflow_availability": self.available,
+            "kubeflow_availability_probes_total": self.probes_total,
+            "kubeflow_availability_failures_total": self.failures_total,
+        })
 
 
 def make_server(prober: AvailabilityProber, port: int) -> ThreadingHTTPServer:

@@ -38,6 +38,16 @@ def main(argv=None) -> int:
                    help="decode steps fused per device dispatch in "
                         "continuous mode; set ~max-new-tokens on "
                         "high-RTT links")
+    p.add_argument("--prefix-cache-slots", type=int, default=0,
+                   help="device prefix-KV pool slots for reuse of shared "
+                        "prompt prefixes (0 disables); matching prompts "
+                        "prefill only their suffix")
+    p.add_argument("--prefix-cache-min-len", type=int, default=16,
+                   help="shortest prefix worth caching/matching")
+    p.add_argument("--prefill-len-buckets", type=int, default=0,
+                   help="power-of-two prefill length buckets below "
+                        "max-seq-len (0 = pad every prompt to "
+                        "max-seq-len)")
     p.add_argument("--dtype", default="",
                    choices=["", "bfloat16", "float32"],
                    help="compute dtype override; empty keeps the model "
@@ -51,6 +61,10 @@ def main(argv=None) -> int:
         # Only the continuous decoder implements early stop; silently
         # generating past EOS would return post-EOS garbage.
         p.error("--eos-id requires --decode-mode=continuous")
+    if args.prefix_cache_slots > 0 and args.decode_mode != "continuous":
+        # Only the continuous decoder carries the prefix pool; silently
+        # ignoring the flag would report cache-off numbers as cache-on.
+        p.error("--prefix-cache-slots requires --decode-mode=continuous")
 
     server = ModelServer(
         EngineConfig(
@@ -63,6 +77,9 @@ def main(argv=None) -> int:
             eos_id=None if args.eos_id < 0 else args.eos_id,
             decode_mode=args.decode_mode,
             decode_chunk=args.decode_chunk,
+            prefix_cache_slots=args.prefix_cache_slots,
+            prefix_cache_min_len=args.prefix_cache_min_len,
+            prefill_len_buckets=args.prefill_len_buckets,
             dtype=args.dtype,
         ),
         port=args.rest_port,

@@ -13,6 +13,15 @@ frees its slot immediately, so a 1-token
 request never waits on a 32-token peer — the decoupling VERDICT round 2
 asked for over the lockstep batch path (serving/engine.py:_generate_batch).
 
+Two admission-cost levers ride on top (both off by default): a
+device-resident **prefix KV cache** (``prefix_cache_slots``) that lets a
+prompt whose leading tokens are already pooled gather those K/V rows and
+prefill only its suffix (host trie in serving/prefix_cache.py, device
+pool + gather/scatter in models/decode.py, publish-on-finish, LRU with
+in-flight pins), and **power-of-two prefill length buckets**
+(``prefill_len_buckets``) so a short prompt rides a short compiled shape
+instead of padding to the full ``prefill_len``.
+
 Tokens surface through per-request queues as each step's sample lands —
 the REST server streams them as JSON lines over chunked transfer-encoding
 and gRPC as a server-streaming method. The reference serves generation
@@ -30,14 +39,22 @@ from collections import deque
 from dataclasses import dataclass, field
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from kubeflow_tpu.models.decode import (
+    admit_prefix_and_step,
     admit_rows_and_step,
     decode_chunk,
     decode_step,
     init_decode_state,
+    init_prefix_pool,
+    prefill,
+    store_prefix_cache,
+    store_prefix_row,
 )
+from kubeflow_tpu.serving.engine import pow2_bucket
+from kubeflow_tpu.serving.prefix_cache import PrefixCache
 
 _DONE = object()
 
@@ -57,6 +74,9 @@ class _Request:
     # should pay.
     prefill_src: tuple | None = None
     error: Exception | None = None
+    # Prefix-cache entry this request's admission read (pinned against
+    # eviction until the request finishes).
+    pinned_prefix: object | None = None
     done: threading.Event = field(default_factory=threading.Event)
     submit_t: float = field(default_factory=time.perf_counter)
     ttft_s: float | None = None
@@ -128,7 +148,9 @@ class ContinuousDecoder:
     def __init__(self, params, cfg, *, slots: int, prefill_len: int,
                  max_new_tokens: int, top_k: int = 0,
                  eos_id: int | None = None, seed: int = 0,
-                 chunk_size: int = 1):
+                 chunk_size: int = 1, prefix_cache_slots: int = 0,
+                 prefix_cache_min_len: int = 16,
+                 prefill_len_buckets: int = 0):
         self.params = params
         self.cfg = cfg
         self.slots = slots
@@ -136,6 +158,25 @@ class ContinuousDecoder:
         self.max_new_tokens = max_new_tokens
         self.top_k = top_k
         self.eos_id = eos_id
+        # Power-of-two prefill length buckets (0 = every prompt pads to
+        # prefill_len): a round's prompts ride the smallest allowed
+        # compiled shape covering them, so a 6-token prompt stops paying
+        # a 128-token prefill. Bucket floor = prefill_len >> buckets.
+        self.prefill_len_buckets = max(0, int(prefill_len_buckets))
+        # Device-resident prefix KV cache: host trie -> pool row of
+        # cached prefix K/V. Admissions that match reuse the rows and
+        # prefill only their suffix; finished prompts publish back.
+        self.prefix_cache = (
+            PrefixCache(prefix_cache_slots, min_len=prefix_cache_min_len)
+            if prefix_cache_slots > 0 else None
+        )
+        self._prefix_pool = (
+            init_prefix_pool(cfg, prefix_cache_slots, prefill_len)
+            if prefix_cache_slots > 0 else None
+        )
+        # Guards trie + pool-reference mutation: prime_prefix() runs on
+        # caller threads while the scheduler thread matches/publishes.
+        self._prefix_lock = threading.Lock()
         # Decode steps fused per device dispatch. 1 = one dispatch per
         # token (finest admission/streaming granularity — right for a
         # local TPU where a dispatch is sub-ms). K>1 trades admission
@@ -157,6 +198,13 @@ class ContinuousDecoder:
         self.dispatches = 0  # device round-trips (the tunnel-cost metric)
         self.prefill_dispatches = 0  # admission round-trips (fused)
         self.admitted = 0            # requests admitted
+        self.prefill_tokens = 0      # real prompt tokens actually prefilled
+        # Prefix-cache counters (all zero when the cache is disabled).
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.prefix_tokens_reused = 0   # prompt tokens served from the pool
+        self.prefix_suffix_tokens = 0   # suffix tokens prefilled on hits
+        self.prefix_inserts = 0         # prefixes published to the pool
         self.ramp_rounds = 0         # admission-only (no-chunk) rounds
         self.ttft_sum = 0.0
         self.ttft_count = 0
@@ -209,16 +257,20 @@ class ContinuousDecoder:
         (:func:`admit_rows_and_step`) — the new requests' first token
         ships on the admission round-trip itself.
 
-        The batch is padded up to a power-of-two bucket (bounding the
-        number of compiled prefill shapes) by repeating the last real
-        admission verbatim — duplicate scatter indices with identical
-        payloads are deterministic, so padding is a no-op re-write.
+        The batch is padded up to a power-of-two bucket in BOTH
+        dimensions (bounding the number of compiled prefill shapes):
+        batch rows by repeating the last real admission verbatim
+        (duplicate scatter indices with identical payloads are
+        deterministic, so padding is a no-op re-write), and — with
+        ``prefill_len_buckets`` — the sequence dim to the smallest
+        allowed power of two covering the round's longest prompt, so
+        short prompts ride short executables instead of paying
+        full-``prefill_len`` prefill compute.
         """
         k = len(pending)
-        bucket = 1
-        while bucket < k:
-            bucket *= 2
-        toks = np.zeros((bucket, self.prefill_len), np.int32)
+        bucket = pow2_bucket(k)
+        t = self._seq_bucket(max(len(req.tokens) for req, _ in pending))
+        toks = np.zeros((bucket, t), np.int32)
         lengths = np.ones((bucket,), np.int32)
         slots = np.zeros((bucket,), np.int32)
         temps = np.zeros((bucket,), np.float32)
@@ -230,16 +282,18 @@ class ContinuousDecoder:
             slots[i] = slot
             temps[i] = req.temperature
             wants[i] = req.want
-        # ONE admission executable per bucket: always the fused variant
-        # (the extra decode step is ~free on device, and a second
-        # plain-admit executable would surprise-compile mid-traffic).
+        # ONE admission executable per (batch, length) bucket: always the
+        # fused variant (the extra decode step is ~free on device, and a
+        # second plain-admit executable would surprise-compile
+        # mid-traffic).
         self._state, last, tok, emit = admit_rows_and_step(
             self._state, self.params, self.cfg,
-            jax.numpy.asarray(slots), jax.numpy.asarray(toks),
-            jax.numpy.asarray(lengths), jax.numpy.asarray(wants),
-            jax.numpy.asarray(temps), self.top_k, self.eos_id)
+            jnp.asarray(slots), jnp.asarray(toks),
+            jnp.asarray(lengths), jnp.asarray(wants),
+            jnp.asarray(temps), self.top_k, self.eos_id)
         self.prefill_dispatches += 1
         self.admitted += k
+        self.prefill_tokens += sum(len(req.tokens) for req, _ in pending)
         # Fetch ONLY the fused step's tokens (one small transfer);
         # vocab-wide prefill logits stay on device behind a lazy
         # per-request resolver — eager [K, V] fetches each admission
@@ -254,10 +308,153 @@ class ContinuousDecoder:
         self.steps += 1
         self._dispatch(tok_np, emit_np)
 
+    def _seq_bucket(self, n: int) -> int:
+        """Compiled prefill length for an ``n``-token prompt."""
+        if self.prefill_len_buckets <= 0:
+            return self.prefill_len
+        floor = max(1, self.prefill_len >> self.prefill_len_buckets)
+        return pow2_bucket(max(n, floor), cap=self.prefill_len)
+
+    def _suffix_bucket(self, n: int) -> int:
+        """Compiled suffix length for prefix-hit admissions. Suffixes are
+        bucketed even when full-prompt bucketing is off — padding a
+        3-token suffix to ``prefill_len`` would erase the reuse win —
+        with a floor bounding the executable count."""
+        if self.prefill_len_buckets > 0:
+            floor = max(1, self.prefill_len >> self.prefill_len_buckets)
+        else:
+            floor = min(8, self.prefill_len)
+        return pow2_bucket(max(n, floor), cap=self.prefill_len)
+
+    def _plan_prefix(self, req: _Request):
+        """Probe the trie for ``req`` and fit the (prefix, suffix-bucket)
+        split into the cache: the suffix block must end within
+        ``total_len`` (an out-of-bounds ``dynamic_update_slice`` start
+        would be CLAMPED by XLA and silently corrupt the row), so when
+        the bucket rounds past the prompt's tail the reused prefix is
+        shortened to ``prompt_len - bucket`` — less reuse, never a wrong
+        write. Returns (entry, prefix_len, bucket) with the entry pinned,
+        or None (miss; any pin released)."""
+        with self._prefix_lock:
+            m = self.prefix_cache.match(req.tokens)
+        if m is None:
+            return None
+        entry, plen = m
+        n = len(req.tokens)
+        s = self._suffix_bucket(n - plen)
+        if plen + s > self.total_len:
+            plen = n - s
+        if s >= n or plen < self.prefix_cache.min_len:
+            # Too little left to reuse once bucketed — full prefill wins.
+            with self._prefix_lock:
+                self.prefix_cache.release(entry)
+            return None
+        return entry, plen, s
+
+    def _admit_prefix(self, req: _Request, slot: int, entry,
+                      prefix_len: int, s: int) -> None:
+        """Prefix-hit admission: ONE dispatch gathers the cached K/V rows
+        into the request's row, prefills only the suffix (padded to the
+        ``s`` length bucket), and takes the fused decode step — so a
+        prompt whose first ``prefix_len`` tokens are pooled pays
+        suffix-sized prefill compute. ``entry`` arrives pinned
+        (match() refcounted it) and stays pinned until the request
+        finishes."""
+        suffix = req.tokens[prefix_len:]
+        toks = np.zeros((1, s), np.int32)
+        toks[0, : len(suffix)] = suffix
+        with self._prefix_lock:
+            pool = self._prefix_pool
+        self._state, last, tok, emit = admit_prefix_and_step(
+            self._state, self.params, self.cfg, jnp.int32(slot), pool,
+            jnp.int32(entry.slot), jnp.int32(prefix_len),
+            jnp.asarray(toks), jnp.int32(len(req.tokens)),
+            jnp.int32(req.want), jnp.float32(req.temperature),
+            self.top_k, self.eos_id)
+        req.pinned_prefix = entry
+        self.prefill_dispatches += 1
+        self.admitted += 1
+        self.prefix_hits += 1
+        self.prefix_tokens_reused += prefix_len
+        self.prefix_suffix_tokens += len(suffix)
+        self.prefill_tokens += len(suffix)
+        tok_np, emit_np = jax.device_get((tok, emit))
+        req.prefill_src = (last, 0)
+        self._post_admit(req, slot)
+        self.steps += 1
+        self._dispatch(tok_np, emit_np)
+
+    def _publish_prefix(self, req: _Request, slot: int) -> None:
+        """Publish a finishing request's prompt K/V (still intact in its
+        row's cache positions 0..len-1) into the prefix pool, so later
+        prompts sharing the prefix skip its prefill. Runs on the
+        scheduler thread BEFORE the slot is freed."""
+        cache = self.prefix_cache
+        if cache is None or req.error is not None:
+            return
+        key = tuple(req.tokens)
+        if len(key) < cache.min_len:
+            return
+        with self._prefix_lock:
+            if cache.has(key):
+                cache.touch(key)
+                return
+            entry = cache.reserve(key)
+            if entry is None:  # every pool slot pinned by peers in flight
+                return
+            self._prefix_pool = store_prefix_row(
+                self._prefix_pool, jnp.int32(entry.slot), self._state,
+                jnp.int32(slot))
+            self.prefix_inserts += 1
+
+    def _release_pin(self, req: _Request) -> None:
+        if req.pinned_prefix is not None and self.prefix_cache is not None:
+            with self._prefix_lock:
+                self.prefix_cache.release(req.pinned_prefix)
+            req.pinned_prefix = None
+
+    def prime_prefix(self, tokens: list[int]) -> bool:
+        """Precompute and pool a prefix (e.g. the shared system prompt at
+        server start) WITHOUT touching the decode state or its RNG — a
+        primed decoder samples byte-identically to an unprimed one.
+        Returns True when the prefix is pooled (already or now)."""
+        if self.prefix_cache is None:
+            return False
+        toks = list(tokens)[: self.prefill_len]
+        if len(toks) < self.prefix_cache.min_len:
+            return False
+        key = tuple(toks)
+        with self._prefix_lock:
+            if self.prefix_cache.has(key):
+                self.prefix_cache.touch(key)
+                return True
+            entry = self.prefix_cache.reserve(key)
+            if entry is None:
+                return False
+            try:
+                t = self._seq_bucket(len(toks))
+                arr = np.zeros((1, t), np.int32)
+                arr[0, : len(toks)] = toks
+                cache, _last = prefill(
+                    self.params, jnp.asarray(arr),
+                    jnp.asarray([len(toks)], np.int32), self.cfg,
+                    total_len=self.prefill_len)
+                self._prefix_pool = store_prefix_cache(
+                    self._prefix_pool, jnp.int32(entry.slot), cache)
+            except Exception:
+                self.prefix_cache.remove(entry)
+                raise
+            self.prefix_inserts += 1
+            self.prefill_tokens += len(toks)  # priming IS a prefill
+            return True
+
     def _post_admit(self, req: _Request, slot: int) -> None:
         if req.want == 0:
             # Pure prefill (caller wants last-position logits only): the row
-            # was inserted inactive; hand the result back immediately.
+            # was inserted inactive; publish its prefix, then hand the
+            # result back immediately.
+            self._publish_prefix(req, slot)
+            self._release_pin(req)
             self._slot_req[slot] = None
             self._finish(req)
         else:
@@ -283,6 +480,10 @@ class ContinuousDecoder:
             self.tokens_emitted += 1
             hit_eos = self.eos_id is not None and tok == self.eos_id
             if hit_eos or len(req.out) >= req.want:
+                # Publish the finished prompt's prefix while its K/V rows
+                # are still intact in the slot, then free it.
+                self._publish_prefix(req, slot)
+                self._release_pin(req)
                 self._slot_req[slot] = None
                 self._active_count -= 1
                 self._finish(req, reason="eos" if hit_eos else "length")
@@ -319,7 +520,24 @@ class ContinuousDecoder:
                     # round — decode throughput must not degrade toward
                     # one dispatch per token. (want==0 admissions are
                     # pure prefills answered in _post_admit.)
-                    self._admit_batch(pending)
+                    #
+                    # With the prefix cache on, each request first probes
+                    # the trie: hits ride suffix-only admissions (one
+                    # dispatch each), misses batch as before.
+                    misses = pending
+                    if self.prefix_cache is not None:
+                        hits, misses = [], []
+                        for req, slot in pending:
+                            plan = self._plan_prefix(req)
+                            if plan is None:
+                                self.prefix_misses += 1
+                                misses.append((req, slot))
+                            else:
+                                hits.append((req, slot, plan))
+                        for req, slot, (entry, plen, s) in hits:
+                            self._admit_prefix(req, slot, entry, plen, s)
+                    if misses:
+                        self._admit_batch(misses)
                     ramp = (any(req.want for req, _ in pending)
                             and (self.chunk_size == 1
                                  or self._ramp_streak < 1))
@@ -376,10 +594,12 @@ class ContinuousDecoder:
     # ------------------------------------------------------------------
 
     def metrics(self) -> dict:
+        cache = self.prefix_cache
         return {
             "decode_steps": self.steps,
             "decode_dispatches": self.dispatches,
             "prefill_dispatches": self.prefill_dispatches,
+            "prefill_tokens": self.prefill_tokens,
             "requests_admitted": self.admitted,
             "ramp_rounds": self.ramp_rounds,
             "tokens_emitted": self.tokens_emitted,
@@ -387,4 +607,11 @@ class ContinuousDecoder:
                            if self.ttft_count else 0.0),
             "in_flight": self._active_count,
             "queued": len(self._pending),
+            "prefix_hits": self.prefix_hits,
+            "prefix_misses": self.prefix_misses,
+            "prefix_evictions": cache.evictions if cache else 0,
+            "prefix_tokens_reused": self.prefix_tokens_reused,
+            "prefix_suffix_tokens": self.prefix_suffix_tokens,
+            "prefix_inserts": self.prefix_inserts,
+            "prefix_entries": len(cache) if cache else 0,
         }

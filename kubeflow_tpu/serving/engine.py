@@ -18,6 +18,22 @@ import numpy as np
 from kubeflow_tpu.models.registry import ModelSpec, get_model
 
 
+def pow2_bucket(n: int, cap: int | None = None) -> int:
+    """Smallest power of two >= ``n`` (floored at 1), clamped to ``cap``.
+
+    The shared shape-bucketing rule: the continuous decoder buckets BOTH
+    its admission batch size and (with ``prefill_len_buckets``) the
+    prefill sequence length through this, so the number of compiled
+    prefill executables stays logarithmic in each dimension.
+    """
+    bucket = 1
+    while bucket < n:
+        bucket *= 2
+    if cap is not None:
+        bucket = min(bucket, cap)
+    return bucket
+
+
 @dataclass
 class EngineConfig:
     model: str = "lm-test-tiny"
@@ -44,6 +60,22 @@ class EngineConfig:
     # chunk 31 → 1.79× lockstep full-gen p50 vs chunk 8's 2.6×,
     # BASELINE.md round 4) while keeping per-request decoupling.
     decode_chunk: int = 1
+    # Device-resident prefix KV cache (continuous mode): pool slots for
+    # cached prompt prefixes (0 disables). A matching admission gathers
+    # the cached K/V rows and prefills ONLY its suffix; finished prompts
+    # publish their prefix back to the pool (LRU eviction, in-flight
+    # pins). Memory per slot: 2 * layers * max_seq_len * kv_heads *
+    # head_dim * dtype bytes.
+    prefix_cache_slots: int = 0
+    # Shortest prefix worth caching or matching: below this the reuse
+    # bookkeeping costs more than the prefill it saves.
+    prefix_cache_min_len: int = 16
+    # Power-of-two sequence-length buckets for prefill: the number of
+    # bucket steps below max_seq_len (0 = pad every prompt to
+    # max_seq_len). E.g. 3 with max_seq_len=128 allows prefill shapes
+    # {16, 32, 64, 128}, so a 6-token prompt rides a 16-wide executable
+    # instead of paying full-length prefill compute.
+    prefill_len_buckets: int = 0
     # Compute dtype override ("bfloat16"/"float32"); empty keeps the
     # model preset's dtype. The tpu-serving manifest's --dtype arg.
     dtype: str = ""

@@ -94,6 +94,10 @@ class ModelServer:
                     top_k=self.engine.cfg.top_k,
                     eos_id=self.engine.cfg.eos_id,
                     chunk_size=self.engine.cfg.decode_chunk,
+                    prefix_cache_slots=self.engine.cfg.prefix_cache_slots,
+                    prefix_cache_min_len=(
+                        self.engine.cfg.prefix_cache_min_len),
+                    prefill_len_buckets=self.engine.cfg.prefill_len_buckets,
                 )
             return self._decoder
 
@@ -219,19 +223,39 @@ class ModelServer:
                 elif self.path == "/monitoring/prometheus/metrics":
                     text = server.metrics.render()
                     if server._decoder is not None:
+                        # One rendering rule for every exporter: the
+                        # observability collector's helper (counters by
+                        # _total suffix, gauges otherwise).
+                        from kubeflow_tpu.observability.collector import \
+                            render_prometheus
+
                         d = server._decoder.metrics()
-                        text += (
-                            "# TYPE serving_decode_steps_total counter\n"
-                            f"serving_decode_steps_total {d['decode_steps']}\n"
-                            "# TYPE serving_decode_dispatches_total counter\n"
-                            "serving_decode_dispatches_total "
-                            f"{d['decode_dispatches']}\n"
-                            "# TYPE serving_tokens_emitted_total counter\n"
-                            "serving_tokens_emitted_total "
-                            f"{d['tokens_emitted']}\n"
-                            "# TYPE serving_ttft_avg_seconds gauge\n"
-                            f"serving_ttft_avg_seconds {d['ttft_avg_s']:.6f}\n"
-                        )
+                        text += render_prometheus({
+                            "serving_decode_steps_total": d["decode_steps"],
+                            "serving_decode_dispatches_total":
+                                d["decode_dispatches"],
+                            "serving_prefill_dispatches_total":
+                                d["prefill_dispatches"],
+                            "serving_prefill_tokens_total":
+                                d["prefill_tokens"],
+                            "serving_requests_admitted_total":
+                                d["requests_admitted"],
+                            "serving_tokens_emitted_total":
+                                d["tokens_emitted"],
+                            "serving_ttft_avg_seconds": d["ttft_avg_s"],
+                            "serving_prefix_hits_total": d["prefix_hits"],
+                            "serving_prefix_misses_total":
+                                d["prefix_misses"],
+                            "serving_prefix_evictions_total":
+                                d["prefix_evictions"],
+                            "serving_prefix_tokens_reused_total":
+                                d["prefix_tokens_reused"],
+                            "serving_prefix_suffix_tokens_total":
+                                d["prefix_suffix_tokens"],
+                            "serving_prefix_entries": d["prefix_entries"],
+                            "serving_in_flight": d["in_flight"],
+                            "serving_queued": d["queued"],
+                        })
                     self._send(200, text, content_type="text/plain")
                 elif self.path.startswith("/v1/models/"):
                     name = self.path[len("/v1/models/"):]
