@@ -20,8 +20,15 @@ reporting TTFT, prefill token volume / dispatches, and the cache counters
 (`prefix_hits`, `prefix_tokens_reused`); emitted tokens must be identical
 both ways.
 
+``--speculative`` benchmarks speculative decoding: the same greedy
+workload with speculation off, with the n-gram proposer, and with a
+draft model, reporting acceptance counters and accepted-tokens-per-
+verify-dispatch (the dispatch-economy win). Greedy outputs must be
+byte-identical in every mode; the regression marker also fires when the
+draft-model run accepts <= 1.5 tokens per dispatch.
+
 Usage: python bench_serving.py [--quick] [--requests N] [--generate]
-       [--prefix-reuse]
+       [--prefix-reuse] [--speculative]
 """
 
 from __future__ import annotations
@@ -274,6 +281,82 @@ def _bench_prefix_reuse(args, model) -> dict:
     }
 
 
+def _bench_speculative(args, model) -> dict:
+    """Speculative-decoding scenario: N concurrent greedy requests through
+    the continuous decoder with speculation off / n-gram / draft-model.
+    Tokens must be byte-identical in every mode (speculation may only
+    change cost); the draft-model run (same weights, so acceptance is
+    structural, not luck) must clear >1.5 accepted tokens per verify
+    dispatch — the dispatch economy that motivates the feature."""
+    from kubeflow_tpu.models.registry import get_model
+    from kubeflow_tpu.serving.continuous import ContinuousDecoder
+
+    spec = get_model(model)
+    params = spec.init(jax.random.PRNGKey(0), spec.config)
+    n = 8 if args.quick else max(8, args.requests // 16)
+    gen = min(args.max_new_tokens, 16)
+    k = args.speculative_k
+    # Mildly repetitive prompts: gives the n-gram proposer something to
+    # find without rigging the model's own continuations.
+    prompts = [([3 + i, 17, 29, 3 + i, 17] * 3)[:12] for i in range(n)]
+
+    runs = {}
+    modes = (("off", {}),
+             ("ngram", {"speculative_k": k, "draft_mode": "ngram"}),
+             ("draft_model", {"speculative_k": k,
+                              "draft_mode": f"model:{model}"}))
+    for label, kw in modes:
+        d = ContinuousDecoder(params, spec.config, slots=8, prefill_len=32,
+                              max_new_tokens=gen, **kw)
+        try:
+            d.generate(prompts[0][:4], 1)  # warm the compiled shapes
+
+            def one(p):
+                h = d.submit(p, gen)
+                return h.result(timeout=300)["tokens"]
+            t0 = time.perf_counter()
+            with ThreadPoolExecutor(args.concurrency) as pool:
+                tokens = list(pool.map(one, prompts))
+            wall = time.perf_counter() - t0
+            m = d.metrics()
+        finally:
+            d.stop()
+        runs[label] = {
+            "tokens": tokens,
+            "wall_s": wall,
+            "decode_dispatches": m["decode_dispatches"],
+            "spec_drafted_tokens": m["spec_drafted_tokens"],
+            "spec_accepted_tokens": m["spec_accepted_tokens"],
+            "spec_verify_dispatches": m["spec_verify_dispatches"],
+            "spec_draft_dispatches": m["spec_draft_dispatches"],
+            "spec_acceptance_rate": round(m["spec_acceptance_rate"], 3),
+        }
+
+    identical = (runs["ngram"]["tokens"] == runs["off"]["tokens"]
+                 and runs["draft_model"]["tokens"] == runs["off"]["tokens"])
+    dm = runs["draft_model"]
+    accepted_per_dispatch = (dm["spec_accepted_tokens"]
+                             / max(dm["spec_verify_dispatches"], 1))
+    return {
+        "metric": "serving_spec_accepted_tokens_per_dispatch",
+        "value": round(accepted_per_dispatch, 2),
+        "unit": "tokens/dispatch",
+        "vs_baseline": 1.0,
+        "acceptance_rate": dm["spec_acceptance_rate"],
+        "ngram_acceptance_rate": runs["ngram"]["spec_acceptance_rate"],
+        "ngram_accepted_tokens": runs["ngram"]["spec_accepted_tokens"],
+        "drafted_tokens": dm["spec_drafted_tokens"],
+        "accepted_tokens": dm["spec_accepted_tokens"],
+        "verify_dispatches": dm["spec_verify_dispatches"],
+        "draft_dispatches": dm["spec_draft_dispatches"],
+        "decode_dispatches_off": runs["off"]["decode_dispatches"],
+        "decode_dispatches_on": dm["decode_dispatches"],
+        "tokens_identical": identical,
+        "regression": (not identical) or accepted_per_dispatch <= 1.5,
+        "config": f"{model} k{k} n{n} gen{gen} c{args.concurrency}",
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
@@ -293,10 +376,19 @@ def main() -> int:
                          "off (identical tokens required)")
     ap.add_argument("--prefix-len", type=int, default=96,
                     help="shared system-prompt length for --prefix-reuse")
+    ap.add_argument("--speculative", action="store_true",
+                    help="benchmark speculative decoding: off vs n-gram "
+                         "vs draft-model proposer (identical greedy "
+                         "tokens required)")
+    ap.add_argument("--speculative-k", type=int, default=4,
+                    help="draft tokens per verify for --speculative")
     args = ap.parse_args()
 
     on_tpu = jax.default_backend() == "tpu"
-    if args.prefix_reuse:
+    if args.speculative:
+        model = "llama-1b" if on_tpu and not args.quick else "lm-test-tiny"
+        result = _bench_speculative(args, model)
+    elif args.prefix_reuse:
         model = "llama-1b" if on_tpu and not args.quick else "lm-test-tiny"
         result = _bench_prefix_reuse(args, model)
     elif args.generate:

@@ -21,4 +21,9 @@ out="$(JAX_PLATFORMS=cpu python bench_serving.py --quick --generate)"
 check_json "$out"
 out="$(JAX_PLATFORMS=cpu python bench_serving.py --quick --prefix-reuse)"
 check_json "$out"
+# Speculative decoding: the marker fires on non-identical greedy outputs
+# (speculation may only change cost, never tokens) or on <=1.5 accepted
+# tokens per verify dispatch in the draft-model run.
+out="$(JAX_PLATFORMS=cpu python bench_serving.py --quick --speculative)"
+check_json "$out"
 echo "bench smoke ok"

@@ -42,6 +42,12 @@ REST_PORT = 8500
         ParamSpec("prefill_len_buckets", 0,
                   "power-of-two prefill length buckets below the max "
                   "sequence length (0 = fixed-length prefill)"),
+        ParamSpec("speculative_k", 0,
+                  "draft tokens verified per fused decode dispatch "
+                  "(0 disables speculative decoding)"),
+        ParamSpec("draft_mode", "ngram",
+                  "speculative draft proposer: ngram or "
+                  "model:<registry-name>"),
         ParamSpec("enable_prometheus", True),
         ParamSpec("dtype", "bfloat16"),
     ],
@@ -59,6 +65,8 @@ def tpu_serving(
     prefix_cache_slots: int,
     prefix_cache_min_len: int,
     prefill_len_buckets: int,
+    speculative_k: int,
+    draft_mode: str,
     enable_prometheus: bool,
     dtype: str,
 ) -> list[dict]:
@@ -75,6 +83,8 @@ def tpu_serving(
         f"--prefix-cache-slots={prefix_cache_slots}",
         f"--prefix-cache-min-len={prefix_cache_min_len}",
         f"--prefill-len-buckets={prefill_len_buckets}",
+        f"--speculative-k={speculative_k}",
+        f"--draft-mode={draft_mode}",
         f"--dtype={dtype}",
     ]
     if enable_prometheus:

@@ -127,12 +127,18 @@ def forward_cached(params, tokens, cfg: TransformerConfig, cache, pos,
     return logits.astype(jnp.float32), {"k": k_new, "v": v_new}
 
 
+def _top_k_mask(logits, top_k: int):
+    """Mask everything below the k-th logit to -inf (no-op for top_k=0)."""
+    if top_k and top_k < logits.shape[-1]:
+        kth = lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, _NEG_INF, logits)
+    return logits
+
+
 def sample_token(logits, key, temperature, top_k: int = 0):
     """logits [B, V], temperature [B] (<=0 → greedy), static top_k."""
     greedy = jnp.argmax(logits, axis=-1)
-    if top_k and top_k < logits.shape[-1]:
-        kth = lax.top_k(logits, top_k)[0][:, -1:]
-        logits = jnp.where(logits < kth, _NEG_INF, logits)
+    logits = _top_k_mask(logits, top_k)
     temp = jnp.maximum(temperature, 1e-6)[:, None]
     sampled = jax.random.categorical(key, logits / temp, axis=-1)
     return jnp.where(temperature <= 0.0, greedy, sampled).astype(jnp.int32)
@@ -468,6 +474,48 @@ def retire_row(state, slot):
             "length": state["length"].at[slot].set(total)}
 
 
+def _single_token_forward(params, cfg: TransformerConfig, k_cache0, v_cache0,
+                          tok, pos_b, token_valid):
+    """One [B, 1] forward at per-row cache positions ``pos_b`` against the
+    persistent caches (the layer loop shared by :func:`_decode_step_body`
+    and the verify commit pass). Returns (logits [B, V], k, v)."""
+    total = k_cache0.shape[2]
+    cos_t, sin_t = rotary_frequencies(cfg.head_dim, total,
+                                      theta=cfg.rope_theta)
+    rope_bt = (cos_t[pos_b[:, None]], sin_t[pos_b[:, None]])
+    x = params["embed"]["kernel"].astype(cfg.dtype)[tok][:, None]
+    valid = jnp.arange(total)[None, :] <= pos_b[:, None]
+
+    def layer_fn(x, layer_and_cache):
+        layer, k_cache, v_cache = layer_and_cache
+        h = rms_norm(x, layer["ln_attn"], eps=cfg.norm_eps)
+        attn, k_cache, v_cache = _ragged_attention(
+            h, layer["attn"], cfg, rope_bt, k_cache, v_cache, pos_b, valid
+        )
+        x = x + attn
+        h = rms_norm(x, layer["ln_mlp"], eps=cfg.norm_eps)
+        if cfg.n_experts:
+            y, _aux = moe_ffn(h, layer["mlp"], cfg,
+                              token_valid=token_valid[:, None])
+            x = x + y
+        else:
+            gate = h @ layer["mlp"]["gate"].astype(cfg.dtype)
+            up = h @ layer["mlp"]["up"].astype(cfg.dtype)
+            x = x + (jax.nn.silu(gate) * up) @ layer["mlp"]["down"].astype(
+                cfg.dtype
+            )
+        return x, (k_cache, v_cache)
+
+    x, (k_new, v_new) = lax.scan(
+        layer_fn, x, (params["layers"], k_cache0, v_cache0)
+    )
+    x = rms_norm(x, params["final_norm"], eps=cfg.norm_eps)
+    head = (params["embed"]["kernel"].T if cfg.tie_embeddings
+            else params["lm_head"]["kernel"])
+    logits = (x @ head.astype(cfg.dtype)).astype(jnp.float32)[:, 0]
+    return logits, k_new, v_new
+
+
 def _decode_step_body(state, params, cfg: TransformerConfig, top_k: int,
                       eos_id: int | None):
     """One decode step (traceable body shared by :func:`decode_step` and
@@ -480,39 +528,9 @@ def _decode_step_body(state, params, cfg: TransformerConfig, top_k: int,
     key, sub = jax.random.split(state["key"])
     tok = sample_token(state["last_logits"], sub, state["temperature"], top_k)
     p_b = state["length"]
-    cos_t, sin_t = rotary_frequencies(cfg.head_dim, total,
-                                      theta=cfg.rope_theta)
-    rope_bt = (cos_t[p_b[:, None]], sin_t[p_b[:, None]])
-    x = params["embed"]["kernel"].astype(cfg.dtype)[tok][:, None]
-    valid = jnp.arange(total)[None, :] <= p_b[:, None]
-
-    def layer_fn(x, layer_and_cache):
-        layer, k_cache, v_cache = layer_and_cache
-        h = rms_norm(x, layer["ln_attn"], eps=cfg.norm_eps)
-        attn, k_cache, v_cache = _ragged_attention(
-            h, layer["attn"], cfg, rope_bt, k_cache, v_cache, p_b, valid
-        )
-        x = x + attn
-        h = rms_norm(x, layer["ln_mlp"], eps=cfg.norm_eps)
-        if cfg.n_experts:
-            y, _aux = moe_ffn(h, layer["mlp"], cfg, token_valid=emit[:, None])
-            x = x + y
-        else:
-            gate = h @ layer["mlp"]["gate"].astype(cfg.dtype)
-            up = h @ layer["mlp"]["up"].astype(cfg.dtype)
-            x = x + (jax.nn.silu(gate) * up) @ layer["mlp"]["down"].astype(
-                cfg.dtype
-            )
-        return x, (k_cache, v_cache)
-
-    x, (k_new, v_new) = lax.scan(
-        layer_fn, x, (params["layers"], state["cache"]["k"],
-                      state["cache"]["v"])
+    logits, k_new, v_new = _single_token_forward(
+        params, cfg, state["cache"]["k"], state["cache"]["v"], tok, p_b, emit
     )
-    x = rms_norm(x, params["final_norm"], eps=cfg.norm_eps)
-    head = (params["embed"]["kernel"].T if cfg.tie_embeddings
-            else params["lm_head"]["kernel"])
-    logits = (x @ head.astype(cfg.dtype)).astype(jnp.float32)[:, 0]
     step_inc = emit.astype(jnp.int32)
     length = p_b + step_inc
     remaining = state["remaining"] - step_inc
@@ -567,3 +585,293 @@ def decode_chunk(state, params, cfg: TransformerConfig, steps: int,
 
     state, (toks, emits) = lax.scan(body, state, None, length=steps)
     return state, toks, emits
+
+
+# ---------------------------------------------------------------------------
+# Speculative decoding (serving/speculative.py holds the host-side proposers)
+# ---------------------------------------------------------------------------
+#
+# Decode is memory-bandwidth-bound: every step reads the whole KV cache to
+# produce ONE token. Verifying K cheap draft tokens in a single [slots, K]
+# forward reads the cache once for up to K+1 tokens of progress — the
+# verify is compute the prefill path already knows how to do. Greedy
+# outputs are byte-identical to plain decode by construction (a draft
+# token is only kept when it equals the argmax the target would have
+# produced); temperature>0 rows use rejection-resampling against the
+# deterministic draft proposal, which leaves the sampled distribution
+# exactly the target's. A verify step is two forwards fused into ONE
+# dispatch: the K-wide scoring pass plus a single-token commit pass that
+# writes the first non-draft token's K/V, so the decode-state invariant
+# (``length`` K/V rows live, ``last_logits`` predicts position
+# ``length``) holds on exit and verify composes freely with
+# ``decode_step``/``decode_chunk``/``retire_row``. Rejected draft tails
+# need no explicit rollback: validity is derived from ``length`` every
+# step, so not advancing past the accepted region IS the rollback.
+
+
+def _span_attention(x, layer, cfg, rope_bt, k_cache, v_cache, pos_b):
+    """Block attention where row ``b``'s ``S`` tokens occupy cache slots
+    ``pos_b[b]..pos_b[b]+S-1`` — the S-wide sibling of
+    :func:`_ragged_attention` (rows at heterogeneous positions). Block
+    token ``s`` attends every cache slot ``<= pos_b + s`` (its own K/V
+    was just written), so causality holds within the block and over the
+    row's history. Out-of-bounds writes (parked rows, cache-tail spill)
+    are dropped by scatter semantics."""
+    b, s, _d = x.shape
+    hd = cfg.head_dim
+    cos, sin = rope_bt
+    q = (x @ layer["wq"].astype(cfg.dtype)).reshape(b, s, cfg.n_heads, hd)
+    k = (x @ layer["wk"].astype(cfg.dtype)).reshape(b, s, cfg.n_kv_heads, hd)
+    v = (x @ layer["wv"].astype(cfg.dtype)).reshape(b, s, cfg.n_kv_heads, hd)
+    q = _rope(q, cos, sin)
+    k = _rope(k, cos, sin)
+    rows = jnp.arange(b)[:, None]
+    cols = pos_b[:, None] + jnp.arange(s)[None, :]
+    k_cache = k_cache.at[rows, cols].set(k)
+    v_cache = v_cache.at[rows, cols].set(v)
+    total = k_cache.shape[1]
+    mask = jnp.arange(total)[None, None, :] <= cols[:, :, None]
+    out = _gqa_attention(q, k_cache, v_cache, mask[:, None, None], cfg)
+    return out @ layer["wo"].astype(cfg.dtype), k_cache, v_cache
+
+
+def _block_forward(params, cfg: TransformerConfig, k_cache0, v_cache0,
+                   tokens, pos_b, token_valid):
+    """[B, S] forward writing K/V at per-row start positions ``pos_b`` →
+    (logits [B, S, V], k, v). The verify scoring pass and the draft
+    model's catch-up feed both ride this."""
+    total = k_cache0.shape[2]
+    _b, s = tokens.shape
+    cos_t, sin_t = rotary_frequencies(cfg.head_dim, total,
+                                      theta=cfg.rope_theta)
+    pos = pos_b[:, None] + jnp.arange(s)[None, :]
+    rope_bt = (cos_t[pos], sin_t[pos])
+    x = params["embed"]["kernel"].astype(cfg.dtype)[tokens]
+
+    def layer_fn(x, layer_and_cache):
+        layer, k_cache, v_cache = layer_and_cache
+        h = rms_norm(x, layer["ln_attn"], eps=cfg.norm_eps)
+        attn, k_cache, v_cache = _span_attention(
+            h, layer["attn"], cfg, rope_bt, k_cache, v_cache, pos_b
+        )
+        x = x + attn
+        h = rms_norm(x, layer["ln_mlp"], eps=cfg.norm_eps)
+        if cfg.n_experts:
+            y, _aux = moe_ffn(h, layer["mlp"], cfg, token_valid=token_valid)
+            x = x + y
+        else:
+            gate = h @ layer["mlp"]["gate"].astype(cfg.dtype)
+            up = h @ layer["mlp"]["up"].astype(cfg.dtype)
+            x = x + (jax.nn.silu(gate) * up) @ layer["mlp"]["down"].astype(
+                cfg.dtype
+            )
+        return x, (k_cache, v_cache)
+
+    x, (k_new, v_new) = lax.scan(
+        layer_fn, x, (params["layers"], k_cache0, v_cache0)
+    )
+    x = rms_norm(x, params["final_norm"], eps=cfg.norm_eps)
+    head = (params["embed"]["kernel"].T if cfg.tie_embeddings
+            else params["lm_head"]["kernel"])
+    return (x @ head.astype(cfg.dtype)).astype(jnp.float32), k_new, v_new
+
+
+def _target_probs(logits, temperature, top_k: int):
+    """Processed target distribution (top-k mask + temperature floor) for
+    speculative accept/resample — must match :func:`sample_token`'s
+    sampling branch exactly or acceptance would test a different
+    distribution than the one decode samples from. logits [..., V],
+    temperature broadcastable to logits[..., 0]."""
+    logits = _top_k_mask(logits, top_k)
+    temp = jnp.maximum(temperature, 1e-6)[..., None]
+    return jax.nn.softmax(logits / temp, axis=-1)
+
+
+def _verify_step_body(state, params, cfg: TransformerConfig, draft,
+                      draft_len, top_k: int, eos_id: int | None):
+    """One speculative verify: score ``draft`` [slots, K] against the
+    decode state, accept each row's longest matching prefix, commit the
+    first non-draft token. Returns (state, tokens [slots, K+1],
+    emitted [slots, K+1]) — ``emitted`` is a per-row prefix mask over
+    the emitted tokens (1..K+1 of them for active rows)."""
+    total = state["cache"]["k"].shape[2]
+    slots, k_w = draft.shape
+    emit0 = state["active"]
+    p_b = state["length"]
+    temp = state["temperature"]
+    key, k_acc, k_res = jax.random.split(state["key"], 3)
+
+    # Pass 1: ONE [slots, K] forward scores every draft position (and
+    # writes the draft K/V — accepted rows keep it, rejected tails stay
+    # masked out by ``length`` until overwritten).
+    in_draft = jnp.arange(k_w)[None, :] < draft_len[:, None]
+    block_logits, k1, v1 = _block_forward(
+        params, cfg, state["cache"]["k"], state["cache"]["v"], draft, p_b,
+        token_valid=emit0[:, None] & in_draft,
+    )
+    # prev_logits[:, i] predicts draft position i: last_logits for i=0,
+    # the scoring pass's own outputs shifted by one after that.
+    prev_logits = jnp.concatenate(
+        [state["last_logits"][:, None], block_logits[:, : k_w - 1]], axis=1
+    )
+    greedy_ok = draft == jnp.argmax(prev_logits, axis=-1)
+    probs = _target_probs(prev_logits, temp[:, None], top_k)
+    p_draft = jnp.take_along_axis(probs, draft[..., None], axis=-1)[..., 0]
+    # Deterministic proposer => q is a point mass: accept w.p. p(d).
+    sampled_ok = jax.random.uniform(k_acc, (slots, k_w)) < p_draft
+    ok = jnp.where((temp <= 0.0)[:, None], greedy_ok, sampled_ok) & in_draft
+    acc = jnp.cumprod(ok.astype(jnp.int32), axis=1)
+    n = acc.sum(axis=1)
+    # Emission is n accepted drafts + 1 committed token, capped so the row
+    # never overruns its budget or its cache (the cap only ever DROPS
+    # accepted drafts — the capped position was accepted, so emitting the
+    # draft token there stays distribution-exact).
+    n_eff = jnp.minimum(n, jnp.maximum(state["remaining"] - 1, 0))
+    n_eff = jnp.minimum(n_eff, jnp.maximum(total - 1 - p_b, 0))
+
+    # Commit token: target sample at position n_eff. On a true rejection
+    # the rejected draft id is masked out first — rejection-resampling
+    # from the residual of a point-mass proposal, which keeps the overall
+    # per-position distribution exactly the target's.
+    all_logits = jnp.concatenate(
+        [prev_logits, block_logits[:, k_w - 1:]], axis=1
+    )
+    commit_logits = jnp.take_along_axis(
+        all_logits, n_eff[:, None, None], axis=1
+    )[:, 0]
+    d_at = jnp.take_along_axis(
+        draft, jnp.minimum(n_eff, k_w - 1)[:, None], axis=1
+    )[:, 0]
+    rejected = (n_eff == n) & (n_eff < draft_len)
+    # Top-k BEFORE the rejection mask: the residual must stay inside the
+    # target's top-k support (masking first and re-thresholding after
+    # would let the k+1-th token leak into the resample).
+    res_logits = jnp.where(
+        rejected[:, None]
+        & (jnp.arange(cfg.vocab_size)[None, :] == d_at[:, None]),
+        _NEG_INF, _top_k_mask(commit_logits, top_k),
+    )
+    commit = sample_token(res_logits, k_res, temp, top_k=0)
+    commit = jnp.where(n_eff < n, d_at, commit)
+
+    idx = jnp.arange(k_w + 1)[None, :]
+    draft_pad = jnp.concatenate(
+        [draft, jnp.zeros((slots, 1), jnp.int32)], axis=1
+    )
+    out = jnp.where(idx < n_eff[:, None], draft_pad, commit[:, None])
+    emitted = emit0[:, None] & (idx <= n_eff[:, None])
+    hit_eos = jnp.zeros((slots,), bool)
+    if eos_id is not None:
+        is_eos = (out == eos_id) & emitted
+        eos_before = jnp.cumsum(is_eos.astype(jnp.int32), axis=1) \
+            - is_eos.astype(jnp.int32)
+        emitted = emitted & (eos_before == 0)  # keep the EOS, drop its tail
+        hit_eos = ((out == eos_id) & emitted).any(axis=1)
+    m = emitted.sum(axis=1).astype(jnp.int32)
+
+    # Pass 2 (same dispatch): write the commit token's K/V at its row
+    # position and refresh last_logits — restores the decode invariant so
+    # the next step (plain or verify) continues seamlessly. Rows parked
+    # by EOS above still run the pass; their write lands inside the row
+    # but the row's length is parked at ``total`` so it is never read.
+    commit_pos = p_b + n_eff
+    logits2, k2, v2 = _single_token_forward(
+        params, cfg, k1, v1, commit, commit_pos, emit0
+    )
+
+    length = p_b + m
+    remaining = state["remaining"] - m
+    active = emit0 & (remaining > 0) & (length < total) & ~hit_eos
+    length = jnp.where(hit_eos, total, length)
+    new_state = {
+        "cache": {"k": k2, "v": v2},
+        "length": length,
+        "remaining": remaining,
+        "active": active,
+        "temperature": temp,
+        "last_logits": jnp.where(emit0[:, None], logits2,
+                                 state["last_logits"]),
+        "key": key,
+    }
+    return new_state, out, emitted
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "top_k", "eos_id"),
+                   donate_argnames=("state",))
+def verify_step(state, params, cfg: TransformerConfig, draft, draft_len,
+                top_k: int = 0, eos_id: int | None = None):
+    """Score ``draft`` [slots, K] tokens against the decode-state KV cache
+    in ONE fused dispatch and emit each row's longest accepted prefix plus
+    one committed target token (1..K+1 tokens of progress per row).
+    Greedy rows are byte-identical to plain :func:`decode_step` chains;
+    temperature>0 rows rejection-resample so the sampled distribution is
+    unchanged. EOS parks rows on device exactly like
+    :func:`_decode_step_body`. Returns (state, tokens [slots, K+1],
+    emitted [slots, K+1])."""
+    return _verify_step_body(state, params, cfg, draft, draft_len, top_k,
+                             eos_id)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "top_k", "eos_id"),
+                   donate_argnames=("state",))
+def verify_chunk(state, params, cfg: TransformerConfig, drafts, draft_lens,
+                 top_k: int = 0, eos_id: int | None = None):
+    """``steps`` verify steps fused into ONE dispatch via ``lax.scan`` —
+    the speculative twin of :func:`decode_chunk`, so a chunk of K-token
+    verifies still pays ~2 RTTs on a high-RTT link. ``drafts``
+    [steps, slots, K] holds each step's proposals (later slices are
+    chain continuations that simply fail verification after an early
+    rejection — correctness never depends on the proposer being right).
+    Returns (state, tokens [steps, slots, K+1], emitted likewise)."""
+
+    def body(s, xs):
+        draft, dlen = xs
+        s, out, emitted = _verify_step_body(s, params, cfg, draft, dlen,
+                                            top_k, eos_id)
+        return s, (out, emitted)
+
+    state, (outs, emits) = lax.scan(body, state, (drafts, draft_lens))
+    return state, outs, emits
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "steps"),
+                   donate_argnames=("state",))
+def extend_and_propose(state, params, cfg: TransformerConfig, feed,
+                       feed_pos, feed_len, steps: int):
+    """Draft-model helper: force-feed each row's newly committed target
+    tokens (``feed`` [slots, S], ``feed_len`` real, starting at cache
+    position ``feed_pos``) into the DRAFT decode state, then greedily
+    decode ``steps`` proposal tokens per row — one dispatch total. The
+    proposal steps advance the draft state past the confirmed region;
+    the next call's feed (at host-tracked confirmed positions) overwrites
+    whatever the target rejected, so no rollback pass is needed. Rows
+    with ``feed_pos`` at the cache end are parked (their writes drop).
+    Returns (state, proposals [slots, steps])."""
+    in_feed = jnp.arange(feed.shape[1])[None, :] < feed_len[:, None]
+    block_logits, k1, v1 = _block_forward(
+        params, cfg, state["cache"]["k"], state["cache"]["v"], feed,
+        feed_pos, token_valid=in_feed,
+    )
+    last = jnp.take_along_axis(
+        block_logits, jnp.maximum(feed_len - 1, 0)[:, None, None], axis=1
+    )[:, 0]
+    live = feed_len > 0
+    state = {
+        "cache": {"k": k1, "v": v1},
+        "length": feed_pos + feed_len,
+        # Proposal budget only — the draft state's remaining/active are
+        # reset from the host's feed every round.
+        "remaining": jnp.where(live, steps + 1, 0).astype(jnp.int32),
+        "active": live,
+        "temperature": jnp.zeros_like(state["temperature"]),
+        "last_logits": jnp.where(live[:, None], last,
+                                 state["last_logits"]),
+        "key": state["key"],
+    }
+
+    def body(s, _):
+        s, tok, _emit = _decode_step_body(s, params, cfg, 0, None)
+        return s, tok
+
+    state, toks = lax.scan(body, state, None, length=steps)
+    return state, toks.T  # [slots, steps]

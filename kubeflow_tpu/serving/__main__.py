@@ -48,6 +48,15 @@ def main(argv=None) -> int:
                    help="power-of-two prefill length buckets below "
                         "max-seq-len (0 = pad every prompt to "
                         "max-seq-len)")
+    p.add_argument("--speculative-k", type=int, default=0,
+                   help="draft tokens verified per fused decode dispatch "
+                        "(0 disables speculative decoding); greedy "
+                        "outputs are unchanged, throughput multiplies "
+                        "with the acceptance rate")
+    p.add_argument("--draft-mode", default="ngram",
+                   help="speculative draft proposer: 'ngram' (host-side "
+                        "prompt/output lookup, zero device cost) or "
+                        "'model:<registry-name>' (small draft model)")
     p.add_argument("--dtype", default="",
                    choices=["", "bfloat16", "float32"],
                    help="compute dtype override; empty keeps the model "
@@ -65,6 +74,14 @@ def main(argv=None) -> int:
         # Only the continuous decoder carries the prefix pool; silently
         # ignoring the flag would report cache-off numbers as cache-on.
         p.error("--prefix-cache-slots requires --decode-mode=continuous")
+    if args.speculative_k > 0 and args.decode_mode != "continuous":
+        # Verification rides the continuous decode state; silently
+        # ignoring the flag would report plain-decode numbers as
+        # speculative ones.
+        p.error("--speculative-k requires --decode-mode=continuous")
+    if not (args.draft_mode == "ngram"
+            or args.draft_mode.startswith("model:")):
+        p.error("--draft-mode must be 'ngram' or 'model:<name>'")
 
     server = ModelServer(
         EngineConfig(
@@ -80,6 +97,8 @@ def main(argv=None) -> int:
             prefix_cache_slots=args.prefix_cache_slots,
             prefix_cache_min_len=args.prefix_cache_min_len,
             prefill_len_buckets=args.prefill_len_buckets,
+            speculative_k=args.speculative_k,
+            draft_mode=args.draft_mode,
             dtype=args.dtype,
         ),
         port=args.rest_port,

@@ -22,6 +22,18 @@ in-flight pins), and **power-of-two prefill length buckets**
 (``prefill_len_buckets``) so a short prompt rides a short compiled shape
 instead of padding to the full ``prefill_len``.
 
+Decode itself has a throughput lever (off by default): **speculative
+decoding** (``speculative_k``). A pluggable proposer
+(serving/speculative.py: host n-gram lookup or a small draft model)
+guesses up to K tokens per row each round, and ONE fused verify dispatch
+(models/decode.py:verify_step) scores them all, keeping each row's
+longest accepted prefix plus one committed target token — up to K+1
+tokens per dispatch against decode's memory-bandwidth bill of one.
+Greedy outputs are byte-identical to speculation off; temperature>0 rows
+rejection-resample so their distribution is unchanged. Per-slot draft
+length auto-tunes (shrinks while a row's drafts keep missing, recovers
+on clean sweeps), and accept/draft counters land in :meth:`metrics`.
+
 Tokens surface through per-request queues as each step's sample lands —
 the REST server streams them as JSON lines over chunked transfer-encoding
 and gRPC as a server-streaming method. The reference serves generation
@@ -52,9 +64,11 @@ from kubeflow_tpu.models.decode import (
     prefill,
     store_prefix_cache,
     store_prefix_row,
+    verify_chunk,
 )
 from kubeflow_tpu.serving.engine import pow2_bucket
 from kubeflow_tpu.serving.prefix_cache import PrefixCache
+from kubeflow_tpu.serving.speculative import make_proposer
 
 _DONE = object()
 
@@ -150,7 +164,8 @@ class ContinuousDecoder:
                  eos_id: int | None = None, seed: int = 0,
                  chunk_size: int = 1, prefix_cache_slots: int = 0,
                  prefix_cache_min_len: int = 16,
-                 prefill_len_buckets: int = 0):
+                 prefill_len_buckets: int = 0, speculative_k: int = 0,
+                 draft_mode: str = "ngram"):
         self.params = params
         self.cfg = cfg
         self.slots = slots
@@ -186,6 +201,25 @@ class ContinuousDecoder:
         # EOS parking moves on-device inside the fused loop either way.
         self.chunk_size = max(1, int(chunk_size))
         self.total_len = prefill_len + max_new_tokens
+        # Speculative decoding: K>0 turns decode rounds into verify
+        # rounds whenever the proposer has drafts — one fused dispatch
+        # scores up to K draft tokens per row (chunk_size>1 fuses that
+        # many verify steps per dispatch, mirroring decode_chunk).
+        self.speculative_k = max(0, int(speculative_k))
+        self._verify_steps = self.chunk_size if self.chunk_size > 1 else 1
+        self._spec = (
+            make_proposer(
+                draft_mode, target_vocab=cfg.vocab_size, slots=slots,
+                total_len=prefill_len + max_new_tokens,
+                propose_steps=(self._verify_steps * self.speculative_k
+                               + self._verify_steps - 1),
+                seed=seed)
+            if self.speculative_k > 0 else None
+        )
+        # Per-slot draft length, auto-tuned in [1, speculative_k]: shrink
+        # while a row's drafts keep missing (verify compute is then pure
+        # overhead), recover on clean sweeps.
+        self._slot_k = [self.speculative_k] * slots
         self._state = init_decode_state(cfg, slots, self.total_len, seed)
         self._slot_req: list[_Request | None] = [None] * slots
         self._active_count = 0
@@ -206,6 +240,10 @@ class ContinuousDecoder:
         self.prefix_suffix_tokens = 0   # suffix tokens prefilled on hits
         self.prefix_inserts = 0         # prefixes published to the pool
         self.ramp_rounds = 0         # admission-only (no-chunk) rounds
+        # Speculative-decoding counters (zero when speculation is off).
+        self.spec_drafted_tokens = 0    # draft tokens submitted to verify
+        self.spec_accepted_tokens = 0   # draft tokens the target kept
+        self.spec_verify_dispatches = 0  # fused verify round-trips
         self.ttft_sum = 0.0
         self.ttft_count = 0
         self._ramp_streak = 0  # consecutive admission-only rounds
@@ -246,6 +284,11 @@ class ContinuousDecoder:
 
     def _finish(self, req: _Request, *, reason: str = "length",
                 error: Exception | None = None) -> None:
+        # Idempotent: the crash path (_fail_all) sweeps everything still
+        # live on loop exit, racing stop() and the inner error handler —
+        # first finisher wins, later calls are no-ops.
+        if req.done.is_set():
+            return
         req.error = error
         req.finish_reason = reason if error is None else "error"
         req.stream.put(_DONE)
@@ -460,6 +503,9 @@ class ContinuousDecoder:
         else:
             self._slot_req[slot] = req
             self._active_count += 1
+            if self._spec is not None:
+                self._spec.reset(slot)
+                self._slot_k[slot] = self.speculative_k
 
     def _dispatch(self, toks: np.ndarray, emitted: np.ndarray) -> None:
         """Route one step's sampled tokens ([slots]) to their requests.
@@ -488,7 +534,143 @@ class ContinuousDecoder:
                 self._active_count -= 1
                 self._finish(req, reason="eos" if hit_eos else "length")
 
+    def _dispatch_block(self, toks: np.ndarray, emitted: np.ndarray) -> None:
+        """Route one verify step's tokens ([slots, K+1], ``emitted`` a
+        per-row prefix mask) to their requests — the multi-token sibling
+        of :func:`_dispatch`. The device already capped each row at its
+        budget and truncated at EOS, so the mask is trusted verbatim."""
+        now = time.perf_counter()
+        for slot in range(self.slots):
+            req = self._slot_req[slot]
+            if req is None or not emitted[slot, 0]:
+                continue
+            last_tok = None
+            for j in range(toks.shape[1]):
+                if not emitted[slot, j]:
+                    break
+                last_tok = int(toks[slot, j])
+                req.out.append(last_tok)
+                if req.ttft_s is None:
+                    req.ttft_s = now - req.submit_t
+                    self.ttft_sum += req.ttft_s
+                    self.ttft_count += 1
+                req.stream.put(last_tok)
+                self.tokens_emitted += 1
+            hit_eos = self.eos_id is not None and last_tok == self.eos_id
+            if hit_eos or len(req.out) >= req.want:
+                self._publish_prefix(req, slot)
+                self._release_pin(req)
+                self._slot_req[slot] = None
+                self._active_count -= 1
+                self._finish(req, reason="eos" if hit_eos else "length")
+
+    def _tune_slot(self, slot: int, accepted: int, drafted: int) -> None:
+        """Shrink a slot's draft length while verification keeps throwing
+        its drafts away (<50% kept — the verify pass is then mostly
+        wasted compute), grow it back one step per clean sweep."""
+        if drafted <= 0:
+            return
+        if accepted * 2 < drafted:
+            self._slot_k[slot] = max(1, self._slot_k[slot] - 1)
+        elif accepted == drafted:
+            self._slot_k[slot] = min(self.speculative_k,
+                                     self._slot_k[slot] + 1)
+
+    def _spec_round(self) -> bool:
+        """One speculative decode round: collect proposals for every live
+        row, verify them all in ONE fused dispatch (``chunk_size`` verify
+        steps when chunking), route the accepted tokens. Returns False —
+        fall through to the plain decode path — when no row has a draft
+        (a verify without drafts would pay two forwards for one token).
+        """
+        steps, k_w = self._verify_steps, self.speculative_k
+        asks = []
+        for slot in range(self.slots):
+            req = self._slot_req[slot]
+            if req is not None:
+                # steps-1 extra chain tokens: each verify step's commit
+                # consumes one, so the next step's slice starts after it.
+                asks.append((slot, req.tokens + req.out,
+                             steps * self._slot_k[slot] + steps - 1))
+        props = self._spec.propose(asks)
+        drafts = np.zeros((steps, self.slots, k_w), np.int32)
+        dlens = np.zeros((steps, self.slots), np.int32)
+        for slot, ctx, _n in asks:
+            prop = props.get(slot) or []
+            req = self._slot_req[slot]
+            budget = req.want - len(req.out)  # tokens the row may still emit
+            off = 0
+            for s in range(steps):
+                if budget <= 0:
+                    break
+                # A verify step emits dlen accepted drafts + 1 commit:
+                # cap dlen so a near-done row doesn't drown its
+                # acceptance stats (and the verify pass) in drafts the
+                # budget could never emit.
+                k_use = min(self._slot_k[slot], budget - 1)
+                seg = prop[off: off + k_use]
+                # Skip the token the commit pass emits between slices —
+                # under full acceptance it IS the next chain token, so
+                # without the skip every later slice arrives off-by-one.
+                off += len(seg) + 1
+                budget -= len(seg) + 1
+                if not seg:
+                    break
+                drafts[s, slot, : len(seg)] = seg
+                dlens[s, slot] = len(seg)
+        if not dlens.any():
+            return False
+        self._state, outs, emits = verify_chunk(
+            self._state, self.params, self.cfg, jnp.asarray(drafts),
+            jnp.asarray(dlens), self.top_k, self.eos_id)
+        self.dispatches += 1
+        self.spec_verify_dispatches += 1
+        self.steps += 2 * steps  # scoring + commit forward per verify
+        self._ramp_streak = 0
+        outs, emits = jax.device_get((outs, emits))
+        for s in range(steps):
+            # Accounting before routing: routing may free the slot.
+            for slot in range(self.slots):
+                d = int(dlens[s, slot])
+                if d == 0 or self._slot_req[slot] is None:
+                    continue
+                m = int(emits[s, slot].sum())
+                acc = min(max(m - 1, 0), d)
+                self.spec_drafted_tokens += d
+                self.spec_accepted_tokens += acc
+                if m:
+                    self._tune_slot(slot, acc, d)
+            self._dispatch_block(outs[s], emits[s])
+        return True
+
     def _loop(self) -> None:
+        """Scheduler-thread entry: run the loop, and on ANY exit — clean
+        stop, inner-handler return, or an escaped exception — fail every
+        stream still live so no StreamHandle ever hangs out its timeout
+        waiting on a dead loop."""
+        err: Exception = RuntimeError("decoder stopped")
+        try:
+            self._run()
+        except Exception as e:
+            err = e
+        finally:
+            self._fail_all(err)
+
+    def _fail_all(self, err: Exception) -> None:
+        with self._cv:
+            self._stopped = True
+            queued = list(self._pending)
+            self._pending.clear()
+        for slot in range(self.slots):
+            req = self._slot_req[slot]
+            if req is not None:
+                self._slot_req[slot] = None
+                self._active_count -= 1
+                self._finish(req, error=err)
+        for req in queued:
+            self._finish(req, error=err)
+
+    def _run(self) -> None:
         while True:
             with self._cv:
                 while (not self._stopped and not self._pending
@@ -548,6 +730,8 @@ class ContinuousDecoder:
                         continue  # this round's step already ran
                 if self._active_count == 0:
                     continue
+                if self._spec is not None and self._spec_round():
+                    continue
                 if self.chunk_size > 1:
                     self._state, toks, emitted = decode_chunk(
                         self._state, self.params, self.cfg,
@@ -568,28 +752,16 @@ class ContinuousDecoder:
                     self.dispatches += 1
                     self._dispatch(*jax.device_get((toks, emitted)))
             except Exception as e:
-                # A failed prefill/decode_step may have invalidated
-                # self._state (the jitted calls donate its buffers), so the
-                # decoder cannot safely take more work: mark it stopped and
-                # fail everything — in-flight, just-admitted, and queued —
-                # with the original error. Later submits get a clear
-                # "decoder is stopped" instead of a donation error.
-                with self._cv:
-                    self._stopped = True
-                    queued = list(self._pending)
-                    self._pending.clear()
-                for slot in range(self.slots):
-                    req = self._slot_req[slot]
-                    if req is not None:
-                        self._slot_req[slot] = None
-                        self._active_count -= 1
-                        self._finish(req, error=e)
+                # A failed prefill/decode/verify may have invalidated
+                # self._state (the jitted calls donate its buffers), so
+                # the decoder cannot safely take more work. Requests
+                # popped this round but not yet registered in a slot
+                # would be invisible to the loop-exit sweep — fail them
+                # here, then let _loop's wrapper fail everything else
+                # (in-flight and queued) with the same error.
                 for req, _slot in pending:
-                    if not req.done.is_set():
-                        self._finish(req, error=e)
-                for req in queued:
                     self._finish(req, error=e)
-                return
+                raise
 
     # ------------------------------------------------------------------
 
@@ -614,4 +786,14 @@ class ContinuousDecoder:
             "prefix_suffix_tokens": self.prefix_suffix_tokens,
             "prefix_inserts": self.prefix_inserts,
             "prefix_entries": len(cache) if cache else 0,
+            "spec_drafted_tokens": self.spec_drafted_tokens,
+            "spec_accepted_tokens": self.spec_accepted_tokens,
+            "spec_verify_dispatches": self.spec_verify_dispatches,
+            "spec_draft_dispatches": (self._spec.dispatches
+                                      if self._spec is not None else 0),
+            "spec_acceptance_rate": (
+                self.spec_accepted_tokens / self.spec_drafted_tokens
+                if self.spec_drafted_tokens else 0.0),
+            "spec_draft_k": (sum(self._slot_k) / len(self._slot_k)
+                             if self._slot_k else 0.0),
         }

@@ -70,6 +70,17 @@ class EngineConfig:
     # Shortest prefix worth caching or matching: below this the reuse
     # bookkeeping costs more than the prefill it saves.
     prefix_cache_min_len: int = 16
+    # Speculative decoding (continuous mode): number of draft tokens
+    # verified per fused dispatch (0 disables). A verify scores K cheap
+    # proposals in one [slots, K] forward and keeps each row's longest
+    # accepted prefix plus one committed token — up to K+1 tokens per
+    # decode round-trip. Greedy outputs are byte-identical either way;
+    # temperature>0 rows rejection-resample (distribution unchanged).
+    speculative_k: int = 0
+    # Where drafts come from: "ngram" (host-side prompt/output n-gram
+    # lookup, zero device cost) or "model:<registry-name>" (a small
+    # draft model sharing the slot layout).
+    draft_mode: str = "ngram"
     # Power-of-two sequence-length buckets for prefill: the number of
     # bucket steps below max_seq_len (0 = pad every prompt to
     # max_seq_len). E.g. 3 with max_seq_len=128 allows prefill shapes

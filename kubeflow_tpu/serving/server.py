@@ -98,6 +98,8 @@ class ModelServer:
                     prefix_cache_min_len=(
                         self.engine.cfg.prefix_cache_min_len),
                     prefill_len_buckets=self.engine.cfg.prefill_len_buckets,
+                    speculative_k=self.engine.cfg.speculative_k,
+                    draft_mode=self.engine.cfg.draft_mode,
                 )
             return self._decoder
 
@@ -253,6 +255,16 @@ class ModelServer:
                             "serving_prefix_suffix_tokens_total":
                                 d["prefix_suffix_tokens"],
                             "serving_prefix_entries": d["prefix_entries"],
+                            "serving_spec_drafted_tokens_total":
+                                d["spec_drafted_tokens"],
+                            "serving_spec_accepted_tokens_total":
+                                d["spec_accepted_tokens"],
+                            "serving_spec_verify_dispatches_total":
+                                d["spec_verify_dispatches"],
+                            "serving_spec_draft_dispatches_total":
+                                d["spec_draft_dispatches"],
+                            "serving_spec_acceptance_rate":
+                                d["spec_acceptance_rate"],
                             "serving_in_flight": d["in_flight"],
                             "serving_queued": d["queued"],
                         })

@@ -1,0 +1,104 @@
+"""DynamicBatcher scheduling semantics: full-batch flush, batch-start
+deadline (timeout runs from submit, not from when the loop got around to
+the item), and stop() draining — no waiter may be left to hit its
+collect timeout."""
+
+import threading
+import time
+
+import pytest
+
+from kubeflow_tpu.serving.batcher import DynamicBatcher
+
+
+class _Recorder:
+    """predict_batch stand-in recording each batch's contents."""
+
+    def __init__(self, delay: float = 0.0):
+        self.batches: list[list[dict]] = []
+        self.delay = delay
+        self.lock = threading.Lock()
+
+    def __call__(self, instances):
+        if self.delay:
+            time.sleep(self.delay)
+        with self.lock:
+            self.batches.append(list(instances))
+        return [{"echo": inst["i"]} for inst in instances]
+
+
+def test_full_batch_flushes_without_waiting_for_timeout():
+    rec = _Recorder()
+    b = DynamicBatcher(rec, batch_size=4, batch_timeout_ms=30_000)
+    try:
+        t0 = time.monotonic()
+        pending = [b.submit_async({"i": i}) for i in range(4)]
+        results = [DynamicBatcher.collect(p, timeout=10) for p in pending]
+        assert time.monotonic() - t0 < 5  # nowhere near the 30s window
+        assert [r["echo"] for r in results] == [0, 1, 2, 3]
+        assert [len(batch) for batch in rec.batches] == [4]
+    finally:
+        b.stop()
+
+
+def test_timeout_flushes_partial_batch():
+    rec = _Recorder()
+    b = DynamicBatcher(rec, batch_size=8, batch_timeout_ms=50)
+    try:
+        pending = [b.submit_async({"i": i}) for i in range(3)]
+        results = [DynamicBatcher.collect(p, timeout=10) for p in pending]
+        assert [r["echo"] for r in results] == [0, 1, 2]
+        assert [len(batch) for batch in rec.batches] == [3]
+    finally:
+        b.stop()
+
+
+def test_deadline_runs_from_submit_not_dequeue():
+    """Items queued while a previous batch is predicting have spent their
+    window already: the next batch must flush them immediately (one batch,
+    no extra wait) instead of opening a fresh full window."""
+    rec = _Recorder(delay=0.3)
+    b = DynamicBatcher(rec, batch_size=8, batch_timeout_ms=50)
+    try:
+        first = b.submit_async({"i": 0})
+        time.sleep(0.15)  # batch 1 ([0]) is mid-predict
+        late = [b.submit_async({"i": i}) for i in (1, 2)]
+        t0 = time.monotonic()
+        for p in late:
+            DynamicBatcher.collect(p, timeout=10)
+        waited = time.monotonic() - t0
+        DynamicBatcher.collect(first, timeout=10)
+        # Batch 2 = both late items together (their deadline had already
+        # expired when the loop picked them up): ~0.15s of batch-1
+        # predict left + batch 2's own 0.3s predict, far under the ~0.6s+
+        # a fresh per-item window would stack up.
+        assert [len(batch) for batch in rec.batches] == [1, 2]
+        assert waited < 0.58, waited
+    finally:
+        b.stop()
+
+
+def test_stop_drains_queued_work():
+    """stop() returns only after every submitted item is answered —
+    predicted if the loop got to it, errored otherwise — so no waiter
+    sits out its collect timeout against a dead thread."""
+    rec = _Recorder(delay=0.2)
+    b = DynamicBatcher(rec, batch_size=1, batch_timeout_ms=5)
+    pending = [b.submit_async({"i": i}) for i in range(3)]
+    b.stop()
+    for p in pending:
+        # Already resolved: collect must return/raise instantly.
+        t0 = time.monotonic()
+        try:
+            r = DynamicBatcher.collect(p, timeout=1)
+            assert "echo" in r
+        except RuntimeError as e:
+            assert "batcher stopped" in str(e)
+        assert time.monotonic() - t0 < 0.5
+
+
+def test_submit_after_stop_raises():
+    b = DynamicBatcher(_Recorder(), batch_size=2, batch_timeout_ms=5)
+    b.stop()
+    with pytest.raises(RuntimeError, match="batcher stopped"):
+        b.submit_async({"i": 0})

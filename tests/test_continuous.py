@@ -400,6 +400,81 @@ def test_batched_admission_mixed_wants_and_pure_prefill(model):
         d.stop()
 
 
+# ---------------------------------------------------------------------------
+# Decode-loop crash propagation (no stream may hang out its timeout)
+# ---------------------------------------------------------------------------
+
+
+def test_loop_crash_fails_inflight_and_queued_promptly(model, monkeypatch):
+    """If the decode loop dies, every live StreamHandle — mid-decode AND
+    still queued — must get the error immediately, not a 60s timeout."""
+    spec, params = model
+    d = ContinuousDecoder(params, spec.config, slots=1, prefill_len=16,
+                          max_new_tokens=8)
+    try:
+        inflight = d.submit([1, 2, 3], 8)
+        next(inflight.tokens(timeout=60))  # decoding is underway
+        boom = RuntimeError("injected decode failure")
+
+        def explode(*_a, **_k):
+            raise boom
+
+        monkeypatch.setattr("kubeflow_tpu.serving.continuous.decode_step",
+                            explode)
+        queued = d.submit([4, 5], 4)  # slots=1: this one sits in _pending
+        t0 = time.perf_counter()
+        with pytest.raises(RuntimeError, match="injected decode failure"):
+            inflight.result(timeout=10)
+        with pytest.raises(RuntimeError, match="injected decode failure"):
+            queued.result(timeout=10)
+        assert time.perf_counter() - t0 < 5  # propagated, not timed out
+        with pytest.raises(RuntimeError, match="stopped"):
+            d.submit([1], 1)  # the dead decoder refuses new work clearly
+    finally:
+        d.stop()
+
+
+def test_loop_crash_during_admission_fails_popped_requests(model,
+                                                           monkeypatch):
+    """A request popped from the queue but not yet registered in a slot
+    when admission blows up must still be failed (it is visible to
+    neither the slot sweep nor the pending deque)."""
+    spec, params = model
+    d = ContinuousDecoder(params, spec.config, slots=2, prefill_len=16,
+                          max_new_tokens=8)
+    try:
+        monkeypatch.setattr(
+            "kubeflow_tpu.serving.continuous.admit_rows_and_step",
+            lambda *a, **k: (_ for _ in ()).throw(
+                RuntimeError("injected admission failure")))
+        h = d.submit([1, 2, 3], 4)
+        with pytest.raises(RuntimeError, match="injected admission"):
+            h.result(timeout=10)
+    finally:
+        d.stop()
+
+
+def test_stream_iteration_raises_loop_error(model, monkeypatch):
+    """tokens() consumers (the streaming REST/gRPC paths) see the crash
+    as a raised error on the iterator, not a silent stall."""
+    spec, params = model
+    d = ContinuousDecoder(params, spec.config, slots=2, prefill_len=16,
+                          max_new_tokens=8)
+    try:
+        h = d.submit([1, 2, 3], 8)
+        it = h.tokens(timeout=60)
+        next(it)
+        monkeypatch.setattr(
+            "kubeflow_tpu.serving.continuous.decode_step",
+            lambda *a, **k: (_ for _ in ()).throw(
+                RuntimeError("injected decode failure")))
+        with pytest.raises(RuntimeError, match="injected decode failure"):
+            for _ in it:
+                pass
+    finally:
+        d.stop()
+
+
 def test_chunked_mixed_lengths_all_complete(model):
     spec, params = model
     d = ContinuousDecoder(params, spec.config, slots=3, prefill_len=16,
