@@ -27,8 +27,15 @@ verify-dispatch (the dispatch-economy win). Greedy outputs must be
 byte-identical in every mode; the regression marker also fires when the
 draft-model run accepts <= 1.5 tokens per dispatch.
 
+``--concurrency-sweep`` benchmarks the paged KV layout against dense at
+EQUAL total KV pool bytes: an offered-concurrency ladder of mixed-length
+requests, reporting tokens/s, peak concurrent in-flight requests, and
+peak KV bytes per layout. The regression marker fires when greedy
+outputs differ between layouts, when paged sustains fewer than 2x the
+dense in-flight peak, or when the paged pool leaks blocks after drain.
+
 Usage: python bench_serving.py [--quick] [--requests N] [--generate]
-       [--prefix-reuse] [--speculative]
+       [--prefix-reuse] [--speculative] [--concurrency-sweep]
 """
 
 from __future__ import annotations
@@ -357,6 +364,111 @@ def _bench_speculative(args, model) -> dict:
     }
 
 
+def _bench_concurrency_sweep(args, model) -> dict:
+    """Dense vs paged KV at EQUAL total pool bytes under an offered-
+    concurrency ladder of mixed-length greedy requests.
+
+    The dense decoder reserves ``slots * total_len`` positions, so its
+    in-flight ceiling is ``slots`` no matter how short the requests are.
+    The paged decoder gets the SAME pool bytes (``slots * total_len /
+    block_size`` blocks) but 4x the slots: admission is bounded by
+    tokens resident, so the mixed-length load packs more concurrent
+    requests into the identical HBM budget. A sequential probe pins
+    byte-identical greedy outputs between layouts; the regression marker
+    fires on divergence, on a paged in-flight peak below 2x dense, or on
+    leaked blocks after drain."""
+    from kubeflow_tpu.models.registry import get_model
+    from kubeflow_tpu.serving.continuous import ContinuousDecoder
+
+    spec = get_model(model)
+    params = spec.init(jax.random.PRNGKey(0), spec.config)
+    gen = min(args.max_new_tokens, 16)
+    prefill_len = 32
+    block = 8
+    total = prefill_len + gen
+    dense_slots = 4
+    pool_blocks = dense_slots * (total // block)  # equal KV bytes
+    cfg = spec.config
+    bytes_per_token = (2 * cfg.n_layers * cfg.n_kv_heads * cfg.head_dim
+                       * jax.numpy.dtype(cfg.dtype).itemsize)
+    ladder = [4, 16] if args.quick else [4, 16, 64]
+
+    def request(i):
+        plen = (4, 6, 8, 10)[i % 4]
+        want = (2, 3, 4, gen // 2)[i % 4]
+        return [3 + (i % 7)] * plen, want
+
+    probes = [[1, 2, 3], [7, 5], [9, 9, 9, 9, 2]]
+    runs = {}
+    for layout in ("dense", "paged"):
+        kw = (dict(kv_layout="paged", kv_block_size=block,
+                   kv_pool_blocks=pool_blocks)
+              if layout == "paged" else {})
+        slots = dense_slots * 4 if layout == "paged" else dense_slots
+        d = ContinuousDecoder(params, spec.config, slots=slots,
+                              prefill_len=prefill_len, max_new_tokens=gen,
+                              prefill_len_buckets=2,
+                              stream_timeout_s=300.0, **kw)
+        try:
+            # Sequential parity probe (also warms compiled shapes):
+            # layout must never change tokens.
+            probe_out = [d.generate(p, 4) ["tokens"] for p in probes]
+            levels = {}
+            for n in ladder:
+                t0 = time.perf_counter()
+
+                def one(i):
+                    toks, want = request(i)
+                    return len(d.submit(toks, want).result()["tokens"])
+                with ThreadPoolExecutor(n) as pool:
+                    emitted = sum(pool.map(one, range(n)))
+                wall = time.perf_counter() - t0
+                levels[n] = round(emitted / wall, 1)
+            m = d.metrics()
+        finally:
+            d.stop()
+        runs[layout] = {
+            "tokens": probe_out,
+            "levels": levels,
+            "peak_in_flight": m["peak_in_flight"],
+            "kv_blocks_peak": m["kv_blocks_peak"],
+            "kv_blocks_in_use": m["kv_blocks_in_use"],
+            "defer_admissions": m["kv_defer_admissions"],
+            "kv_peak_bytes": (
+                m["kv_blocks_peak"] * block * bytes_per_token
+                if layout == "paged"
+                else slots * total * bytes_per_token),
+        }
+
+    identical = runs["paged"]["tokens"] == runs["dense"]["tokens"]
+    leak = runs["paged"]["kv_blocks_in_use"]
+    dense_peak = runs["dense"]["peak_in_flight"]
+    paged_peak = runs["paged"]["peak_in_flight"]
+    top = ladder[-1]
+    return {
+        "metric": "serving_paged_peak_in_flight",
+        "value": paged_peak,
+        "unit": "requests",
+        "vs_baseline": 1.0,
+        "dense_peak_in_flight": dense_peak,
+        "concurrency_ratio": round(paged_peak / max(dense_peak, 1), 2),
+        "tokens_per_sec_dense": runs["dense"]["levels"],
+        "tokens_per_sec_paged": runs["paged"]["levels"],
+        "pool_bytes": pool_blocks * block * bytes_per_token,
+        "kv_peak_bytes_dense": runs["dense"]["kv_peak_bytes"],
+        "kv_peak_bytes_paged": runs["paged"]["kv_peak_bytes"],
+        "defer_admissions": runs["paged"]["defer_admissions"],
+        "kv_blocks_in_use_after_drain": leak,
+        "tokens_identical": identical,
+        "regression": ((not identical) or leak != 0
+                       or paged_peak < 2 * dense_peak),
+        "config": f"{model} ladder{ladder} gen{gen} "
+                  f"prefill{prefill_len} block{block} "
+                  f"pool{pool_blocks} slots{dense_slots}v"
+                  f"{dense_slots * 4} top{top}",
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
@@ -382,10 +494,18 @@ def main() -> int:
                          "tokens required)")
     ap.add_argument("--speculative-k", type=int, default=4,
                     help="draft tokens per verify for --speculative")
+    ap.add_argument("--concurrency-sweep", action="store_true",
+                    help="benchmark paged vs dense KV at equal pool "
+                         "bytes under an offered-concurrency ladder "
+                         "(identical greedy tokens and a >=2x in-flight "
+                         "peak required)")
     args = ap.parse_args()
 
     on_tpu = jax.default_backend() == "tpu"
-    if args.speculative:
+    if args.concurrency_sweep:
+        model = "llama-1b" if on_tpu and not args.quick else "lm-test-tiny"
+        result = _bench_concurrency_sweep(args, model)
+    elif args.speculative:
         model = "llama-1b" if on_tpu and not args.quick else "lm-test-tiny"
         result = _bench_speculative(args, model)
     elif args.prefix_reuse:

@@ -14,6 +14,8 @@ if not lines:
 rec = json.loads(lines[-1])  # non-JSON output fails here
 if rec.get("regression"):
     sys.exit(f"bench regression marker set: {rec}")
+if rec.get("kv_blocks_in_use_after_drain", 0) != 0:
+    sys.exit(f"paged KV pool leaked blocks after drain: {rec}")
 '
 }
 
@@ -25,5 +27,10 @@ check_json "$out"
 # (speculation may only change cost, never tokens) or on <=1.5 accepted
 # tokens per verify dispatch in the draft-model run.
 out="$(JAX_PLATFORMS=cpu python bench_serving.py --quick --speculative)"
+check_json "$out"
+# Paged KV: the marker fires on dense/paged greedy divergence, on a
+# paged in-flight peak below 2x dense at equal pool bytes, or on a
+# block leak after drain (kv_blocks_in_use must return to 0).
+out="$(JAX_PLATFORMS=cpu python bench_serving.py --quick --concurrency-sweep)"
 check_json "$out"
 echo "bench smoke ok"

@@ -48,6 +48,15 @@ REST_PORT = 8500
         ParamSpec("draft_mode", "ngram",
                   "speculative draft proposer: ngram or "
                   "model:<registry-name>"),
+        ParamSpec("kv_layout", "dense",
+                  "KV-cache layout: dense (full-length row per decode "
+                  "slot) or paged (block pool; admission by memory, "
+                  "zero-copy prefix sharing)"),
+        ParamSpec("kv_block_size", 16,
+                  "tokens per KV block (paged layout)"),
+        ParamSpec("kv_pool_blocks", 0,
+                  "physical blocks in the paged pool (0 = dense-parity "
+                  "sizing)"),
         ParamSpec("enable_prometheus", True),
         ParamSpec("dtype", "bfloat16"),
     ],
@@ -67,6 +76,9 @@ def tpu_serving(
     prefill_len_buckets: int,
     speculative_k: int,
     draft_mode: str,
+    kv_layout: str,
+    kv_block_size: int,
+    kv_pool_blocks: int,
     enable_prometheus: bool,
     dtype: str,
 ) -> list[dict]:
@@ -85,6 +97,9 @@ def tpu_serving(
         f"--prefill-len-buckets={prefill_len_buckets}",
         f"--speculative-k={speculative_k}",
         f"--draft-mode={draft_mode}",
+        f"--kv-layout={kv_layout}",
+        f"--kv-block-size={kv_block_size}",
+        f"--kv-pool-blocks={kv_pool_blocks}",
         f"--dtype={dtype}",
     ]
     if enable_prometheus:

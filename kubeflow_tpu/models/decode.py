@@ -216,10 +216,40 @@ def generate(params, prompt_tokens, prompt_lengths, cfg: TransformerConfig,
 # so the cache write and attention mask are per-row.
 
 
-def _ragged_attention(x, layer, cfg, rope_bt, k_cache, v_cache, pos_b, valid):
+def _pool_gather(pool, table):
+    """Read a layer's block pool ``[N, Bs, H, hd]`` through block table
+    ``[B, MB]`` into virtual rows ``[B, MB*Bs, H, hd]`` — virtual position
+    ``p`` of row ``b`` lives at block ``table[b, p // Bs]``, offset
+    ``p % Bs``. Sentinel entries (``>= N``, the unallocated marker) clamp
+    to the last block; the junk they surface sits in positions the
+    validity mask already excludes, so it contributes exact zeros."""
+    _n, _bs, h, hd = pool.shape
+    return pool[table].reshape(table.shape[0], -1, h, hd)
+
+
+def _pool_write(pool, table, cols, vals):
+    """Scatter ``vals`` [B, S, H, hd] at per-row virtual positions
+    ``cols`` [B, S] through the block table. Out-of-range cols (rows
+    parked at ``total``) and sentinel table entries resolve to a
+    physical index past the pool, which scatter semantics drop — the
+    paged twin of the dense path's parked-row no-op write."""
+    n, bs = pool.shape[0], pool.shape[1]
+    mb = table.shape[1]
+    blk = jnp.take_along_axis(table, jnp.clip(cols // bs, 0, mb - 1), axis=1)
+    blk = jnp.where((cols >= 0) & (cols < mb * bs), blk, n)
+    return pool.at[blk, cols % bs].set(vals)
+
+
+def _ragged_attention(x, layer, cfg, rope_bt, k_cache, v_cache, pos_b, valid,
+                      table=None):
     """Single-token attention where row ``b`` writes cache slot ``pos_b[b]``
     — the continuous-batching variant of :func:`_cached_attention` (rows at
-    heterogeneous positions). x: [B, 1, D]; pos_b: [B]; valid: [B, total]."""
+    heterogeneous positions). x: [B, 1, D]; pos_b: [B]; valid: [B, total].
+
+    With ``table`` ([B, max_blocks]) the caches are a paged block pool
+    ``[N, Bs, H, hd]``: the write scatters through the table and the
+    attention reads the row gathered at block granularity — same math,
+    same mask, so outputs are byte-identical to the dense layout."""
     b, s, _d = x.shape
     hd = cfg.head_dim
     cos, sin = rope_bt
@@ -231,9 +261,16 @@ def _ragged_attention(x, layer, cfg, rope_bt, k_cache, v_cache, pos_b, valid):
     rows = jnp.arange(b)
     # Out-of-bounds pos_b (a retired row parked at total) is dropped by
     # scatter semantics — retired rows write nowhere.
-    k_cache = k_cache.at[rows, pos_b].set(k[:, 0])
-    v_cache = v_cache.at[rows, pos_b].set(v[:, 0])
-    out = _gqa_attention(q, k_cache, v_cache,
+    if table is None:
+        k_cache = k_cache.at[rows, pos_b].set(k[:, 0])
+        v_cache = v_cache.at[rows, pos_b].set(v[:, 0])
+        k_read, v_read = k_cache, v_cache
+    else:
+        k_cache = _pool_write(k_cache, table, pos_b[:, None], k)
+        v_cache = _pool_write(v_cache, table, pos_b[:, None], v)
+        k_read = _pool_gather(k_cache, table)
+        v_read = _pool_gather(v_cache, table)
+    out = _gqa_attention(q, k_read, v_read,
                          valid[:, None, None, None, :], cfg)
     return out @ layer["wo"].astype(cfg.dtype), k_cache, v_cache
 
@@ -474,12 +511,36 @@ def retire_row(state, slot):
             "length": state["length"].at[slot].set(total)}
 
 
+def _state_kv(state):
+    """Layout-agnostic view of a decode state's KV storage: returns
+    ``(k, v, table, total)``. Dense states carry ``[L, slots, total, H,
+    hd]`` caches (table None); paged states carry the block pool
+    ``[L, N, Bs, H, hd]`` plus the ``[slots, max_blocks]`` block table
+    (virtual ``total = max_blocks * Bs``)."""
+    if "pool" in state:
+        k = state["pool"]["k"]
+        table = state["block_table"]
+        return k, state["pool"]["v"], table, table.shape[1] * k.shape[2]
+    k = state["cache"]["k"]
+    return k, state["cache"]["v"], None, k.shape[2]
+
+
+def _with_kv(state, k, v):
+    """Refresh a state's KV storage under whichever layout it carries."""
+    if "pool" in state:
+        return {**state, "pool": {"k": k, "v": v}}
+    return {**state, "cache": {"k": k, "v": v}}
+
+
 def _single_token_forward(params, cfg: TransformerConfig, k_cache0, v_cache0,
-                          tok, pos_b, token_valid):
+                          tok, pos_b, token_valid, table=None):
     """One [B, 1] forward at per-row cache positions ``pos_b`` against the
     persistent caches (the layer loop shared by :func:`_decode_step_body`
-    and the verify commit pass). Returns (logits [B, V], k, v)."""
-    total = k_cache0.shape[2]
+    and the verify commit pass). With ``table`` the caches are the paged
+    block pool read/written through the block table. Returns
+    (logits [B, V], k, v)."""
+    total = (k_cache0.shape[2] if table is None
+             else table.shape[1] * k_cache0.shape[2])
     cos_t, sin_t = rotary_frequencies(cfg.head_dim, total,
                                       theta=cfg.rope_theta)
     rope_bt = (cos_t[pos_b[:, None]], sin_t[pos_b[:, None]])
@@ -490,7 +551,8 @@ def _single_token_forward(params, cfg: TransformerConfig, k_cache0, v_cache0,
         layer, k_cache, v_cache = layer_and_cache
         h = rms_norm(x, layer["ln_attn"], eps=cfg.norm_eps)
         attn, k_cache, v_cache = _ragged_attention(
-            h, layer["attn"], cfg, rope_bt, k_cache, v_cache, pos_b, valid
+            h, layer["attn"], cfg, rope_bt, k_cache, v_cache, pos_b, valid,
+            table=table,
         )
         x = x + attn
         h = rms_norm(x, layer["ln_mlp"], eps=cfg.norm_eps)
@@ -522,14 +584,15 @@ def _decode_step_body(state, params, cfg: TransformerConfig, top_k: int,
     :func:`decode_chunk`). With ``eos_id`` set, a row that samples it is
     parked ON DEVICE (active cleared, write position parked at ``total``
     like :func:`retire_row`) so a fused multi-step loop needs no host
-    round-trip per token to stop at EOS."""
-    total = state["cache"]["k"].shape[2]
+    round-trip per token to stop at EOS. Works on either KV layout
+    (:func:`_state_kv`): dense per-slot rows or the paged block pool."""
+    k0, v0, table, total = _state_kv(state)
     emit = state["active"]
     key, sub = jax.random.split(state["key"])
     tok = sample_token(state["last_logits"], sub, state["temperature"], top_k)
     p_b = state["length"]
     logits, k_new, v_new = _single_token_forward(
-        params, cfg, state["cache"]["k"], state["cache"]["v"], tok, p_b, emit
+        params, cfg, k0, v0, tok, p_b, emit, table=table
     )
     step_inc = emit.astype(jnp.int32)
     length = p_b + step_inc
@@ -542,16 +605,15 @@ def _decode_step_body(state, params, cfg: TransformerConfig, top_k: int,
         # row's cache scatter on subsequent fused steps.
         length = jnp.where(hit_eos, total, length)
     new_state = {
-        "cache": {"k": k_new, "v": v_new},
+        **state,
         "length": length,
         "remaining": remaining,
         "active": active,
-        "temperature": state["temperature"],
         "last_logits": jnp.where(emit[:, None], logits,
                                  state["last_logits"]),
         "key": key,
     }
-    return new_state, tok, emit
+    return _with_kv(new_state, k_new, v_new), tok, emit
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "top_k", "eos_id"),
@@ -609,14 +671,16 @@ def decode_chunk(state, params, cfg: TransformerConfig, steps: int,
 # step, so not advancing past the accepted region IS the rollback.
 
 
-def _span_attention(x, layer, cfg, rope_bt, k_cache, v_cache, pos_b):
+def _span_attention(x, layer, cfg, rope_bt, k_cache, v_cache, pos_b,
+                    table=None):
     """Block attention where row ``b``'s ``S`` tokens occupy cache slots
     ``pos_b[b]..pos_b[b]+S-1`` — the S-wide sibling of
     :func:`_ragged_attention` (rows at heterogeneous positions). Block
     token ``s`` attends every cache slot ``<= pos_b + s`` (its own K/V
     was just written), so causality holds within the block and over the
     row's history. Out-of-bounds writes (parked rows, cache-tail spill)
-    are dropped by scatter semantics."""
+    are dropped by scatter semantics. With ``table`` the caches are the
+    paged block pool, written/read through the block table."""
     b, s, _d = x.shape
     hd = cfg.head_dim
     cos, sin = rope_bt
@@ -625,22 +689,32 @@ def _span_attention(x, layer, cfg, rope_bt, k_cache, v_cache, pos_b):
     v = (x @ layer["wv"].astype(cfg.dtype)).reshape(b, s, cfg.n_kv_heads, hd)
     q = _rope(q, cos, sin)
     k = _rope(k, cos, sin)
-    rows = jnp.arange(b)[:, None]
     cols = pos_b[:, None] + jnp.arange(s)[None, :]
-    k_cache = k_cache.at[rows, cols].set(k)
-    v_cache = v_cache.at[rows, cols].set(v)
-    total = k_cache.shape[1]
+    if table is None:
+        rows = jnp.arange(b)[:, None]
+        k_cache = k_cache.at[rows, cols].set(k)
+        v_cache = v_cache.at[rows, cols].set(v)
+        k_read, v_read = k_cache, v_cache
+        total = k_cache.shape[1]
+    else:
+        k_cache = _pool_write(k_cache, table, cols, k)
+        v_cache = _pool_write(v_cache, table, cols, v)
+        k_read = _pool_gather(k_cache, table)
+        v_read = _pool_gather(v_cache, table)
+        total = table.shape[1] * k_cache.shape[1]
     mask = jnp.arange(total)[None, None, :] <= cols[:, :, None]
-    out = _gqa_attention(q, k_cache, v_cache, mask[:, None, None], cfg)
+    out = _gqa_attention(q, k_read, v_read, mask[:, None, None], cfg)
     return out @ layer["wo"].astype(cfg.dtype), k_cache, v_cache
 
 
 def _block_forward(params, cfg: TransformerConfig, k_cache0, v_cache0,
-                   tokens, pos_b, token_valid):
+                   tokens, pos_b, token_valid, table=None):
     """[B, S] forward writing K/V at per-row start positions ``pos_b`` →
-    (logits [B, S, V], k, v). The verify scoring pass and the draft
-    model's catch-up feed both ride this."""
-    total = k_cache0.shape[2]
+    (logits [B, S, V], k, v). The verify scoring pass, the paged
+    suffix-only prefill, and the draft model's catch-up feed all ride
+    this."""
+    total = (k_cache0.shape[2] if table is None
+             else table.shape[1] * k_cache0.shape[2])
     _b, s = tokens.shape
     cos_t, sin_t = rotary_frequencies(cfg.head_dim, total,
                                       theta=cfg.rope_theta)
@@ -652,7 +726,8 @@ def _block_forward(params, cfg: TransformerConfig, k_cache0, v_cache0,
         layer, k_cache, v_cache = layer_and_cache
         h = rms_norm(x, layer["ln_attn"], eps=cfg.norm_eps)
         attn, k_cache, v_cache = _span_attention(
-            h, layer["attn"], cfg, rope_bt, k_cache, v_cache, pos_b
+            h, layer["attn"], cfg, rope_bt, k_cache, v_cache, pos_b,
+            table=table,
         )
         x = x + attn
         h = rms_norm(x, layer["ln_mlp"], eps=cfg.norm_eps)
@@ -694,7 +769,7 @@ def _verify_step_body(state, params, cfg: TransformerConfig, draft,
     first non-draft token. Returns (state, tokens [slots, K+1],
     emitted [slots, K+1]) — ``emitted`` is a per-row prefix mask over
     the emitted tokens (1..K+1 of them for active rows)."""
-    total = state["cache"]["k"].shape[2]
+    k0, v0, table, total = _state_kv(state)
     slots, k_w = draft.shape
     emit0 = state["active"]
     p_b = state["length"]
@@ -706,8 +781,8 @@ def _verify_step_body(state, params, cfg: TransformerConfig, draft,
     # masked out by ``length`` until overwritten).
     in_draft = jnp.arange(k_w)[None, :] < draft_len[:, None]
     block_logits, k1, v1 = _block_forward(
-        params, cfg, state["cache"]["k"], state["cache"]["v"], draft, p_b,
-        token_valid=emit0[:, None] & in_draft,
+        params, cfg, k0, v0, draft, p_b,
+        token_valid=emit0[:, None] & in_draft, table=table,
     )
     # prev_logits[:, i] predicts draft position i: last_logits for i=0,
     # the scoring pass's own outputs shifted by one after that.
@@ -776,7 +851,7 @@ def _verify_step_body(state, params, cfg: TransformerConfig, draft,
     # but the row's length is parked at ``total`` so it is never read.
     commit_pos = p_b + n_eff
     logits2, k2, v2 = _single_token_forward(
-        params, cfg, k1, v1, commit, commit_pos, emit0
+        params, cfg, k1, v1, commit, commit_pos, emit0, table=table
     )
 
     length = p_b + m
@@ -784,16 +859,15 @@ def _verify_step_body(state, params, cfg: TransformerConfig, draft,
     active = emit0 & (remaining > 0) & (length < total) & ~hit_eos
     length = jnp.where(hit_eos, total, length)
     new_state = {
-        "cache": {"k": k2, "v": v2},
+        **state,
         "length": length,
         "remaining": remaining,
         "active": active,
-        "temperature": temp,
         "last_logits": jnp.where(emit0[:, None], logits2,
                                  state["last_logits"]),
         "key": key,
     }
-    return new_state, out, emitted
+    return _with_kv(new_state, k2, v2), out, emitted
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "top_k", "eos_id"),
@@ -875,3 +949,182 @@ def extend_and_propose(state, params, cfg: TransformerConfig, feed,
 
     state, toks = lax.scan(body, state, None, length=steps)
     return state, toks.T  # [slots, steps]
+
+
+# ---------------------------------------------------------------------------
+# Paged KV cache (serving/kv_allocator.py holds the host-side allocator)
+# ---------------------------------------------------------------------------
+#
+# The dense layout above reserves ``total_len`` K/V positions per decode
+# slot — every admitted request pays worst-case HBM no matter its actual
+# prompt or budget. The paged layout stores K/V in a pool of fixed-size
+# blocks and maps each slot's virtual positions through a per-slot block
+# table: slot ``b``'s position ``p`` lives at block
+# ``table[b, p // Bs]``, offset ``p % Bs``. Concurrency is then bounded
+# by TOKENS RESIDENT (blocks in use), not by ``slots * total_len``, and
+# a prefix-cache hit shares the donor's full blocks by reference
+# (refcounts in the host allocator) with zero device copies — only a
+# partially-filled tail block is copy-on-write'd.
+#
+# Attention reads gather the row at block granularity and the math,
+# masks, and widths are kept identical to the dense path (masked junk
+# contributes exact zeros), so greedy outputs are byte-identical between
+# layouts; ``decode_step`` / ``decode_chunk`` / ``verify_step`` /
+# ``verify_chunk`` accept either state via :func:`_state_kv`. Table
+# entries are initialised to ``num_blocks`` (an out-of-range sentinel):
+# writes through unallocated entries are dropped by scatter semantics
+# and gathers clamp into junk the validity mask already excludes.
+
+
+def init_paged_state(cfg: TransformerConfig, slots: int, num_blocks: int,
+                     block_size: int, max_blocks_per_seq: int, seed: int = 0):
+    """Paged server decode state: a device block pool
+    ``[L, num_blocks, block_size, Hkv, hd]`` shared by all slots plus a
+    per-slot block table. Virtual row width is
+    ``max_blocks_per_seq * block_size`` (the dense ``total_len``)."""
+    shape = (cfg.n_layers, num_blocks, block_size, cfg.n_kv_heads,
+             cfg.head_dim)
+    return {
+        "pool": {"k": jnp.zeros(shape, cfg.dtype),
+                 "v": jnp.zeros(shape, cfg.dtype)},
+        "block_table": jnp.full((slots, max_blocks_per_seq), num_blocks,
+                                jnp.int32),
+        "length": jnp.zeros((slots,), jnp.int32),
+        "remaining": jnp.zeros((slots,), jnp.int32),
+        "active": jnp.zeros((slots,), bool),
+        "temperature": jnp.zeros((slots,), jnp.float32),
+        "last_logits": jnp.zeros((slots, cfg.vocab_size), jnp.float32),
+        "key": jax.random.PRNGKey(seed),
+    }
+
+
+def _paged_admit_rows_body(state, params, cfg: TransformerConfig, slots,
+                           prompt_tokens, prompt_lengths, remaining,
+                           temperature):
+    """Prefill a round's admissions into a scratch dense cache (the exact
+    dense-path math, so logits are byte-identical), then scatter each
+    row's K/V into the pool blocks the host allocated for its slot
+    (``state["block_table"][slots]``; sentinel entries drop their
+    writes)."""
+    pool_k, pool_v = state["pool"]["k"], state["pool"]["v"]
+    bs = pool_k.shape[2]
+    mb = state["block_table"].shape[1]
+    total = mb * bs
+    b, t0 = prompt_tokens.shape
+    cache = init_cache(cfg, b, total)
+    prompt_lengths = jnp.maximum(prompt_lengths, 1)
+    valid = jnp.arange(total)[None, :] < prompt_lengths[:, None]
+    positions = jnp.broadcast_to(jnp.arange(t0)[None], (b, t0))
+    logits, cache = forward_cached(
+        params, prompt_tokens, cfg, cache, 0, positions, valid,
+        token_valid=positions < prompt_lengths[:, None],
+    )
+    last = jnp.take_along_axis(
+        logits, (prompt_lengths - 1)[:, None, None], axis=1
+    )[:, 0]
+    rows_tbl = state["block_table"][slots]  # [b, mb]
+    upd_k = cache["k"].reshape(cfg.n_layers, b, mb, bs, cfg.n_kv_heads,
+                               cfg.head_dim)
+    upd_v = cache["v"].reshape(cfg.n_layers, b, mb, bs, cfg.n_kv_heads,
+                               cfg.head_dim)
+    return {
+        **state,
+        "pool": {"k": pool_k.at[:, rows_tbl].set(upd_k),
+                 "v": pool_v.at[:, rows_tbl].set(upd_v)},
+        "length": state["length"].at[slots].set(prompt_lengths),
+        "remaining": state["remaining"].at[slots].set(remaining),
+        "active": state["active"].at[slots].set(remaining > 0),
+        "temperature": state["temperature"].at[slots].set(temperature),
+        "last_logits": state["last_logits"].at[slots].set(last),
+    }, last
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "top_k", "eos_id"),
+                   donate_argnames=("state",))
+def paged_admit_rows_and_step(state, params, cfg: TransformerConfig, slots,
+                              prompt_tokens, prompt_lengths, remaining,
+                              temperature, top_k: int = 0,
+                              eos_id: int | None = None):
+    """Paged twin of :func:`admit_rows_and_step`: prefill ``[K, T0]``
+    prompts, scatter them into the slots' allocated pool blocks, AND run
+    one fused decode step — still a single dispatch. The host must have
+    written each admitted slot's block table row before the call."""
+    state, last = _paged_admit_rows_body(state, params, cfg, slots,
+                                         prompt_tokens, prompt_lengths,
+                                         remaining, temperature)
+    state, tok, emit = _decode_step_body(state, params, cfg, top_k, eos_id)
+    return state, last, tok, emit
+
+
+def _paged_admit_prefix_body(state, params, cfg: TransformerConfig, slot,
+                             prefix_len, suffix_tokens, prompt_len,
+                             remaining, temperature):
+    """Suffix-only prefill through the slot's block table: the leading
+    ``prefix_len`` positions are already backed by shared (and possibly
+    one CoW'd) blocks, so the forward reads them in place — ZERO
+    device-side copies of the reused prefix — and writes only the
+    suffix K/V into the slot's owned blocks."""
+    table_row = state["block_table"][slot][None]  # [1, mb]
+    _b, s = suffix_tokens.shape
+    suffix_len = jnp.maximum(prompt_len - prefix_len, 1)
+    logits, pool_k, pool_v = _block_forward(
+        params, cfg, state["pool"]["k"], state["pool"]["v"], suffix_tokens,
+        jnp.reshape(prefix_len, (1,)),
+        token_valid=jnp.arange(s)[None, :] < suffix_len, table=table_row,
+    )
+    last = jnp.take_along_axis(
+        logits, jnp.reshape(suffix_len - 1, (1, 1, 1)), axis=1
+    )[:, 0]
+    return {
+        **state,
+        "pool": {"k": pool_k, "v": pool_v},
+        "length": state["length"].at[slot].set(prompt_len),
+        "remaining": state["remaining"].at[slot].set(remaining),
+        "active": state["active"].at[slot].set(remaining > 0),
+        "temperature": state["temperature"].at[slot].set(temperature),
+        "last_logits": state["last_logits"].at[slot].set(last[0]),
+    }, last
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "top_k", "eos_id"),
+                   donate_argnames=("state",))
+def paged_admit_prefix_and_step(state, params, cfg: TransformerConfig, slot,
+                                prefix_len, suffix_tokens, prompt_len,
+                                remaining, temperature, top_k: int = 0,
+                                eos_id: int | None = None):
+    """Paged twin of :func:`admit_prefix_and_step` — except the reused
+    prefix is never gathered or copied: the host mapped the donor's full
+    blocks into ``slot``'s table (refcount-shared) and CoW'd at most the
+    one partially-filled tail block, so this dispatch only prefills the
+    suffix and takes the fused decode step."""
+    state, last = _paged_admit_prefix_body(state, params, cfg, slot,
+                                           prefix_len, suffix_tokens,
+                                           prompt_len, remaining,
+                                           temperature)
+    state, tok, emit = _decode_step_body(state, params, cfg, top_k, eos_id)
+    return state, last, tok, emit
+
+
+@functools.partial(jax.jit, donate_argnames=("pool",))
+def store_blocks(pool, block_ids, cache):
+    """Scatter a batch-1 :func:`prefill` cache into pool blocks
+    ``block_ids`` ([nblk]; sentinel entries drop) — the paged prime path
+    (preload a shared system prompt without touching the decode RNG)."""
+    n_layers = pool["k"].shape[0]
+    bs = pool["k"].shape[2]
+    nblk = block_ids.shape[0]
+    tail = pool["k"].shape[3:]
+    k = cache["k"][:, 0, : nblk * bs].reshape(n_layers, nblk, bs, *tail)
+    v = cache["v"][:, 0, : nblk * bs].reshape(n_layers, nblk, bs, *tail)
+    return {"k": pool["k"].at[:, block_ids].set(k),
+            "v": pool["v"].at[:, block_ids].set(v)}
+
+
+@functools.partial(jax.jit, donate_argnames=("pool",))
+def copy_block(pool, dst, src):
+    """Copy one block's K/V across the pool — the copy-on-write for a
+    partially-filled shared tail block (the ONLY device copy a prefix
+    hit ever pays). ``dst``/``src`` are traced, one executable serves
+    every pair."""
+    return {"k": pool["k"].at[:, dst].set(pool["k"][:, src]),
+            "v": pool["v"].at[:, dst].set(pool["v"][:, src])}

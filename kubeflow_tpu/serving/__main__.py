@@ -57,6 +57,24 @@ def main(argv=None) -> int:
                    help="speculative draft proposer: 'ngram' (host-side "
                         "prompt/output lookup, zero device cost) or "
                         "'model:<registry-name>' (small draft model)")
+    p.add_argument("--kv-layout", default="dense",
+                   choices=["dense", "paged"],
+                   help="continuous-mode KV layout: 'dense' reserves a "
+                        "full-length row per decode slot; 'paged' backs "
+                        "requests with fixed-size blocks from a shared "
+                        "pool — admission bounded by memory, zero-copy "
+                        "prefix sharing, byte-identical greedy outputs")
+    p.add_argument("--kv-block-size", type=int, default=16,
+                   help="tokens per KV block (paged layout); must divide "
+                        "max-seq-len + max-new-tokens")
+    p.add_argument("--kv-pool-blocks", type=int, default=0,
+                   help="physical blocks in the paged pool (0 = "
+                        "dense-parity sizing: batch-size sequences at "
+                        "worst case)")
+    p.add_argument("--stream-timeout-s", type=float, default=60.0,
+                   help="default wait for generation results/streams; "
+                        "raise under heavy load so memory-deferred "
+                        "admissions don't time callers out")
     p.add_argument("--dtype", default="",
                    choices=["", "bfloat16", "float32"],
                    help="compute dtype override; empty keeps the model "
@@ -82,6 +100,20 @@ def main(argv=None) -> int:
     if not (args.draft_mode == "ngram"
             or args.draft_mode.startswith("model:")):
         p.error("--draft-mode must be 'ngram' or 'model:<name>'")
+    if args.kv_layout == "paged":
+        if args.decode_mode != "continuous":
+            # Only the continuous decoder carries the block pool;
+            # silently ignoring the flag would report dense numbers as
+            # paged ones.
+            p.error("--kv-layout=paged requires --decode-mode=continuous")
+        if args.kv_block_size <= 0:
+            p.error("--kv-block-size must be positive")
+        if (args.max_seq_len + args.max_new_tokens) % args.kv_block_size:
+            # Fail at flag-parse time, not at the first generation
+            # request (the decoder is built lazily).
+            p.error(f"--kv-block-size {args.kv_block_size} must divide "
+                    f"max-seq-len + max-new-tokens = "
+                    f"{args.max_seq_len + args.max_new_tokens}")
 
     server = ModelServer(
         EngineConfig(
@@ -99,6 +131,10 @@ def main(argv=None) -> int:
             prefill_len_buckets=args.prefill_len_buckets,
             speculative_k=args.speculative_k,
             draft_mode=args.draft_mode,
+            kv_layout=args.kv_layout,
+            kv_block_size=args.kv_block_size,
+            kv_pool_blocks=args.kv_pool_blocks,
+            stream_timeout_s=args.stream_timeout_s,
             dtype=args.dtype,
         ),
         port=args.rest_port,

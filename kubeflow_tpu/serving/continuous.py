@@ -57,16 +57,22 @@ import numpy as np
 from kubeflow_tpu.models.decode import (
     admit_prefix_and_step,
     admit_rows_and_step,
+    copy_block,
     decode_chunk,
     decode_step,
     init_decode_state,
+    init_paged_state,
     init_prefix_pool,
+    paged_admit_prefix_and_step,
+    paged_admit_rows_and_step,
     prefill,
+    store_blocks,
     store_prefix_cache,
     store_prefix_row,
     verify_chunk,
 )
 from kubeflow_tpu.serving.engine import pow2_bucket
+from kubeflow_tpu.serving.kv_allocator import BlockAllocator
 from kubeflow_tpu.serving.prefix_cache import PrefixCache
 from kubeflow_tpu.serving.speculative import make_proposer
 
@@ -91,6 +97,11 @@ class _Request:
     # Prefix-cache entry this request's admission read (pinned against
     # eviction until the request finishes).
     pinned_prefix: object | None = None
+    # Paged layout: (entry, prefix_len, suffix_bucket) planned at pop
+    # time — the plan must precede the block reservation so the entry
+    # is pinned before memory-pressure reclaim runs, and so the
+    # reservation only covers the NON-shared block count.
+    admit_plan: tuple | None = None
     done: threading.Event = field(default_factory=threading.Event)
     submit_t: float = field(default_factory=time.perf_counter)
     ttft_s: float | None = None
@@ -105,13 +116,22 @@ class _Request:
 
 
 class StreamHandle:
-    """Caller-side view of an in-flight generation."""
+    """Caller-side view of an in-flight generation.
 
-    def __init__(self, req: _Request):
+    ``default_timeout`` is the decoder's ``stream_timeout_s`` — callers
+    that pass no explicit timeout inherit it, so a deployment expecting
+    memory-deferred admissions under load can raise ONE knob instead of
+    chasing hard-coded 60s waits through every caller.
+    """
+
+    def __init__(self, req: _Request, default_timeout: float = 60.0):
         self._req = req
+        self._default_timeout = default_timeout
 
-    def tokens(self, timeout: float = 60.0):
+    def tokens(self, timeout: float | None = None):
         """Yield tokens as the decode loop emits them."""
+        if timeout is None:
+            timeout = self._default_timeout
         while True:
             try:
                 item = self._req.stream.get(timeout=timeout)
@@ -124,7 +144,7 @@ class StreamHandle:
                 return
             yield item
 
-    def result(self, timeout: float = 60.0, *,
+    def result(self, timeout: float | None = None, *,
                with_logits: bool | None = None) -> dict:
         """Block until the request finishes; returns the full prediction.
 
@@ -133,6 +153,8 @@ class StreamHandle:
         no tokens (pure-prefill scoring, where the logits ARE the
         answer); pass True to force (return_logits callers).
         """
+        if timeout is None:
+            timeout = self._default_timeout
         if not self._req.done.wait(timeout):
             raise TimeoutError("generation timed out")
         if self._req.error is not None:
@@ -165,7 +187,10 @@ class ContinuousDecoder:
                  chunk_size: int = 1, prefix_cache_slots: int = 0,
                  prefix_cache_min_len: int = 16,
                  prefill_len_buckets: int = 0, speculative_k: int = 0,
-                 draft_mode: str = "ngram"):
+                 draft_mode: str = "ngram", kv_layout: str = "dense",
+                 kv_block_size: int = 16, kv_pool_blocks: int = 0,
+                 kv_low_watermark: int = 0,
+                 stream_timeout_s: float = 60.0):
         self.params = params
         self.cfg = cfg
         self.slots = slots
@@ -173,6 +198,7 @@ class ContinuousDecoder:
         self.max_new_tokens = max_new_tokens
         self.top_k = top_k
         self.eos_id = eos_id
+        self.stream_timeout_s = float(stream_timeout_s)
         # Power-of-two prefill length buckets (0 = every prompt pads to
         # prefill_len): a round's prompts ride the smallest allowed
         # compiled shape covering them, so a 6-token prompt stops paying
@@ -181,13 +207,20 @@ class ContinuousDecoder:
         # Device-resident prefix KV cache: host trie -> pool row of
         # cached prefix K/V. Admissions that match reuse the rows and
         # prefill only their suffix; finished prompts publish back.
+        if kv_layout not in ("dense", "paged"):
+            raise ValueError(f"unknown kv_layout {kv_layout!r}")
+        self.kv_layout = kv_layout
         self.prefix_cache = (
             PrefixCache(prefix_cache_slots, min_len=prefix_cache_min_len)
             if prefix_cache_slots > 0 else None
         )
+        # Dense layout only: the prefix pool is a second full-width copy
+        # of each cached prefix. The paged layout supersedes it — a hit
+        # SHARES the donor's pool blocks by refcount (zero device
+        # copies), so the main pool is the only KV storage.
         self._prefix_pool = (
             init_prefix_pool(cfg, prefix_cache_slots, prefill_len)
-            if prefix_cache_slots > 0 else None
+            if prefix_cache_slots > 0 and kv_layout == "dense" else None
         )
         # Guards trie + pool-reference mutation: prime_prefix() runs on
         # caller threads while the scheduler thread matches/publishes.
@@ -220,7 +253,44 @@ class ContinuousDecoder:
         # while a row's drafts keep missing (verify compute is then pure
         # overhead), recover on clean sweeps.
         self._slot_k = [self.speculative_k] * slots
-        self._state = init_decode_state(cfg, slots, self.total_len, seed)
+        if kv_layout == "paged":
+            self.kv_block_size = max(1, int(kv_block_size))
+            if self.total_len % self.kv_block_size:
+                raise ValueError(
+                    f"kv_block_size {self.kv_block_size} must divide "
+                    f"prefill_len + max_new_tokens = {self.total_len} "
+                    "(equal virtual row width is what makes paged decode "
+                    "byte-identical to dense)")
+            mb = self.total_len // self.kv_block_size
+            # 0 = worst-case parity with the dense reservation: the pool
+            # can back every slot at full length, so paged is never more
+            # restrictive than dense. Smaller pools trade that for HBM;
+            # larger slots counts then buy real concurrency.
+            num_blocks = int(kv_pool_blocks) or slots * mb
+            if num_blocks < mb:
+                raise ValueError(
+                    f"kv_pool_blocks {num_blocks} cannot back even one "
+                    f"worst-case sequence ({mb} blocks)")
+            self._alloc = BlockAllocator(num_blocks, self.kv_block_size)
+            self._max_blocks_per_seq = mb
+            # Host mirror of the device block table; sentinel
+            # ``num_blocks`` marks unallocated entries (writes through
+            # them are dropped on device).
+            self._table = np.full((slots, mb), num_blocks, np.int32)
+            self._slot_blocks: list[list[int]] = [[] for _ in range(slots)]
+            self._state = init_paged_state(cfg, slots, num_blocks,
+                                           self.kv_block_size, mb, seed)
+        else:
+            self.kv_block_size = int(kv_block_size)
+            self._alloc = None
+            self._state = init_decode_state(cfg, slots, self.total_len, seed)
+        self.kv_low_watermark = max(0, int(kv_low_watermark))
+        # Serializes device access to self._state between the scheduler
+        # thread and caller-thread prime_prefix (which, in paged mode,
+        # writes primed blocks into the SHARED pool — the jitted calls
+        # donate state buffers, so unsynchronized access would read
+        # donated storage).
+        self._state_lock = threading.Lock()
         self._slot_req: list[_Request | None] = [None] * slots
         self._active_count = 0
         self._pending: deque[_Request] = deque()
@@ -246,7 +316,22 @@ class ContinuousDecoder:
         self.spec_verify_dispatches = 0  # fused verify round-trips
         self.ttft_sum = 0.0
         self.ttft_count = 0
+        # Paged-KV counters (zero in the dense layout).
+        self.kv_cow_copies = 0       # tail-block copy-on-writes
+        self.kv_shared_blocks = 0    # blocks mapped by refcount on hits
+        self.kv_defer_admissions = 0  # rounds deferred for memory
+        self.kv_blocks_peak = 0      # high-water blocks_in_use
+        self.peak_in_flight = 0      # high-water concurrent requests
+        # Counter mutations and metrics() reads go through this lock so
+        # derived ratios (ttft_avg_s, spec_acceptance_rate) are computed
+        # from a CONSISTENT snapshot, never from a torn sum/count pair
+        # mid-update. Leaf lock: never acquired while holding it.
+        self._mlock = threading.Lock()
         self._ramp_streak = 0  # consecutive admission-only rounds
+        if self.prefix_cache is not None and self._alloc is not None:
+            # Trie evictions must return the entry's refcounted blocks
+            # to the pool; remove() fires this under the prefix lock.
+            self.prefix_cache.on_evict = self._drop_entry_blocks
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -264,10 +349,11 @@ class ContinuousDecoder:
                 raise RuntimeError("decoder is stopped")
             self._pending.append(req)
             self._cv.notify()
-        return StreamHandle(req)
+        return StreamHandle(req, self.stream_timeout_s)
 
     def generate(self, tokens: list[int], max_new_tokens: int,
-                 temperature: float = 0.0, timeout: float = 60.0) -> dict:
+                 temperature: float = 0.0,
+                 timeout: float | None = None) -> dict:
         return self.submit(tokens, max_new_tokens, temperature).result(timeout)
 
     def stop(self) -> None:
@@ -293,6 +379,45 @@ class ContinuousDecoder:
         req.finish_reason = reason if error is None else "error"
         req.stream.put(_DONE)
         req.done.set()
+
+    # -- paged-KV bookkeeping (no-ops in the dense layout) -------------
+
+    def _drop_entry_blocks(self, entry) -> None:
+        """Prefix-trie eviction hook: release the entry's refcounted
+        blocks. Called by PrefixCache.remove() with the prefix lock
+        held — must not re-acquire it."""
+        for b in (entry.blocks or ()):
+            self._alloc.free(b)
+
+    def _set_table_row(self, slot: int, blocks: list[int]) -> None:
+        """Point ``slot``'s host block-table row at ``blocks`` (sentinel
+        beyond them); uploaded to device at the next admission call."""
+        self._table[slot, :] = self._alloc.num_blocks
+        self._table[slot, : len(blocks)] = blocks
+
+    def _free_slot_blocks(self, slot: int) -> None:
+        """Return a retiring slot's block references to the allocator.
+        Idempotent — the crash path can race the normal finish path, and
+        only the first call finds blocks to free."""
+        if self._alloc is None:
+            return
+        with self._prefix_lock:
+            blocks, self._slot_blocks[slot] = self._slot_blocks[slot], []
+            for b in blocks:
+                self._alloc.free(b)
+            if blocks:
+                self._table[slot, :] = self._alloc.num_blocks
+
+    def _reclaim_blocks(self, need: int) -> None:
+        """Evict unpinned prefix-cache entries (LRU first) until ``need``
+        blocks are free — cache-held blocks are reclaimable memory, not
+        reservations, so admission pressure beats cold cache entries.
+        Caller holds the prefix lock."""
+        if self.prefix_cache is None:
+            return
+        while self._alloc.free_blocks < need:
+            if not self.prefix_cache.evict_lru():
+                break
 
     def _admit_batch(self, pending: list[tuple[_Request, int]]) -> None:
         """Admit a round's pending requests in ONE dispatch that fuses
@@ -328,15 +453,27 @@ class ContinuousDecoder:
         # ONE admission executable per (batch, length) bucket: always the
         # fused variant (the extra decode step is ~free on device, and a
         # second plain-admit executable would surprise-compile
-        # mid-traffic).
-        self._state, last, tok, emit = admit_rows_and_step(
-            self._state, self.params, self.cfg,
-            jnp.asarray(slots), jnp.asarray(toks),
-            jnp.asarray(lengths), jnp.asarray(wants),
-            jnp.asarray(temps), self.top_k, self.eos_id)
-        self.prefill_dispatches += 1
-        self.admitted += k
-        self.prefill_tokens += sum(len(req.tokens) for req, _ in pending)
+        # mid-traffic). The paged twin reads each slot's block-table row
+        # (allocated at pop time) instead of scattering into dense rows.
+        with self._state_lock:
+            if self._alloc is not None:
+                self._state["block_table"] = jnp.asarray(self._table)
+                self._state, last, tok, emit = paged_admit_rows_and_step(
+                    self._state, self.params, self.cfg,
+                    jnp.asarray(slots), jnp.asarray(toks),
+                    jnp.asarray(lengths), jnp.asarray(wants),
+                    jnp.asarray(temps), self.top_k, self.eos_id)
+            else:
+                self._state, last, tok, emit = admit_rows_and_step(
+                    self._state, self.params, self.cfg,
+                    jnp.asarray(slots), jnp.asarray(toks),
+                    jnp.asarray(lengths), jnp.asarray(wants),
+                    jnp.asarray(temps), self.top_k, self.eos_id)
+        with self._mlock:
+            self.prefill_dispatches += 1
+            self.admitted += k
+            self.prefill_tokens += sum(len(req.tokens)
+                                       for req, _ in pending)
         # Fetch ONLY the fused step's tokens (one small transfer);
         # vocab-wide prefill logits stay on device behind a lazy
         # per-request resolver — eager [K, V] fetches each admission
@@ -406,21 +543,50 @@ class ContinuousDecoder:
         suffix = req.tokens[prefix_len:]
         toks = np.zeros((1, s), np.int32)
         toks[0, : len(suffix)] = suffix
-        with self._prefix_lock:
-            pool = self._prefix_pool
-        self._state, last, tok, emit = admit_prefix_and_step(
-            self._state, self.params, self.cfg, jnp.int32(slot), pool,
-            jnp.int32(entry.slot), jnp.int32(prefix_len),
-            jnp.asarray(toks), jnp.int32(len(req.tokens)),
-            jnp.int32(req.want), jnp.float32(req.temperature),
-            self.top_k, self.eos_id)
+        if self._alloc is not None:
+            # The pop-time reservation already mapped the donor's FULL
+            # prefix blocks into this slot by refcount — zero device
+            # copies. Here only a partially-filled tail block pays its
+            # CoW (one block copy), then the suffix prefill reads the
+            # shared prefix in place through the block table.
+            bs = self.kv_block_size
+            n_full = prefix_len // bs
+            with self._state_lock:
+                if prefix_len % bs:
+                    # First owned block (table index n_full) receives
+                    # the donor's partially-shared tail content.
+                    self._state["pool"] = copy_block(
+                        self._state["pool"],
+                        jnp.int32(self._slot_blocks[slot][n_full]),
+                        jnp.int32(entry.blocks[n_full]))
+                self._state["block_table"] = jnp.asarray(self._table)
+                self._state, last, tok, emit = paged_admit_prefix_and_step(
+                    self._state, self.params, self.cfg, jnp.int32(slot),
+                    jnp.int32(prefix_len), jnp.asarray(toks),
+                    jnp.int32(len(req.tokens)), jnp.int32(req.want),
+                    jnp.float32(req.temperature), self.top_k, self.eos_id)
+            with self._mlock:
+                self.kv_shared_blocks += n_full
+                if prefix_len % bs:
+                    self.kv_cow_copies += 1
+        else:
+            with self._prefix_lock:
+                pool = self._prefix_pool
+            with self._state_lock:
+                self._state, last, tok, emit = admit_prefix_and_step(
+                    self._state, self.params, self.cfg, jnp.int32(slot),
+                    pool, jnp.int32(entry.slot), jnp.int32(prefix_len),
+                    jnp.asarray(toks), jnp.int32(len(req.tokens)),
+                    jnp.int32(req.want), jnp.float32(req.temperature),
+                    self.top_k, self.eos_id)
         req.pinned_prefix = entry
-        self.prefill_dispatches += 1
-        self.admitted += 1
-        self.prefix_hits += 1
-        self.prefix_tokens_reused += prefix_len
-        self.prefix_suffix_tokens += len(suffix)
-        self.prefill_tokens += len(suffix)
+        with self._mlock:
+            self.prefill_dispatches += 1
+            self.admitted += 1
+            self.prefix_hits += 1
+            self.prefix_tokens_reused += prefix_len
+            self.prefix_suffix_tokens += len(suffix)
+            self.prefill_tokens += len(suffix)
         tok_np, emit_np = jax.device_get((tok, emit))
         req.prefill_src = (last, 0)
         self._post_admit(req, slot)
@@ -445,9 +611,21 @@ class ContinuousDecoder:
             entry = cache.reserve(key)
             if entry is None:  # every pool slot pinned by peers in flight
                 return
-            self._prefix_pool = store_prefix_row(
-                self._prefix_pool, jnp.int32(entry.slot), self._state,
-                jnp.int32(slot))
+            if self._alloc is not None:
+                # Paged publish is pure bookkeeping: the prompt's K/V
+                # already lives in the slot's pool blocks, so the entry
+                # just takes a reference on the blocks covering the key
+                # (they outlive the slot's own release). ZERO copies.
+                n_pub = min(self._alloc.blocks_for(len(key)),
+                            len(self._slot_blocks[slot]))
+                blocks = tuple(self._slot_blocks[slot][:n_pub])
+                for b in blocks:
+                    self._alloc.share(b)
+                entry.blocks = blocks
+            else:
+                self._prefix_pool = store_prefix_row(
+                    self._prefix_pool, jnp.int32(entry.slot), self._state,
+                    jnp.int32(slot))
             self.prefix_inserts += 1
 
     def _release_pin(self, req: _Request) -> None:
@@ -474,19 +652,51 @@ class ContinuousDecoder:
             entry = self.prefix_cache.reserve(key)
             if entry is None:
                 return False
-            try:
-                t = self._seq_bucket(len(toks))
-                arr = np.zeros((1, t), np.int32)
-                arr[0, : len(toks)] = toks
-                cache, _last = prefill(
-                    self.params, jnp.asarray(arr),
-                    jnp.asarray([len(toks)], np.int32), self.cfg,
-                    total_len=self.prefill_len)
-                self._prefix_pool = store_prefix_cache(
-                    self._prefix_pool, jnp.int32(entry.slot), cache)
-            except Exception:
-                self.prefix_cache.remove(entry)
-                raise
+            if self._alloc is not None:
+                # Paged prime: prefill into freshly allocated pool
+                # blocks owned by the trie entry itself (refcount 1,
+                # released on eviction). The state lock serializes the
+                # pool write against the scheduler's donated steps.
+                nblk = self._alloc.blocks_for(len(toks))
+                self._reclaim_blocks(nblk)
+                if not self._alloc.can_alloc(nblk):
+                    self.prefix_cache.remove(entry)
+                    return False
+                blocks = self._alloc.alloc(nblk)
+                self.kv_blocks_peak = max(self.kv_blocks_peak,
+                                          self._alloc.blocks_in_use)
+                try:
+                    w = nblk * self.kv_block_size
+                    arr = np.zeros((1, w), np.int32)
+                    arr[0, : len(toks)] = toks
+                    cache, _last = prefill(
+                        self.params, jnp.asarray(arr),
+                        jnp.asarray([len(toks)], np.int32), self.cfg,
+                        total_len=w)
+                    with self._state_lock:
+                        self._state["pool"] = store_blocks(
+                            self._state["pool"],
+                            jnp.asarray(blocks, np.int32), cache)
+                except Exception:
+                    for b in blocks:
+                        self._alloc.free(b)
+                    self.prefix_cache.remove(entry)
+                    raise
+                entry.blocks = tuple(blocks)
+            else:
+                try:
+                    t = self._seq_bucket(len(toks))
+                    arr = np.zeros((1, t), np.int32)
+                    arr[0, : len(toks)] = toks
+                    cache, _last = prefill(
+                        self.params, jnp.asarray(arr),
+                        jnp.asarray([len(toks)], np.int32), self.cfg,
+                        total_len=self.prefill_len)
+                    self._prefix_pool = store_prefix_cache(
+                        self._prefix_pool, jnp.int32(entry.slot), cache)
+                except Exception:
+                    self.prefix_cache.remove(entry)
+                    raise
             self.prefix_inserts += 1
             self.prefill_tokens += len(toks)  # priming IS a prefill
             return True
@@ -498,11 +708,14 @@ class ContinuousDecoder:
             # result back immediately.
             self._publish_prefix(req, slot)
             self._release_pin(req)
+            self._free_slot_blocks(slot)
             self._slot_req[slot] = None
             self._finish(req)
         else:
             self._slot_req[slot] = req
             self._active_count += 1
+            self.peak_in_flight = max(self.peak_in_flight,
+                                      self._active_count)
             if self._spec is not None:
                 self._spec.reset(slot)
                 self._slot_k[slot] = self.speculative_k
@@ -512,6 +725,7 @@ class ContinuousDecoder:
         EOS parking already happened on device (``_decode_step_body``);
         the host only finishes the request and frees the slot."""
         now = time.perf_counter()
+        emitted_n, ttft_sum, ttft_n = 0, 0.0, 0
         for slot in range(self.slots):
             req = self._slot_req[slot]
             if req is None or not emitted[slot]:
@@ -520,19 +734,24 @@ class ContinuousDecoder:
             req.out.append(tok)
             if req.ttft_s is None:
                 req.ttft_s = now - req.submit_t
-                self.ttft_sum += req.ttft_s
-                self.ttft_count += 1
+                ttft_sum += req.ttft_s
+                ttft_n += 1
             req.stream.put(tok)
-            self.tokens_emitted += 1
+            emitted_n += 1
             hit_eos = self.eos_id is not None and tok == self.eos_id
             if hit_eos or len(req.out) >= req.want:
                 # Publish the finished prompt's prefix while its K/V rows
                 # are still intact in the slot, then free it.
                 self._publish_prefix(req, slot)
                 self._release_pin(req)
+                self._free_slot_blocks(slot)
                 self._slot_req[slot] = None
                 self._active_count -= 1
                 self._finish(req, reason="eos" if hit_eos else "length")
+        with self._mlock:
+            self.tokens_emitted += emitted_n
+            self.ttft_sum += ttft_sum
+            self.ttft_count += ttft_n
 
     def _dispatch_block(self, toks: np.ndarray, emitted: np.ndarray) -> None:
         """Route one verify step's tokens ([slots, K+1], ``emitted`` a
@@ -540,6 +759,7 @@ class ContinuousDecoder:
         of :func:`_dispatch`. The device already capped each row at its
         budget and truncated at EOS, so the mask is trusted verbatim."""
         now = time.perf_counter()
+        emitted_n, ttft_sum, ttft_n = 0, 0.0, 0
         for slot in range(self.slots):
             req = self._slot_req[slot]
             if req is None or not emitted[slot, 0]:
@@ -552,17 +772,22 @@ class ContinuousDecoder:
                 req.out.append(last_tok)
                 if req.ttft_s is None:
                     req.ttft_s = now - req.submit_t
-                    self.ttft_sum += req.ttft_s
-                    self.ttft_count += 1
+                    ttft_sum += req.ttft_s
+                    ttft_n += 1
                 req.stream.put(last_tok)
-                self.tokens_emitted += 1
+                emitted_n += 1
             hit_eos = self.eos_id is not None and last_tok == self.eos_id
             if hit_eos or len(req.out) >= req.want:
                 self._publish_prefix(req, slot)
                 self._release_pin(req)
+                self._free_slot_blocks(slot)
                 self._slot_req[slot] = None
                 self._active_count -= 1
                 self._finish(req, reason="eos" if hit_eos else "length")
+        with self._mlock:
+            self.tokens_emitted += emitted_n
+            self.ttft_sum += ttft_sum
+            self.ttft_count += ttft_n
 
     def _tune_slot(self, slot: int, accepted: int, drafted: int) -> None:
         """Shrink a slot's draft length while verification keeps throwing
@@ -620,26 +845,32 @@ class ContinuousDecoder:
                 dlens[s, slot] = len(seg)
         if not dlens.any():
             return False
-        self._state, outs, emits = verify_chunk(
-            self._state, self.params, self.cfg, jnp.asarray(drafts),
-            jnp.asarray(dlens), self.top_k, self.eos_id)
-        self.dispatches += 1
-        self.spec_verify_dispatches += 1
-        self.steps += 2 * steps  # scoring + commit forward per verify
+        with self._state_lock:
+            self._state, outs, emits = verify_chunk(
+                self._state, self.params, self.cfg, jnp.asarray(drafts),
+                jnp.asarray(dlens), self.top_k, self.eos_id)
+        with self._mlock:
+            self.dispatches += 1
+            self.spec_verify_dispatches += 1
+            self.steps += 2 * steps  # scoring + commit forward per verify
         self._ramp_streak = 0
         outs, emits = jax.device_get((outs, emits))
         for s in range(steps):
             # Accounting before routing: routing may free the slot.
+            drafted, accepted = 0, 0
             for slot in range(self.slots):
                 d = int(dlens[s, slot])
                 if d == 0 or self._slot_req[slot] is None:
                     continue
                 m = int(emits[s, slot].sum())
                 acc = min(max(m - 1, 0), d)
-                self.spec_drafted_tokens += d
-                self.spec_accepted_tokens += acc
+                drafted += d
+                accepted += acc
                 if m:
                     self._tune_slot(slot, acc, d)
+            with self._mlock:
+                self.spec_drafted_tokens += drafted
+                self.spec_accepted_tokens += accepted
             self._dispatch_block(outs[s], emits[s])
         return True
 
@@ -667,6 +898,10 @@ class ContinuousDecoder:
                 self._slot_req[slot] = None
                 self._active_count -= 1
                 self._finish(req, error=err)
+            # Every slot's block references return to the pool — also
+            # covers blocks reserved at pop time for an admission that
+            # never registered (idempotent with the finish path's free).
+            self._free_slot_blocks(slot)
         for req in queued:
             self._finish(req, error=err)
 
@@ -682,11 +917,68 @@ class ContinuousDecoder:
                 if self._stopped:
                     return
                 pending = []
+                deferred = False
                 for slot in range(self.slots):
-                    if not self._pending:
+                    if not self._pending or deferred:
                         break
-                    if self._slot_req[slot] is None:
+                    if self._slot_req[slot] is not None:
+                        continue
+                    if self._alloc is None:
                         pending.append((self._pending.popleft(), slot))
+                        continue
+                    # Memory-aware admission: a request enters only when
+                    # its WORST-CASE block count fits the pool (so the
+                    # stream can never OOM mid-decode), reserving the
+                    # blocks here so prime_prefix can't race them away.
+                    # The prefix plan runs FIRST: a hit pins its entry
+                    # (reclaim then can't evict it underneath) and
+                    # shrinks the reservation to the non-shared blocks.
+                    # The low-watermark defers admission while other
+                    # work is in flight instead of draining the pool to
+                    # zero headroom.
+                    while self._pending:
+                        req = self._pending[0]
+                        worst = self._alloc.blocks_for(
+                            max(len(req.tokens), 1) + req.want)
+                        if worst > self._alloc.num_blocks:
+                            self._pending.popleft()
+                            self._finish(req, error=ValueError(
+                                f"request needs {worst} KV blocks but "
+                                f"the pool holds "
+                                f"{self._alloc.num_blocks}"))
+                            continue
+                        plan = (self._plan_prefix(req)
+                                if self.prefix_cache is not None else None)
+                        n_shared = (plan[1] // self.kv_block_size
+                                    if plan is not None else 0)
+                        need = worst - n_shared
+                        with self._prefix_lock:
+                            self._reclaim_blocks(need)
+                            headroom = self._alloc.free_blocks - need
+                            busy = self._active_count > 0 or pending
+                            if headroom < (self.kv_low_watermark
+                                           if busy else 0):
+                                if plan is not None:
+                                    self.prefix_cache.release(plan[0])
+                                deferred = True
+                                break
+                            own = self._alloc.alloc(need)
+                            shared = (list(plan[0].blocks[:n_shared])
+                                      if plan is not None else [])
+                            for b in shared:
+                                self._alloc.share(b)
+                            self.kv_blocks_peak = max(
+                                self.kv_blocks_peak,
+                                self._alloc.blocks_in_use)
+                        req.admit_plan = plan
+                        blocks = shared + own
+                        self._slot_blocks[slot] = blocks
+                        self._set_table_row(slot, blocks)
+                        pending.append((self._pending.popleft(), slot))
+                        break
+                if deferred:
+                    with self._mlock:
+                        self.kv_defer_admissions += 1
             try:
                 if pending:
                     # Admission fuses prefill + insert + one decode step
@@ -710,7 +1002,12 @@ class ContinuousDecoder:
                     if self.prefix_cache is not None:
                         hits, misses = [], []
                         for req, slot in pending:
-                            plan = self._plan_prefix(req)
+                            # Paged admissions planned at pop time (the
+                            # plan gates the block reservation); dense
+                            # ones probe the trie here.
+                            plan = (req.admit_plan
+                                    if self._alloc is not None
+                                    else self._plan_prefix(req))
                             if plan is None:
                                 self.prefix_misses += 1
                                 misses.append((req, slot))
@@ -733,23 +1030,27 @@ class ContinuousDecoder:
                 if self._spec is not None and self._spec_round():
                     continue
                 if self.chunk_size > 1:
-                    self._state, toks, emitted = decode_chunk(
-                        self._state, self.params, self.cfg,
-                        self.chunk_size, self.top_k, self.eos_id,
-                    )
-                    self.steps += self.chunk_size
-                    self.dispatches += 1
+                    with self._state_lock:
+                        self._state, toks, emitted = decode_chunk(
+                            self._state, self.params, self.cfg,
+                            self.chunk_size, self.top_k, self.eos_id,
+                        )
+                    with self._mlock:
+                        self.steps += self.chunk_size
+                        self.dispatches += 1
                     self._ramp_streak = 0
                     toks, emitted = jax.device_get((toks, emitted))
                     for k in range(self.chunk_size):
                         self._dispatch(toks[k], emitted[k])
                 else:
-                    self._state, toks, emitted = decode_step(
-                        self._state, self.params, self.cfg, self.top_k,
-                        self.eos_id,
-                    )
-                    self.steps += 1
-                    self.dispatches += 1
+                    with self._state_lock:
+                        self._state, toks, emitted = decode_step(
+                            self._state, self.params, self.cfg, self.top_k,
+                            self.eos_id,
+                        )
+                    with self._mlock:
+                        self.steps += 1
+                        self.dispatches += 1
                     self._dispatch(*jax.device_get((toks, emitted)))
             except Exception as e:
                 # A failed prefill/decode/verify may have invalidated
@@ -757,43 +1058,66 @@ class ContinuousDecoder:
                 # the decoder cannot safely take more work. Requests
                 # popped this round but not yet registered in a slot
                 # would be invisible to the loop-exit sweep — fail them
-                # here, then let _loop's wrapper fail everything else
-                # (in-flight and queued) with the same error.
+                # here (returning any pop-time block reservation), then
+                # let _loop's wrapper fail everything else (in-flight
+                # and queued) with the same error.
                 for req, _slot in pending:
                     self._finish(req, error=e)
+                    self._free_slot_blocks(_slot)
                 raise
 
     # ------------------------------------------------------------------
 
     def metrics(self) -> dict:
         cache = self.prefix_cache
-        return {
-            "decode_steps": self.steps,
-            "decode_dispatches": self.dispatches,
-            "prefill_dispatches": self.prefill_dispatches,
-            "prefill_tokens": self.prefill_tokens,
-            "requests_admitted": self.admitted,
-            "ramp_rounds": self.ramp_rounds,
-            "tokens_emitted": self.tokens_emitted,
-            "ttft_avg_s": (self.ttft_sum / self.ttft_count
-                           if self.ttft_count else 0.0),
-            "in_flight": self._active_count,
-            "queued": len(self._pending),
-            "prefix_hits": self.prefix_hits,
-            "prefix_misses": self.prefix_misses,
-            "prefix_evictions": cache.evictions if cache else 0,
-            "prefix_tokens_reused": self.prefix_tokens_reused,
-            "prefix_suffix_tokens": self.prefix_suffix_tokens,
-            "prefix_inserts": self.prefix_inserts,
-            "prefix_entries": len(cache) if cache else 0,
-            "spec_drafted_tokens": self.spec_drafted_tokens,
-            "spec_accepted_tokens": self.spec_accepted_tokens,
-            "spec_verify_dispatches": self.spec_verify_dispatches,
-            "spec_draft_dispatches": (self._spec.dispatches
-                                      if self._spec is not None else 0),
-            "spec_acceptance_rate": (
-                self.spec_accepted_tokens / self.spec_drafted_tokens
-                if self.spec_drafted_tokens else 0.0),
-            "spec_draft_k": (sum(self._slot_k) / len(self._slot_k)
-                             if self._slot_k else 0.0),
-        }
+        # One lock-guarded snapshot of every counter the scheduler
+        # mutates, so derived ratios (ttft_avg_s, spec_acceptance_rate)
+        # are computed from matching sum/count pairs — never from a
+        # torn read taken mid-update.
+        with self._mlock:
+            snap = {
+                "decode_steps": self.steps,
+                "decode_dispatches": self.dispatches,
+                "prefill_dispatches": self.prefill_dispatches,
+                "prefill_tokens": self.prefill_tokens,
+                "requests_admitted": self.admitted,
+                "ramp_rounds": self.ramp_rounds,
+                "tokens_emitted": self.tokens_emitted,
+                "ttft_avg_s": (self.ttft_sum / self.ttft_count
+                               if self.ttft_count else 0.0),
+                "in_flight": self._active_count,
+                "peak_in_flight": self.peak_in_flight,
+                "queued": len(self._pending),
+                "prefix_hits": self.prefix_hits,
+                "prefix_misses": self.prefix_misses,
+                "prefix_tokens_reused": self.prefix_tokens_reused,
+                "prefix_suffix_tokens": self.prefix_suffix_tokens,
+                "prefix_inserts": self.prefix_inserts,
+                "spec_drafted_tokens": self.spec_drafted_tokens,
+                "spec_accepted_tokens": self.spec_accepted_tokens,
+                "spec_verify_dispatches": self.spec_verify_dispatches,
+                "spec_draft_dispatches": (self._spec.dispatches
+                                          if self._spec is not None else 0),
+                "spec_acceptance_rate": (
+                    self.spec_accepted_tokens / self.spec_drafted_tokens
+                    if self.spec_drafted_tokens else 0.0),
+                "spec_draft_k": (sum(self._slot_k) / len(self._slot_k)
+                                 if self._slot_k else 0.0),
+                "kv_cow_copies": self.kv_cow_copies,
+                "kv_shared_blocks": self.kv_shared_blocks,
+                "kv_defer_admissions": self.kv_defer_admissions,
+            }
+        # Allocator / trie stats live under the prefix lock — taken in a
+        # SEPARATE scope (never nested with the metrics lock) so the two
+        # subsystems can't deadlock against each other.
+        with self._prefix_lock:
+            snap["prefix_evictions"] = cache.evictions if cache else 0
+            snap["prefix_entries"] = len(cache) if cache else 0
+            snap["kv_blocks_total"] = (self._alloc.num_blocks
+                                       if self._alloc else 0)
+            snap["kv_blocks_in_use"] = (self._alloc.blocks_in_use
+                                        if self._alloc else 0)
+            snap["kv_blocks_peak"] = self.kv_blocks_peak
+            snap["kv_block_size"] = (self.kv_block_size
+                                     if self._alloc else 0)
+        return snap

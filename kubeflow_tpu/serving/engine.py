@@ -87,6 +87,29 @@ class EngineConfig:
     # {16, 32, 64, 128}, so a 6-token prompt rides a 16-wide executable
     # instead of paying full-length prefill compute.
     prefill_len_buckets: int = 0
+    # KV-cache layout (continuous mode). "dense": one
+    # [total_len = max_seq_len + max_new_tokens] K/V row reserved per
+    # decode slot — worst-case HBM per admission. "paged": K/V lives in
+    # a pool of kv_block_size-token blocks mapped through per-slot block
+    # tables, so a request only holds blocks for its OWN prompt+budget,
+    # admission is bounded by memory (tokens resident) instead of slots,
+    # and prefix-cache hits share blocks by refcount with zero device
+    # copies. Greedy outputs are byte-identical between layouts.
+    kv_layout: str = "dense"
+    # Tokens per KV block (paged). Must divide max_seq_len +
+    # max_new_tokens. Smaller blocks waste less tail (internal
+    # fragmentation ~ block_size/2 tokens per request) but lengthen the
+    # block table; 16 suits the default shapes.
+    kv_block_size: int = 16
+    # Physical blocks in the paged pool. 0 = dense-parity sizing
+    # (batch_size * total_len / kv_block_size): same worst case as
+    # dense. Set explicitly to cap KV HBM — admission then defers
+    # instead of overcommitting.
+    kv_pool_blocks: int = 0
+    # Default wait (seconds) for StreamHandle.tokens()/result() when the
+    # caller passes none — raise it when memory-deferred admissions
+    # under load would spuriously time callers out.
+    stream_timeout_s: float = 60.0
     # Compute dtype override ("bfloat16"/"float32"); empty keeps the
     # model preset's dtype. The tpu-serving manifest's --dtype arg.
     dtype: str = ""

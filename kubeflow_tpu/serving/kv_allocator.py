@@ -1,0 +1,98 @@
+"""Host-side block allocator for the paged KV cache.
+
+The paged decode layout (models/decode.py:init_paged_state) stores K/V in
+a device pool of fixed-size blocks instead of one dense
+``[slots, total_len]`` row per decode slot; this module is the host half
+that decides *which* physical blocks back each slot's virtual positions.
+It is deliberately dumb and auditable:
+
+- a **free list** of physical block ids (LIFO, so hot blocks are reused
+  while still cache-resident),
+- a **refcount** per block. ``alloc`` hands out blocks at refcount 1;
+  ``share`` bumps a live block (zero-copy prefix reuse: a prefix-cache
+  hit maps the donor's full blocks straight into the new slot's table);
+  ``free`` drops a reference and returns the block to the free list when
+  the last holder lets go.
+
+Every transition is guarded: sharing a free block or freeing a block
+below refcount zero raises instead of silently corrupting the pool — the
+serving invariants ("no block is referenced by two live slots unless
+refcounted-shared", "every block is freed exactly once") are enforced
+here, at the single choke point, rather than re-derived at each call
+site.
+
+Pure host logic — no jax imports — so the allocator is unit-testable
+without a device and safe to mutate under the decoder's prefix lock.
+"""
+
+from __future__ import annotations
+
+
+class BlockAllocator:
+    """Free list + refcounts over ``num_blocks`` physical KV blocks."""
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks <= 0:
+            raise ValueError("BlockAllocator needs at least one block")
+        if block_size <= 0:
+            raise ValueError("block_size must be positive")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        # LIFO free list: ascending ids pop first (determinism helps the
+        # byte-identity tests pin block placement).
+        self._free = list(range(num_blocks - 1, -1, -1))
+        self._refs = [0] * num_blocks
+
+    # -- introspection -------------------------------------------------
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def blocks_in_use(self) -> int:
+        return self.num_blocks - len(self._free)
+
+    def ref_count(self, block: int) -> int:
+        return self._refs[block]
+
+    def blocks_for(self, tokens: int) -> int:
+        """Worst-case block count for ``tokens`` KV positions (>= 1, so a
+        zero-token degenerate request still reserves a write target)."""
+        return max(1, -(-int(tokens) // self.block_size))
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    # -- transitions ---------------------------------------------------
+
+    def alloc(self, n: int) -> list[int]:
+        """Claim ``n`` blocks at refcount 1. Raises ``MemoryError`` when
+        the pool cannot serve the request — callers gate on
+        :meth:`can_alloc` under their lock, so hitting this means a
+        bookkeeping bug, not backpressure."""
+        if n > len(self._free):
+            raise MemoryError(
+                f"requested {n} KV blocks but only {len(self._free)} of "
+                f"{self.num_blocks} are free"
+            )
+        out = [self._free.pop() for _ in range(n)]
+        for b in out:
+            self._refs[b] = 1
+        return out
+
+    def share(self, block: int) -> None:
+        """Add a reference to a LIVE block (zero-copy prefix sharing)."""
+        if self._refs[block] <= 0:
+            raise ValueError(f"sharing free block {block}")
+        self._refs[block] += 1
+
+    def free(self, block: int) -> None:
+        """Drop one reference; the last drop returns the block to the
+        free list. Freeing an already-free block raises — a double free
+        would let two slots scribble over each other's KV."""
+        if self._refs[block] <= 0:
+            raise ValueError(f"double free of block {block}")
+        self._refs[block] -= 1
+        if self._refs[block] == 0:
+            self._free.append(block)

@@ -26,12 +26,15 @@ from dataclasses import dataclass, field
 
 @dataclass(eq=False)  # identity hash: entries live in per-node sets
 class PrefixEntry:
-    """One cached prefix: ``key`` tokens occupy pool row ``slot``."""
+    """One cached prefix: ``key`` tokens occupy pool row ``slot`` (dense
+    layout) or the refcounted pool blocks ``blocks`` (paged layout —
+    ``slot`` is then just a capacity token)."""
 
     key: tuple[int, ...]
     slot: int
     refs: int = 0       # in-flight admissions reading this slot
     last_used: int = 0  # LRU clock tick
+    blocks: tuple[int, ...] | None = None  # paged: KV blocks held
 
     def __len__(self) -> int:
         return len(self.key)
@@ -63,6 +66,11 @@ class PrefixCache:
         self._free = list(range(slots - 1, -1, -1))
         self._clock = 0
         self.evictions = 0
+        # Paged layout: called with the entry on every remove() so its
+        # refcounted pool blocks return to the allocator. Fires under
+        # whatever lock the caller serializes the cache with — the hook
+        # must not re-acquire it.
+        self.on_evict = None
 
     def __len__(self) -> int:
         return len(self._by_key)
@@ -154,11 +162,24 @@ class PrefixCache:
             return None
         return min(candidates, key=lambda e: e.last_used)
 
+    def evict_lru(self) -> bool:
+        """Evict the least-recently-used UNPINNED entry (memory-pressure
+        reclaim, counted as an eviction). Returns False when every entry
+        is pinned or the cache is empty."""
+        victim = self._lru_unpinned()
+        if victim is None:
+            return False
+        self.remove(victim)
+        self.evictions += 1
+        return True
+
     def remove(self, entry: PrefixEntry) -> None:
         """Drop ``entry`` from the trie and return its slot to the free
         list (explicit removal; eviction accounting is reserve()'s)."""
         if self._by_key.pop(entry.key, None) is None:
             return
+        if self.on_evict is not None:
+            self.on_evict(entry)
         node = self._root
         node.entries.discard(entry)
         path = [node]

@@ -100,6 +100,10 @@ class ModelServer:
                     prefill_len_buckets=self.engine.cfg.prefill_len_buckets,
                     speculative_k=self.engine.cfg.speculative_k,
                     draft_mode=self.engine.cfg.draft_mode,
+                    kv_layout=self.engine.cfg.kv_layout,
+                    kv_block_size=self.engine.cfg.kv_block_size,
+                    kv_pool_blocks=self.engine.cfg.kv_pool_blocks,
+                    stream_timeout_s=self.engine.cfg.stream_timeout_s,
                 )
             return self._decoder
 
@@ -265,6 +269,15 @@ class ModelServer:
                                 d["spec_draft_dispatches"],
                             "serving_spec_acceptance_rate":
                                 d["spec_acceptance_rate"],
+                            "serving_kv_blocks_total": d["kv_blocks_total"],
+                            "serving_kv_blocks_in_use":
+                                d["kv_blocks_in_use"],
+                            "serving_kv_cow_copies_total":
+                                d["kv_cow_copies"],
+                            "serving_kv_shared_blocks_total":
+                                d["kv_shared_blocks"],
+                            "serving_kv_defer_admissions_total":
+                                d["kv_defer_admissions"],
                             "serving_in_flight": d["in_flight"],
                             "serving_queued": d["queued"],
                         })

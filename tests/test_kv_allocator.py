@@ -1,0 +1,66 @@
+"""BlockAllocator invariants: the host half of the paged KV cache.
+
+Every serving-level guarantee ("no block referenced by two live slots
+unless refcounted-shared", "every block is freed exactly once") reduces
+to these transitions being sound, so they are pinned directly.
+"""
+
+import pytest
+
+from kubeflow_tpu.serving.kv_allocator import BlockAllocator
+
+
+def test_blocks_for_is_ceil_with_floor_one():
+    a = BlockAllocator(8, block_size=8)
+    assert a.blocks_for(0) == 1
+    assert a.blocks_for(1) == 1
+    assert a.blocks_for(8) == 1
+    assert a.blocks_for(9) == 2
+    assert a.blocks_for(16) == 2
+    assert a.blocks_for(17) == 3
+
+
+def test_alloc_distinct_ids_at_ref_one():
+    a = BlockAllocator(4, block_size=8)
+    got = a.alloc(3)
+    assert len(set(got)) == 3
+    assert all(a.ref_count(b) == 1 for b in got)
+    assert a.free_blocks == 1
+    assert a.blocks_in_use == 3
+
+
+def test_alloc_exhaustion_raises_after_can_alloc_says_no():
+    a = BlockAllocator(2, block_size=8)
+    a.alloc(2)
+    assert not a.can_alloc(1)
+    with pytest.raises(MemoryError):
+        a.alloc(1)
+
+
+def test_share_free_lifecycle():
+    a = BlockAllocator(2, block_size=8)
+    (b,) = a.alloc(1)
+    a.share(b)
+    assert a.ref_count(b) == 2
+    a.free(b)                     # one holder left
+    assert a.blocks_in_use == 1
+    a.free(b)                     # last holder: back on the free list
+    assert a.blocks_in_use == 0
+    assert sorted(a.alloc(2)) == sorted([b, a.num_blocks - 1 - b])
+
+
+def test_double_free_and_share_of_free_block_raise():
+    a = BlockAllocator(2, block_size=8)
+    (b,) = a.alloc(1)
+    a.free(b)
+    with pytest.raises(ValueError, match="double free"):
+        a.free(b)
+    with pytest.raises(ValueError, match="free block"):
+        a.share(b)
+
+
+def test_constructor_validation():
+    with pytest.raises(ValueError):
+        BlockAllocator(0, block_size=8)
+    with pytest.raises(ValueError):
+        BlockAllocator(4, block_size=0)
