@@ -41,8 +41,14 @@ PEAK_BF16 = 197e12
 
 def run_training(model_name: str, batch_size: int, seq_len: int,
                  steps: int, opt_name: str, *, grad_dtype=None,
-                 trace_dir=None, overrides=None) -> dict:
-    """Train ``steps`` steps; returns tok/s-per-chip, MFU and final loss."""
+                 trace_dir=None, overrides=None, accum_steps=1) -> dict:
+    """Train ``steps`` steps; returns tok/s-per-chip, MFU and final loss.
+
+    ``accum_steps > 1`` benchmarks gradient-accumulation microbatching:
+    each optimizer step scans accum_steps microbatches of ``batch_size``
+    rows — effective batch batch_size×accum at the HBM footprint of one
+    microbatch, so configs whose equivalent single batch OOMs become
+    feasible (and their delivered MFU measurable)."""
     from kubeflow_tpu.models.registry import get_model
     from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
     from kubeflow_tpu.train.data import place_batch, synthetic_batch
@@ -56,10 +62,15 @@ def run_training(model_name: str, batch_size: int, seq_len: int,
                           total_steps=steps + 2, grad_dtype=grad_dtype)
     state = init_state(jax.random.PRNGKey(0), model, opt, mesh)
     n_params = sum(x.size for x in jax.tree.leaves(state.params))
-    step_fn = build_train_step(model, opt, mesh)
-    batch = place_batch(
-        synthetic_batch(model, batch_size, seq_len), mesh, model
-    )
+    step_fn = build_train_step(model, opt, mesh, accum_steps=accum_steps)
+    host_batch = synthetic_batch(model, batch_size * accum_steps, seq_len)
+    if accum_steps > 1:
+        host_batch = {
+            k: v.reshape(accum_steps, batch_size, *v.shape[1:])
+            for k, v in host_batch.items()
+        }
+    batch = place_batch(host_batch, mesh, model,
+                        microbatched=accum_steps > 1)
 
     # Warmup/compile.
     state, metrics = step_fn(state, batch)
@@ -77,7 +88,7 @@ def run_training(model_name: str, batch_size: int, seq_len: int,
     if trace_dir:
         jax.profiler.stop_trace()
 
-    tokens_per_sec = steps * batch_size * seq_len / dt
+    tokens_per_sec = steps * batch_size * accum_steps * seq_len / dt
     per_chip = tokens_per_sec / n_devices
     # Standard MFU accounting (PaLM appendix B / MaxText): parameter
     # FLOPs (6N fwd+bwd) PLUS the causal self-attention matmuls —
@@ -100,30 +111,58 @@ def run_training(model_name: str, batch_size: int, seq_len: int,
         "params_m": n_params / 1e6,
         "model_tflops_per_token": flops_per_token / 1e12,
         "final_loss": loss,
-        "config": f"{model_name} bs{batch_size} seq{seq_len} {opt_name} "
-                  f"bf16 x{n_devices}chip",
+        "config": f"{model_name} bs{batch_size}"
+                  + (f"x{accum_steps}accum" if accum_steps > 1 else "")
+                  + f" seq{seq_len} {opt_name} bf16 x{n_devices}chip",
     }
 
 
-def run_training_isolated(*args, **kwargs) -> dict:
-    """``run_training`` in a FRESH subprocess. Configs are sized to the
-    HBM cliff (BASELINE.md): allocator residue from a previous config in
-    the same process measurably thrashes the next (observed 60.5%
-    standalone vs 16.6% after three in-process runs; clear_caches alone
-    did not save the tightest config). One process per config makes each
-    measurement order-independent."""
+def run_input_pipeline(model_name: str, batch_size: int, seq_len: int,
+                       steps: int, *, prefetch: int, accum_steps: int = 1,
+                       opt_name: str = "adamw") -> dict:
+    """Train through the REAL input pipeline (train.loop): a fresh batch
+    is synthesized and placed every step, so this measures what
+    ``run_training``'s single pre-placed batch cannot — input stall.
+    Returns the loop's result dict (samples_per_sec, input_stall_pct,
+    host_wait_ms_per_step, loss...)."""
+    from kubeflow_tpu.train.loop import RunConfig, run
+    from kubeflow_tpu.train.optimizers import OptimizerConfig
+
+    cfg = RunConfig(
+        model=model_name, batch_size=batch_size, seq_len=seq_len,
+        steps=steps, log_every=max(steps, 1),
+        optimizer=OptimizerConfig(name=opt_name, warmup_steps=2,
+                                  total_steps=steps + 2),
+        prefetch=prefetch, accum_steps=accum_steps,
+        graceful_shutdown=False,
+    )
+    result = run(cfg, log=lambda *a, **k: None)
+    import gc
+    gc.collect()
+    jax.clear_caches()
+    return result
+
+
+def run_training_isolated(*args, _fn: str = "run_training",
+                          **kwargs) -> dict:
+    """A bench function (default ``run_training``) in a FRESH subprocess.
+    Configs are sized to the HBM cliff (BASELINE.md): allocator residue
+    from a previous config in the same process measurably thrashes the
+    next (observed 60.5% standalone vs 16.6% after three in-process runs;
+    clear_caches alone did not save the tightest config). One process per
+    config makes each measurement order-independent."""
     import pickle
     import subprocess
     import sys
     import tempfile
 
     with tempfile.NamedTemporaryFile(suffix=".pkl") as out:
-        payload = pickle.dumps((args, kwargs, out.name))
+        payload = pickle.dumps((_fn, args, kwargs, out.name))
         code = (
             "import pickle, sys\n"
-            "args, kwargs, out = pickle.loads(sys.stdin.buffer.read())\n"
-            "from bench import run_training\n"
-            "result = run_training(*args, **kwargs)\n"
+            "fn, args, kwargs, out = pickle.loads(sys.stdin.buffer.read())\n"
+            "import bench\n"
+            "result = getattr(bench, fn)(*args, **kwargs)\n"
             "pickle.dump(result, open(out, 'wb'))\n"
         )
         proc = subprocess.run(
@@ -178,6 +217,8 @@ def main() -> int:
                         help="flagship only (fast iteration)")
     parser.add_argument("--skip-serving", action="store_true",
                         help="training configs only (fast iteration)")
+    parser.add_argument("--skip-pipeline", action="store_true",
+                        help="skip the input-pipeline stall comparison")
     parser.add_argument("--serving-requests", type=int, default=40)
     parser.add_argument("--trace-dir", default=None,
                         help="capture a jax.profiler trace of the timed steps")
@@ -187,15 +228,24 @@ def main() -> int:
     if args.quick or not on_tpu:
         flagship = run_training("lm-test-tiny", 8, 128, args.steps, "adamw",
                                 trace_dir=args.trace_dir)
-        deep = deep512 = None
+        deep = deep512 = accum = None
     else:
         # adafactor: factored slots buy model width (= MFU). Each config
         # runs in its own process (see run_training_isolated).
         flagship = run_training_isolated("flagship-1b", 4, 2048,
                                          args.steps, "adafactor",
                                          trace_dir=args.trace_dir)
-        deep = deep512 = deep1024 = deep2048 = None
+        deep = deep512 = deep1024 = deep2048 = accum = None
         if not args.skip_deep:
+            # Gradient accumulation at the flagship shape: effective
+            # batch 32×seq2048 on a config whose equivalent SINGLE batch
+            # does not fit v5e HBM (the standard flagship config already
+            # sits at the bs4 memory cliff, BASELINE.md) — accumulation
+            # is the only way to that effective batch at fixed slot
+            # memory.
+            accum = run_training_isolated("flagship-1b", 4, 2048,
+                                          args.steps, "adafactor",
+                                          accum_steps=8)
             # Deep steps are ~4× faster than flagship steps; run more so
             # per-step dispatch noise amortizes out of the measurement.
             deep_steps = max(args.steps, 30)
@@ -255,6 +305,49 @@ def main() -> int:
             "deep_mfu_seq1024_pct": round(deep1024["mfu"] * 100, 2),
             "deep_mfu_seq2048_pct": round(deep2048["mfu"] * 100, 2),
         })
+    if accum is not None:
+        out.update({
+            "accum_mfu_pct": round(accum["mfu"] * 100, 2),
+            "accum_tokens_per_sec_per_chip": round(
+                accum["tokens_per_sec_per_chip"], 1),
+            "accum_config": accum["config"],
+        })
+
+    # Input-pipeline overlap gate: train through the REAL input path
+    # (fresh batch synthesized + placed every step) with prefetch off and
+    # on. Prefetch may only hide stall, never change data — batch order
+    # is byte-identical by construction, so a final-loss mismatch sets
+    # the regression marker the CI smoke fails on.
+    if not args.skip_pipeline:
+        pipe_steps = max(args.steps, 6)
+        if args.quick or not on_tpu:
+            pipe_off = run_input_pipeline("lm-test-tiny", 8, 128,
+                                          pipe_steps, prefetch=0)
+            pipe_on = run_input_pipeline("lm-test-tiny", 8, 128,
+                                         pipe_steps, prefetch=2)
+        else:
+            pipe_off = run_training_isolated(
+                "flagship-deep", 32, 256, pipe_steps,
+                _fn="run_input_pipeline", prefetch=0,
+                opt_name="adafactor")
+            pipe_on = run_training_isolated(
+                "flagship-deep", 32, 256, pipe_steps,
+                _fn="run_input_pipeline", prefetch=2,
+                opt_name="adafactor")
+        out.update({
+            "train_input_stall_pct": pipe_on["input_stall_pct"],
+            "train_input_stall_off_pct": pipe_off["input_stall_pct"],
+            "train_pipeline_samples_per_sec": round(
+                pipe_on["samples_per_sec"], 1),
+            "train_pipeline_speedup": round(
+                pipe_on["samples_per_sec"]
+                / max(pipe_off["samples_per_sec"], 1e-9), 3),
+        })
+        if abs(pipe_on["loss"] - pipe_off["loss"]) > (
+                1e-6 * max(1.0, abs(pipe_off["loss"]))):
+            out["regression"] = (
+                f"prefetch changed final loss: on={pipe_on['loss']} "
+                f"off={pipe_off['loss']}")
 
     # Serving numbers ride the same driver-facing line (VERDICT r4 weak
     # #1: a claim the gate can't see is a claim the next round can

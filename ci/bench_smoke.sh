@@ -34,3 +34,18 @@ check_json "$out"
 out="$(JAX_PLATFORMS=cpu python bench_serving.py --quick --concurrency-sweep)"
 check_json "$out"
 echo "bench smoke ok"
+# Training input pipeline: prefetch-on must match prefetch-off final
+# loss byte-for-byte (bench.py sets the regression marker otherwise)
+# and the stall accounting must ride the driver-facing line.
+out="$(JAX_PLATFORMS=cpu python bench.py --quick --steps 6)"
+check_json "$out"
+printf '%s\n' "$out" | python -c '
+import json, sys
+rec = json.loads([ln for ln in sys.stdin.read().splitlines()
+                  if ln.strip()][-1])
+for key in ("train_input_stall_pct", "train_input_stall_off_pct",
+            "train_pipeline_speedup"):
+    if key not in rec:
+        sys.exit(f"bench output missing {key}: {rec}")
+'
+echo "train pipeline smoke ok"

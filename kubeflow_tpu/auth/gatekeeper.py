@@ -104,12 +104,17 @@ class AuthService:
 
     def check_login(self, username: str, password: str) -> bool:
         got = hashlib.sha256(password.encode()).hexdigest()
-        return (hmac.compare_digest(username, self.username)
+        # Compare utf-8 encoded bytes: compare_digest raises TypeError on
+        # non-ASCII str operands, so a unicode username must 401, not
+        # crash the handler thread.
+        return (hmac.compare_digest(username.encode(),
+                                    self.username.encode())
                 and hmac.compare_digest(got, self.password_hash))
 
     def check_service_account(self, name: str, key: str) -> bool:
         want = self.service_accounts.get(name)
-        return bool(want) and bool(key) and hmac.compare_digest(key, want)
+        return (bool(want) and bool(key)
+                and hmac.compare_digest(key.encode(), want.encode()))
 
     def issue_cookie(self, now: float | None = None) -> str:
         expires = int((now or time.time()) + self.session_seconds)

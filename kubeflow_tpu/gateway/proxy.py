@@ -166,8 +166,17 @@ def make_proxy_handler(gw):
                         is_retry=False):
             # On a retry the request body stream is already consumed —
             # only bodyless idempotent methods reach here retrying.
-            length = (0 if is_retry
-                      else int(self.headers.get("Content-Length", 0)))
+            try:
+                length = (0 if is_retry
+                          else int(self.headers.get("Content-Length", 0)))
+            except ValueError:
+                # Malformed client header: answer 400 instead of dying
+                # with an uncaught traceback and a dropped connection.
+                gw.errors_total += 1
+                self._respond(400, json.dumps(
+                    {"error": "malformed Content-Length"}).encode())
+                self.close_connection = True  # unread body would desync
+                return
             body = self.rfile.read(length) if length else None
             # Forwarded prefix and authenticated identity are
             # gateway-asserted — client-supplied copies must never
@@ -299,23 +308,35 @@ def make_proxy_handler(gw):
 
         def _relay_response(self, resp, extra_headers=None):
             try:
+                # Parse the upstream length BEFORE the status line goes
+                # out: a malformed upstream Content-Length must become a
+                # clean 502, which is impossible once bytes are written.
+                upstream_len = resp.getheader("Content-Length")
+                if upstream_len is not None:
+                    try:
+                        upstream_len = int(upstream_len)
+                    except ValueError:
+                        gw.errors_total += 1
+                        self._respond(502, json.dumps(
+                            {"error": "malformed upstream Content-Length"}
+                        ).encode())
+                        return
                 self.send_response(resp.status)
                 for k, v in resp.getheaders():
                     if k.lower() not in _HOP_HEADERS:
                         self.send_header(k, v)
                 for k, v in (extra_headers or {}).items():
                     self.send_header(k, v)
-                upstream_len = resp.getheader("Content-Length")
                 bodyless = (self.command == "HEAD"
                             or resp.status in (204, 304)
                             or 100 <= resp.status < 200)
                 if bodyless or upstream_len is not None:
                     if upstream_len is not None:
-                        self.send_header("Content-Length", upstream_len)
+                        self.send_header("Content-Length",
+                                         str(upstream_len))
                     self.end_headers()
                     if not bodyless:
-                        self._relay_known_length(resp,
-                                                 int(upstream_len))
+                        self._relay_known_length(resp, upstream_len)
                 else:
                     self._relay_stream(resp)
                 self.wfile.flush()

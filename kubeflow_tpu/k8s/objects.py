@@ -361,12 +361,18 @@ def service_account(name: str, namespace: str, labels: Mapping[str, str] | None 
     }
 
 
-def policy_rule(api_groups: Sequence[str], resources: Sequence[str], verbs: Sequence[str]) -> dict:
-    return {
+def policy_rule(api_groups: Sequence[str], resources: Sequence[str], verbs: Sequence[str],
+                resource_names: Sequence[str] | None = None) -> dict:
+    rule = {
         "apiGroups": list(api_groups),
         "resources": list(resources),
         "verbs": list(verbs),
     }
+    if resource_names:
+        # Pin get/update grants to named objects — RBAC least privilege
+        # for controllers that only ever touch their own config objects.
+        rule["resourceNames"] = list(resource_names)
+    return rule
 
 
 def cluster_role(name: str, rules: Sequence[dict], labels: Mapping[str, str] | None = None) -> dict:

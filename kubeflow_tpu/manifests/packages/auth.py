@@ -105,15 +105,26 @@ def admission_webhook(namespace: str, image: str, ca_bundle: str) -> list[dict]:
     args = ["--port=8443"]
     rbac: list[dict] = []
     if self_sign:
+        from kubeflow_tpu.apis.jobs import API_GROUP, PLURALS
+
         args += ["--self-sign", "--patch-ca", f"--namespace={namespace}"]
+        # Pinned with resourceNames to exactly what patch_ca_bundles
+        # touches — this webhook's own config and the job CRDs' conversion
+        # stanzas. Unpinned update on all webhooks/CRDs would let a
+        # compromised pod rewrite any admission clientConfig cluster-wide
+        # (cluster-admin-adjacent).
         rbac = [
             k8s.cluster_role(name, [
                 k8s.policy_rule(["admissionregistration.k8s.io"],
                                 ["mutatingwebhookconfigurations"],
-                                ["get", "update"]),
+                                ["get", "update"],
+                                resource_names=[name]),
                 k8s.policy_rule(["apiextensions.k8s.io"],
                                 ["customresourcedefinitions"],
-                                ["get", "update"]),
+                                ["get", "update"],
+                                resource_names=sorted(
+                                    f"{plural}.{API_GROUP}"
+                                    for plural in PLURALS.values())),
             ], labels),
             k8s.cluster_role_binding(name, name, name, namespace),
         ]

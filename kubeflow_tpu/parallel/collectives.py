@@ -20,6 +20,30 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 
+def shard_map(fn, *, mesh, in_specs, out_specs, check_vma: bool = False,
+              axis_names=None):
+    """Version-portable ``jax.shard_map``: newer jax exposes it at the top
+    level with ``check_vma``/``axis_names``; older releases spell it
+    ``jax.experimental.shard_map.shard_map`` with ``check_rep`` and the
+    COMPLEMENT set ``auto`` (axes left automatic rather than axes made
+    manual). Every shard_map in this repo goes through here so kernels
+    run on both."""
+    if hasattr(jax, "shard_map"):
+        kwargs = {} if axis_names is None else {"axis_names": axis_names}
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma,
+                             **kwargs)
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    kwargs = {}
+    if axis_names is not None:
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        if auto:
+            kwargs["auto"] = auto
+    return _legacy(fn, mesh=mesh, in_specs=in_specs,
+                   out_specs=out_specs, check_rep=check_vma, **kwargs)
+
+
 def psum(x, axis: str | Sequence[str]):
     return lax.psum(x, axis_name=axis)
 
@@ -40,13 +64,21 @@ def ring_permute(x, axis: str, *, shift: int = 1):
     """Send x to the neighbor ``shift`` steps around the ring; receive from
     the opposite neighbor. The building block of ring attention and of
     bidirectional-bandwidth allreduce on a torus."""
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis_name=axis, perm=perm)
 
 
 def axis_index(axis: str):
     return lax.axis_index(axis)
+
+
+def axis_size(axis: str) -> int:
+    """Static mesh-axis size inside a shard_map region, version-portable:
+    newer jax has lax.axis_size; older releases constant-fold psum(1)."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis)
+    return lax.psum(1, axis)
 
 
 def shard_map_over(mesh: Mesh, in_specs, out_specs, *, check_vma: bool = False):
@@ -57,7 +89,7 @@ def shard_map_over(mesh: Mesh, in_specs, out_specs, *, check_vma: bool = False):
     """
 
     def wrap(fn):
-        return jax.shard_map(
+        return shard_map(
             fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma
         )
 
@@ -73,7 +105,7 @@ def allreduce_mean(mesh: Mesh, axis: str):
 
     @jax.jit
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=P(axis),
         out_specs=P(axis),

@@ -12,6 +12,7 @@ ICI (intra-slice) and DCN (multi-slice) topologies.
 
 from __future__ import annotations
 
+import itertools
 import os
 from dataclasses import dataclass
 
@@ -101,6 +102,45 @@ def initialize_from_env(environ=None) -> ProcessInfo:
     return info
 
 
+_gang_seq = itertools.count()
+
+
+def global_any(flag: bool, *, timeout_ms: int = 60_000) -> bool:
+    """All-reduce a per-process boolean over the gang's coordination
+    service: True everywhere iff ANY process passed True. The per-step
+    agreement that makes graceful preemption collective-safe — kubelet
+    evictions deliver SIGTERM per pod at different steps, but orbax's
+    save is a barrier across the gang, so every process must break (and
+    checkpoint) at the SAME step.
+
+    Rides the jax.distributed KV store + barrier rather than a device
+    collective: no XLA dispatch enters the step pipeline, and it works
+    on every backend (the CPU fake gang included). Every process must
+    call this at the same loop point and the same number of times — the
+    call counter doubles as the agreement round id. Single-process is a
+    local no-op."""
+    if jax.process_count() <= 1:
+        return bool(flag)
+    from jax._src import distributed as _distributed
+
+    client = _distributed.global_state.client
+    seq = next(_gang_seq)
+    prefix = f"ktpu/stop/{seq}/"
+    client.key_value_set(f"{prefix}{jax.process_index()}",
+                         "1" if flag else "0")
+    # Without the barrier a fast process could read before a slow one
+    # writes and the gang would disagree; with it, the timeout (not a
+    # deadlock) is the failure mode when a peer died uncleanly.
+    client.wait_at_barrier(f"ktpu/stop-barrier/{seq}", timeout_ms)
+    votes = client.key_value_dir_get(prefix)
+    if seq > 0:
+        try:  # best-effort GC of the previous round's keys
+            client.key_value_delete(f"ktpu/stop/{seq - 1}/")
+        except Exception:
+            pass
+    return any(vote == "1" for _, vote in votes)
+
+
 def barrier(name: str = "barrier") -> None:
     """Block until every process reaches this point (checkpoint/teardown
     ordering — the role the openmpi sidecar's file signals play at
@@ -112,5 +152,9 @@ def barrier(name: str = "barrier") -> None:
 
 
 def shutdown() -> None:
-    if jax.distributed.is_initialized():
+    # jax.distributed.is_initialized is missing on some jax versions;
+    # the client handle is the portable initialized-ness signal.
+    from jax._src import distributed as _distributed
+
+    if _distributed.global_state.client is not None:
         jax.distributed.shutdown()

@@ -27,12 +27,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from kubeflow_tpu.parallel.collectives import shard_map as _shard_map
 from kubeflow_tpu.parallel.mesh import AXIS_PIPELINE
-
-try:  # jax >= 0.6 exposes shard_map at top level
-    from jax import shard_map as _shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
 
 
 def pipeline_apply(layer_fn, stage_params, x, mesh, *, n_micro: int):

@@ -21,6 +21,10 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from kubeflow_tpu.parallel.collectives import (
+    axis_size as collectives_axis_size,
+    shard_map,
+)
 from kubeflow_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_SEQUENCE
 
 _NEG_INF = -1e30
@@ -54,7 +58,7 @@ def _causal_bias(q_start, k_start, tq, tk):
 
 def _ring_attention_sharded(q, k, v, *, causal: bool, axis: str):
     """Per-device body under shard_map. q,k,v: [B, H, T_local, D]."""
-    n = lax.axis_size(axis)
+    n = collectives_axis_size(axis)
     idx = lax.axis_index(axis)
     b, h, t_local, d = q.shape
     scale = 1.0 / (d**0.5)
@@ -104,7 +108,7 @@ def ring_attention(
     """
     spec = P(tuple(batch_axes) or None, None, axis, None)
     body = functools.partial(_ring_attention_sharded, causal=causal, axis=axis)
-    return jax.shard_map(
+    return shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )(q, k, v)

@@ -10,9 +10,12 @@ workload (SURVEY.md §5.4). Here it's part of the framework:
 - :mod:`~kubeflow_tpu.train.checkpoint` — orbax save/restore (restart-from-
   checkpoint, which the reference lacks entirely).
 - :mod:`~kubeflow_tpu.train.data` — synthetic + host-sharded batch pipelines.
+- :mod:`~kubeflow_tpu.train.prefetch` — overlapped input pipeline (background
+  producer placing batch N+k while step N runs).
 - :mod:`~kubeflow_tpu.train.loop` — the worker entrypoint JaxJob pods run.
 """
 
+from kubeflow_tpu.train.prefetch import Prefetcher
 from kubeflow_tpu.train.trainer import TrainState, build_train_step, init_state
 
-__all__ = ["TrainState", "build_train_step", "init_state"]
+__all__ = ["Prefetcher", "TrainState", "build_train_step", "init_state"]

@@ -57,18 +57,36 @@ def synthetic_stream(model: ModelSpec, batch_size: int, seq_len: int = 512,
         step += 1
 
 
-def place_batch(batch: dict, mesh: Mesh, model: ModelSpec) -> dict:
+def place_batch(batch: dict, mesh: Mesh, model: ModelSpec, *,
+                microbatched: bool = False) -> dict:
     """Place a (per-process) host batch onto the mesh with the model's batch
     sharding. Single-process: device_put; multi-host: assemble the global
-    array from each process's local shard."""
+    array from each process's local shard. ``microbatched`` marks leaves
+    carrying a leading [accum_steps, ...] axis (stack_microbatches): the
+    scan axis stays replicated and the batch spec shifts one dim right."""
     spec = model.batch_partition_spec(model.config)
+    lead = (None,) if microbatched else ()
 
     def place(x):
         x = np.asarray(x)
-        ndim_spec = tuple(spec)[: x.ndim] + (None,) * max(0, x.ndim - len(spec))
+        ndim_spec = lead + tuple(spec)[: x.ndim - len(lead)]
+        ndim_spec += (None,) * max(0, x.ndim - len(ndim_spec))
         sharding = NamedSharding(mesh, jax.sharding.PartitionSpec(*ndim_spec))
         if jax.process_count() == 1:
             return jax.device_put(x, sharding)
         return jax.make_array_from_process_local_data(sharding, x)
 
     return jax.tree.map(place, batch)
+
+
+def stack_microbatches(stream: Iterator[dict],
+                       accum_steps: int) -> Iterator[dict]:
+    """[accum_steps, batch, ...] stacked host batches — the unit the
+    gradient-accumulation train step scans (trainer.build_train_step
+    ``accum_steps``). Consumes ``accum_steps`` stream entries per yield,
+    in order, so the stream stays stateless in (seed, microbatch-step)."""
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    while True:
+        micro = [next(stream) for _ in range(accum_steps)]
+        yield jax.tree.map(lambda *xs: np.stack(xs), *micro)

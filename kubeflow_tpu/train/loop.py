@@ -17,11 +17,16 @@ from dataclasses import dataclass, field
 import jax
 
 from kubeflow_tpu.models.registry import get_model
-from kubeflow_tpu.parallel.distributed import initialize_from_env
+from kubeflow_tpu.parallel.distributed import global_any, initialize_from_env
 from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
 from kubeflow_tpu.train import checkpoint as ckpt_lib
-from kubeflow_tpu.train.data import place_batch, synthetic_stream
+from kubeflow_tpu.train.data import (
+    place_batch,
+    stack_microbatches,
+    synthetic_stream,
+)
 from kubeflow_tpu.train.optimizers import OptimizerConfig
+from kubeflow_tpu.train.prefetch import Prefetcher
 from kubeflow_tpu.train.trainer import (
     build_train_step,
     init_state,
@@ -39,6 +44,16 @@ class RunConfig:
     seq_len: int = 128
     steps: int = 100
     log_every: int = 10
+    # Input-pipeline overlap (train.prefetch): a producer thread
+    # synthesizes/reads and places batch N+k while step N runs; `prefetch`
+    # is the queue depth (0 = fully synchronous). Batch order is
+    # byte-identical either way, so resume stays data-exact.
+    prefetch: int = 2
+    # Gradient accumulation (trainer.build_train_step): each optimizer
+    # step scans `accum_steps` microbatches of `batch_size` rows —
+    # effective batch batch_size×accum_steps at fixed HBM. The data
+    # stream advances accum_steps microbatches per step.
+    accum_steps: int = 1
     # KTPU token-corpus file (train.tokenstore); empty = synthetic data.
     data_path: str | None = None
     checkpoint_dir: str | None = None
@@ -121,15 +136,22 @@ def run(cfg: RunConfig, *, log=print) -> dict:
 def _train(cfg, info, model, mesh, opt_cfg, state, start_step, ckpt,
            stop_requested, log):
 
-    step_fn = build_train_step(model, opt_cfg, mesh)
+    step_fn = build_train_step(model, opt_cfg, mesh,
+                               accum_steps=cfg.accum_steps)
+    # The stream position counts MICROBATCHES: an accumulating run
+    # resumed at optimizer step N replays from microbatch N×accum_steps —
+    # data-exact resume stays stateless in (seed, step).
+    stream_step = start_step * cfg.accum_steps
+    store = None
     if cfg.data_path:
         from kubeflow_tpu.train.tokenstore import TokenStore
 
         # Stateless in (seed, step): restarting at start_step replays the
         # exact stream position — checkpoint resume is data-exact.
-        stream = TokenStore(cfg.data_path).stream(
+        store = TokenStore(cfg.data_path)
+        stream = store.stream(
             cfg.batch_size, cfg.seq_len, seed=cfg.seed,
-            start_step=start_step, shard=info.process_id,
+            start_step=stream_step, shard=info.process_id,
             num_shards=info.num_processes,
         )
         if getattr(model.config, "context_parallel", False):
@@ -142,49 +164,110 @@ def _train(cfg, info, model, mesh, opt_cfg, state, start_step, ckpt,
             )
     else:
         stream = synthetic_stream(model, cfg.batch_size, cfg.seq_len,
-                                  seed=cfg.seed, start_step=start_step)
+                                  seed=cfg.seed, start_step=stream_step)
+    if cfg.accum_steps > 1:
+        stream = stack_microbatches(stream, cfg.accum_steps)
+
+    def place(b):
+        return place_batch(b, mesh, model,
+                           microbatched=cfg.accum_steps > 1)
+
+    prefetcher = None
+    if cfg.prefetch > 0:
+        # Each process prefetches only its own shard (the stream above is
+        # already per-process); placement is collective-free, so the
+        # producer thread is multi-host safe.
+        prefetcher = Prefetcher(stream, place, depth=cfg.prefetch)
+        batches = prefetcher
+    else:
+        batches = (place(b) for b in stream)
+
+    # SIGTERM lands per pod at different steps, but checkpoint save is a
+    # collective — under a gang the local flag is all-reduced each step
+    # so every process breaks (and saves) at the SAME step.
+    gang = cfg.graceful_shutdown and info.num_processes > 1
 
     metrics = {}
-    t_last = time.perf_counter()
+    t_start = time.perf_counter()
+    t_last = t_start
+    samples_per_step = cfg.batch_size * cfg.accum_steps
     samples_since = 0
     throughput = 0.0
+    host_wait_total = 0.0
+    host_wait_since = 0.0
+    step_time_ema = None
+    steps_done = 0
     profiling = False
     preempted_at = None
-    for step in range(start_step, cfg.steps):
-        if cfg.profile_dir and info.process_id == 0:
-            if step - start_step == cfg.profile_start_step:
-                jax.profiler.start_trace(cfg.profile_dir)
-                profiling = True
-            elif (profiling and
-                  step - start_step ==
-                  cfg.profile_start_step + cfg.profile_steps):
-                jax.profiler.stop_trace()
-                profiling = False
-                log(f"profiler trace written to {cfg.profile_dir}")
-        batch = place_batch(next(stream), mesh, model)
-        state, metrics = step_fn(state, batch)
-        samples_since += cfg.batch_size
-        if (step + 1) % cfg.log_every == 0 or step + 1 == cfg.steps:
-            loss = float(metrics["loss"])  # sync point
-            now = time.perf_counter()
-            throughput = samples_since / (now - t_last)
-            t_last, samples_since = now, 0
-            log(
-                f"step={step + 1} loss={loss:.4f} "
-                f"samples/sec={throughput:.1f}"
-            )
-        if stop_requested:
-            # Eviction: save the just-completed step SYNCHRONOUSLY (the
-            # grace window is for exactly this) so resume continues from
-            # here, not from the last periodic checkpoint.
-            preempted_at = step + 1
-            if ckpt is not None:
-                ckpt.save(preempted_at, state, force=True)
-                ckpt.wait()
-                log(f"preempted: checkpoint saved at step {preempted_at}")
-            break
-        if ckpt is not None and (step + 1) % cfg.checkpoint_every == 0:
-            ckpt.save(step + 1, state)  # async: training continues
+    try:
+        for step in range(start_step, cfg.steps):
+            t_step = time.perf_counter()
+            if cfg.profile_dir and info.process_id == 0:
+                if step - start_step == cfg.profile_start_step:
+                    jax.profiler.start_trace(cfg.profile_dir)
+                    profiling = True
+                elif (profiling and
+                      step - start_step ==
+                      cfg.profile_start_step + cfg.profile_steps):
+                    jax.profiler.stop_trace()
+                    profiling = False
+                    log(f"profiler trace written to {cfg.profile_dir}")
+            # Host wait: time this step spent blocked on input (queue
+            # wait under prefetch; synthesis + placement when
+            # synchronous) — the stall the overlap exists to hide.
+            t_fetch = time.perf_counter()
+            batch = next(batches)
+            host_wait = time.perf_counter() - t_fetch
+            host_wait_total += host_wait
+            host_wait_since += host_wait
+            state, metrics = step_fn(state, batch)
+            steps_done += 1
+            samples_since += samples_per_step
+            step_time = time.perf_counter() - t_step
+            step_time_ema = (step_time if step_time_ema is None
+                             else 0.9 * step_time_ema + 0.1 * step_time)
+            if (step + 1) % cfg.log_every == 0 or step + 1 == cfg.steps:
+                loss = float(metrics["loss"])  # sync point
+                now = time.perf_counter()
+                window = now - t_last
+                throughput = samples_since / window
+                stall_pct = 100.0 * host_wait_since / max(window, 1e-9)
+                depth = (f" qdepth={prefetcher.qsize()}"
+                         if prefetcher is not None else "")
+                t_last, samples_since, host_wait_since = now, 0, 0.0
+                log(
+                    f"step={step + 1} loss={loss:.4f} "
+                    f"samples/sec={throughput:.1f} "
+                    f"input_stall={stall_pct:.1f}%"
+                    f"{depth}"
+                )
+            stop_now = bool(stop_requested)
+            if gang:
+                stop_now = global_any(stop_now)
+            if stop_now:
+                # Eviction: save the just-completed step SYNCHRONOUSLY
+                # (the grace window is for exactly this) so resume
+                # continues from here, not from the last periodic
+                # checkpoint. Under a gang, stop_now is the all-reduced
+                # flag, so the save below is entered by every process at
+                # the same step.
+                preempted_at = step + 1
+                if ckpt is not None:
+                    ckpt.save(preempted_at, state, force=True)
+                    ckpt.wait()
+                    log(f"preempted: checkpoint saved at step "
+                        f"{preempted_at}")
+                break
+            if ckpt is not None and (step + 1) % cfg.checkpoint_every == 0:
+                ckpt.save(step + 1, state)  # async: training continues
+    finally:
+        # Loop exit, preemption, or an exception anywhere above: the
+        # producer thread must never outlive the loop.
+        if prefetcher is not None:
+            prefetcher.close()
+        if store is not None:
+            store.close()
+    total_time = time.perf_counter() - t_start
     if profiling:  # short runs: close the trace instead of dropping it
         jax.profiler.stop_trace()
         log(f"profiler trace written to {cfg.profile_dir}")
@@ -200,6 +283,17 @@ def _train(cfg, info, model, mesh, opt_cfg, state, start_step, ckpt,
         "samples_per_sec": throughput,
         "process_id": info.process_id,
         "preempted": preempted_at is not None,
+        # Input-stall accounting: fraction of wall time the loop sat
+        # blocked on input, mean per-step host wait, and the step-time
+        # EMA — the numbers that make the overlap win gated, not
+        # asserted (bench.py train_input_stall_pct).
+        "input_stall_pct": round(
+            100.0 * host_wait_total / max(total_time, 1e-9), 2),
+        "host_wait_ms_per_step": round(
+            1e3 * host_wait_total / max(steps_done, 1), 3),
+        "step_time_ema_ms": round(1e3 * (step_time_ema or 0.0), 3),
+        "prefetch_depth": cfg.prefetch,
+        "accum_steps": cfg.accum_steps,
     }
     if info.process_id == 0 and preempted_at is None:
         publish_metrics(result, log=log)

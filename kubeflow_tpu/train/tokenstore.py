@@ -142,9 +142,17 @@ class TokenStore:
         return self._handle is not None
 
     def close(self) -> None:
+        """Idempotent; the train loop closes the store when the input
+        pipeline shuts down (prefetcher exit, preemption, exception)."""
         if self._handle is not None:
             self._lib.ts_close(self._handle)
             self._handle = None
+
+    def __enter__(self) -> "TokenStore":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     # ------------------------------------------------------------------
 
@@ -195,7 +203,11 @@ class TokenStore:
                start_step: int = 0, shard: int = 0,
                num_shards: int = 1) -> Iterator[dict]:
         """Training batches {"tokens": [batch, seq_len+1]}; each process
-        perturbs the seed by its shard id so shards draw disjoint streams."""
+        perturbs the seed by its shard id so shards draw disjoint streams.
+
+        Reads are stateless over a read-only mmap (native and numpy
+        backends alike), so the iterator is safe to drive from the
+        prefetcher's producer thread while the main thread steps."""
         step = start_step
         shard_seed = seed ^ _splitmix64(shard * 0x1000 + num_shards)
         while True:

@@ -496,3 +496,105 @@ def test_preemption_resume_e2e_continues_loss_trajectory(api, tmp_path):
         compared += 1
     assert compared >= 50  # a real trajectory, not a fragment
     assert resumed.get(250) == pytest.approx(control[250], abs=2e-4)
+
+
+def test_global_any_agrees_across_staggered_gang():
+    """The stop-flag agreement primitive (ADVICE r5 #2), isolated: two
+    real processes join the rendezvous and run the same global_any
+    sequence; one raises its local flag at round 3, the other at round
+    6. BOTH must observe the first True at round 3 — the earliest
+    signal wins everywhere, which is what lets the train loop break at
+    one common step. Coordination-service based, so this runs on the
+    plain CPU fake gang (no cross-process XLA needed)."""
+    port = free_port()
+    prog = (
+        "import os\n"
+        "from kubeflow_tpu.parallel.distributed import ("
+        "global_any, initialize_from_env, shutdown)\n"
+        "initialize_from_env()\n"
+        "flag_at = int(os.environ['FLAG_AT'])\n"
+        "first_true = -1\n"
+        "for round_id in range(8):\n"
+        "    agreed = global_any(round_id >= flag_at)\n"
+        "    if agreed and first_true < 0:\n"
+        "        first_true = round_id\n"
+        "print('FIRST_TRUE=' + str(first_true))\n"
+        "shutdown()\n"
+    )
+    procs = []
+    for pid, flag_at in ((0, 3), (1, 6)):
+        env = worker_env(port, 2, pid, devices=1)
+        env["FLAG_AT"] = str(flag_at)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", prog], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            cwd=REPO,
+        ))
+    outs = [p.communicate(timeout=120)[0] for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out
+        assert "FIRST_TRUE=3" in out, out
+
+
+@pytest.mark.slow
+def test_gang_preemption_checkpoints_common_step(tmp_path):
+    """ADVICE r5 #2: kubelet evictions deliver SIGTERM per pod at
+    different times, but orbax's save is a collective — the loop
+    all-reduces the stop flag every step, so BOTH gang members break at
+    the SAME step and the grace-window checkpoint commits at one common
+    step instead of deadlocking the save barrier until SIGKILL. The
+    stagger below lands the second SIGTERM well after the first; the
+    all-reduce (not the signal) is what stops process 1."""
+    import signal
+    import time as time_mod
+
+    from kubeflow_tpu.train import checkpoint as ckpt_lib
+
+    port = free_port()
+    ck = str(tmp_path / "ck")
+    cfg = {"model": "lm-test-tiny", "batch_size": 4, "seq_len": 16,
+           "steps": 20000, "log_every": 1, "checkpoint_dir": ck,
+           "checkpoint_every": 1000000, "checkpoint_async": False,
+           "mesh": {"data": 4}, "prefetch": 2, "seed": 3}
+    envs = []
+    for pid in range(2):
+        env = worker_env(port, 2, pid, devices=2)
+        env["PYTHONUNBUFFERED"] = "1"  # prompt step lines for the trigger
+        envs.append(env)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "kubeflow_tpu.train.loop",
+             json.dumps(cfg)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, cwd=REPO,
+        )
+        for env in envs
+    ]
+    try:
+        # Wait for real training progress on worker 0, then stagger.
+        deadline = time_mod.monotonic() + 240
+        lines0 = []
+        for line in procs[0].stdout:
+            lines0.append(line)
+            if line.startswith("step=3 "):
+                break
+            assert time_mod.monotonic() < deadline, "".join(lines0)
+        procs[0].send_signal(signal.SIGTERM)
+        time_mod.sleep(0.3)
+        procs[1].send_signal(signal.SIGTERM)
+        out0 = "".join(lines0) + procs[0].communicate(timeout=180)[0]
+        out1 = procs[1].communicate(timeout=180)[0]
+    finally:
+        for p in procs:
+            p.kill()
+    assert procs[0].returncode == 0, out0
+    assert procs[1].returncode == 0, out1
+    saved = []
+    for out in (out0, out1):
+        assert "preempted: checkpoint saved at step" in out, out
+        saved.append(int(
+            out.split("preempted: checkpoint saved at step")[1].split()[0]))
+    # One COMMON step across the gang — the collective save completed.
+    assert saved[0] == saved[1], (saved, out0[-2000:], out1[-2000:])
+    assert saved[0] >= 3
+    assert ckpt_lib.latest_step(ck) == saved[0]

@@ -917,3 +917,122 @@ def test_outlier_route_flags_injected_anomalies(api):
     finally:
         gw.stop()
         backend.close()
+
+
+def test_malformed_client_content_length_is_400():
+    """ADVICE r5 #4: `int()` on a malformed client Content-Length used
+    to kill the handler thread — no response, dropped connection. The
+    gateway must answer 400 and keep serving."""
+    import socket
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from kubeflow_tpu.gateway import Route
+
+    class Echo(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            body = b'{"ok": true}'
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    backend = ThreadingHTTPServer(("127.0.0.1", 0), Echo)
+    threading.Thread(target=backend.serve_forever, daemon=True).start()
+    table = RouteTable()
+    table.set_routes([Route(
+        name="m", prefix="/m/",
+        service=f"127.0.0.1:{backend.server_address[1]}")])
+    gw = Gateway(table, port=0, admin_port=0)
+    gw.start()
+    try:
+        port = gw._proxy.server_address[1]
+        client = socket.create_connection(("127.0.0.1", port), timeout=10)
+        client.sendall((
+            f"POST /m/x HTTP/1.1\r\nHost: 127.0.0.1:{port}\r\n"
+            "Content-Length: abc\r\n\r\n").encode())
+        resp = b""
+        while b"\r\n\r\n" not in resp:
+            chunk = client.recv(4096)
+            if not chunk:
+                break
+            resp += chunk
+        assert b" 400 " in resp.split(b"\r\n", 1)[0] + b" ", resp
+        assert b"malformed Content-Length" in resp + client.recv(4096)
+        client.close()
+        # The handler thread survived: a well-formed request still flows.
+        status, body, _ = http("POST", f"http://127.0.0.1:{port}/m/x",
+                               {"a": 1})
+        assert status == 200 and body == {"ok": True}
+        assert gw.errors_total >= 1
+    finally:
+        gw.stop()
+        backend.shutdown()
+
+
+def test_malformed_upstream_content_length_is_502():
+    """ADVICE r5 #4, upstream side: a backend advertising
+    `Content-Length: banana` must surface as a clean 502 — the parse
+    happens BEFORE the status line goes out, so the client sees a real
+    response, not a half-written 200."""
+    import socket
+
+    from kubeflow_tpu.gateway import Route
+
+    class RawBackend:
+        def __init__(self):
+            self.sock = socket.socket()
+            self.sock.bind(("127.0.0.1", 0))
+            self.sock.listen(8)
+            self.port = self.sock.getsockname()[1]
+            threading.Thread(target=self._serve, daemon=True).start()
+
+        def _serve(self):
+            while True:
+                try:
+                    conn, _ = self.sock.accept()
+                except OSError:
+                    return
+                threading.Thread(target=self._session, args=(conn,),
+                                 daemon=True).start()
+
+        def _session(self, conn):
+            try:
+                data = b""
+                while b"\r\n\r\n" not in data:
+                    chunk = conn.recv(4096)
+                    if not chunk:
+                        break
+                    data += chunk
+                conn.sendall(b"HTTP/1.1 200 OK\r\n"
+                             b"Content-Length: banana\r\n\r\nhello")
+                conn.close()
+            except OSError:
+                pass
+
+        def close(self):
+            self.sock.close()
+
+    backend = RawBackend()
+    table = RouteTable()
+    table.set_routes([Route(name="u", prefix="/u/",
+                            service=f"127.0.0.1:{backend.port}")])
+    gw = Gateway(table, port=0, admin_port=0)
+    gw.start()
+    try:
+        port = gw._proxy.server_address[1]
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/u/x",
+                                   timeout=10)
+        assert e.value.code == 502
+        assert "malformed upstream" in json.loads(e.value.read())["error"]
+        assert gw.errors_total >= 1
+    finally:
+        gw.stop()
+        backend.close()

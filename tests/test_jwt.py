@@ -646,3 +646,48 @@ def test_prober_bad_sa_key_counts_down(secured_gateway):
                                 token_client=tc)
     assert prober.probe_once() is False
     assert prober.failures_total == 1
+
+
+def test_login_non_ascii_credentials_rejected_not_crash():
+    """ADVICE r5 #3: hmac.compare_digest raises TypeError on non-ASCII
+    str operands — a unicode username or SA key must produce a clean
+    401, not a handler-thread traceback and a dropped connection.
+    (Ring-free server: the login path needs no signing keys.)"""
+    import hashlib
+    import urllib.parse
+
+    # Direct API surface: encoded-bytes compare, False not TypeError.
+    auth = AuthService("admin", hashlib.sha256(b"pw").hexdigest(),
+                       service_accounts={"prober": "key"})
+    assert not auth.check_login("ädmin", "pw")
+    assert not auth.check_login("админ", "pw")
+    assert not auth.check_service_account("prober", "kéy")
+    assert auth.check_login("admin", "pw")
+
+    # Over real HTTP: a non-ASCII username on the login form 401s and
+    # the server keeps answering (the thread did not die mid-request).
+    httpd = make_auth_server(auth, 0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        form = urllib.parse.urlencode(
+            {"username": "ädmin", "password": "pw"}).encode()
+        req = urllib.request.Request(
+            f"{base}/login", data=form, method="POST",
+            headers={"Content-Type": "application/x-www-form-urlencoded"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req)
+        assert e.value.code == 401
+        good = urllib.parse.urlencode(
+            {"username": "admin", "password": "pw"}).encode()
+        req = urllib.request.Request(
+            f"{base}/login", data=good, method="POST",
+            headers={"Content-Type": "application/x-www-form-urlencoded"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            # 302 redirect to "/" — urllib follows it and the bare
+            # server answers 404 there; reaching it proves the login
+            # succeeded on a live handler thread.
+            urllib.request.urlopen(req)
+        assert e.value.code == 404
+    finally:
+        httpd.shutdown()

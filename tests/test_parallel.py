@@ -1,6 +1,8 @@
 """Parallelism-library tests on the 8-device virtual CPU mesh (the fake-slice
 harness SURVEY.md §4 calls for — distributed semantics without TPUs)."""
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -86,8 +88,8 @@ def test_ring_permute_rotates():
     mesh = build_mesh(MeshConfig(data=8))
 
     @jax.jit
-    @jax.shard_map(mesh=mesh, in_specs=P(AXIS_DATA), out_specs=P(AXIS_DATA),
-                   check_vma=False)
+    @functools.partial(collectives.shard_map, mesh=mesh,
+                       in_specs=P(AXIS_DATA), out_specs=P(AXIS_DATA))
     def rotate(x):
         return collectives.ring_permute(x, AXIS_DATA, shift=1)
 

@@ -188,7 +188,9 @@ def test_sigterm_saves_final_checkpoint_and_resumes(tmp_path):
     import time
 
     ck = str(tmp_path / "ck")
-    cfg = {"model": "lm-test-tiny", "batch_size": 4, "seq_len": 32,
+    # batch_size must be divisible by the default data mesh (all 8 fake
+    # devices) for place_batch's sharding.
+    cfg = {"model": "lm-test-tiny", "batch_size": 8, "seq_len": 32,
            "steps": 2000, "log_every": 1, "checkpoint_dir": ck,
            "checkpoint_every": 100000, "seed": 3}
     env = dict(os.environ, JAX_PLATFORMS="cpu",
