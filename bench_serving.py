@@ -34,8 +34,16 @@ peak KV bytes per layout. The regression marker fires when greedy
 outputs differ between layouts, when paged sustains fewer than 2x the
 dense in-flight peak, or when the paged pool leaks blocks after drain.
 
+``--kv-dtype-sweep`` benchmarks int8 vs fp paged KV at EQUAL total pool
+bytes (int8's ~2x blocks must buy >=1.8x the in-flight peak) plus the
+fused block-table attention decode path (no dense KV gather traced into
+the compiled step, tokens/s holding the gather baseline). Fp blocks
+must stay byte-identical to dense; int8/fused greedy tokens must agree
+within the pinned tolerance.
+
 Usage: python bench_serving.py [--quick] [--requests N] [--generate]
        [--prefix-reuse] [--speculative] [--concurrency-sweep]
+       [--kv-dtype-sweep]
 """
 
 from __future__ import annotations
@@ -469,6 +477,216 @@ def _bench_concurrency_sweep(args, model) -> dict:
     }
 
 
+def _decode_burst_tps(d, gen, n_thr=8, rounds=3) -> float:
+    """Decode-heavy tokens/s of ``n_thr`` concurrent full-length
+    generations, best of ``rounds`` after an untimed warm burst. Which
+    admission batch buckets the warm burst compiles depends on thread
+    arrival races, so early timed rounds can still eat a stray compile;
+    the best round is the steady state both paths are compared at."""
+    def one(i):
+        return len(d.submit([3 + (i % 7)] * 8, gen).result()["tokens"])
+
+    with ThreadPoolExecutor(n_thr) as pool:
+        list(pool.map(one, range(n_thr)))  # warm the common buckets
+    best = 0.0
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(n_thr) as pool:
+            emitted = sum(pool.map(one, range(n_thr)))
+        best = max(best, emitted / (time.perf_counter() - t0))
+    return best
+
+
+def _bench_kv_dtype_sweep(args, model) -> dict:
+    """Int8 vs fp paged KV at EQUAL pool bytes, plus the fused
+    block-table attention decode path.
+
+    Three gates ride the regression marker:
+
+    - **Equal-HBM concurrency**: the int8 pool gets the same HBM budget
+      priced at int8 bytes/token (payload 1 byte/elem + one f32 scale
+      per position per head), which buys ~``fp_bytes*hd/(hd+4)``x the
+      blocks; under a mixed-length ladder its in-flight peak must reach
+      >= 1.8x the fp pool's.
+    - **Parity**: fp-block probes must match the dense reference
+      byte-for-byte (the pinned-accuracy default config); int8 and
+      fused probes must agree with the fp tokens within the pinned
+      tolerance (quantization/online-softmax may flip a late argmax on
+      this random-init model, never the stream wholesale).
+    - **No dense materialization**: the fused run's compiled decode step
+      must never trace the pool gather (`_pool_gather` call count stays
+      0 — tracing is when XLA would bake the dense [B, total] view into
+      the executable), and its decode throughput rides the artifact as
+      ``serving_decode_tokens_per_sec`` next to the gather baseline.
+    """
+    import kubeflow_tpu.models.decode as decode_mod
+    from kubeflow_tpu.models.registry import get_model
+    from kubeflow_tpu.serving.continuous import ContinuousDecoder
+    from kubeflow_tpu.serving.kv_allocator import kv_bytes_per_token
+
+    # Single-head override keeps the CPU preset tiny while giving int8 a
+    # realistic head_dim (64): at hd=16 the per-head scale overhead eats
+    # the density win and the equal-HBM gate would test nothing.
+    overrides = ({"n_heads": 1, "n_kv_heads": 1}
+                 if model == "lm-test-tiny" else {})
+    spec = get_model(model, **overrides)
+    cfg = spec.config
+    params = spec.init(jax.random.PRNGKey(0), cfg)
+    gen = min(args.max_new_tokens, 16)
+    prefill_len = 32
+    block = 8
+    total = prefill_len + gen
+    fp_blocks = 4 * (total // block)  # four worst-case sequences
+    itemsize = jax.numpy.dtype(cfg.dtype).itemsize
+    bpt = {d: kv_bytes_per_token(cfg.n_layers, cfg.n_kv_heads,
+                                 cfg.head_dim, itemsize, d)
+           for d in ("fp", "int8")}
+    pool_bytes = fp_blocks * block * bpt["fp"]
+    int8_blocks = pool_bytes // (block * bpt["int8"])  # equal HBM
+    slots = 32
+    offered = 24 if args.quick else 64
+    probes = [[1, 2, 3], [7, 5, 11, 4], [9, 9, 9, 9, 2],
+              list(range(4, 20))]
+    probe_gen = 6
+
+    def request(i):
+        plen = (6, 8, 10, 7)[i % 4]
+        want = (3, 4, 6, 5)[i % 4]
+        return [3 + (i % 7)] * plen, want
+
+    def decoder(**kw):
+        return ContinuousDecoder(
+            params, cfg, slots=kw.pop("slots", slots),
+            prefill_len=prefill_len, max_new_tokens=gen,
+            prefill_len_buckets=2, stream_timeout_s=300.0, **kw)
+
+    def probe_tokens(d):
+        return [d.generate(p, probe_gen, timeout=300)["tokens"]
+                for p in probes]
+
+    def agreement(a, b):
+        """Mean per-probe fraction of positions where the streams agree
+        — robust to one late argmax flip cascading a tail."""
+        fracs = [sum(x == y for x, y in zip(s, t)) / max(len(s), 1)
+                 for s, t in zip(a, b)]
+        return sum(fracs) / len(fracs)
+
+    # Dense reference for the fp bitwise gate (also the probe oracle).
+    d = decoder(slots=4)
+    try:
+        ref = probe_tokens(d)
+    finally:
+        d.stop()
+
+    runs = {}
+    for label, kw in (
+        ("fp", dict(kv_layout="paged", kv_block_size=block,
+                    kv_pool_blocks=fp_blocks)),
+        ("int8", dict(kv_layout="paged", kv_block_size=block,
+                      kv_pool_blocks=int8_blocks, kv_dtype="int8")),
+    ):
+        d = decoder(**kw)
+        try:
+            toks = probe_tokens(d)
+            t0 = time.perf_counter()
+
+            def one(i):
+                p, want = request(i)
+                return len(d.submit(p, want).result()["tokens"])
+            with ThreadPoolExecutor(offered) as pool:
+                emitted = sum(pool.map(one, range(offered)))
+            wall = time.perf_counter() - t0
+            m = d.metrics()
+        finally:
+            d.stop()
+        runs[label] = {
+            "tokens": toks,
+            "tokens_per_sec": round(emitted / wall, 1),
+            "peak_in_flight": m["peak_in_flight"],
+            "pool_blocks": m["kv_blocks_total"],
+            "kv_bytes_total": m["kv_bytes_total"],
+            "leak": m["kv_blocks_in_use"],
+            "defers": m["kv_defer_admissions"],
+        }
+
+    # Fused block-table attention: same fp pool, decode reads through
+    # the kernel. The gather counter counts TRACES — a nonzero count
+    # means XLA baked the dense view into the fused executable.
+    gather_calls = {"n": 0}
+    real_gather = decode_mod._pool_gather
+
+    def counting_gather(*a, **kw):
+        gather_calls["n"] += 1
+        return real_gather(*a, **kw)
+
+    decode_mod._pool_gather = counting_gather
+    try:
+        d = decoder(kv_layout="paged", kv_block_size=block,
+                    kv_pool_blocks=fp_blocks, kv_fused=True)
+        try:
+            fused_tokens = probe_tokens(d)
+            traced_gathers = gather_calls["n"]
+            fused_tps = _decode_burst_tps(d, gen)
+        finally:
+            d.stop()
+    finally:
+        decode_mod._pool_gather = real_gather
+    # Gather baseline on the identical decode-heavy workload.
+    d = decoder(kv_layout="paged", kv_block_size=block,
+                kv_pool_blocks=fp_blocks)
+    try:
+        gather_tps = _decode_burst_tps(d, gen)
+    finally:
+        d.stop()
+
+    fp_identical = runs["fp"]["tokens"] == ref
+    int8_agree = agreement(runs["int8"]["tokens"], runs["fp"]["tokens"])
+    fused_agree = agreement(fused_tokens, runs["fp"]["tokens"])
+    ratio = runs["int8"]["peak_in_flight"] / max(
+        runs["fp"]["peak_in_flight"], 1)
+    # Pinned tolerance: quantization (and the fused path's f32 online
+    # softmax) may flip a LATE argmax on this random-init tiny model;
+    # wholesale divergence means broken scales/masking, not rounding.
+    tol = 0.75
+    return {
+        "metric": "serving_int8_equal_hbm_concurrency_ratio",
+        "value": round(ratio, 2),
+        "unit": "x",
+        "vs_baseline": 1.0,
+        "pool_bytes": pool_bytes,
+        "kv_bytes_per_token_fp": bpt["fp"],
+        "kv_bytes_per_token_int8": bpt["int8"],
+        "pool_blocks_fp": runs["fp"]["pool_blocks"],
+        "pool_blocks_int8": runs["int8"]["pool_blocks"],
+        "peak_in_flight_fp": runs["fp"]["peak_in_flight"],
+        "peak_in_flight_int8": runs["int8"]["peak_in_flight"],
+        "tokens_per_sec_fp": runs["fp"]["tokens_per_sec"],
+        "tokens_per_sec_int8": runs["int8"]["tokens_per_sec"],
+        "fp_tokens_identical": fp_identical,
+        "int8_token_agreement": round(int8_agree, 3),
+        "fused_token_agreement": round(fused_agree, 3),
+        "token_tolerance": tol,
+        "serving_decode_tokens_per_sec": round(fused_tps, 1),
+        "decode_tokens_per_sec_baseline": round(gather_tps, 1),
+        "fused_gather_traces": traced_gathers,
+        "kv_blocks_in_use_after_drain": (runs["fp"]["leak"]
+                                         + runs["int8"]["leak"]),
+        "defer_admissions_int8": runs["int8"]["defers"],
+        "regression": ((not fp_identical) or ratio < 1.8
+                       or int8_agree < tol or fused_agree < tol
+                       or traced_gathers != 0
+                       # Fused decode must hold the gather baseline
+                       # (0.9 floor absorbs CPU scheduler noise; a
+                       # broken kernel path is far below it).
+                       or fused_tps < 0.9 * gather_tps
+                       or runs["fp"]["leak"] != 0
+                       or runs["int8"]["leak"] != 0),
+        "config": f"{model} hd{cfg.head_dim} block{block} "
+                  f"fp{fp_blocks}v int8 {int8_blocks} blocks "
+                  f"slots{slots} offered{offered} gen{gen}",
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
@@ -499,10 +717,19 @@ def main() -> int:
                          "bytes under an offered-concurrency ladder "
                          "(identical greedy tokens and a >=2x in-flight "
                          "peak required)")
+    ap.add_argument("--kv-dtype-sweep", action="store_true",
+                    help="benchmark int8 vs fp paged KV at equal pool "
+                         "bytes (>=1.8x in-flight peak, fp bitwise "
+                         "parity, int8/fused within pinned tolerance) "
+                         "plus the fused block-table attention decode "
+                         "path (no dense KV gather traced)")
     args = ap.parse_args()
 
     on_tpu = jax.default_backend() == "tpu"
-    if args.concurrency_sweep:
+    if args.kv_dtype_sweep:
+        model = "llama-1b" if on_tpu and not args.quick else "lm-test-tiny"
+        result = _bench_kv_dtype_sweep(args, model)
+    elif args.concurrency_sweep:
         model = "llama-1b" if on_tpu and not args.quick else "lm-test-tiny"
         result = _bench_concurrency_sweep(args, model)
     elif args.speculative:

@@ -33,6 +33,14 @@ check_json "$out"
 # block leak after drain (kv_blocks_in_use must return to 0).
 out="$(JAX_PLATFORMS=cpu python bench_serving.py --quick --concurrency-sweep)"
 check_json "$out"
+# Int8 KV + fused block-table attention: the marker fires when int8
+# sustains <1.8x the fp in-flight peak at equal pool bytes, when fp
+# blocks are not bitwise-identical to dense, when int8/fused greedy
+# tokens fall outside the pinned tolerance, when the fused decode path
+# traces a dense KV gather (the materialization it exists to remove),
+# when it falls below the gather baseline's tokens/s, or on a leak.
+out="$(JAX_PLATFORMS=cpu python bench_serving.py --quick --kv-dtype-sweep)"
+check_json "$out"
 echo "bench smoke ok"
 # Training input pipeline: prefetch-on must match prefetch-off final
 # loss byte-for-byte (bench.py sets the regression marker otherwise)

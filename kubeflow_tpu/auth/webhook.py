@@ -188,9 +188,9 @@ def make_server(port: int, *, certfile: str = "",
     return httpd
 
 
-def self_sign(namespace: str, service: str = "admission-webhook"):
+def _mint_ca_and_leaf(namespace: str, service: str):
     """Generate a webhook serving CA + leaf for the Service DNS names.
-    Returns (KeyCert leaf, base64 CA bundle)."""
+    Returns (KeyCert ca, KeyCert leaf, base64 CA bundle)."""
     from kubeflow_tpu.auth import pki
 
     ca = pki.make_ca(f"{service}-ca.{namespace}")
@@ -200,7 +200,74 @@ def self_sign(namespace: str, service: str = "admission-webhook"):
         service,
     ], duration_seconds=365 * 24 * 3600)
     bundle = base64.b64encode(ca.cert_pem.encode()).decode()
+    return ca, leaf, bundle
+
+
+def self_sign(namespace: str, service: str = "admission-webhook"):
+    """Generate a webhook serving CA + leaf for the Service DNS names.
+    Returns (KeyCert leaf, base64 CA bundle)."""
+    _ca, leaf, bundle = _mint_ca_and_leaf(namespace, service)
     return leaf, bundle
+
+
+def ensure_shared_ca(client, namespace: str,
+                     service: str = "admission-webhook",
+                     secret_name: str = "admission-webhook-tls"):
+    """Cluster-wide self-sign: ONE CA/leaf per deployment, not one per
+    pod. With ``--self-sign`` and ``replicas > 1`` each pod used to mint
+    its own CA and race :func:`patch_ca_bundles` — whichever pod patched
+    last won the clientConfigs while its peers kept serving leaves from
+    a different root, so a fraction of admission/conversion dials failed
+    TLS verification forever. Persisting CA + leaf in a Secret makes the
+    mint a cluster-wide once: every pod first loads the Secret; on miss
+    it mints and ``create``s, and the apiserver's create-conflict (409)
+    picks the single winner — losers throw their candidate away and load
+    the winner's. Returns (KeyCert leaf, base64 CA bundle, created)."""
+    from kubeflow_tpu.auth.pki import KeyCert
+    from kubeflow_tpu.k8s.client import ApiError
+
+    def _load(secret):
+        data = secret.get("data", {}) or {}
+
+        def field(key):
+            return base64.b64decode(data.get(key, "")).decode()
+
+        leaf = KeyCert(key_pem=field("tls.key"), cert_pem=field("tls.crt"),
+                       ca_pem=field("ca.crt"))
+        if not (leaf.key_pem and leaf.cert_pem and leaf.ca_pem):
+            raise ValueError(
+                f"secret {secret_name} is missing tls.key/tls.crt/ca.crt")
+        return leaf, base64.b64encode(leaf.ca_pem.encode()).decode()
+
+    existing = client.get_or_none("v1", "Secret", secret_name, namespace)
+    if existing is not None:
+        return (*_load(existing), False)
+    ca, leaf, bundle = _mint_ca_and_leaf(namespace, service)
+    secret = {
+        "apiVersion": "v1",
+        "kind": "Secret",
+        "metadata": {"name": secret_name, "namespace": namespace,
+                     "labels": {"app": service}},
+        "type": "kubernetes.io/tls",
+        "data": {
+            "tls.crt": base64.b64encode(leaf.cert_pem.encode()).decode(),
+            "tls.key": base64.b64encode(leaf.key_pem.encode()).decode(),
+            "ca.crt": base64.b64encode(ca.cert_pem.encode()).decode(),
+            # CA key rides along so a future rotation can re-issue
+            # leaves under the SAME root without re-patching bundles.
+            "ca.key": base64.b64encode(ca.key_pem.encode()).decode(),
+        },
+    }
+    try:
+        client.create(secret)
+    except ApiError as e:
+        if e.code != 409:
+            raise
+        # Lost the race: a peer pod created it between our get and
+        # create. Its CA is the cluster's CA now — load it.
+        return (*_load(client.get("v1", "Secret", secret_name, namespace)),
+                False)
+    return leaf, bundle, True
 
 
 def patch_ca_bundles(client, ca_bundle_b64: str,
@@ -285,10 +352,31 @@ def main(argv=None) -> int:
 
     certfile, keyfile = args.tls_cert, args.tls_key
     bundle = ""
+    client = None
+    ca_secret_shared = False
     if args.self_sign:
         import tempfile
 
-        leaf, bundle = self_sign(args.pod_namespace or args.namespace)
+        ns = args.pod_namespace or args.namespace
+        if args.patch_ca:
+            # Replicated deployments MUST share one CA: per-pod minting
+            # races patch_ca_bundles and strands peers on an unpatched
+            # root. First writer persists CA+leaf in a Secret
+            # (create-conflict picks the winner); everyone else loads.
+            client = client_from_args(args)
+            try:
+                leaf, bundle, _created = ensure_shared_ca(client, ns)
+                ca_secret_shared = True
+            except (OSError, ValueError) as e:
+                # Secret API unreachable at boot: fall back to a local
+                # mint so the pod comes up; the patch retry loop keeps
+                # converging the bundle.
+                print(json.dumps({"msg": "shared-CA secret unavailable, "
+                                         "self-signing locally",
+                                  "error": str(e)}), flush=True)
+                leaf, bundle = self_sign(ns)
+        else:
+            leaf, bundle = self_sign(ns)
         cert_f = tempfile.NamedTemporaryFile("w", suffix=".pem",
                                              delete=False)
         cert_f.write(leaf.chain_pem)
@@ -310,7 +398,8 @@ def main(argv=None) -> int:
                 pass
     patched = failed = 0
     if args.patch_ca and bundle:
-        client = client_from_args(args)
+        if client is None:
+            client = client_from_args(args)
         patched, failed = patch_ca_bundles(client, bundle)
         if failed:
             import threading
@@ -329,6 +418,7 @@ def main(argv=None) -> int:
     print(json.dumps({"msg": "admission webhook up", "port": args.port,
                       "tls": bool(certfile),
                       "self_signed": args.self_sign,
+                      "ca_secret_shared": ca_secret_shared,
                       "ca_bundles_patched": patched,
                       "ca_patches_failed": failed}), flush=True)
     try:

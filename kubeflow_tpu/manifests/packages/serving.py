@@ -57,6 +57,13 @@ REST_PORT = 8500
         ParamSpec("kv_pool_blocks", 0,
                   "physical blocks in the paged pool (0 = dense-parity "
                   "sizing)"),
+        ParamSpec("kv_dtype", "fp",
+                  "paged KV residency precision: fp (bitwise-parity "
+                  "default) or int8 (~2x blocks per HBM byte within a "
+                  "pinned greedy tolerance)"),
+        ParamSpec("kv_fused_attention", False,
+                  "fuse the paged decode read into the block-table "
+                  "attention kernel (no dense KV gather per step)"),
         ParamSpec("enable_prometheus", True),
         ParamSpec("dtype", "bfloat16"),
     ],
@@ -79,6 +86,8 @@ def tpu_serving(
     kv_layout: str,
     kv_block_size: int,
     kv_pool_blocks: int,
+    kv_dtype: str,
+    kv_fused_attention: bool,
     enable_prometheus: bool,
     dtype: str,
 ) -> list[dict]:
@@ -100,8 +109,11 @@ def tpu_serving(
         f"--kv-layout={kv_layout}",
         f"--kv-block-size={kv_block_size}",
         f"--kv-pool-blocks={kv_pool_blocks}",
+        f"--kv-dtype={kv_dtype}",
         f"--dtype={dtype}",
     ]
+    if kv_fused_attention:
+        args.insert(-1, "--kv-fused-attention")
     if enable_prometheus:
         args.append("--enable-prometheus")
     pod_annotations = (

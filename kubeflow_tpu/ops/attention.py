@@ -309,6 +309,241 @@ def _flash_vjp_bwd(causal, scale, block_q, block_k, res, g):
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
+# ---------------------------------------------------------------------------
+# Paged block-table decode attention (models/decode.py's fused read path)
+# ---------------------------------------------------------------------------
+#
+# The paged KV layout stores K/V in a pool of fixed-size blocks; slot
+# ``b``'s virtual position ``p`` lives at block ``table[b, p // Bs]``,
+# offset ``p % Bs``. The reference read path gathers the whole virtual
+# row ``[B, MB*Bs, Hkv, hd]`` per layer per decode step before dense
+# attention — at serving shapes that materialization IS the decode
+# bandwidth bill. The fused paths below walk the table instead and
+# compute span attention one block at a time with an online softmax, so
+# the dense view never exists:
+#
+# - ``"xla"``: a ``lax.scan`` over table columns (any backend) — each
+#   step touches one ``[B, Bs, Hkv, hd]`` block.
+# - ``"pallas"``: the TPU kernel. The block table and per-row positions
+#   ride scalar prefetch so the index_map DMAs exactly the physical
+#   block each grid step needs; int8 pools are dequantized in-register
+#   (scale broadcast over the lane dim) between the DMA and the MXU.
+#
+# Pools may be quantized: ``{"q": int8 [N, Bs, Hkv, hd], "scale": f32
+# [N, Bs, Hkv]}`` with one abs-max scale per (position, kv head).
+# Numerics: scores/softmax/accumulation in f32 (an online softmax is not
+# bitwise-identical to the one-shot reference, which is why
+# models/decode.py keeps the gather path as the pinned-parity default).
+
+
+def _kv_payload(pool):
+    """The payload array of a (possibly quantized) block pool."""
+    return pool["q"] if isinstance(pool, dict) else pool
+
+
+def _read_block(pool, blk):
+    """Gather ONE physical block per row ([B] ids → [B, Bs, Hkv, hd] f32),
+    dequantizing int8 payloads against their per-position scales."""
+    if isinstance(pool, dict):
+        return (pool["q"][blk].astype(jnp.float32)
+                * pool["scale"][blk][..., None])
+    return pool[blk].astype(jnp.float32)
+
+
+def _paged_decode_xla(qg, k_pool, v_pool, table, pos, sm_scale):
+    """Blockwise online-softmax walk of the table. qg: [B, Hkv, G, hd];
+    pools: [N, Bs, Hkv, hd] (or quantized dicts); table: [B, MB]; pos:
+    [B] (row attends virtual positions <= pos). Returns [B, Hkv, G, hd]
+    f32 — no ``[B, MB*Bs]`` view is ever built."""
+    n, bs = _kv_payload(k_pool).shape[0], _kv_payload(k_pool).shape[1]
+    b, hkv, g, hd = qg.shape
+    mb = table.shape[1]
+    q32 = qg.astype(jnp.float32)
+
+    def step(carry, j):
+        m, l, acc = carry
+        # Sentinel entries (>= N, the unallocated marker) clamp to the
+        # last block; the junk they surface sits past ``pos`` where the
+        # span mask already excludes it.
+        blk = jnp.clip(table[:, j], 0, n - 1)
+        k_b = _read_block(k_pool, blk)
+        v_b = _read_block(v_pool, blk)
+        s = jnp.einsum("bkgd,bskd->bkgs", q32, k_b,
+                       preferred_element_type=jnp.float32) * sm_scale
+        span = j * bs + jnp.arange(bs)[None, :]
+        s = jnp.where((span <= pos[:, None])[:, None, None, :], s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * corr + jnp.einsum("bkgs,bskd->bkgd", p, v_b)
+        return (m_new, l, acc), None
+
+    init = (
+        jnp.full((b, hkv, g, 1), _NEG_INF, jnp.float32),
+        jnp.zeros((b, hkv, g, 1), jnp.float32),
+        jnp.zeros((b, hkv, g, hd), jnp.float32),
+    )
+    (m, l, acc), _ = lax.scan(step, init, jnp.arange(mb))
+    ok = m > _NEG_INF / 2  # pos >= 0 keeps slot 0 live, but stay defensive
+    return jnp.where(ok, acc / jnp.where(l == 0.0, 1.0, l), 0.0)
+
+
+def _paged_decode_pallas(qg, k_pool, v_pool, table, pos, sm_scale,
+                         interpret=False):
+    """TPU kernel twin of :func:`_paged_decode_xla`. Grid is
+    ``(B, Hkv, MB)`` with the table column innermost; the scalar-prefetched
+    table drives each step's K/V DMA (the gather never exists, not even
+    blockwise on host), and int8 tiles are dequantized in-register."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    quant = isinstance(k_pool, dict)
+    kq = _kv_payload(k_pool)
+    n, bs, hkv, hd = kq.shape
+    b, _, g, _ = qg.shape
+    mb = table.shape[1]
+    # Head-major pools: one (block, head) tile [Bs, hd] is a contiguous
+    # DMA. Scales get a trailing singleton so their tile is 2D.
+    kt = kq.transpose(0, 2, 1, 3)
+    vt = _kv_payload(v_pool).transpose(0, 2, 1, 3)
+    operands = [kt, vt]
+    if quant:
+        operands += [k_pool["scale"].transpose(0, 2, 1)[..., None],
+                     v_pool["scale"].transpose(0, 2, 1)[..., None]]
+
+    def kernel(tbl_ref, pos_ref, q_ref, k_ref, v_ref, *rest):
+        if quant:
+            ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+        else:
+            o_ref, m_ref, l_ref, acc_ref = rest
+        row = pl.program_id(0)
+        j = pl.program_id(2)
+
+        @pl.when(j == 0)
+        def _init():
+            m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+            l_ref[:] = jnp.zeros_like(l_ref)
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        k = k_ref[:].astype(jnp.float32)
+        v = v_ref[:].astype(jnp.float32)
+        if quant:  # in-register dequant: [Bs, 1] scale over the lane dim
+            k = k * ks_ref[:]
+            v = v * vs_ref[:]
+        s = jnp.dot(q_ref[:].astype(jnp.float32), k.T,
+                    preferred_element_type=jnp.float32) * sm_scale
+        span = j * bs + lax.broadcasted_iota(jnp.int32, (1, bs), 1)
+        s = jnp.where(span <= pos_ref[row], s, _NEG_INF)
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * corr + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+        m_ref[:] = m_new
+
+        @pl.when(j == mb - 1)
+        def _flush():
+            l = l_ref[:]
+            ok = m_ref[:] > _NEG_INF / 2
+            o_ref[:] = jnp.where(
+                ok, acc_ref[:] / jnp.where(l == 0.0, 1.0, l), 0.0)
+
+    def _blk(tbl, _pos, row, j):
+        # Sentinel entries clamp like the XLA path; the span mask hides
+        # whatever the clamped DMA brings in.
+        return jnp.minimum(tbl[row, j], n - 1)
+
+    in_specs = [
+        pl.BlockSpec((None, None, g, hd),
+                     lambda row, h, j, tbl, pos: (row, h, 0, 0)),
+        pl.BlockSpec((None, None, bs, hd),
+                     lambda row, h, j, tbl, pos: (_blk(tbl, pos, row, j),
+                                                  h, 0, 0)),
+        pl.BlockSpec((None, None, bs, hd),
+                     lambda row, h, j, tbl, pos: (_blk(tbl, pos, row, j),
+                                                  h, 0, 0)),
+    ]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((None, None, bs, 1),
+                         lambda row, h, j, tbl, pos: (_blk(tbl, pos, row, j),
+                                                      h, 0, 0)),
+            pl.BlockSpec((None, None, bs, 1),
+                         lambda row, h, j, tbl, pos: (_blk(tbl, pos, row, j),
+                                                      h, 0, 0)),
+        ]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, hkv, mb),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((None, None, g, hd),
+                               lambda row, h, j, tbl, pos: (row, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((g, 1), jnp.float32),
+            pltpu.VMEM((g, 1), jnp.float32),
+            pltpu.VMEM((g, hd), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, g, hd), jnp.float32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(table.astype(jnp.int32), pos.astype(jnp.int32), qg, *operands)
+
+
+def _paged_kernel_supported(k_pool) -> bool:
+    """The real (non-interpret) kernel wants a TPU and lane-aligned
+    tiles; everything else rides the XLA walk."""
+    try:
+        if jax.devices()[0].platform != "tpu":
+            return False
+    except RuntimeError:
+        return False
+    payload = _kv_payload(k_pool)
+    _n, bs, _hkv, hd = payload.shape
+    return hd % 128 == 0 and bs % 8 == 0
+
+
+def paged_decode_attention(q, k_pool, v_pool, table, pos, *,
+                           n_kv_heads: int, scale: float | None = None,
+                           implementation: str | None = None,
+                           interpret: bool = False):
+    """Fused single-token attention over a paged KV pool.
+
+    q: [B, Hq, hd] (one decode token per row, already rotary-embedded);
+    k_pool/v_pool: [N, Bs, Hkv, hd] block pools, or quantized dicts
+    ``{"q": int8, "scale": f32 [N, Bs, Hkv]}``; table: [B, MB] block
+    table (entries >= N are unallocated sentinels); pos: [B] — row ``b``
+    attends virtual positions ``<= pos[b]``. Returns [B, Hq, hd] f32.
+
+    ``implementation``: None (auto: pallas on TPU for supported shapes,
+    else xla), "pallas", or "xla". Both walk the block table with an
+    online softmax — the gathered ``[B, MB*Bs, Hkv, hd]`` view is never
+    materialized, which is the point."""
+    b, hq, hd = q.shape
+    if hq % n_kv_heads:
+        raise ValueError(
+            f"query heads {hq} not a multiple of kv heads {n_kv_heads}")
+    group = hq // n_kv_heads
+    sm_scale = (hd ** -0.5) if scale is None else scale
+    qg = q.reshape(b, n_kv_heads, group, hd)
+    if implementation is None:
+        implementation = ("pallas" if _paged_kernel_supported(k_pool)
+                          else "xla")
+    if implementation == "pallas":
+        out = _paged_decode_pallas(qg, k_pool, v_pool, table, pos,
+                                   sm_scale, interpret=interpret)
+    elif implementation == "xla":
+        out = _paged_decode_xla(qg, k_pool, v_pool, table, pos, sm_scale)
+    else:
+        raise ValueError(f"unknown implementation {implementation!r}")
+    return out.reshape(b, hq, hd)
+
+
 def flash_attention(
     q,
     k,

@@ -71,6 +71,17 @@ def main(argv=None) -> int:
                    help="physical blocks in the paged pool (0 = "
                         "dense-parity sizing: batch-size sequences at "
                         "worst case)")
+    p.add_argument("--kv-dtype", default="fp", choices=["fp", "int8"],
+                   help="paged KV residency precision: 'fp' keeps the "
+                        "model dtype (bitwise-parity default); 'int8' "
+                        "quantizes blocks with per-position per-head "
+                        "scales — ~2x blocks per HBM byte within a "
+                        "pinned greedy-token tolerance")
+    p.add_argument("--kv-fused-attention", action="store_true",
+                   help="fuse the paged decode read into a block-table "
+                        "attention kernel (no dense KV gather per step; "
+                        "int8 dequantized in-register); numerics are "
+                        "f32-equivalent, not bitwise")
     p.add_argument("--stream-timeout-s", type=float, default=60.0,
                    help="default wait for generation results/streams; "
                         "raise under heavy load so memory-deferred "
@@ -100,6 +111,14 @@ def main(argv=None) -> int:
     if not (args.draft_mode == "ngram"
             or args.draft_mode.startswith("model:")):
         p.error("--draft-mode must be 'ngram' or 'model:<name>'")
+    if args.kv_dtype != "fp" and args.kv_layout != "paged":
+        # Quantized residency exists only in the block pool; silently
+        # ignoring the flag would report fp memory numbers as int8 ones.
+        p.error("--kv-dtype=int8 requires --kv-layout=paged")
+    if args.kv_fused_attention and args.kv_layout != "paged":
+        # The fused kernel reads through the block table; dense rows
+        # have no table to walk.
+        p.error("--kv-fused-attention requires --kv-layout=paged")
     if args.kv_layout == "paged":
         if args.decode_mode != "continuous":
             # Only the continuous decoder carries the block pool;
@@ -134,6 +153,8 @@ def main(argv=None) -> int:
             kv_layout=args.kv_layout,
             kv_block_size=args.kv_block_size,
             kv_pool_blocks=args.kv_pool_blocks,
+            kv_dtype=args.kv_dtype,
+            kv_fused=args.kv_fused_attention,
             stream_timeout_s=args.stream_timeout_s,
             dtype=args.dtype,
         ),

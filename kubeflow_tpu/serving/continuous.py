@@ -72,7 +72,10 @@ from kubeflow_tpu.models.decode import (
     verify_chunk,
 )
 from kubeflow_tpu.serving.engine import pow2_bucket
-from kubeflow_tpu.serving.kv_allocator import BlockAllocator
+from kubeflow_tpu.serving.kv_allocator import (
+    BlockAllocator,
+    kv_bytes_per_token,
+)
 from kubeflow_tpu.serving.prefix_cache import PrefixCache
 from kubeflow_tpu.serving.speculative import make_proposer
 
@@ -189,7 +192,8 @@ class ContinuousDecoder:
                  prefill_len_buckets: int = 0, speculative_k: int = 0,
                  draft_mode: str = "ngram", kv_layout: str = "dense",
                  kv_block_size: int = 16, kv_pool_blocks: int = 0,
-                 kv_low_watermark: int = 0,
+                 kv_low_watermark: int = 0, kv_dtype: str = "fp",
+                 kv_fused: bool = False,
                  stream_timeout_s: float = 60.0):
         self.params = params
         self.cfg = cfg
@@ -210,6 +214,23 @@ class ContinuousDecoder:
         if kv_layout not in ("dense", "paged"):
             raise ValueError(f"unknown kv_layout {kv_layout!r}")
         self.kv_layout = kv_layout
+        # KV residency precision: "fp" keeps the model dtype (bitwise
+        # parity with dense pinned in tests); "int8" stores blocks
+        # quantized with per-position per-head scales, roughly doubling
+        # blocks per HBM byte at a pinned greedy-token tolerance.
+        if kv_dtype not in ("fp", "int8"):
+            raise ValueError(f"unknown kv_dtype {kv_dtype!r}")
+        if kv_dtype == "int8" and kv_layout != "paged":
+            raise ValueError("kv_dtype='int8' requires kv_layout='paged'")
+        self.kv_dtype = kv_dtype
+        # Fused block-table attention for the paged decode step: the
+        # kernel walks the table (int8 dequantized in-register) instead
+        # of gathering the dense [slots, total_len] view each step. Off
+        # by default — the gather path is the pinned-accuracy reference
+        # (bitwise for fp blocks).
+        if kv_fused and kv_layout != "paged":
+            raise ValueError("kv_fused requires kv_layout='paged'")
+        self.kv_fused = bool(kv_fused)
         self.prefix_cache = (
             PrefixCache(prefix_cache_slots, min_len=prefix_cache_min_len)
             if prefix_cache_slots > 0 else None
@@ -271,7 +292,11 @@ class ContinuousDecoder:
                 raise ValueError(
                     f"kv_pool_blocks {num_blocks} cannot back even one "
                     f"worst-case sequence ({mb} blocks)")
-            self._alloc = BlockAllocator(num_blocks, self.kv_block_size)
+            self._alloc = BlockAllocator(
+                num_blocks, self.kv_block_size,
+                bytes_per_token=kv_bytes_per_token(
+                    cfg.n_layers, cfg.n_kv_heads, cfg.head_dim,
+                    jnp.dtype(cfg.dtype).itemsize, kv_dtype))
             self._max_blocks_per_seq = mb
             # Host mirror of the device block table; sentinel
             # ``num_blocks`` marks unallocated entries (writes through
@@ -279,7 +304,8 @@ class ContinuousDecoder:
             self._table = np.full((slots, mb), num_blocks, np.int32)
             self._slot_blocks: list[list[int]] = [[] for _ in range(slots)]
             self._state = init_paged_state(cfg, slots, num_blocks,
-                                           self.kv_block_size, mb, seed)
+                                           self.kv_block_size, mb, seed,
+                                           kv_dtype=kv_dtype)
         else:
             self.kv_block_size = int(kv_block_size)
             self._alloc = None
@@ -462,7 +488,8 @@ class ContinuousDecoder:
                     self._state, self.params, self.cfg,
                     jnp.asarray(slots), jnp.asarray(toks),
                     jnp.asarray(lengths), jnp.asarray(wants),
-                    jnp.asarray(temps), self.top_k, self.eos_id)
+                    jnp.asarray(temps), self.top_k, self.eos_id,
+                    self.kv_fused)
             else:
                 self._state, last, tok, emit = admit_rows_and_step(
                     self._state, self.params, self.cfg,
@@ -564,7 +591,8 @@ class ContinuousDecoder:
                     self._state, self.params, self.cfg, jnp.int32(slot),
                     jnp.int32(prefix_len), jnp.asarray(toks),
                     jnp.int32(len(req.tokens)), jnp.int32(req.want),
-                    jnp.float32(req.temperature), self.top_k, self.eos_id)
+                    jnp.float32(req.temperature), self.top_k, self.eos_id,
+                    self.kv_fused)
             with self._mlock:
                 self.kv_shared_blocks += n_full
                 if prefix_len % bs:
@@ -848,7 +876,8 @@ class ContinuousDecoder:
         with self._state_lock:
             self._state, outs, emits = verify_chunk(
                 self._state, self.params, self.cfg, jnp.asarray(drafts),
-                jnp.asarray(dlens), self.top_k, self.eos_id)
+                jnp.asarray(dlens), self.top_k, self.eos_id,
+                self.kv_fused)
         with self._mlock:
             self.dispatches += 1
             self.spec_verify_dispatches += 1
@@ -1034,6 +1063,7 @@ class ContinuousDecoder:
                         self._state, toks, emitted = decode_chunk(
                             self._state, self.params, self.cfg,
                             self.chunk_size, self.top_k, self.eos_id,
+                            self.kv_fused,
                         )
                     with self._mlock:
                         self.steps += self.chunk_size
@@ -1046,7 +1076,7 @@ class ContinuousDecoder:
                     with self._state_lock:
                         self._state, toks, emitted = decode_step(
                             self._state, self.params, self.cfg, self.top_k,
-                            self.eos_id,
+                            self.eos_id, self.kv_fused,
                         )
                     with self._mlock:
                         self.steps += 1
@@ -1120,4 +1150,15 @@ class ContinuousDecoder:
             snap["kv_blocks_peak"] = self.kv_blocks_peak
             snap["kv_block_size"] = (self.kv_block_size
                                      if self._alloc else 0)
+            # Real-byte accounting: the autoscaler must scale on bytes
+            # resident, not block counts whose HBM meaning shifts with
+            # kv_dtype (an int8 block is ~half an fp block).
+            snap["kv_dtype"] = self.kv_dtype if self._alloc else "fp"
+            snap["kv_fused"] = self.kv_fused
+            snap["kv_bytes_per_token"] = (self._alloc.bytes_per_token
+                                          if self._alloc else 0)
+            snap["kv_bytes_in_use"] = (self._alloc.bytes_in_use
+                                       if self._alloc else 0)
+            snap["kv_bytes_total"] = (self._alloc.bytes_total
+                                      if self._alloc else 0)
         return snap

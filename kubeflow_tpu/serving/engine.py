@@ -106,6 +106,18 @@ class EngineConfig:
     # dense. Set explicitly to cap KV HBM — admission then defers
     # instead of overcommitting.
     kv_pool_blocks: int = 0
+    # KV residency precision (paged layout). "fp" keeps the model dtype
+    # — greedy outputs bitwise-identical to dense, the pinned-accuracy
+    # default. "int8" quantizes blocks (per-position per-head abs-max
+    # scales, dequantized at read): ~2x blocks per HBM byte at a pinned
+    # greedy-token tolerance; size kv_pool_blocks up accordingly.
+    kv_dtype: str = "fp"
+    # Fused block-table attention for the paged decode step: walk the
+    # table inside the attention kernel (int8 dequantized in-register)
+    # instead of gathering the dense [slots, total_len] KV view every
+    # step. Off by default — the gather path is the bitwise-parity
+    # reference; fused numerics are f32-equivalent, not bitwise.
+    kv_fused: bool = False
     # Default wait (seconds) for StreamHandle.tokens()/result() when the
     # caller passes none — raise it when memory-deferred admissions
     # under load would spuriously time callers out.

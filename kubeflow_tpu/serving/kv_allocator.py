@@ -27,17 +27,52 @@ without a device and safe to mutate under the decoder's prefix lock.
 
 from __future__ import annotations
 
+# One float32 abs-max scale per (layer, position, kv head) rides each
+# int8 payload byte stream — the scale pool is indexed by the SAME block
+# ids, so every refcount transition below covers payload and scales as
+# one unit.
+KV_SCALE_BYTES = 4
+
+
+def kv_bytes_per_token(n_layers: int, n_kv_heads: int, head_dim: int,
+                       fp_bytes: int, kv_dtype: str = "fp") -> int:
+    """HBM bytes one resident K+V position costs in the paged pool.
+
+    ``fp``: ``2 * L * Hkv * hd * fp_bytes``. ``int8``: the payload drops
+    to one byte per element but each (position, head) carries a
+    :data:`KV_SCALE_BYTES` scale, so the per-head cost is
+    ``hd + KV_SCALE_BYTES`` — the honest number an autoscaler must see
+    (scale overhead is why int8 is ~``fp_bytes * hd / (hd + 4)``x, not
+    exactly ``fp_bytes``x, denser)."""
+    if kv_dtype == "int8":
+        per_head = head_dim + KV_SCALE_BYTES
+    elif kv_dtype in ("", "fp"):
+        per_head = head_dim * fp_bytes
+    else:
+        raise ValueError(f"unknown kv_dtype {kv_dtype!r}")
+    return 2 * n_layers * n_kv_heads * per_head
+
 
 class BlockAllocator:
-    """Free list + refcounts over ``num_blocks`` physical KV blocks."""
+    """Free list + refcounts over ``num_blocks`` physical KV blocks.
 
-    def __init__(self, num_blocks: int, block_size: int):
+    ``bytes_per_token`` (set by the owner from
+    :func:`kv_bytes_per_token`) prices the pool in real HBM bytes so
+    stats consumers — the Prometheus gauges the ROADMAP-1 autoscaler
+    scales on — see bytes resident, not just block counts whose meaning
+    shifts with ``kv_dtype``."""
+
+    def __init__(self, num_blocks: int, block_size: int,
+                 bytes_per_token: int = 0):
         if num_blocks <= 0:
             raise ValueError("BlockAllocator needs at least one block")
         if block_size <= 0:
             raise ValueError("block_size must be positive")
+        if bytes_per_token < 0:
+            raise ValueError("bytes_per_token must be >= 0")
         self.num_blocks = num_blocks
         self.block_size = block_size
+        self.bytes_per_token = bytes_per_token
         # LIFO free list: ascending ids pop first (determinism helps the
         # byte-identity tests pin block placement).
         self._free = list(range(num_blocks - 1, -1, -1))
@@ -52,6 +87,16 @@ class BlockAllocator:
     @property
     def blocks_in_use(self) -> int:
         return self.num_blocks - len(self._free)
+
+    @property
+    def bytes_in_use(self) -> int:
+        """HBM bytes currently claimed (0 when unpriced)."""
+        return self.blocks_in_use * self.block_size * self.bytes_per_token
+
+    @property
+    def bytes_total(self) -> int:
+        """HBM bytes of the whole pool (0 when unpriced)."""
+        return self.num_blocks * self.block_size * self.bytes_per_token
 
     def ref_count(self, block: int) -> int:
         return self._refs[block]

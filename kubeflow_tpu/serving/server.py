@@ -103,6 +103,8 @@ class ModelServer:
                     kv_layout=self.engine.cfg.kv_layout,
                     kv_block_size=self.engine.cfg.kv_block_size,
                     kv_pool_blocks=self.engine.cfg.kv_pool_blocks,
+                    kv_dtype=self.engine.cfg.kv_dtype,
+                    kv_fused=self.engine.cfg.kv_fused,
                     stream_timeout_s=self.engine.cfg.stream_timeout_s,
                 )
             return self._decoder
@@ -272,6 +274,17 @@ class ModelServer:
                             "serving_kv_blocks_total": d["kv_blocks_total"],
                             "serving_kv_blocks_in_use":
                                 d["kv_blocks_in_use"],
+                            # Real-byte gauges for the autoscaler:
+                            # block counts shift meaning with kv_dtype,
+                            # bytes do not.
+                            "serving_kv_bytes_per_token":
+                                d["kv_bytes_per_token"],
+                            "serving_kv_bytes_in_use":
+                                d["kv_bytes_in_use"],
+                            "serving_kv_bytes_total":
+                                d["kv_bytes_total"],
+                            "serving_kv_dtype_int8":
+                                int(d["kv_dtype"] == "int8"),
                             "serving_kv_cow_copies_total":
                                 d["kv_cow_copies"],
                             "serving_kv_shared_blocks_total":

@@ -401,3 +401,88 @@ def test_crd_declares_both_versions():
     beta_schema = versions["v1beta1"]["schema"]["openAPIV3Schema"]
     assert beta_schema["properties"]["spec"]["properties"][
         "replicaSpecs"]["type"] == "array"
+
+
+@pytest.fixture()
+def fake_mint(monkeypatch):
+    """Deterministic-per-call CA/leaf mint so the Secret race logic is
+    testable without the ``cryptography`` package: every call returns
+    DIFFERENT material (like real minting), so any test assertion that
+    two pods share material proves the Secret did the sharing."""
+    import base64 as b64
+    import itertools
+
+    from kubeflow_tpu.auth import webhook
+    from kubeflow_tpu.auth.pki import KeyCert
+
+    counter = itertools.count()
+
+    def mint(namespace, service):
+        n = next(counter)
+        ca = KeyCert(key_pem=f"ca-key-{n}", cert_pem=f"ca-cert-{n}\n")
+        leaf = KeyCert(key_pem=f"leaf-key-{n}", cert_pem=f"leaf-cert-{n}\n",
+                       ca_pem=ca.cert_pem)
+        bundle = b64.b64encode(ca.cert_pem.encode()).decode()
+        return ca, leaf, bundle
+
+    monkeypatch.setattr(webhook, "_mint_ca_and_leaf", mint)
+    return mint
+
+
+def test_shared_ca_secret_first_writer_wins(jobs_env, fake_mint):
+    """ADVICE r5 #5: with --self-sign and replicas>1, each pod used to
+    mint its own CA and race patch_ca_bundles — the last patcher won the
+    clientConfigs while its peers served leaves from a different root.
+    ensure_shared_ca persists CA+leaf in a Secret: the first pod creates
+    it, every later pod loads the SAME material, so all replicas serve
+    one root and the patched bundle verifies against every pod."""
+    from kubeflow_tpu.auth.webhook import ensure_shared_ca, patch_ca_bundles
+
+    api = jobs_env
+    leaf1, bundle1, created1 = ensure_shared_ca(api, NS)
+    leaf2, bundle2, created2 = ensure_shared_ca(api, NS)  # "second pod"
+    assert created1 and not created2
+    assert bundle2 == bundle1
+    assert leaf2.cert_pem == leaf1.cert_pem
+    assert leaf2.key_pem == leaf1.key_pem
+    assert leaf2.ca_pem == leaf1.ca_pem
+    sec = api.get("v1", "Secret", "admission-webhook-tls", NS)
+    assert sec["type"] == "kubernetes.io/tls"
+    assert set(sec["data"]) == {"tls.crt", "tls.key", "ca.crt", "ca.key"}
+    # Both pods patch the same bundle; the second pass is a no-op, so
+    # clientConfigs can never flap between roots again.
+    assert patch_ca_bundles(api, bundle1)[1] == 0
+    assert patch_ca_bundles(api, bundle2) == (0, 0)
+
+
+def test_shared_ca_secret_create_conflict_loads_winner(jobs_env, fake_mint):
+    """The true race: both pods pass the existence probe, both create —
+    the loser's 409 must make it adopt the winner's CA, not crash and
+    not serve its own candidate."""
+    from kubeflow_tpu.auth.webhook import ensure_shared_ca
+
+    api = jobs_env
+    real_get_or_none = api.get_or_none
+    state = {"raced": False}
+
+    def racing_get_or_none(api_version, kind, name, namespace=None):
+        out = real_get_or_none(api_version, kind, name, namespace)
+        if (kind == "Secret" and out is None and not state["raced"]):
+            # A peer pod wins the mint between our probe and our create.
+            state["raced"] = True
+            _leaf, _bundle, created = ensure_shared_ca(api, NS)
+            assert created
+            return None  # this pod still believes the secret is absent
+        return out
+
+    api.get_or_none = racing_get_or_none
+    try:
+        leaf, bundle, created = ensure_shared_ca(api, NS)
+    finally:
+        api.get_or_none = real_get_or_none
+    assert not created  # lost the race cleanly
+    sec = api.get("v1", "Secret", "admission-webhook-tls", NS)
+    import base64 as b64
+    assert b64.b64decode(sec["data"]["tls.crt"]).decode() == leaf.cert_pem
+    assert b64.b64encode(
+        b64.b64decode(sec["data"]["ca.crt"])).decode() == bundle

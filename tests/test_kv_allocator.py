@@ -64,3 +64,31 @@ def test_constructor_validation():
         BlockAllocator(0, block_size=8)
     with pytest.raises(ValueError):
         BlockAllocator(4, block_size=0)
+
+
+def test_bytes_pricing_follows_refcount_lifecycle():
+    """Scale arrays ride the SAME block ids as the int8 payload, so one
+    refcount lifecycle governs both: bytes_in_use prices blocks (payload
+    + scales together), shares never double-bill, and the last free
+    returns the bytes — the leak check the serving tests gate on covers
+    the scale pool by construction."""
+    from kubeflow_tpu.serving.kv_allocator import kv_bytes_per_token
+
+    bpt = kv_bytes_per_token(2, 2, 16, 2, "int8")  # 2*2*2*(16+4)
+    assert bpt == 160
+    a = BlockAllocator(4, block_size=8, bytes_per_token=bpt)
+    (b1, b2) = a.alloc(2)
+    assert a.bytes_in_use == 2 * 8 * bpt
+    a.share(b1)          # zero-copy prefix share: same bytes, one block
+    assert a.bytes_in_use == 2 * 8 * bpt
+    a.free(b1)
+    assert a.bytes_in_use == 2 * 8 * bpt  # one holder left on b1
+    a.free(b1)
+    a.free(b2)
+    assert a.bytes_in_use == 0
+    assert a.bytes_total == 4 * 8 * bpt
+
+
+def test_bytes_per_token_validation():
+    with pytest.raises(ValueError):
+        BlockAllocator(4, block_size=8, bytes_per_token=-1)
