@@ -1,0 +1,24 @@
+#!/bin/sh
+# CI exposition lint (ci/pipeline.yaml `metrics-lint` stage): boot every
+# /metrics surface in-process — model server (decoder driven), gateway
+# admin, availability prober, operator HealthServer — scrape each over
+# real HTTP, and validate TYPE lines, label escaping and histogram
+# bucket ordering with the pure-python promtool-style checker. Exactly
+# one renderer (kubeflow_tpu/observability/metrics.py) may know the
+# exposition text format; this stage is what keeps a fifth hand-rolled
+# renderer from creeping back in.
+set -e
+
+JAX_PLATFORMS=cpu python -m kubeflow_tpu.observability.lint --self-check
+
+# The grep-able single-renderer invariant: no "# TYPE" string literal
+# anywhere outside observability/metrics.py (every exporter must go
+# through the shared renderer, and tests assert via its type_line()).
+offenders="$(grep -rl '# TYPE' kubeflow_tpu tests bench.py bench_serving.py \
+    --include='*.py' | grep -v 'observability/metrics.py' || true)"
+if [ -n "$offenders" ]; then
+    echo "exposition renderer leaked outside observability/metrics.py:"
+    echo "$offenders"
+    exit 1
+fi
+echo "single-renderer invariant ok"

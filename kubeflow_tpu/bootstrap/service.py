@@ -32,6 +32,7 @@ from pathlib import Path
 from kubeflow_tpu.cli.coordinator import Coordinator
 from kubeflow_tpu.config.defaults import default_kfdef
 from kubeflow_tpu.config.kfdef import PLATFORM_NONE
+from kubeflow_tpu.observability.metrics import render_prometheus
 
 # Click-to-deploy page (the gcp-click-to-deploy React SPA's role,
 # components/gcp-click-to-deploy/src/DeployForm.tsx, server-rendered):
@@ -156,14 +157,11 @@ class BootstrapService:
     def metrics(self) -> str:
         deployed = sum(1 for s in self._status.values()
                        if s.get("phase") == "Deployed")
-        return (
-            "# TYPE bootstrap_requests_total counter\n"
-            f"bootstrap_requests_total {self.requests}\n"
-            "# TYPE bootstrap_errors_total counter\n"
-            f"bootstrap_errors_total {self.errors}\n"
-            "# TYPE bootstrap_apps_deployed gauge\n"
-            f"bootstrap_apps_deployed {deployed}\n"
-        )
+        return render_prometheus({
+            "bootstrap_requests_total": self.requests,
+            "bootstrap_errors_total": self.errors,
+            "bootstrap_apps_deployed": deployed,
+        })
 
     # ------------------------------------------------------------------
     # HTTP

@@ -29,6 +29,8 @@ from typing import Callable
 
 from kubeflow_tpu.gateway.admin import make_admin_handler
 from kubeflow_tpu.gateway.proxy import make_proxy_handler
+from kubeflow_tpu.observability.metrics import MetricRegistry
+from kubeflow_tpu.observability.tracing import TraceStore
 from kubeflow_tpu.gateway.resilience import (
     BanditStats,
     OutlierStats,
@@ -129,6 +131,19 @@ class Gateway:
         self.errors_total = 0
         self.tunnels_total = 0
         self.shadow_total = 0
+        # Shared observability registry (served on the admin /metrics):
+        # per-route upstream latency distributions — the signal a
+        # metric-driven autoscaler reads per backend pool.
+        self.registry = MetricRegistry()
+        self.upstream_latency = self.registry.histogram(
+            "gateway_upstream_latency_seconds",
+            "Upstream request latency (connect to response headers)",
+            labels=("route",))
+        # Per-request timelines (received → upstream → relayed), ring-
+        # bounded, served at the admin /debug/requests. The request id
+        # recorded here is the same X-Request-ID forwarded upstream, so
+        # a gateway hop and its decoder stream correlate by one id.
+        self.trace = TraceStore()
         self._proxy: ThreadingHTTPServer | None = None
         self._admin: ThreadingHTTPServer | None = None
         self._redirect: ThreadingHTTPServer | None = None

@@ -8,6 +8,9 @@ from __future__ import annotations
 import json
 from http.server import BaseHTTPRequestHandler
 
+from kubeflow_tpu.observability.metrics import render_prometheus
+from kubeflow_tpu.observability.tracing import render_debug
+
 
 def make_admin_handler(gw):
     class Handler(BaseHTTPRequestHandler):
@@ -30,30 +33,28 @@ def make_admin_handler(gw):
                 body = json.dumps(gw.health.snapshot()).encode()
                 ctype = "application/json"
             elif self.path == "/metrics":
-                body = (
-                    "# TYPE gateway_requests_total counter\n"
-                    f"gateway_requests_total {gw.requests_total}\n"
-                    "# TYPE gateway_errors_total counter\n"
-                    f"gateway_errors_total {gw.errors_total}\n"
-                    "# TYPE gateway_upgrade_tunnels_total counter\n"
-                    f"gateway_upgrade_tunnels_total {gw.tunnels_total}\n"
-                    "# TYPE gateway_shadow_requests_total counter\n"
-                    f"gateway_shadow_requests_total {gw.shadow_total}\n"
-                    "# TYPE gateway_retries_total counter\n"
-                    f"gateway_retries_total {gw.retries_total}\n"
-                    "# TYPE gateway_outliers_total counter\n"
-                    f"gateway_outliers_total {gw.outliers.totals()[0]}\n"
-                    "# TYPE gateway_outlier_scored_total counter\n"
-                    "gateway_outlier_scored_total "
-                    f"{gw.outliers.totals()[1]}\n"
-                    "# TYPE gateway_jwt_verified_total counter\n"
-                    "gateway_jwt_verified_total "
-                    f"{getattr(gw.jwt_verifier, 'verified_total', 0)}\n"
-                    "# TYPE gateway_jwt_rejected_total counter\n"
-                    "gateway_jwt_rejected_total "
-                    f"{getattr(gw.jwt_verifier, 'rejected_total', 0)}\n"
-                ).encode()
+                # Counters through the shared dict renderer (typed by
+                # the _total suffix), histograms (per-route upstream
+                # latency) through the gateway's registry — one
+                # exposition renderer for the whole platform.
+                body = (render_prometheus({
+                    "gateway_requests_total": gw.requests_total,
+                    "gateway_errors_total": gw.errors_total,
+                    "gateway_upgrade_tunnels_total": gw.tunnels_total,
+                    "gateway_shadow_requests_total": gw.shadow_total,
+                    "gateway_retries_total": gw.retries_total,
+                    "gateway_outliers_total": gw.outliers.totals()[0],
+                    "gateway_outlier_scored_total":
+                        gw.outliers.totals()[1],
+                    "gateway_jwt_verified_total":
+                        getattr(gw.jwt_verifier, "verified_total", 0),
+                    "gateway_jwt_rejected_total":
+                        getattr(gw.jwt_verifier, "rejected_total", 0),
+                }) + gw.registry.render()).encode()
                 ctype = "text/plain"
+            elif self.path.partition("?")[0] == "/debug/requests":
+                body, ctype = render_debug(gw.trace,
+                                           self.path.partition("?")[2])
             elif self.path in ("/healthz", "/readyz"):
                 body, ctype = b'{"status":"ok"}', "application/json"
             else:

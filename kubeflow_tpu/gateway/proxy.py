@@ -20,6 +20,10 @@ from http.client import HTTPConnection
 from http.server import BaseHTTPRequestHandler
 
 from kubeflow_tpu.gateway.resilience import OutlierStats
+from kubeflow_tpu.observability.tracing import (
+    REQUEST_ID_HEADER,
+    gen_request_id,
+)
 
 # Hop-by-hop headers never forwarded (RFC 7230 §6.1).
 _HOP_HEADERS = {
@@ -39,6 +43,9 @@ def make_proxy_handler(gw):
         def _respond(self, code: int, body: bytes,
                      headers: dict | None = None) -> None:
             self.send_response(code)
+            rid = getattr(self, "_request_id", None)
+            if rid:
+                self.send_header(REQUEST_ID_HEADER, rid)
             for k, v in (headers or {}).items():
                 self.send_header(k, v)
             if headers is None or "Content-Type" not in headers:
@@ -50,6 +57,12 @@ def make_proxy_handler(gw):
 
         def _handle(self):
             gw.requests_total += 1
+            # Request id: preserved when the client sent one, generated
+            # otherwise — echoed on every response this gateway writes
+            # and forwarded to the upstream, so one id follows the
+            # request through gateway → server → decoder.
+            self._request_id = (self.headers.get(REQUEST_ID_HEADER)
+                                or gen_request_id())
             if self.path == "/healthz":
                 self._respond(200, b'{"status":"ok"}')
                 return
@@ -180,14 +193,17 @@ def make_proxy_handler(gw):
             body = self.rfile.read(length) if length else None
             # Forwarded prefix and authenticated identity are
             # gateway-asserted — client-supplied copies must never
-            # reach the backend (spoofing).
+            # reach the backend (spoofing). The request id is gateway-
+            # asserted too, but *preserves* the client's value.
             headers = {
                 k: v for k, v in self.headers.items()
                 if k.lower() not in _HOP_HEADERS
                 and k.lower() not in ("x-forwarded-prefix",
-                                      "x-auth-identity")
+                                      "x-auth-identity",
+                                      "x-request-id")
             }
             headers["X-Forwarded-Prefix"] = route.prefix
+            headers[REQUEST_ID_HEADER] = self._request_id
             if getattr(self, "_identity", None):
                 # The x-goog-authenticated-user-email analogue.
                 headers["X-Auth-Identity"] = self._identity
@@ -208,15 +224,25 @@ def make_proxy_handler(gw):
                     }
             bandit = (route.strategy == "epsilon-greedy"
                       and service is not None)
+            # Gateway-hop timeline (skipped on the retry re-entry — the
+            # original request's timeline is still open upstack).
+            tl = None if is_retry else gw.trace.start(self._request_id)
+            if tl is not None:
+                tl.event("received", route=route.name,
+                         method=self.command, path=self.path)
             conn = HTTPConnection(host, port,
                                   timeout=gw.upstream_timeout)
             try:
+                t_up = time.perf_counter()
                 try:
                     self._connect_upstream(conn)
                     conn.request(self.command, path, body=body,
                                  headers=headers)
                     resp = conn.getresponse()
                 except OSError as e:
+                    if tl is not None:
+                        tl.event("upstream_failed",
+                                 upstream=f"{host}:{port}")
                     if bandit:
                         gw.bandit.record(route.name, service, 0.0)
                     if service is not None:
@@ -253,6 +279,13 @@ def make_proxy_handler(gw):
                         ).encode(),
                     )
                     return
+                # Per-route upstream latency distribution (connect →
+                # response headers): the autoscaler-facing signal.
+                gw.upstream_latency.labels(route.name).observe(
+                    time.perf_counter() - t_up)
+                if tl is not None:
+                    tl.event("upstream_response", status=resp.status,
+                             upstream=f"{host}:{port}")
                 if bandit:
                     # Implicit reward: server errors are failures.
                     gw.bandit.record(route.name, service,
@@ -267,6 +300,8 @@ def make_proxy_handler(gw):
                 self._relay_response(resp, tag_headers)
             finally:
                 conn.close()
+                if tl is not None:
+                    tl.close()  # idempotent; covers the error returns too
 
         def _mirror(self, route, path, body, headers):
             """Fire-and-forget request mirror (seldon shadow/outlier
@@ -323,8 +358,15 @@ def make_proxy_handler(gw):
                         return
                 self.send_response(resp.status)
                 for k, v in resp.getheaders():
-                    if k.lower() not in _HOP_HEADERS:
+                    # The request id on the wire is gateway-asserted
+                    # (same value the upstream echoed) — drop the
+                    # upstream copy so the client never sees it twice.
+                    if (k.lower() not in _HOP_HEADERS
+                            and k.lower() != "x-request-id"):
                         self.send_header(k, v)
+                rid = getattr(self, "_request_id", None)
+                if rid:
+                    self.send_header(REQUEST_ID_HEADER, rid)
                 for k, v in (extra_headers or {}).items():
                     self.send_header(k, v)
                 bodyless = (self.command == "HEAD"
@@ -409,7 +451,8 @@ def make_proxy_handler(gw):
             gw.tunnels_total += 1
             lines = [f"{self.command} {path} HTTP/1.1",
                      f"Host: {host}:{port}",
-                     f"X-Forwarded-Prefix: {route.prefix}"]
+                     f"X-Forwarded-Prefix: {route.prefix}",
+                     f"{REQUEST_ID_HEADER}: {self._request_id}"]
             if getattr(self, "_identity", None):
                 lines.append(f"X-Auth-Identity: {self._identity}")
             # Hop-by-hop headers are the handshake here — forward
@@ -418,7 +461,7 @@ def make_proxy_handler(gw):
             lines += [
                 f"{k}: {v}" for k, v in self.headers.items()
                 if k.lower() not in ("host", "x-forwarded-prefix",
-                                     "x-auth-identity")
+                                     "x-auth-identity", "x-request-id")
             ]
             try:
                 backend.sendall(

@@ -156,9 +156,21 @@ def tpu_serving(
                 {"name": "rest", "port": REST_PORT, "targetPort": REST_PORT},
             ],
             labels=labels,
-            annotations=gateway_route(
-                name, f"/models/{name}/", f"{name}.{namespace}:{REST_PORT}"
-            ),
+            # Gateway route + service-level scrape annotations: the
+            # prometheus service discovery (kubernetes-services job)
+            # scrapes replicas through the Service as well, so the
+            # decoder's histograms reach the autoscaler even when pod
+            # discovery is off.
+            annotations={
+                **gateway_route(
+                    name, f"/models/{name}/",
+                    f"{name}.{namespace}:{REST_PORT}"),
+                **({"prometheus.io/scrape": "true",
+                    "prometheus.io/path":
+                        "/monitoring/prometheus/metrics",
+                    "prometheus.io/port": str(REST_PORT)}
+                   if enable_prometheus else {}),
+            },
         ),
     ]
 

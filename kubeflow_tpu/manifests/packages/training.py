@@ -121,6 +121,14 @@ def training_operator(
             labels=labels,
             service_account=name,
             volumes=[k8s.config_map_volume("config", f"{name}-config")],
+            # The manager's HealthServer exposes the operator runtime
+            # registry (reconcile latency, workqueue depth/adds/retries,
+            # watch reopens, conflicts — labeled by kind) on :8443.
+            pod_annotations={
+                "prometheus.io/scrape": "true",
+                "prometheus.io/path": "/metrics",
+                "prometheus.io/port": "8443",
+            },
         )
     )
     return objs

@@ -1,2 +1,14 @@
-"""Observability runtimes: availability prober (metric-collector analogue,
-metric-collector/service-readiness/kubeflow-readiness.py)."""
+"""Observability: the platform's signal plane.
+
+- :mod:`kubeflow_tpu.observability.metrics` — the unified MetricRegistry
+  (Counter/Gauge/Histogram) and the ONE Prometheus exposition renderer
+  every ``/metrics`` surface serves through;
+- :mod:`kubeflow_tpu.observability.tracing` — ``X-Request-ID``
+  propagation, per-stream lifecycle timelines, ``/debug/requests`` and
+  chrome-trace export;
+- :mod:`kubeflow_tpu.observability.collector` — availability prober
+  (metric-collector analogue,
+  metric-collector/service-readiness/kubeflow-readiness.py);
+- :mod:`kubeflow_tpu.observability.lint` — promtool-style exposition
+  checker the CI metrics-lint stage runs against every live endpoint.
+"""

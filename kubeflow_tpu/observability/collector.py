@@ -23,25 +23,13 @@ import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+# Re-exported for the exporters (and tests) that historically imported
+# the renderer from here; the implementation — the ONE place that knows
+# the exposition text format — now lives in observability.metrics.
+from kubeflow_tpu.observability.metrics import render_prometheus
 from kubeflow_tpu.runtime import strip_glog_args
 
 log = logging.getLogger(__name__)
-
-
-def render_prometheus(metrics: dict) -> str:
-    """Render name→value pairs in Prometheus exposition format.
-
-    Names ending in ``_total`` are typed ``counter``, everything else
-    ``gauge`` — the shared rendering rule for every hand-rolled exporter
-    in the platform (this prober's /metrics, the model server's decoder
-    gauges), so there is exactly one place that knows the text format.
-    """
-    out = []
-    for name, value in metrics.items():
-        kind = "counter" if name.endswith("_total") else "gauge"
-        text = f"{value:.6f}" if isinstance(value, float) else str(value)
-        out.append(f"# TYPE {name} {kind}\n{name} {text}\n")
-    return "".join(out)
 
 
 class TokenClient:

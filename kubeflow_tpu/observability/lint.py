@@ -1,0 +1,322 @@
+"""Promtool-style Prometheus exposition checker (pure python).
+
+``lint(text)`` validates a scraped ``/metrics`` body against the
+exposition format the platform's single renderer
+(:mod:`kubeflow_tpu.observability.metrics`) is supposed to emit:
+
+- TYPE header lines well-formed, known kinds, at most one per family;
+- every sample belongs to a family declared BEFORE it (the bug class
+  this exists for: the old HealthServer typed every gauge ``counter``);
+- counter families named ``*_total``;
+- metric/label names legal, label values quoted with only legal escapes;
+- histogram series: ``le`` bounds strictly increasing, cumulative counts
+  non-decreasing, a ``+Inf`` bucket present and equal to ``_count``,
+  ``_sum``/``_count`` present.
+
+``python -m kubeflow_tpu.observability.lint --self-check`` is the CI
+stage (ci/metrics_lint.sh): it boots the model server, the gateway
+admin port, the availability prober and an operator HealthServer
+in-process, scrapes each endpoint over real HTTP, and fails on any
+violation — so a renderer regression can't reach a real Prometheus.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+import urllib.request
+
+_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*")
+_LABEL_RE = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*")
+_KINDS = {"counter", "gauge", "histogram", "summary", "untyped"}
+# Histogram/summary component suffixes resolve to their base family.
+_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _parse_value(token: str) -> float:
+    if token in ("+Inf", "Inf"):
+        return float("inf")
+    if token == "-Inf":
+        return float("-inf")
+    return float(token)  # NaN parses natively
+
+
+def _parse_sample(line: str) -> tuple[str, dict, float]:
+    """Parse ``name{label="v",...} value`` → (name, labels, value).
+    Raises ValueError on any malformation (bad name, bad escape,
+    unterminated quote, missing value)."""
+    m = _NAME_RE.match(line)
+    if m is None:
+        raise ValueError("sample does not start with a metric name")
+    name = m.group(0)
+    rest = line[m.end():]
+    labels: dict[str, str] = {}
+    if rest.startswith("{"):
+        i = 1
+        while True:
+            if i >= len(rest):
+                raise ValueError("unterminated label set")
+            if rest[i] == "}":
+                i += 1
+                break
+            lm = _LABEL_RE.match(rest, i)
+            if lm is None:
+                raise ValueError(f"bad label name at {rest[i:]!r}")
+            key = lm.group(0)
+            i = lm.end()
+            if i >= len(rest) or rest[i] != "=":
+                raise ValueError(f"label {key!r} missing '='")
+            i += 1
+            if i >= len(rest) or rest[i] != '"':
+                raise ValueError(f"label {key!r} value not quoted")
+            i += 1
+            out = []
+            while True:
+                if i >= len(rest):
+                    raise ValueError(f"label {key!r} unterminated quote")
+                ch = rest[i]
+                if ch == "\\":
+                    if i + 1 >= len(rest) or rest[i + 1] not in '\\"n':
+                        raise ValueError(
+                            f"label {key!r} has an illegal escape")
+                    out.append({"n": "\n"}.get(rest[i + 1], rest[i + 1]))
+                    i += 2
+                elif ch == '"':
+                    i += 1
+                    break
+                else:
+                    out.append(ch)
+                    i += 1
+            labels[key] = "".join(out)
+            if i < len(rest) and rest[i] == ",":
+                i += 1
+        rest = rest[i:]
+    parts = rest.split()
+    if not parts:
+        raise ValueError("sample has no value")
+    return name, labels, _parse_value(parts[0])
+
+
+def lint(text: str) -> list[str]:
+    """Validate one exposition body; returns a list of violations
+    (empty = clean)."""
+    errors: list[str] = []
+    declared: dict[str, str] = {}
+    # (family, labelkey) → list of (le, cumulative count), plus the
+    # matching _sum/_count samples for cross-checks.
+    buckets: dict[tuple, list[tuple[float, float]]] = {}
+    counts: dict[tuple, float] = {}
+    sums: dict[tuple, float] = {}
+
+    def family_of(name: str) -> str | None:
+        if name in declared:
+            return name
+        for suffix in _SUFFIXES:
+            base = name.removesuffix(suffix)
+            if (base != name and base in declared
+                    and declared[base] in ("histogram", "summary")):
+                return base
+        return None
+
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 2 and parts[1] == "TYPE":
+                if len(parts) < 4:
+                    errors.append(f"line {lineno}: malformed TYPE line")
+                    continue
+                name, kind = parts[2], parts[3].strip()
+                if _NAME_RE.fullmatch(name) is None:
+                    errors.append(
+                        f"line {lineno}: bad metric name {name!r}")
+                if kind not in _KINDS:
+                    errors.append(
+                        f"line {lineno}: unknown type {kind!r}")
+                if name in declared:
+                    errors.append(
+                        f"line {lineno}: duplicate TYPE for {name}")
+                if kind == "counter" and not name.endswith("_total"):
+                    errors.append(
+                        f"line {lineno}: counter {name} must end _total")
+                declared[name] = kind
+            continue
+        try:
+            name, labels, value = _parse_sample(line)
+        except ValueError as e:
+            errors.append(f"line {lineno}: {e}")
+            continue
+        fam = family_of(name)
+        if fam is None:
+            errors.append(
+                f"line {lineno}: sample {name} has no preceding TYPE")
+            continue
+        kind = declared[fam]
+        if kind in ("counter", "gauge") and name != fam:
+            errors.append(
+                f"line {lineno}: {kind} sample {name} != family {fam}")
+        if kind == "counter" and value < 0:
+            errors.append(f"line {lineno}: counter {name} is negative")
+        if kind == "histogram":
+            key = (fam, tuple(sorted(
+                (k, v) for k, v in labels.items() if k != "le")))
+            if name == f"{fam}_bucket":
+                if "le" not in labels:
+                    errors.append(
+                        f"line {lineno}: {name} sample missing le")
+                    continue
+                try:
+                    le = _parse_value(labels["le"])
+                except ValueError:
+                    errors.append(
+                        f"line {lineno}: unparseable le "
+                        f"{labels['le']!r}")
+                    continue
+                buckets.setdefault(key, []).append((le, value))
+            elif name == f"{fam}_count":
+                counts[key] = value
+            elif name == f"{fam}_sum":
+                sums[key] = value
+
+    for (fam, labelkey), series in buckets.items():
+        where = f"{fam}{dict(labelkey) if labelkey else ''}"
+        les = [le for le, _ in series]
+        if les != sorted(les) or len(set(les)) != len(les):
+            errors.append(f"{where}: le bounds not strictly increasing")
+        cum = [c for _, c in series]
+        if any(b < a for a, b in zip(cum, cum[1:])):
+            errors.append(f"{where}: bucket counts not cumulative")
+        if not les or les[-1] != float("inf"):
+            errors.append(f"{where}: missing +Inf bucket")
+        elif (fam, labelkey) in counts and cum[-1] != counts[
+                (fam, labelkey)]:
+            errors.append(f"{where}: +Inf bucket != _count")
+        if (fam, labelkey) not in counts:
+            errors.append(f"{where}: missing _count")
+        if (fam, labelkey) not in sums:
+            errors.append(f"{where}: missing _sum")
+    return errors
+
+
+def lint_url(url: str, timeout: float = 10.0) -> list[str]:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        text = resp.read().decode()
+    if not text.strip():
+        return [f"{url}: empty exposition body"]
+    return [f"{url}: {e}" for e in lint(text)]
+
+
+def _self_check() -> int:
+    """Boot every /metrics surface in-process and lint a real scrape of
+    each: model server (decoder driven once so histograms/timelines have
+    samples), gateway admin, availability prober, operator HealthServer.
+    """
+    import json
+    import socket
+    import threading
+
+    from kubeflow_tpu.gateway import Gateway, RouteTable
+    from kubeflow_tpu.observability.collector import (
+        AvailabilityProber,
+        make_server,
+    )
+    from kubeflow_tpu.operators.base import OPERATOR_METRICS, Controller
+    from kubeflow_tpu.runtime import HealthServer
+    from kubeflow_tpu.serving.engine import EngineConfig
+    from kubeflow_tpu.serving.server import ModelServer
+
+    failures: list[str] = []
+    stops = []
+    try:
+        # 1. Model server — one generation so the decoder's histograms,
+        # counters and trace ring all carry real samples.
+        server = ModelServer(
+            EngineConfig(model="lm-test-tiny", batch_size=2,
+                         max_seq_len=32, max_new_tokens=4),
+            port=0, batch_timeout_ms=2)
+        server.start()
+        stops.append(server.stop)
+        base = f"http://127.0.0.1:{server.port}"
+        req = urllib.request.Request(
+            f"{base}/v1/models/lm-test-tiny:predict", method="POST",
+            data=json.dumps({"instances": [
+                {"tokens": [1, 2, 3], "max_new_tokens": 4}]}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            resp.read()
+        failures += lint_url(f"{base}/monitoring/prometheus/metrics")
+
+        # 2. Gateway admin port.
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            admin_port = s.getsockname()[1]
+        gw = Gateway(RouteTable(), port=0, admin_port=admin_port,
+                     probe_interval=0)
+        gw.start()
+        stops.append(gw.stop)
+        failures += lint_url(f"http://127.0.0.1:{admin_port}/metrics")
+
+        # 3. Availability prober, probing the model server's front door.
+        prober = AvailabilityProber(f"{base}/healthz", interval=3600)
+        prober.probe_once()
+        phttpd = make_server(prober, 0)
+        threading.Thread(target=phttpd.serve_forever,
+                         daemon=True).start()
+        stops.append(phttpd.shutdown)
+        pport = phttpd.server_address[1]
+        failures += lint_url(f"http://127.0.0.1:{pport}/metrics")
+
+        # 4. An operator HealthServer over the shared runtime registry —
+        # one reconcile observed so the histogram has samples.
+        class _LintProbe(Controller):
+            api_version = "kubeflow-tpu.org/v1"
+            kind = "LintProbe"
+
+            def reconcile(self, obj):
+                return None
+
+        ctrl = _LintProbe(client=None)
+        ctrl._safe_reconcile({"metadata": {"name": "probe"}})
+        ctrl._enqueue(("ns", "probe"))
+        health = HealthServer(
+            0, lambda: {"kubeflow_tpu_controllers_running": 1},
+            registry=OPERATOR_METRICS)
+        health.start()
+        stops.append(health.stop)
+        failures += lint_url(f"http://127.0.0.1:{health.port}/metrics")
+    finally:
+        for stop in reversed(stops):
+            stop()
+    for failure in failures:
+        print(f"FAIL {failure}")
+    surfaces = "model-server, gateway-admin, prober, operator"
+    if failures:
+        print(f"metrics lint: {len(failures)} violation(s) across "
+              f"{surfaces}")
+        return 1
+    print(f"metrics lint ok ({surfaces})")
+    return 0
+
+
+def main(argv=None) -> int:
+    """``python -m kubeflow_tpu.observability.lint [--self-check] [url…]``
+    — lint live endpoints by URL, and/or the in-process self-check."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    rc = 0
+    if "--self-check" in argv:
+        argv.remove("--self-check")
+        rc = _self_check()
+    for url in argv:
+        failures = lint_url(url)
+        for failure in failures:
+            print(f"FAIL {failure}")
+        if failures:
+            rc = 1
+        else:
+            print(f"ok {url}")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -35,8 +35,34 @@ import time
 from typing import Hashable, Iterable
 
 from kubeflow_tpu.k8s.client import ApiError, K8sClient, retry_on_conflict
+from kubeflow_tpu.observability.metrics import MetricRegistry
 
 log = logging.getLogger(__name__)
+
+# Process-wide operator runtime registry, served by controller_main's
+# HealthServer: every controller in the manager lands its reconcile
+# latency, workqueue, watch and conflict signals here, labeled by kind —
+# so ONE scrape of the manager's /metrics sees the whole runtime.
+OPERATOR_METRICS = MetricRegistry()
+_M_RECONCILE = OPERATOR_METRICS.histogram(
+    "operator_reconcile_seconds",
+    "Reconcile call latency per kind", labels=("kind",))
+_M_ADDS = OPERATOR_METRICS.counter(
+    "operator_workqueue_adds_total",
+    "Keys enqueued (events, resyncs, requeues)", labels=("kind",))
+_M_RETRIES = OPERATOR_METRICS.counter(
+    "operator_workqueue_retries_total",
+    "Keys requeued under failure backoff", labels=("kind",))
+_M_DEPTH = OPERATOR_METRICS.gauge(
+    "operator_workqueue_depth",
+    "Keys currently pending in the workqueue", labels=("kind",))
+_M_REOPENS = OPERATOR_METRICS.counter(
+    "operator_watch_reopens_total",
+    "Dead watch streams reopened", labels=("kind",))
+_M_CONFLICTS = OPERATOR_METRICS.counter(
+    "operator_reconcile_conflicts_total",
+    "Reconciles lost to optimistic-concurrency conflicts (409)",
+    labels=("kind",))
 
 
 class RateLimiter:
@@ -154,6 +180,39 @@ class Controller:
         self._streams_lock = threading.Lock()
         self._pumps: list[threading.Thread] = []
 
+    @property
+    def _kind_label(self) -> str:
+        """Metric label for this controller. Resolved lazily (NOT at
+        __init__) because some controllers assign ``self.kind`` after
+        ``super().__init__`` (JobController's per-kind instances)."""
+        return self.kind or type(self).__name__
+
+    @property
+    def _m_reconcile(self):
+        return _M_RECONCILE.labels(self._kind_label)
+
+    @property
+    def _m_depth(self):
+        return _M_DEPTH.labels(self._kind_label)
+
+    @property
+    def _m_reopens(self):
+        return _M_REOPENS.labels(self._kind_label)
+
+    @property
+    def _m_conflicts(self):
+        return _M_CONFLICTS.labels(self._kind_label)
+
+    def _enqueue(self, key: Hashable, delay: float = 0.0, *,
+                 retry: bool = False) -> None:
+        """All queue adds route through here so the workqueue counters
+        and the depth gauge can't drift from the queue itself."""
+        _M_ADDS.labels(self._kind_label).inc()
+        if retry:
+            _M_RETRIES.labels(self._kind_label).inc()
+        self._queue.add(key, delay)
+        self._m_depth.set(len(self._queue))
+
     # -- to implement -------------------------------------------------------
 
     def reconcile(self, obj: dict) -> float | None:
@@ -182,16 +241,20 @@ class Controller:
 
     def _safe_reconcile(self, obj: dict) -> None:
         name = obj.get("metadata", {}).get("name", "?")
+        t0 = time.perf_counter()
         try:
             self.reconcile(obj)
         except ApiError as e:
             if e.code == 409:
                 # Optimistic-concurrency loss: requeued by the caller.
+                self._m_conflicts.inc()
                 log.debug("%s/%s conflict, will retry", self.kind, name)
             else:
                 log.exception("%s/%s reconcile failed", self.kind, name)
         except Exception:
             log.exception("%s/%s reconcile failed", self.kind, name)
+        finally:
+            self._m_reconcile.observe(time.perf_counter() - t0)
 
     def _push_status(self, obj: dict) -> dict | None:
         """Write ``obj``'s status onto the live object, refetching and
@@ -247,6 +310,7 @@ class Controller:
                     next_resync = now + (self.resync_seconds if ok else 0.5)
                 key = self._queue.get(
                     timeout=max(min(next_resync - now, 0.2), 0.01))
+                self._m_depth.set(len(self._queue))
                 if key is not None:
                     self._process(key)
         finally:
@@ -259,7 +323,7 @@ class Controller:
     def _enqueue_all(self) -> bool:
         try:
             for obj in self.client.list(self.api_version, self.kind):
-                self._queue.add(self._key(obj))
+                self._enqueue(self._key(obj))
             return True
         except ApiError as e:
             log.debug("%s: resync list failed (%s), retrying", self.kind, e)
@@ -298,6 +362,7 @@ class Controller:
             if self._stop.is_set():
                 return
             reconnecting = True
+            self._m_reopens.inc()
             if events_seen:
                 backoff = self.watch_reopen_base_seconds
             log.debug("%s: watch %s dropped after %d events; reopening",
@@ -317,11 +382,11 @@ class Controller:
                     log.exception("%s/%s reconcile_deleted failed",
                                   self.kind, key[1])
             else:
-                self._queue.add(key)
+                self._enqueue(key)
         else:
             for ref in obj.get("metadata", {}).get("ownerReferences", []):
                 if ref.get("kind") == self.kind:
-                    self._queue.add(
+                    self._enqueue(
                         (obj["metadata"].get("namespace", "") or "",
                          ref["name"]))
 
@@ -333,28 +398,32 @@ class Controller:
         except Exception as e:
             log.debug("%s/%s fetch failed (%s), backing off",
                       self.kind, name, e)
-            self._queue.add(key, self._limiter.when(key))
+            self._enqueue(key, self._limiter.when(key), retry=True)
             return
         if obj is None:
             self._limiter.forget(key)
             return
+        t0 = time.perf_counter()
         try:
             result = self.reconcile(obj)
         except ApiError as e:
             if e.code == 409:
+                self._m_conflicts.inc()
                 log.debug("%s/%s conflict, backing off", self.kind, name)
             else:
                 log.warning("%s/%s reconcile failed (%s), backing off",
                             self.kind, name, e)
-            self._queue.add(key, self._limiter.when(key))
+            self._enqueue(key, self._limiter.when(key), retry=True)
         except Exception:
             log.exception("%s/%s reconcile failed, backing off",
                           self.kind, name)
-            self._queue.add(key, self._limiter.when(key))
+            self._enqueue(key, self._limiter.when(key), retry=True)
         else:
             self._limiter.forget(key)
             if isinstance(result, (int, float)) and result > 0:
-                self._queue.add(key, float(result))
+                self._enqueue(key, float(result))
+        finally:
+            self._m_reconcile.observe(time.perf_counter() - t0)
 
     def stop(self) -> None:
         self._stop.set()

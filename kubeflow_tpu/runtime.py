@@ -24,6 +24,7 @@ from kubeflow_tpu.k8s.client import (
     K8sClient,
     KindRegistry,
 )
+from kubeflow_tpu.observability.metrics import MetricRegistry, render_prometheus
 
 log = logging.getLogger(__name__)
 
@@ -102,15 +103,28 @@ def client_from_args(args) -> K8sClient:
 class HealthServer:
     """`/healthz` + `/metrics` sidecar port every manager binary exposes (the
     promhttp `/metrics` contract, bootstrap/cmd/bootstrap/app/ksServer.go:1460).
+
+    ``/metrics`` serves through the shared observability renderer: the
+    optional ``registry`` (labeled counters/gauges/histograms — the
+    operator runtime's reconcile/workqueue instrumentation) plus the
+    ``metrics_fn`` dict typed by the ``_total``-suffix rule. That rule
+    replaces the old handler, which stamped EVERY metric ``counter`` —
+    queue depths and running-controller gauges were mislabeled.
     """
 
-    def __init__(self, port: int, metrics_fn: Callable[[], dict] | None = None):
+    def __init__(self, port: int, metrics_fn: Callable[[], dict] | None = None,
+                 registry: MetricRegistry | None = None):
         self.port = port
         self._metrics_fn = metrics_fn or (lambda: {})
+        self._registry = registry
         self._httpd: ThreadingHTTPServer | None = None
 
+    def render_metrics(self) -> str:
+        text = self._registry.render() if self._registry is not None else ""
+        return text + render_prometheus(self._metrics_fn())
+
     def start(self) -> None:
-        metrics_fn = self._metrics_fn
+        server = self
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):
@@ -120,11 +134,7 @@ class HealthServer:
                 if self.path in ("/healthz", "/readyz", "/livez"):
                     body, ctype = b'{"status":"ok"}', "application/json"
                 elif self.path == "/metrics":
-                    lines = []
-                    for k, v in metrics_fn().items():
-                        lines.append(f"# TYPE {k} counter")
-                        lines.append(f"{k} {v}")
-                    body = ("\n".join(lines) + "\n").encode()
+                    body = server.render_metrics().encode()
                     ctype = "text/plain"
                 else:
                     self.send_response(404)
@@ -137,6 +147,7 @@ class HealthServer:
                 self.wfile.write(body)
 
         self._httpd = ThreadingHTTPServer(("0.0.0.0", self.port), Handler)
+        self.port = self._httpd.server_address[1]  # resolve port 0
         threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
 
     def stop(self) -> None:
@@ -183,12 +194,17 @@ def controller_main(
                           "controllers": len(controllers)}))
         return 0
 
-    from kubeflow_tpu.operators.base import run_controllers
+    from kubeflow_tpu.operators.base import OPERATOR_METRICS, run_controllers
 
     health = None
     if args.metrics_port:
         counts = {"kubeflow_tpu_controllers_running": len(controllers)}
-        health = HealthServer(args.metrics_port, lambda: counts)
+        # The shared operator registry carries every controller's
+        # reconcile-latency histogram and workqueue/watch counters,
+        # labeled by kind — the runtime signals the cluster scheduler
+        # and autoscaler policies consume.
+        health = HealthServer(args.metrics_port, lambda: counts,
+                              registry=OPERATOR_METRICS)
         health.start()
     elector = None
     lost_leadership = False

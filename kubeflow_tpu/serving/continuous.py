@@ -71,6 +71,8 @@ from kubeflow_tpu.models.decode import (
     store_prefix_row,
     verify_chunk,
 )
+from kubeflow_tpu.observability.metrics import MetricRegistry
+from kubeflow_tpu.observability.tracing import TraceStore
 from kubeflow_tpu.serving.engine import pow2_bucket
 from kubeflow_tpu.serving.kv_allocator import (
     BlockAllocator,
@@ -109,6 +111,12 @@ class _Request:
     submit_t: float = field(default_factory=time.perf_counter)
     ttft_s: float | None = None
     finish_reason: str = "length"
+    # Request-scoped trace: id propagated from the gateway (or minted at
+    # submit) + the lifecycle timeline recorded into the decoder's
+    # TraceStore. last_emit_t feeds the inter-token histogram.
+    request_id: str = ""
+    timeline: object | None = None
+    last_emit_t: float | None = None
 
     def resolve_prefill_logits(self) -> np.ndarray | None:
         if self.prefill_logits is None and self.prefill_src is not None:
@@ -353,6 +361,34 @@ class ContinuousDecoder:
         # from a CONSISTENT snapshot, never from a torn sum/count pair
         # mid-update. Leaf lock: never acquired while holding it.
         self._mlock = threading.Lock()
+        # Latency *distributions* (the autoscaler/scheduler signals
+        # averages can't carry): TTFT, inter-token gap, device dispatch
+        # duration by kind, queue wait, and per-dispatch batch occupancy.
+        # Rendered by the model server's /monitoring exposition; quantile
+        # estimates surface in metrics() (p50/p90/p99).
+        self.registry = MetricRegistry()
+        self._h_ttft = self.registry.histogram(
+            "serving_ttft_seconds", "Submit to first emitted token")
+        self._h_itl = self.registry.histogram(
+            "serving_inter_token_seconds",
+            "Host-side gap between a stream's token arrivals")
+        self._h_queue_wait = self.registry.histogram(
+            "serving_queue_wait_seconds",
+            "Submit to slot admission (includes memory deferrals)")
+        self._h_dispatch = self.registry.histogram(
+            "serving_dispatch_seconds",
+            "Device round-trip duration", labels=("kind",))
+        occ_bounds, b = [], 1
+        while b < slots:
+            occ_bounds.append(b)
+            b *= 2
+        occ_bounds.append(slots)
+        self._h_occupancy = self.registry.histogram(
+            "serving_batch_occupancy",
+            "Active slots per decode dispatch", buckets=occ_bounds)
+        # Per-stream lifecycle timelines, bounded ring, served at the
+        # model server's /debug/requests (JSON + chrome-trace export).
+        self.trace = TraceStore()
         self._ramp_streak = 0  # consecutive admission-only rounds
         if self.prefix_cache is not None and self._alloc is not None:
             # Trie evictions must return the entry's refcounted blocks
@@ -364,16 +400,26 @@ class ContinuousDecoder:
     # ------------------------------------------------------------------
 
     def submit(self, tokens: list[int], max_new_tokens: int,
-               temperature: float = 0.0) -> StreamHandle:
+               temperature: float = 0.0, *,
+               request_id: str | None = None) -> StreamHandle:
         if len(tokens) > self.prefill_len:
             tokens = tokens[: self.prefill_len]
         req = _Request(tokens=list(tokens),
                        want=min(max_new_tokens, self.max_new_tokens),
                        temperature=float(temperature))
+        # Lifecycle timeline, keyed by the propagated X-Request-ID (or a
+        # fresh one): submit marks t=0, queued marks entry to the pending
+        # deque — every later phase hangs off these two anchors.
+        req.timeline = self.trace.start(request_id)
+        req.request_id = req.timeline.request_id
+        req.timeline.event("submit", prompt_tokens=len(req.tokens),
+                           want=req.want)
         with self._cv:
             if self._stopped:
+                req.timeline.close(error=RuntimeError("decoder is stopped"))
                 raise RuntimeError("decoder is stopped")
             self._pending.append(req)
+            req.timeline.event("queued", depth=len(self._pending))
             self._cv.notify()
         return StreamHandle(req, self.stream_timeout_s)
 
@@ -403,6 +449,11 @@ class ContinuousDecoder:
             return
         req.error = error
         req.finish_reason = reason if error is None else "error"
+        if req.timeline is not None:
+            # Every finish path funnels here, so a closed request can
+            # never leak an open timeline — the invariant the chaos
+            # (_fail_all) test pins.
+            req.timeline.close(req.finish_reason, error=error)
         req.stream.put(_DONE)
         req.done.set()
 
@@ -434,16 +485,21 @@ class ContinuousDecoder:
             if blocks:
                 self._table[slot, :] = self._alloc.num_blocks
 
-    def _reclaim_blocks(self, need: int) -> None:
+    def _reclaim_blocks(self, need: int, timeline=None) -> None:
         """Evict unpinned prefix-cache entries (LRU first) until ``need``
         blocks are free — cache-held blocks are reclaimable memory, not
         reservations, so admission pressure beats cold cache entries.
-        Caller holds the prefix lock."""
+        Caller holds the prefix lock. Evictions forced by an admission
+        land on that request's timeline."""
         if self.prefix_cache is None:
             return
+        evicted = 0
         while self._alloc.free_blocks < need:
             if not self.prefix_cache.evict_lru():
                 break
+            evicted += 1
+        if evicted and timeline is not None:
+            timeline.event("kv_evict", entries=evicted)
 
     def _admit_batch(self, pending: list[tuple[_Request, int]]) -> None:
         """Admit a round's pending requests in ONE dispatch that fuses
@@ -481,6 +537,7 @@ class ContinuousDecoder:
         # second plain-admit executable would surprise-compile
         # mid-traffic). The paged twin reads each slot's block-table row
         # (allocated at pop time) instead of scattering into dense rows.
+        t_disp = time.perf_counter()
         with self._state_lock:
             if self._alloc is not None:
                 self._state["block_table"] = jnp.asarray(self._table)
@@ -506,8 +563,13 @@ class ContinuousDecoder:
         # per-request resolver — eager [K, V] fetches each admission
         # round cost more tunnel time than the decode itself.
         tok_np, emit_np = jax.device_get((tok, emit))
+        self._h_dispatch.labels("admit").observe(
+            time.perf_counter() - t_disp)
         for i, (req, slot) in enumerate(pending):
             req.prefill_src = (last, i)
+            if req.timeline is not None:
+                req.timeline.event("prefill", tokens=len(req.tokens),
+                                   bucket=t)
             self._post_admit(req, slot)
         # The fused decode step's tokens (new rows' first token AND
         # every peer row's next token) — routed after _post_admit so
@@ -570,6 +632,7 @@ class ContinuousDecoder:
         suffix = req.tokens[prefix_len:]
         toks = np.zeros((1, s), np.int32)
         toks[0, : len(suffix)] = suffix
+        t_disp = time.perf_counter()
         if self._alloc is not None:
             # The pop-time reservation already mapped the donor's FULL
             # prefix blocks into this slot by refcount — zero device
@@ -616,7 +679,12 @@ class ContinuousDecoder:
             self.prefix_suffix_tokens += len(suffix)
             self.prefill_tokens += len(suffix)
         tok_np, emit_np = jax.device_get((tok, emit))
+        self._h_dispatch.labels("admit").observe(
+            time.perf_counter() - t_disp)
         req.prefill_src = (last, 0)
+        if req.timeline is not None:
+            req.timeline.event("prefill", tokens=len(suffix),
+                               prefix_reused=prefix_len, bucket=s)
         self._post_admit(req, slot)
         self.steps += 1
         self._dispatch(tok_np, emit_np)
@@ -729,6 +797,16 @@ class ContinuousDecoder:
             self.prefill_tokens += len(toks)  # priming IS a prefill
             return True
 
+    def _mark_admitted(self, req: _Request, slot: int) -> None:
+        """Record the pop→slot transition: queue-wait histogram + the
+        timeline's admitted event (deferral rounds stretch this wait —
+        exactly the signal the admission instrumentation must carry)."""
+        wait = time.perf_counter() - req.submit_t
+        self._h_queue_wait.observe(wait)
+        if req.timeline is not None:
+            req.timeline.event("admitted", slot=slot,
+                               wait_ms=round(1e3 * wait, 3))
+
     def _post_admit(self, req: _Request, slot: int) -> None:
         if req.want == 0:
             # Pure prefill (caller wants last-position logits only): the row
@@ -764,6 +842,15 @@ class ContinuousDecoder:
                 req.ttft_s = now - req.submit_t
                 ttft_sum += req.ttft_s
                 ttft_n += 1
+                self._h_ttft.observe(req.ttft_s)
+                if req.timeline is not None:
+                    req.timeline.event("first_token")
+            else:
+                if req.last_emit_t is not None:
+                    self._h_itl.observe(now - req.last_emit_t)
+                if req.timeline is not None:
+                    req.timeline.event("dispatch", tokens=1)
+            req.last_emit_t = now
             req.stream.put(tok)
             emitted_n += 1
             hit_eos = self.eos_id is not None and tok == self.eos_id
@@ -793,6 +880,8 @@ class ContinuousDecoder:
             if req is None or not emitted[slot, 0]:
                 continue
             last_tok = None
+            row_emitted = 0
+            first_here = req.ttft_s is None
             for j in range(toks.shape[1]):
                 if not emitted[slot, j]:
                     break
@@ -802,8 +891,18 @@ class ContinuousDecoder:
                     req.ttft_s = now - req.submit_t
                     ttft_sum += req.ttft_s
                     ttft_n += 1
+                    self._h_ttft.observe(req.ttft_s)
+                    if req.timeline is not None:
+                        req.timeline.event("first_token")
                 req.stream.put(last_tok)
                 emitted_n += 1
+                row_emitted += 1
+            if row_emitted:
+                if req.last_emit_t is not None:
+                    self._h_itl.observe(now - req.last_emit_t)
+                req.last_emit_t = now
+                if req.timeline is not None and not first_here:
+                    req.timeline.event("dispatch", tokens=row_emitted)
             hit_eos = self.eos_id is not None and last_tok == self.eos_id
             if hit_eos or len(req.out) >= req.want:
                 self._publish_prefix(req, slot)
@@ -873,6 +972,8 @@ class ContinuousDecoder:
                 dlens[s, slot] = len(seg)
         if not dlens.any():
             return False
+        self._h_occupancy.observe(self._active_count)
+        t_disp = time.perf_counter()
         with self._state_lock:
             self._state, outs, emits = verify_chunk(
                 self._state, self.params, self.cfg, jnp.asarray(drafts),
@@ -884,6 +985,8 @@ class ContinuousDecoder:
             self.steps += 2 * steps  # scoring + commit forward per verify
         self._ramp_streak = 0
         outs, emits = jax.device_get((outs, emits))
+        self._h_dispatch.labels("verify").observe(
+            time.perf_counter() - t_disp)
         for s in range(steps):
             # Accounting before routing: routing may free the slot.
             drafted, accepted = 0, 0
@@ -953,7 +1056,9 @@ class ContinuousDecoder:
                     if self._slot_req[slot] is not None:
                         continue
                     if self._alloc is None:
-                        pending.append((self._pending.popleft(), slot))
+                        req = self._pending.popleft()
+                        self._mark_admitted(req, slot)
+                        pending.append((req, slot))
                         continue
                     # Memory-aware admission: a request enters only when
                     # its WORST-CASE block count fits the pool (so the
@@ -982,13 +1087,17 @@ class ContinuousDecoder:
                                     if plan is not None else 0)
                         need = worst - n_shared
                         with self._prefix_lock:
-                            self._reclaim_blocks(need)
+                            self._reclaim_blocks(need, req.timeline)
                             headroom = self._alloc.free_blocks - need
                             busy = self._active_count > 0 or pending
                             if headroom < (self.kv_low_watermark
                                            if busy else 0):
                                 if plan is not None:
                                     self.prefix_cache.release(plan[0])
+                                if req.timeline is not None:
+                                    req.timeline.event(
+                                        "deferred", need=need,
+                                        free=self._alloc.free_blocks)
                                 deferred = True
                                 break
                             own = self._alloc.alloc(need)
@@ -1003,7 +1112,9 @@ class ContinuousDecoder:
                         blocks = shared + own
                         self._slot_blocks[slot] = blocks
                         self._set_table_row(slot, blocks)
-                        pending.append((self._pending.popleft(), slot))
+                        self._pending.popleft()
+                        self._mark_admitted(req, slot)
+                        pending.append((req, slot))
                         break
                 if deferred:
                     with self._mlock:
@@ -1058,6 +1169,8 @@ class ContinuousDecoder:
                     continue
                 if self._spec is not None and self._spec_round():
                     continue
+                self._h_occupancy.observe(self._active_count)
+                t_disp = time.perf_counter()
                 if self.chunk_size > 1:
                     with self._state_lock:
                         self._state, toks, emitted = decode_chunk(
@@ -1070,6 +1183,8 @@ class ContinuousDecoder:
                         self.dispatches += 1
                     self._ramp_streak = 0
                     toks, emitted = jax.device_get((toks, emitted))
+                    self._h_dispatch.labels("decode").observe(
+                        time.perf_counter() - t_disp)
                     for k in range(self.chunk_size):
                         self._dispatch(toks[k], emitted[k])
                 else:
@@ -1081,7 +1196,10 @@ class ContinuousDecoder:
                     with self._mlock:
                         self.steps += 1
                         self.dispatches += 1
-                    self._dispatch(*jax.device_get((toks, emitted)))
+                    toks, emitted = jax.device_get((toks, emitted))
+                    self._h_dispatch.labels("decode").observe(
+                        time.perf_counter() - t_disp)
+                    self._dispatch(toks, emitted)
             except Exception as e:
                 # A failed prefill/decode/verify may have invalidated
                 # self._state (the jitted calls donate its buffers), so
@@ -1115,6 +1233,7 @@ class ContinuousDecoder:
                 "tokens_emitted": self.tokens_emitted,
                 "ttft_avg_s": (self.ttft_sum / self.ttft_count
                                if self.ttft_count else 0.0),
+                "trace_open": self.trace.open_count,
                 "in_flight": self._active_count,
                 "peak_in_flight": self.peak_in_flight,
                 "queued": len(self._pending),
@@ -1161,4 +1280,13 @@ class ContinuousDecoder:
                                        if self._alloc else 0)
             snap["kv_bytes_total"] = (self._alloc.bytes_total
                                       if self._alloc else 0)
+        # Histogram-backed latency quantiles (ttft_avg_s above stays for
+        # backward compatibility — bench_serving.py and dashboards read
+        # it — but the distribution is what autoscaling policies need).
+        # Histogram locks are leaves, taken outside the snapshot locks.
+        for key, hist in (("ttft", self._h_ttft),
+                          ("inter_token", self._h_itl),
+                          ("queue_wait", self._h_queue_wait)):
+            for q, tag in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
+                snap[f"{key}_{tag}_s"] = hist.quantile(q)
         return snap

@@ -18,36 +18,42 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from kubeflow_tpu.observability.metrics import (
+    MetricRegistry,
+    render_prometheus,
+)
+from kubeflow_tpu.observability.tracing import (
+    REQUEST_ID_HEADER,
+    gen_request_id,
+    render_debug,
+)
 from kubeflow_tpu.serving.batcher import DynamicBatcher
 from kubeflow_tpu.serving.engine import EngineConfig, InferenceEngine
 
 
 class _Metrics:
+    """Server-level request metrics on the shared registry: request and
+    error counters plus a latency *histogram* (the old renderer exposed a
+    sum/count summary — no percentiles, and its own copy of the text
+    format)."""
+
     def __init__(self) -> None:
-        self.lock = threading.Lock()
-        self.requests = 0
-        self.errors = 0
-        self.latency_sum = 0.0
-        self.latency_count = 0
+        self.registry = MetricRegistry()
+        self._requests = self.registry.counter(
+            "serving_requests_total", "HTTP requests handled")
+        self._errors = self.registry.counter(
+            "serving_errors_total", "HTTP requests that failed")
+        self._latency = self.registry.histogram(
+            "serving_latency_seconds", "End-to-end request latency")
 
     def observe(self, seconds: float, error: bool) -> None:
-        with self.lock:
-            self.requests += 1
-            self.errors += int(error)
-            self.latency_sum += seconds
-            self.latency_count += 1
+        self._requests.inc()
+        if error:
+            self._errors.inc()
+        self._latency.observe(seconds)
 
     def render(self) -> str:
-        with self.lock:
-            return (
-                "# TYPE serving_requests_total counter\n"
-                f"serving_requests_total {self.requests}\n"
-                "# TYPE serving_errors_total counter\n"
-                f"serving_errors_total {self.errors}\n"
-                "# TYPE serving_latency_seconds summary\n"
-                f"serving_latency_seconds_sum {self.latency_sum:.6f}\n"
-                f"serving_latency_seconds_count {self.latency_count}\n"
-            )
+        return self.registry.render()
 
 
 class ModelServer:
@@ -111,7 +117,8 @@ class ModelServer:
 
     # ------------------------------------------------------------------
 
-    def handle_predict(self, name: str, body: dict) -> dict:
+    def handle_predict(self, name: str, body: dict,
+                       request_id: str | None = None) -> dict:
         if name != self.engine.cfg.model:
             raise KeyError(f"model {name!r} not served")
         instances = body.get("instances")
@@ -123,11 +130,16 @@ class ModelServer:
         # lengths are decoupled — a short request returns as soon as ITS
         # tokens are done); plain predicts coalesce in the dynamic batcher.
         handles = []
-        for inst in instances:
+        for i, inst in enumerate(instances):
             if inst.get("max_new_tokens") and self.decoder is not None:
+                # One HTTP request id; multi-instance bodies suffix the
+                # instance index so each stream's timeline stays unique.
+                rid = (request_id if request_id and i == 0
+                       else f"{request_id}-{i}" if request_id else None)
                 handles.append(("gen", inst, self.decoder.submit(
                     inst["tokens"], inst["max_new_tokens"],
                     float(inst.get("temperature", 0.0)),
+                    request_id=rid,
                 )))
             else:
                 handles.append(("batch", inst,
@@ -159,7 +171,8 @@ class ModelServer:
             pred["logits"] = res["prefill_logits"].tolist()
         return pred
 
-    def handle_predict_stream(self, name: str, body: dict):
+    def handle_predict_stream(self, name: str, body: dict,
+                              request_id: str | None = None):
         """Streaming generation: yields JSON-line dicts, one per token, then
         a terminal ``{"done": true, ...}`` record. Exactly one instance per
         stream (the chunked-HTTP / gRPC-stream unit is a single sequence)."""
@@ -177,6 +190,7 @@ class ModelServer:
         handle = self.decoder.submit(
             inst["tokens"], inst["max_new_tokens"],
             float(inst.get("temperature", 0.0)),
+            request_id=request_id,
         )
 
         # Validation above runs eagerly (before the HTTP 200 goes out); only
@@ -217,6 +231,9 @@ class ModelServer:
                     else json.dumps(payload)
                 ).encode()
                 self.send_response(code)
+                rid = getattr(self, "_request_id", None)
+                if rid:
+                    self.send_header(REQUEST_ID_HEADER, rid)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
@@ -231,13 +248,14 @@ class ModelServer:
                 elif self.path == "/monitoring/prometheus/metrics":
                     text = server.metrics.render()
                     if server._decoder is not None:
-                        # One rendering rule for every exporter: the
-                        # observability collector's helper (counters by
-                        # _total suffix, gauges otherwise).
-                        from kubeflow_tpu.observability.collector import \
-                            render_prometheus
-
+                        # One renderer for every exporter: the decoder's
+                        # registry carries the latency histograms
+                        # (TTFT, inter-token, dispatch, queue wait,
+                        # occupancy); the dict below maps its counter
+                        # snapshot (counters by _total suffix, gauges
+                        # otherwise).
                         d = server._decoder.metrics()
+                        text += server._decoder.registry.render()
                         text += render_prometheus({
                             "serving_decode_steps_total": d["decode_steps"],
                             "serving_decode_dispatches_total":
@@ -295,6 +313,17 @@ class ModelServer:
                             "serving_queued": d["queued"],
                         })
                     self._send(200, text, content_type="text/plain")
+                elif self.path.partition("?")[0] == "/debug/requests":
+                    # One curl away: the decoder's per-stream lifecycle
+                    # timelines (JSON; ?format=chrome for a
+                    # chrome://tracing file; ?id=<rid> filters).
+                    if server._decoder is None:
+                        self._send(200, {"open": [], "finished": []})
+                    else:
+                        body, ctype = render_debug(
+                            server._decoder.trace,
+                            self.path.partition("?")[2])
+                        self._send(200, body.decode(), content_type=ctype)
                 elif self.path.startswith("/v1/models/"):
                     name = self.path[len("/v1/models/"):]
                     try:
@@ -324,6 +353,9 @@ class ModelServer:
                 decoder failure becomes an error record + clean terminal
                 chunk, never a second status line."""
                 self.send_response(200)
+                rid = getattr(self, "_request_id", None)
+                if rid:
+                    self.send_header(REQUEST_ID_HEADER, rid)
                 self.send_header("Content-Type", "application/jsonlines")
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
@@ -339,6 +371,11 @@ class ModelServer:
             def do_POST(self):
                 t0 = time.perf_counter()
                 error = False
+                # Request id: honor the gateway's (or the client's),
+                # mint one otherwise; echoed on every response and keyed
+                # into the decoder's timeline for this stream.
+                self._request_id = (self.headers.get(REQUEST_ID_HEADER)
+                                    or gen_request_id())
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     body = json.loads(self.rfile.read(length) or b"{}")
@@ -347,10 +384,14 @@ class ModelServer:
                         name = self.path[len("/v1/models/"):-len(":predict")]
                         if body.get("stream"):
                             self._send_stream(
-                                server.handle_predict_stream(name, body)
+                                server.handle_predict_stream(
+                                    name, body,
+                                    request_id=self._request_id)
                             )
                         else:
-                            self._send(200, server.handle_predict(name, body))
+                            self._send(200, server.handle_predict(
+                                name, body,
+                                request_id=self._request_id))
                     else:
                         error = True
                         self._send(404, {"error": f"no route {self.path}"})

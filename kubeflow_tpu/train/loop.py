@@ -17,6 +17,7 @@ from dataclasses import dataclass, field
 import jax
 
 from kubeflow_tpu.models.registry import get_model
+from kubeflow_tpu.observability.metrics import Histogram
 from kubeflow_tpu.parallel.distributed import global_any, initialize_from_env
 from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
 from kubeflow_tpu.train import checkpoint as ckpt_lib
@@ -196,6 +197,10 @@ def _train(cfg, info, model, mesh, opt_cfg, state, start_step, ckpt,
     host_wait_total = 0.0
     host_wait_since = 0.0
     step_time_ema = None
+    # Step-time distribution riding the stall accounting: the EMA hides
+    # stragglers; the histogram's p50/p99 expose them (the signal a gang
+    # scheduler needs to spot a slow replica).
+    step_hist = Histogram()
     steps_done = 0
     profiling = False
     preempted_at = None
@@ -224,6 +229,7 @@ def _train(cfg, info, model, mesh, opt_cfg, state, start_step, ckpt,
             steps_done += 1
             samples_since += samples_per_step
             step_time = time.perf_counter() - t_step
+            step_hist.observe(step_time)
             step_time_ema = (step_time if step_time_ema is None
                              else 0.9 * step_time_ema + 0.1 * step_time)
             if (step + 1) % cfg.log_every == 0 or step + 1 == cfg.steps:
@@ -292,6 +298,8 @@ def _train(cfg, info, model, mesh, opt_cfg, state, start_step, ckpt,
         "host_wait_ms_per_step": round(
             1e3 * host_wait_total / max(steps_done, 1), 3),
         "step_time_ema_ms": round(1e3 * (step_time_ema or 0.0), 3),
+        "step_time_p50_ms": round(1e3 * step_hist.quantile(0.5), 3),
+        "step_time_p99_ms": round(1e3 * step_hist.quantile(0.99), 3),
         "prefetch_depth": cfg.prefetch,
         "accum_steps": cfg.accum_steps,
     }

@@ -1036,3 +1036,134 @@ def test_malformed_upstream_content_length_is_502():
     finally:
         gw.stop()
         backend.close()
+
+
+def test_request_id_generated_preserved_echoed_forwarded():
+    """Observability satellite: the gateway's X-Request-ID contract over
+    raw sockets — generated when the client sent none, preserved when
+    present, echoed exactly once on the response, and forwarded to the
+    upstream."""
+    import socket
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from kubeflow_tpu.gateway import Route
+
+    seen_ids = []
+
+    class Capture(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            seen_ids.append(self.headers.get("X-Request-ID"))
+            body = b'{"ok": true}'
+            self.send_response(200)
+            # The upstream echoes the id too (the model server does);
+            # the gateway must de-duplicate, not relay a second copy.
+            self.send_header("X-Request-ID",
+                             self.headers.get("X-Request-ID", ""))
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    backend = ThreadingHTTPServer(("127.0.0.1", 0), Capture)
+    threading.Thread(target=backend.serve_forever, daemon=True).start()
+    table = RouteTable()
+    table.set_routes([Route(
+        name="m", prefix="/m/",
+        service=f"127.0.0.1:{backend.server_address[1]}")])
+    gw = Gateway(table, port=0, admin_port=0)
+    gw.start()
+
+    def raw_get(extra_header=""):
+        port = gw._proxy.server_address[1]
+        client = socket.create_connection(("127.0.0.1", port), timeout=10)
+        client.sendall((
+            f"GET /m/x HTTP/1.1\r\nHost: 127.0.0.1:{port}\r\n"
+            f"{extra_header}Connection: close\r\n\r\n").encode())
+        resp = b""
+        while True:
+            chunk = client.recv(4096)
+            if not chunk:
+                break
+            resp += chunk
+        client.close()
+        head = resp.split(b"\r\n\r\n", 1)[0].decode()
+        rid_lines = [ln.split(":", 1)[1].strip()
+                     for ln in head.split("\r\n")
+                     if ln.lower().startswith("x-request-id:")]
+        return head, rid_lines
+
+    try:
+        # Absent → generated: response carries exactly one non-empty id,
+        # and it is the same id the upstream received.
+        _head, rids = raw_get()
+        assert len(rids) == 1 and rids[0], rids
+        assert seen_ids == [rids[0]]
+
+        # Present → preserved verbatim, echoed, forwarded.
+        _head, rids = raw_get("X-Request-ID: client-chosen-42\r\n")
+        assert rids == ["client-chosen-42"]
+        assert seen_ids[-1] == "client-chosen-42"
+
+        # The gateway's own (non-proxied) responses echo too.
+        _head, rids = raw_get("X-Request-ID: health-7\r\n")
+        assert rids == ["health-7"]
+    finally:
+        gw.stop()
+        backend.shutdown()
+
+
+def test_single_request_traced_gateway_server_decoder(platform):
+    """Acceptance criterion: one request through gateway → model server
+    → decoder yields ONE request id everywhere, and the decoder
+    timeline's span sum matches the observed end-to-end latency within
+    measurement noise."""
+    import time
+
+    _api, gw, base = platform
+    payload = {"instances": [{"tokens": [5, 6, 7], "max_new_tokens": 6}]}
+    url = f"{base}/models/lm/v1/models/lm-test-tiny:predict"
+
+    # Warm-up: first contact builds + compiles the decoder (outside any
+    # timeline); the measured request then isolates serving latency.
+    http("POST", url, payload)
+
+    rid = "trace-e2e-0001"
+    t0 = time.perf_counter()
+    code, out, headers = http("POST", url, payload,
+                              headers={"X-Request-ID": rid})
+    e2e_ms = 1e3 * (time.perf_counter() - t0)
+    assert code == 200 and len(out["predictions"][0]["tokens"]) == 6
+    assert headers["X-Request-ID"] == rid  # echoed through the gateway
+
+    # The decoder's timeline, fetched THROUGH the gateway (the one-curl
+    # contract): same id, closed, full lifecycle.
+    code, dbg, _ = http("GET",
+                        f"{base}/models/lm/debug/requests?id={rid}")
+    assert code == 200
+    recs = dbg["requests"]
+    assert len(recs) == 1, recs
+    rec = recs[0]
+    assert rec["request_id"] == rid and rec["status"] == "length"
+    names = [e["name"] for e in rec["events"]]
+    for expected in ("submit", "queued", "admitted", "prefill",
+                     "first_token", "finish"):
+        assert expected in names, (expected, names)
+
+    # Span sum == timeline duration (by construction) and within
+    # measurement noise of the observed end-to-end latency: the decoder
+    # window nests inside the client's, short only of HTTP/proxy
+    # overhead.
+    span_sum_ms = sum(s["duration_ms"] for s in rec["spans"])
+    assert span_sum_ms == pytest.approx(rec["duration_ms"], abs=0.05)
+    assert span_sum_ms <= e2e_ms + 1.0
+    assert e2e_ms - span_sum_ms <= max(0.5 * e2e_ms, 150.0), (
+        e2e_ms, span_sum_ms)
+
+    # The gateway hop recorded the same id on its own timeline.
+    gw_recs = gw.trace.find(rid)
+    assert gw_recs and all(r["status"] != "open" for r in gw_recs)

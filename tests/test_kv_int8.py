@@ -19,6 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from kubeflow_tpu.observability.metrics import type_line
 import kubeflow_tpu.models.decode as decode_mod
 from kubeflow_tpu.ops.attention import paged_decode_attention
 from kubeflow_tpu.serving.continuous import ContinuousDecoder
@@ -372,5 +373,5 @@ def test_int8_metrics_and_prometheus_gauges(model):
         server.stop()
     assert "serving_kv_dtype_int8 1" in text
     assert f"serving_kv_bytes_per_token {want}" in text
-    assert "# TYPE serving_kv_bytes_in_use gauge" in text
+    assert type_line("serving_kv_bytes_in_use", "gauge") in text
     assert "serving_kv_bytes_total" in text

@@ -14,6 +14,7 @@ import time
 import jax
 import pytest
 
+from kubeflow_tpu.observability.metrics import type_line
 from kubeflow_tpu.serving.continuous import (
     ContinuousDecoder,
     StreamHandle,
@@ -394,6 +395,6 @@ def test_paged_counters_exported_as_prometheus(model):
         server.stop()
     assert "serving_kv_blocks_total 12" in text  # 4 slots * 24/8 blocks
     assert "serving_kv_blocks_in_use" in text
-    assert "# TYPE serving_kv_shared_blocks_total counter" in text
+    assert type_line("serving_kv_shared_blocks_total", "counter") in text
     assert "serving_kv_cow_copies_total" in text
     assert "serving_kv_defer_admissions_total 0" in text

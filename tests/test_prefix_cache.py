@@ -15,6 +15,7 @@ from pathlib import Path
 import jax
 import pytest
 
+from kubeflow_tpu.observability.metrics import type_line
 from kubeflow_tpu.serving.continuous import ContinuousDecoder
 from kubeflow_tpu.serving.engine import EngineConfig, pow2_bucket
 from kubeflow_tpu.serving.prefix_cache import PrefixCache
@@ -296,10 +297,10 @@ def test_prefix_counters_exported_as_prometheus(model):
         conn.close()
     finally:
         server.stop()
-    assert "# TYPE serving_prefix_hits_total counter\n" \
-           "serving_prefix_hits_total 1\n" in text
+    assert (type_line("serving_prefix_hits_total", "counter")
+            + "serving_prefix_hits_total 1\n") in text
     assert "serving_prefix_tokens_reused_total 19" in text
-    assert "# TYPE serving_prefix_entries gauge" in text
+    assert type_line("serving_prefix_entries", "gauge") in text
     assert "serving_prefill_dispatches_total" in text
     assert "serving_prefill_tokens_total" in text
 
@@ -308,5 +309,5 @@ def test_collector_helper_renders_types():
     from kubeflow_tpu.observability.collector import render_prometheus
 
     text = render_prometheus({"x_total": 3, "y": 1.5})
-    assert text == ("# TYPE x_total counter\nx_total 3\n"
-                    "# TYPE y gauge\ny 1.500000\n")
+    assert text == (type_line("x_total", "counter") + "x_total 3\n"
+                    + type_line("y", "gauge") + "y 1.500000\n")

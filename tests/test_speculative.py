@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from kubeflow_tpu.observability.metrics import type_line
 from kubeflow_tpu.models.decode import (
     decode_chunk,
     decode_step,
@@ -381,7 +382,8 @@ def test_spec_counters_in_prometheus_export(model):
         conn.close()
     finally:
         server.stop()
-    assert "# TYPE serving_spec_accepted_tokens_total counter" in text
+    assert type_line("serving_spec_accepted_tokens_total",
+                     "counter") in text
     assert "serving_spec_drafted_tokens_total" in text
     assert "serving_spec_verify_dispatches_total" in text
     assert "serving_spec_acceptance_rate" in text
