@@ -41,9 +41,23 @@ the compiled step, tokens/s holding the gather baseline). Fp blocks
 must stay byte-identical to dense; int8/fused greedy tokens must agree
 within the pinned tolerance.
 
+``--fleet-sweep`` benchmarks the replicated decoder pool: 1 vs 4
+replicas at EQUAL per-replica KV pool bytes on shared-prefix traffic,
+routed prefix-affine (rendezvous hash of the leading tokens,
+serving/fleet.py) vs seeded-random. Each replica is timed on its own
+routed shard — one accelerator per replica in production; on the
+single-accelerator CI host the shards run back to back so they never
+fight for the one core — and aggregate tokens/s is the sum of
+per-replica rates. The regression marker fires when the 4-replica
+aggregate falls under 3.4x the single replica (starved or empty
+replicas depress their shard's rate, so broken placement fails the
+gate), when prefix-affine routing does not beat random routing's mean
+per-replica prefix-cache hit rate strictly, when greedy tokens differ
+across any run, or when any replica leaks KV blocks.
+
 Usage: python bench_serving.py [--quick] [--requests N] [--generate]
        [--prefix-reuse] [--speculative] [--concurrency-sweep]
-       [--kv-dtype-sweep]
+       [--kv-dtype-sweep] [--fleet-sweep]
 """
 
 from __future__ import annotations
@@ -687,6 +701,160 @@ def _bench_kv_dtype_sweep(args, model) -> dict:
     }
 
 
+def _bench_fleet_sweep(args, model) -> dict:
+    """Replica-pool scaling + routing-locality scenario.
+
+    Shared-prefix traffic (G groups, each sharing a ``plen``-token
+    leading prefix) is routed over a DecoderFleet by rendezvous hash of
+    the leading tokens. Every replica — and the single-replica baseline
+    — gets the SAME paged pool bytes and prefix-cache slots, so the
+    fleet's axis is replicas, not per-replica memory. Per replica, its
+    routed shard runs an UNTIMED leader phase (first request of each
+    routed group — seeds the trie and absorbs any stray executable
+    compile) and then the timed follower phase, whose hit pattern is
+    deterministic: affine routing keeps every group on one replica
+    (followers hit its warmed trie), random routing shatters groups
+    across the fleet. Replicas are timed on their own shard (one
+    accelerator per replica in production; back to back here so shards
+    never share the CI host's single core) and aggregate tokens/s sums
+    per-replica follower-phase rates — an empty or starved replica
+    contributes ~0, so broken placement fails the >=3.4x gate. The
+    single replica at the same per-replica resources must hold the
+    WHOLE group working set in one trie/pool, which is exactly the
+    thrash the fleet's partitioning removes — the locality argument
+    this PR exists for, measured."""
+    from kubeflow_tpu.models.registry import get_model
+    from kubeflow_tpu.serving.continuous import ContinuousDecoder
+    from kubeflow_tpu.serving.fleet import DecoderFleet
+
+    spec = get_model(model)
+    params = spec.init(jax.random.PRNGKey(0), spec.config)
+    gen = 8
+    prefill_len = 32
+    block = 8
+    slots = 8
+    plen = 24  # group-shared prefix (>= prefix_cache_min_len)
+    # Equal per-replica pool bytes in EVERY run: dense-parity sizing for
+    # one replica's slots, never scaled with the fleet.
+    pool_blocks = slots * ((prefill_len + gen) // block)
+    groups = 16
+    per_group = 12 if args.quick else 24
+    requests = []
+    for g in range(groups):
+        prefix = [(g * 7 + j) % 97 + 3 for j in range(plen)]
+        for r in range(per_group):
+            requests.append((g, prefix + [200 + g, 150 + r % 40,
+                                          11 + r % 5, 7 + r // 40]))
+
+    def make_decoder():
+        return ContinuousDecoder(
+            params, spec.config, slots=slots, prefill_len=prefill_len,
+            max_new_tokens=gen, prefix_cache_slots=8,
+            prefix_cache_min_len=16, prefill_len_buckets=2,
+            kv_layout="paged", kv_block_size=block,
+            kv_pool_blocks=pool_blocks, stream_timeout_s=600.0)
+
+    def run(n_replicas, router):
+        reps = {f"r{i}": make_decoder() for i in range(n_replicas)}
+        fleet = DecoderFleet(reps, affinity_tokens=plen, router=router,
+                             seed=7)
+        shards = {nm: [] for nm in reps}
+        for idx, (g, toks) in enumerate(requests):
+            shards[fleet.route(toks)].append((idx, g, toks))
+        tokens_by_idx = {}
+        per = {}
+        try:
+            for nm, shard in shards.items():
+                if not shard:
+                    per[nm] = {"requests": 0, "tokens_per_sec": 0.0,
+                               "hit_rate": 0.0}
+                    continue
+                d = reps[nm]
+                leaders, followers, seen = [], [], set()
+                for idx, g, toks in shard:
+                    (followers if g in seen else leaders).append(
+                        (idx, toks))
+                    seen.add(g)
+
+                def one(item):
+                    idx, toks = item
+                    return idx, d.submit(toks, gen).result(
+                        timeout=600)["tokens"]
+                # Untimed leader phase: publishes each routed group's
+                # prefix and compiles any shape this shard will use.
+                with ThreadPoolExecutor(min(len(leaders), 24)) as pool:
+                    for idx, out_toks in pool.map(one, leaders):
+                        tokens_by_idx[idx] = out_toks
+                m0 = d.metrics()
+                emitted = 0
+                t0 = time.perf_counter()
+                with ThreadPoolExecutor(min(len(followers), 24)) as pool:
+                    for idx, out_toks in pool.map(one, followers):
+                        tokens_by_idx[idx] = out_toks
+                        emitted += len(out_toks)
+                wall = time.perf_counter() - t0
+                m = d.metrics()
+                hits = m["prefix_hits"] - m0["prefix_hits"]
+                misses = m["prefix_misses"] - m0["prefix_misses"]
+                per[nm] = {
+                    "requests": len(shard),
+                    "tokens_per_sec": round(emitted / wall, 1),
+                    "prefix_hits": hits,
+                    "prefix_misses": misses,
+                    "hit_rate": round(hits / max(hits + misses, 1), 3),
+                }
+            # Slot-held blocks must all be back in the pool (cache-held
+            # entry blocks are live on purpose — future hits read them).
+            leaked = sum(len(b) for d in reps.values()
+                         for b in d._slot_blocks)
+        finally:
+            fleet.stop()
+        loaded = [p for p in per.values() if p["requests"]]
+        return {
+            "tokens": [tokens_by_idx[i] for i in range(len(requests))],
+            "aggregate_tokens_per_sec": round(
+                sum(p["tokens_per_sec"] for p in loaded), 1),
+            "hit_rate_mean": round(
+                sum(p["hit_rate"] for p in loaded) / len(loaded), 3),
+            "per_replica": per,
+            "leaked_blocks": leaked,
+        }
+
+    single = run(1, "affine")
+    affine = run(4, "affine")
+    rand = run(4, "random")
+
+    ratio = (affine["aggregate_tokens_per_sec"]
+             / max(single["aggregate_tokens_per_sec"], 1e-9))
+    identical = (single["tokens"] == affine["tokens"]
+                 == rand["tokens"])
+    leaked = (single["leaked_blocks"] + affine["leaked_blocks"]
+              + rand["leaked_blocks"])
+    return {
+        "metric": "serving_fleet_aggregate_scaling",
+        "value": round(ratio, 2),
+        "unit": "x",
+        "vs_baseline": 1.0,
+        "single_tokens_per_sec": single["aggregate_tokens_per_sec"],
+        "fleet_tokens_per_sec": affine["aggregate_tokens_per_sec"],
+        "random_tokens_per_sec": rand["aggregate_tokens_per_sec"],
+        "affine_hit_rate_mean": affine["hit_rate_mean"],
+        "random_hit_rate_mean": rand["hit_rate_mean"],
+        "single_hit_rate_mean": single["hit_rate_mean"],
+        "per_replica_affine": affine["per_replica"],
+        "per_replica_random": rand["per_replica"],
+        "tokens_identical": identical,
+        "kv_blocks_in_use_after_drain": leaked,
+        "regression": ((not identical) or ratio < 3.4
+                       or affine["hit_rate_mean"]
+                       <= rand["hit_rate_mean"]
+                       or leaked != 0),
+        "config": f"{model} groups{groups}x{per_group} prefix{plen} "
+                  f"gen{gen} slots{slots} pool{pool_blocks} "
+                  f"block{block} replicas1v4",
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
@@ -717,6 +885,12 @@ def main() -> int:
                          "bytes under an offered-concurrency ladder "
                          "(identical greedy tokens and a >=2x in-flight "
                          "peak required)")
+    ap.add_argument("--fleet-sweep", action="store_true",
+                    help="benchmark the replicated decoder pool: 1 vs 4 "
+                         "replicas at equal per-replica pool bytes on "
+                         "shared-prefix traffic (>=3.4x aggregate "
+                         "tokens/s and a strictly higher prefix hit "
+                         "rate than random routing required)")
     ap.add_argument("--kv-dtype-sweep", action="store_true",
                     help="benchmark int8 vs fp paged KV at equal pool "
                          "bytes (>=1.8x in-flight peak, fp bitwise "
@@ -726,7 +900,10 @@ def main() -> int:
     args = ap.parse_args()
 
     on_tpu = jax.default_backend() == "tpu"
-    if args.kv_dtype_sweep:
+    if args.fleet_sweep:
+        model = "llama-1b" if on_tpu and not args.quick else "lm-test-tiny"
+        result = _bench_fleet_sweep(args, model)
+    elif args.kv_dtype_sweep:
         model = "llama-1b" if on_tpu and not args.quick else "lm-test-tiny"
         result = _bench_kv_dtype_sweep(args, model)
     elif args.concurrency_sweep:

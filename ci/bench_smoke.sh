@@ -41,6 +41,13 @@ check_json "$out"
 # when it falls below the gather baseline's tokens/s, or on a leak.
 out="$(JAX_PLATFORMS=cpu python bench_serving.py --quick --kv-dtype-sweep)"
 check_json "$out"
+# Fleet serving: the marker fires when 4 replicas at equal per-replica
+# pool bytes sustain <3.4x the single replica's aggregate tokens/s on
+# shared-prefix traffic, when prefix-affine routing fails to beat
+# seeded-random routing's per-replica prefix hit rate strictly, when
+# greedy tokens differ across runs, or when any replica leaks blocks.
+out="$(JAX_PLATFORMS=cpu python bench_serving.py --quick --fleet-sweep)"
+check_json "$out"
 echo "bench smoke ok"
 # Training input pipeline: prefetch-on must match prefetch-off final
 # loss byte-for-byte (bench.py sets the regression marker otherwise)

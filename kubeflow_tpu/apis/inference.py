@@ -1,0 +1,150 @@
+"""InferenceService CRD API — the fleet-serving control surface.
+
+The reference serves models as a hand-sized tf-serving Deployment behind
+an http-proxy (kubeflow/tf-serving/tf-serving-template.libsonnet:29-49);
+this CRD is that stack at production shape: ONE object declares a model,
+a replica range, the engine knobs, and the autoscaling targets, and the
+InferenceService operator (operators/inference.py) reconciles N
+model-server replicas, a prefix-affine gateway route over them, and a
+metric-driven autoscaler consuming the PR-7 latency histograms.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.k8s import objects as k8s
+from kubeflow_tpu.version import API_GROUP
+
+INFERENCE_KIND = "InferenceService"
+INFERENCE_PLURAL = "inferenceservices"
+INFERENCE_API_VERSION = f"{API_GROUP}/v1"
+
+# Autoscale policy defaults: targets are BREACH thresholds (p99s over the
+# PR-7 histograms, KV fill over the real-byte gauges); scale-down needs
+# every signal under target * scale_down_ratio (hysteresis band) AND
+# cooldown_seconds since the last scale event (flap damping).
+DEFAULT_AUTOSCALE = {
+    "queueWaitP99Ms": 500.0,
+    "ttftP99Ms": 2000.0,
+    "kvBytesUtilization": 0.85,
+    "scaleDownRatio": 0.5,
+    "cooldownSeconds": 60.0,
+    "scrapePeriodSeconds": 10.0,
+}
+
+
+def inference_service_crd() -> dict:
+    autoscale_props = {
+        "queueWaitP99Ms": {"type": "number", "minimum": 0},
+        "ttftP99Ms": {"type": "number", "minimum": 0},
+        "kvBytesUtilization": {"type": "number", "minimum": 0,
+                               "maximum": 1},
+        "scaleDownRatio": {"type": "number", "minimum": 0, "maximum": 1},
+        "cooldownSeconds": {"type": "number", "minimum": 0},
+        "scrapePeriodSeconds": {"type": "number", "minimum": 0},
+    }
+    schema = {
+        "type": "object",
+        "properties": {
+            "spec": {
+                "type": "object",
+                "required": ["model"],
+                "properties": {
+                    "model": {"type": "string"},
+                    "modelPath": {"type": "string"},
+                    "image": {"type": "string"},
+                    "replicas": {"type": "integer", "minimum": 0},
+                    "minReplicas": {"type": "integer", "minimum": 1},
+                    "maxReplicas": {"type": "integer", "minimum": 1},
+                    "tpuChipsPerReplica": {"type": "integer",
+                                           "minimum": 0},
+                    # Engine knobs passed verbatim to the model-server
+                    # args (the tpu-serving param surface).
+                    "engine": {
+                        "type": "object",
+                        "x-kubernetes-preserve-unknown-fields": True,
+                    },
+                    "router": {
+                        "type": "object",
+                        "properties": {
+                            "affinityTokens": {"type": "integer",
+                                               "minimum": 1},
+                            "pressure": {"type": "integer",
+                                         "minimum": 0},
+                        },
+                    },
+                    "autoscale": {"type": "object",
+                                  "properties": autoscale_props},
+                },
+            },
+            "status": {"type": "object",
+                       "x-kubernetes-preserve-unknown-fields": True},
+        },
+    }
+    return k8s.crd(
+        group=API_GROUP,
+        kind=INFERENCE_KIND,
+        plural=INFERENCE_PLURAL,
+        short_names=["isvc"],
+        categories=["all", "kubeflow-tpu"],
+        versions=[
+            k8s.crd_version(
+                "v1",
+                schema=schema,
+                storage=True,
+                printer_columns=[
+                    k8s.printer_column("Model", ".spec.model"),
+                    k8s.printer_column("Replicas", ".status.replicas",
+                                       "integer"),
+                    k8s.printer_column("Ready", ".status.readyReplicas",
+                                       "integer"),
+                    k8s.printer_column("Phase", ".status.phase"),
+                    k8s.printer_column("Age", ".metadata.creationTimestamp",
+                                       "date"),
+                ],
+            )
+        ],
+    )
+
+
+def inference_service(
+    name: str,
+    namespace: str,
+    model: str,
+    *,
+    model_path: str = "",
+    image: str = "",
+    replicas: int = 1,
+    min_replicas: int = 1,
+    max_replicas: int = 4,
+    tpu_chips_per_replica: int = 0,
+    engine: dict | None = None,
+    affinity_tokens: int = 32,
+    pressure: int = 8,
+    autoscale: dict | None = None,
+) -> dict:
+    """Build an InferenceService CR. ``engine`` maps tpu-serving param
+    names (batch_size, kv_layout, ...) to values; ``autoscale`` overrides
+    DEFAULT_AUTOSCALE keys."""
+    spec: dict = {
+        "model": model,
+        "replicas": int(replicas),
+        "minReplicas": int(min_replicas),
+        "maxReplicas": int(max_replicas),
+        "router": {"affinityTokens": int(affinity_tokens),
+                   "pressure": int(pressure)},
+        "autoscale": {**DEFAULT_AUTOSCALE, **(autoscale or {})},
+    }
+    if model_path:
+        spec["modelPath"] = model_path
+    if image:
+        spec["image"] = image
+    if tpu_chips_per_replica:
+        spec["tpuChipsPerReplica"] = int(tpu_chips_per_replica)
+    if engine:
+        spec["engine"] = dict(engine)
+    return {
+        "apiVersion": INFERENCE_API_VERSION,
+        "kind": INFERENCE_KIND,
+        "metadata": k8s.metadata(name, namespace, {"app": name}),
+        "spec": spec,
+    }

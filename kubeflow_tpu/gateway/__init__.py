@@ -32,6 +32,7 @@ from kubeflow_tpu.gateway.proxy import make_proxy_handler
 from kubeflow_tpu.observability.metrics import MetricRegistry
 from kubeflow_tpu.observability.tracing import TraceStore
 from kubeflow_tpu.gateway.resilience import (
+    BackendLoad,
     BanditStats,
     OutlierStats,
     UpstreamHealth,
@@ -39,8 +40,8 @@ from kubeflow_tpu.gateway.resilience import (
 from kubeflow_tpu.gateway.routing import Route, RouteTable, routes_from_service
 
 __all__ = [
-    "BanditStats", "Gateway", "OutlierStats", "Route", "RouteTable",
-    "UpstreamHealth", "routes_from_service",
+    "BackendLoad", "BanditStats", "Gateway", "OutlierStats", "Route",
+    "RouteTable", "UpstreamHealth", "routes_from_service",
 ]
 
 log = logging.getLogger(__name__)
@@ -131,6 +132,11 @@ class Gateway:
         self.errors_total = 0
         self.tunnels_total = 0
         self.shadow_total = 0
+        # Per-backend in-flight depth — the pressure signal the
+        # prefix-affine replica-pool strategy spills on (exact for the
+        # traffic this gateway carries; no scrape freshness to trust).
+        self.load = BackendLoad()
+        self.affine_spills = 0
         # Shared observability registry (served on the admin /metrics):
         # per-route upstream latency distributions — the signal a
         # metric-driven autoscaler reads per backend pool.

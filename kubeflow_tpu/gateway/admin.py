@@ -29,8 +29,12 @@ def make_admin_handler(gw):
                 ctype = "application/json"
             elif self.path == "/upstreams":
                 # Upstream health + circuit state, per backend (the
-                # envoy clusters/outlier admin surface).
-                body = json.dumps(gw.health.snapshot()).encode()
+                # envoy clusters/outlier admin surface), plus the
+                # in-flight depth the prefix-affine spill reads.
+                snap = gw.health.snapshot()
+                for svc, depth in gw.load.snapshot().items():
+                    snap.setdefault(svc, {})["in_flight"] = depth
+                body = json.dumps(snap).encode()
                 ctype = "application/json"
             elif self.path == "/metrics":
                 # Counters through the shared dict renderer (typed by
@@ -43,6 +47,7 @@ def make_admin_handler(gw):
                     "gateway_upgrade_tunnels_total": gw.tunnels_total,
                     "gateway_shadow_requests_total": gw.shadow_total,
                     "gateway_retries_total": gw.retries_total,
+                    "gateway_affine_spills_total": gw.affine_spills,
                     "gateway_outliers_total": gw.outliers.totals()[0],
                     "gateway_outlier_scored_total":
                         gw.outliers.totals()[1],

@@ -11,6 +11,7 @@ tunnel live on the handler itself.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import socket
 import threading
@@ -24,6 +25,10 @@ from kubeflow_tpu.observability.tracing import (
     REQUEST_ID_HEADER,
     gen_request_id,
 )
+from kubeflow_tpu.serving.affinity import (
+    prefix_affinity_key,
+    rendezvous_order,
+)
 
 # Hop-by-hop headers never forwarded (RFC 7230 §6.1).
 _HOP_HEADERS = {
@@ -31,6 +36,26 @@ _HOP_HEADERS = {
     "proxy-authorization", "te", "trailers", "transfer-encoding", "upgrade",
     "host", "content-length",
 }
+
+
+def affinity_key_for(body: bytes | None, path: str, width: int) -> str:
+    """Routing key for a prefix-affine route: the prompt's leading
+    tokens when the body is a predict payload (requests sharing a
+    prefix land on the same replica — the point), a digest of the raw
+    body otherwise, the path for bodyless requests. Never raises —
+    unparseable traffic still routes deterministically."""
+    if body:
+        try:
+            payload = json.loads(body)
+            inst = (payload.get("instances") or [None])[0] \
+                if isinstance(payload, dict) else None
+            toks = inst.get("tokens") if isinstance(inst, dict) else None
+            if isinstance(toks, list) and toks:
+                return prefix_affinity_key(toks, width)
+        except (ValueError, TypeError, UnicodeDecodeError):
+            pass
+        return hashlib.blake2b(body[:1024], digest_size=8).hexdigest()
+    return path
 
 
 def make_proxy_handler(gw):
@@ -125,7 +150,25 @@ def make_proxy_handler(gw):
                                      "login": "/login"}).encode(),
                 )
                 return
-            service = self._pick_backend(route)
+            # Prefix-affine routes hash the request BODY (the prompt's
+            # leading tokens), so it must be read before the pick — the
+            # other strategies keep the lazy read in _proxy_http.
+            body = None
+            affinity_key = None
+            if (route.strategy == "prefix-affine"
+                    and not self._is_upgrade()):
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                except ValueError:
+                    gw.errors_total += 1
+                    self._respond(400, json.dumps(
+                        {"error": "malformed Content-Length"}).encode())
+                    self.close_connection = True
+                    return
+                body = self.rfile.read(length) if length else b""
+                affinity_key = affinity_key_for(
+                    body, self.path, route.affinity_tokens)
+            service = self._pick_backend(route, key=affinity_key)
             target = route.target_for(self.path, service)
             # Re-point at the resolved backend address.
             target = target.replace(service, gw.resolve(service), 1)
@@ -138,13 +181,15 @@ def make_proxy_handler(gw):
                              backend_path)
                 return
             self._proxy_http(route, parts.hostname, parts.port,
-                             backend_path, service)
+                             backend_path, service, body=body)
 
-        def _pick_backend(self, route, exclude: str | None = None
-                          ) -> str:
+        def _pick_backend(self, route, exclude: str | None = None,
+                          key: str | None = None) -> str:
             """Choose a backend with ejected upstreams filtered out of
-            the pick set (weighted draws AND bandit arms); ``exclude``
-            additionally drops the backend a retry just failed on."""
+            the pick set (weighted draws, bandit arms, AND the
+            rendezvous member set — the health machinery is how dead
+            replicas leave the hash ring); ``exclude`` additionally
+            drops the backend a retry just failed on."""
             if not route.backends:
                 return route.service  # nowhere else to go
             services = gw.health.filter_healthy(
@@ -152,7 +197,24 @@ def make_proxy_handler(gw):
             )
             if exclude and len(services) > 1:
                 services = [s for s in services if s != exclude]
-            if route.strategy == "epsilon-greedy":
+            if route.strategy == "prefix-affine":
+                # Rendezvous placement: order[0] is the affine replica
+                # for this key; excluding a dead/ejected backend remaps
+                # ONLY its keys (survivors keep their order). Spill to
+                # the least-loaded backend when the affine replica is
+                # over the in-flight pressure bound — locality yields
+                # to a real hotspot, and only then.
+                order = rendezvous_order(key or self.path, services)
+                picked = order[0]
+                if (route.pressure > 0 and len(order) > 1
+                        and gw.load.depth(picked) >= route.pressure):
+                    spill = gw.load.least_loaded(order[1:])
+                    if (spill is not None
+                            and gw.load.depth(spill)
+                            < gw.load.depth(picked)):
+                        picked = spill
+                        gw.affine_spills += 1
+            elif route.strategy == "epsilon-greedy":
                 picked = gw.bandit.pick(route, gw.rng, services)
             else:
                 weights = {b[0]: b[1] for b in route.backends}
@@ -176,21 +238,24 @@ def make_proxy_handler(gw):
         # -- plain HTTP: streamed relay -----------------------------
 
         def _proxy_http(self, route, host, port, path, service=None,
-                        is_retry=False):
+                        is_retry=False, body=None):
             # On a retry the request body stream is already consumed —
             # only bodyless idempotent methods reach here retrying.
-            try:
-                length = (0 if is_retry
-                          else int(self.headers.get("Content-Length", 0)))
-            except ValueError:
-                # Malformed client header: answer 400 instead of dying
-                # with an uncaught traceback and a dropped connection.
-                gw.errors_total += 1
-                self._respond(400, json.dumps(
-                    {"error": "malformed Content-Length"}).encode())
-                self.close_connection = True  # unread body would desync
-                return
-            body = self.rfile.read(length) if length else None
+            # ``body`` is pre-read when the route strategy needed it for
+            # the backend pick (prefix-affine hashes the prompt).
+            if body is None and not is_retry:
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                except ValueError:
+                    # Malformed client header: answer 400 instead of
+                    # dying with an uncaught traceback and a dropped
+                    # connection.
+                    gw.errors_total += 1
+                    self._respond(400, json.dumps(
+                        {"error": "malformed Content-Length"}).encode())
+                    self.close_connection = True  # unread body desyncs
+                    return
+                body = self.rfile.read(length) if length else None
             # Forwarded prefix and authenticated identity are
             # gateway-asserted — client-supplied copies must never
             # reach the backend (spoofing). The request id is gateway-
@@ -232,6 +297,11 @@ def make_proxy_handler(gw):
                          method=self.command, path=self.path)
             conn = HTTPConnection(host, port,
                                   timeout=gw.upstream_timeout)
+            if service is not None:
+                # Queue-depth accounting spans the WHOLE upstream
+                # exchange (streamed relays included) — the pressure
+                # signal prefix-affine spill decisions read.
+                gw.load.acquire(service)
             try:
                 t_up = time.perf_counter()
                 try:
@@ -300,6 +370,8 @@ def make_proxy_handler(gw):
                 self._relay_response(resp, tag_headers)
             finally:
                 conn.close()
+                if service is not None:
+                    gw.load.release(service)
                 if tl is not None:
                     tl.close()  # idempotent; covers the error returns too
 

@@ -253,6 +253,49 @@ class UpstreamHealth:
             }
 
 
+class BackendLoad:
+    """Per-backend in-flight request counter — the gateway-local queue
+    depth the ``prefix-affine`` route strategy spills on. Passive and
+    exact for the traffic THIS gateway carries (the pressure signal must
+    not depend on a metrics scrape being fresh): acquired when a request
+    is dispatched upstream, released when its relay finishes, streamed
+    bodies included."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._in_flight: dict[str, int] = {}
+
+    def acquire(self, service: str) -> None:
+        with self._lock:
+            self._in_flight[service] = self._in_flight.get(service, 0) + 1
+
+    def release(self, service: str) -> None:
+        with self._lock:
+            n = self._in_flight.get(service, 0) - 1
+            if n > 0:
+                self._in_flight[service] = n
+            else:
+                self._in_flight.pop(service, None)
+
+    def depth(self, service: str) -> int:
+        with self._lock:
+            return self._in_flight.get(service, 0)
+
+    def least_loaded(self, services: list[str]) -> str | None:
+        """The lowest-depth service; ties keep the CALLER's order (the
+        rendezvous spill sequence), so spill targets are deterministic."""
+        with self._lock:
+            if not services:
+                return None
+            return min(services,
+                       key=lambda s: (self._in_flight.get(s, 0),
+                                      services.index(s)))
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return dict(self._in_flight)
+
+
 class BanditStats:
     """Per-(route, backend) reward averages for epsilon-greedy routes."""
 

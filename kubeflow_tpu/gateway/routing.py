@@ -37,8 +37,18 @@ class Route:
     # random variant with probability epsilon, otherwise exploit the
     # best observed reward; rewards come from response status (5xx/
     # connect-fail = 0) or the admin feedback endpoint.
+    # "prefix-affine": the replica-pool router — rendezvous-hash the
+    # prompt's leading tokens over the healthy backends so requests
+    # sharing a prefix land on ONE replica (its prefix cache keeps
+    # hitting), spilling to the least-loaded backend when the affine
+    # replica is over ``pressure`` in-flight requests.
     strategy: str = "weighted"
     epsilon: float = 0.1
+    # prefix-affine knobs: leading tokens hashed into the routing key,
+    # and the per-backend in-flight bound past which the affine pick
+    # spills (0 = never spill).
+    affinity_tokens: int = 32
+    pressure: int = 0
     # Shadow/mirror target: every request is also sent fire-and-forget to
     # this backend; its response is discarded and its failures invisible.
     shadow: str = ""
@@ -101,11 +111,22 @@ def routes_from_service(svc: dict) -> list[Route]:
             if not service:
                 raise KeyError("service")
             strategy = spec.get("strategy", "weighted")
-            if strategy not in ("weighted", "epsilon-greedy"):
+            if strategy not in ("weighted", "epsilon-greedy",
+                                "prefix-affine"):
                 raise ValueError(f"unknown strategy {strategy!r}")
             epsilon = float(spec.get("epsilon", 0.1))
             if not 0.0 <= epsilon <= 1.0:
                 raise ValueError("epsilon must be in [0, 1]")
+            affinity_tokens = int(spec.get("affinity_tokens", 32))
+            if affinity_tokens < 1:
+                raise ValueError("affinity_tokens must be >= 1")
+            pressure = int(spec.get("pressure", 0))
+            if pressure < 0:
+                raise ValueError("pressure must be >= 0")
+            if strategy == "prefix-affine" and not spec.get("backends"):
+                # One backend is nothing to hash over — surface the
+                # misconfiguration instead of silently direct-routing.
+                raise ValueError("prefix-affine needs a backends pool")
             outlier = spec.get("outlier", {}) or {}
             outlier_threshold = float(outlier.get("threshold", 0.0))
             outlier_window = int(outlier.get("window", 100))
@@ -122,6 +143,7 @@ def routes_from_service(svc: dict) -> list[Route]:
                 name=spec["name"], prefix=spec["prefix"],
                 service=service, rewrite=spec.get("rewrite", "/"),
                 backends=backends, strategy=strategy, epsilon=epsilon,
+                affinity_tokens=affinity_tokens, pressure=pressure,
                 shadow=spec.get("shadow", ""),
                 outlier_threshold=outlier_threshold,
                 outlier_window=outlier_window,

@@ -150,7 +150,9 @@ GATEWAY_ROUTE_ANNOTATION = "kubeflow-tpu.org/gateway-route"
 def gateway_route(name: str, prefix: str, service: str, rewrite: str = "/",
                   backends: list | None = None, shadow: str = "",
                   strategy: str = "", epsilon: float | None = None,
-                  outlier: dict | None = None) -> dict:
+                  outlier: dict | None = None,
+                  affinity_tokens: int | None = None,
+                  pressure: int | None = None) -> dict:
     """Gateway route annotation for a Service — the platform-wide analogue of
     the `getambassador.io/config` annotations the reference attaches to every
     web-app Service (kubeflow/common/ambassador.libsonnet route pattern). The
@@ -177,6 +179,13 @@ def gateway_route(name: str, prefix: str, service: str, rewrite: str = "/",
         # {threshold, window}: running z-score anomaly tagging (the
         # seldon outlier-detector-v1alpha2 surface).
         spec["outlier"] = outlier
+    if affinity_tokens is not None:
+        # prefix-affine replica-pool knobs: leading tokens hashed into
+        # the rendezvous routing key, and the per-backend in-flight
+        # bound past which the affine pick spills to least-loaded.
+        spec["affinity_tokens"] = int(affinity_tokens)
+    if pressure is not None:
+        spec["pressure"] = int(pressure)
     return {
         GATEWAY_ROUTE_ANNOTATION: yaml.safe_dump(spec, sort_keys=True)
     }

@@ -1,0 +1,96 @@
+"""InferenceService package: fleet-serving CRD + CR prototype.
+
+The declarative face of the replicated decoder pool: one
+``inference-service`` prototype renders the InferenceService CRD and a
+CR declaring model, replica range, engine knobs, prefix-affine router
+knobs, and autoscale targets — the operator
+(kubeflow_tpu.operators.inference) does the rest. The reference's
+closest shape is a tf-serving Deployment with a hand-set ``replicas``
+(tf-serving-template.libsonnet:29-49); this is that surface with the
+replica count handed to a metric-driven control loop.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.apis.inference import (
+    inference_service,
+    inference_service_crd,
+)
+from kubeflow_tpu.manifests.core import ParamSpec, prototype
+from kubeflow_tpu.version import DEFAULT_NAMESPACE
+
+
+@prototype(
+    "inference-service",
+    "Replicated model-serving fleet: InferenceService CRD + CR — N "
+    "model-server replicas behind a prefix-affine gateway route, "
+    "autoscaled on queue-wait/TTFT p99 and KV-byte utilization",
+    params=[
+        ParamSpec("name"),
+        ParamSpec("namespace", DEFAULT_NAMESPACE),
+        ParamSpec("model", "", "served model name (defaults to `name`)"),
+        ParamSpec("model_path", "", "gs://, s3://, /pvc/ or local model dir"),
+        ParamSpec("replicas", 1, "initial replica count"),
+        ParamSpec("min_replicas", 1, "autoscaler floor"),
+        ParamSpec("max_replicas", 4, "autoscaler ceiling"),
+        ParamSpec("num_tpu_chips", 1,
+                  "google.com/tpu chips per replica (0 = CPU)"),
+        ParamSpec("affinity_tokens", 32,
+                  "leading prompt tokens hashed into the rendezvous "
+                  "routing key (>= the prefix cache min length, so "
+                  "every cacheable prefix maps to one replica)"),
+        ParamSpec("pressure", 8,
+                  "per-replica in-flight bound past which the affine "
+                  "pick spills to the least-loaded replica (0 = never)"),
+        ParamSpec("queue_wait_p99_ms", 500.0,
+                  "scale-up breach threshold on the queue-wait p99"),
+        ParamSpec("ttft_p99_ms", 2000.0,
+                  "scale-up breach threshold on the TTFT p99"),
+        ParamSpec("kv_bytes_utilization", 0.85,
+                  "scale-up breach threshold on KV bytes in use / total"),
+        ParamSpec("scale_down_ratio", 0.5,
+                  "hysteresis band: scale down only when every signal "
+                  "is under target * this ratio"),
+        ParamSpec("cooldown_seconds", 60.0,
+                  "minimum gap between a scale event and a scale-down"),
+        ParamSpec("scrape_period_seconds", 10.0,
+                  "autoscaler reconcile/scrape cadence"),
+    ],
+)
+def inference_service_proto(
+    name: str,
+    namespace: str,
+    model: str,
+    model_path: str,
+    replicas: int,
+    min_replicas: int,
+    max_replicas: int,
+    num_tpu_chips: int,
+    affinity_tokens: int,
+    pressure: int,
+    queue_wait_p99_ms: float,
+    ttft_p99_ms: float,
+    kv_bytes_utilization: float,
+    scale_down_ratio: float,
+    cooldown_seconds: float,
+    scrape_period_seconds: float,
+) -> list[dict]:
+    cr = inference_service(
+        name, namespace, model or name,
+        model_path=model_path,
+        replicas=replicas,
+        min_replicas=min_replicas,
+        max_replicas=max_replicas,
+        tpu_chips_per_replica=num_tpu_chips,
+        affinity_tokens=affinity_tokens,
+        pressure=pressure,
+        autoscale={
+            "queueWaitP99Ms": float(queue_wait_p99_ms),
+            "ttftP99Ms": float(ttft_p99_ms),
+            "kvBytesUtilization": float(kv_bytes_utilization),
+            "scaleDownRatio": float(scale_down_ratio),
+            "cooldownSeconds": float(cooldown_seconds),
+            "scrapePeriodSeconds": float(scrape_period_seconds),
+        },
+    )
+    return [inference_service_crd(), cr]

@@ -47,6 +47,12 @@ SNAPSHOT_CASES: dict[str, tuple[str, dict]] = {
         "inference-server",
         {"name": "external", "image": "example/infer:1", "port": 8080},
     ),
+    "inference-service": (
+        "inference-service",
+        {"name": "llama", "model_path": "gs://models/llama",
+         "replicas": 2, "min_replicas": 1, "max_replicas": 4,
+         "num_tpu_chips": 4},
+    ),
     "nfs-volume": ("nfs-volume", {"server": "10.0.0.2"}),
     "serving-route": (
         "serving-route",

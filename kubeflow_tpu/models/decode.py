@@ -22,7 +22,10 @@ import jax.numpy as jnp
 from jax import lax
 
 from kubeflow_tpu.ops import rms_norm
-from kubeflow_tpu.ops.attention import paged_decode_attention
+from kubeflow_tpu.ops.attention import (
+    paged_decode_attention,
+    paged_span_attention,
+)
 from kubeflow_tpu.ops.rotary import rotary_frequencies
 from kubeflow_tpu.models.transformer import TransformerConfig, moe_ffn
 
@@ -736,7 +739,7 @@ def decode_chunk(state, params, cfg: TransformerConfig, steps: int,
 
 
 def _span_attention(x, layer, cfg, rope_bt, k_cache, v_cache, pos_b,
-                    table=None):
+                    table=None, fused=False):
     """Block attention where row ``b``'s ``S`` tokens occupy cache slots
     ``pos_b[b]..pos_b[b]+S-1`` — the S-wide sibling of
     :func:`_ragged_attention` (rows at heterogeneous positions). Block
@@ -744,7 +747,12 @@ def _span_attention(x, layer, cfg, rope_bt, k_cache, v_cache, pos_b,
     was just written), so causality holds within the block and over the
     row's history. Out-of-bounds writes (parked rows, cache-tail spill)
     are dropped by scatter semantics. With ``table`` the caches are the
-    paged block pool, written/read through the block table."""
+    paged block pool, written/read through the block table; ``fused``
+    swaps the gathered read for the span block-walk
+    (ops/attention.py:paged_span_attention) so the dense
+    ``[B, MB*Bs]`` view is never materialized — the same contract (and
+    the same f32-equivalent-not-bitwise caveat) as the fused decode
+    read."""
     b, s, _d = x.shape
     hd = cfg.head_dim
     cos, sin = rope_bt
@@ -763,6 +771,15 @@ def _span_attention(x, layer, cfg, rope_bt, k_cache, v_cache, pos_b,
     else:
         k_cache = _pool_write(k_cache, table, cols, k)
         v_cache = _pool_write(v_cache, table, cols, v)
+        if fused:
+            # Span contract: token ``s`` attends positions <= pos_b + s
+            # — exactly the mask below, walked block-by-block instead
+            # of gathered dense.
+            out = paged_span_attention(
+                q, k_cache, v_cache, table, pos_b,
+                n_kv_heads=cfg.n_kv_heads,
+            ).reshape(b, s, cfg.n_heads * hd).astype(cfg.dtype)
+            return out @ layer["wo"].astype(cfg.dtype), k_cache, v_cache
         k_read = _pool_gather(k_cache, table)
         v_read = _pool_gather(v_cache, table)
         total = table.shape[1] * _kv_arr(k_cache).shape[1]
@@ -773,11 +790,12 @@ def _span_attention(x, layer, cfg, rope_bt, k_cache, v_cache, pos_b,
 
 
 def _block_forward(params, cfg: TransformerConfig, k_cache0, v_cache0,
-                   tokens, pos_b, token_valid, table=None):
+                   tokens, pos_b, token_valid, table=None, fused=False):
     """[B, S] forward writing K/V at per-row start positions ``pos_b`` →
     (logits [B, S, V], k, v). The verify scoring pass, the paged
     suffix-only prefill, and the draft model's catch-up feed all ride
-    this."""
+    this; ``fused`` routes the paged span read through the block-walk
+    instead of the dense gather."""
     total = (k_cache0.shape[2] if table is None
              else table.shape[1] * _kv_arr(k_cache0).shape[2])
     _b, s = tokens.shape
@@ -792,7 +810,7 @@ def _block_forward(params, cfg: TransformerConfig, k_cache0, v_cache0,
         h = rms_norm(x, layer["ln_attn"], eps=cfg.norm_eps)
         attn, k_cache, v_cache = _span_attention(
             h, layer["attn"], cfg, rope_bt, k_cache, v_cache, pos_b,
-            table=table,
+            table=table, fused=fused,
         )
         x = x + attn
         h = rms_norm(x, layer["ln_mlp"], eps=cfg.norm_eps)
@@ -844,11 +862,13 @@ def _verify_step_body(state, params, cfg: TransformerConfig, draft,
 
     # Pass 1: ONE [slots, K] forward scores every draft position (and
     # writes the draft K/V — accepted rows keep it, rejected tails stay
-    # masked out by ``length`` until overwritten).
+    # masked out by ``length`` until overwritten). ``fused`` walks the
+    # span read through the block table instead of gathering the dense
+    # view — the K-wide twin of the fused decode read.
     in_draft = jnp.arange(k_w)[None, :] < draft_len[:, None]
     block_logits, k1, v1 = _block_forward(
         params, cfg, k0, v0, draft, p_b,
-        token_valid=emit0[:, None] & in_draft, table=table,
+        token_valid=emit0[:, None] & in_draft, table=table, fused=fused,
     )
     # prev_logits[:, i] predicts draft position i: last_logits for i=0,
     # the scoring pass's own outputs shifted by one after that.
@@ -1159,12 +1179,14 @@ def paged_admit_rows_and_step(state, params, cfg: TransformerConfig, slots,
 
 def _paged_admit_prefix_body(state, params, cfg: TransformerConfig, slot,
                              prefix_len, suffix_tokens, prompt_len,
-                             remaining, temperature):
+                             remaining, temperature, fused=False):
     """Suffix-only prefill through the slot's block table: the leading
     ``prefix_len`` positions are already backed by shared (and possibly
     one CoW'd) blocks, so the forward reads them in place — ZERO
     device-side copies of the reused prefix — and writes only the
-    suffix K/V into the slot's owned blocks."""
+    suffix K/V into the slot's owned blocks. ``fused`` block-walks the
+    span read too, so a fused deployment never materializes the dense
+    row even at admission."""
     table_row = state["block_table"][slot][None]  # [1, mb]
     _b, s = suffix_tokens.shape
     suffix_len = jnp.maximum(prompt_len - prefix_len, 1)
@@ -1172,6 +1194,7 @@ def _paged_admit_prefix_body(state, params, cfg: TransformerConfig, slot,
         params, cfg, state["pool"]["k"], state["pool"]["v"], suffix_tokens,
         jnp.reshape(prefix_len, (1,)),
         token_valid=jnp.arange(s)[None, :] < suffix_len, table=table_row,
+        fused=fused,
     )
     last = jnp.take_along_axis(
         logits, jnp.reshape(suffix_len - 1, (1, 1, 1)), axis=1
@@ -1203,7 +1226,7 @@ def paged_admit_prefix_and_step(state, params, cfg: TransformerConfig, slot,
     state, last = _paged_admit_prefix_body(state, params, cfg, slot,
                                            prefix_len, suffix_tokens,
                                            prompt_len, remaining,
-                                           temperature)
+                                           temperature, kv_fused)
     state, tok, emit = _decode_step_body(state, params, cfg, top_k, eos_id,
                                          kv_fused)
     return state, last, tok, emit

@@ -19,6 +19,7 @@ def make_all_controllers(client):
         EndpointController,
         IssuerController,
     )
+    from kubeflow_tpu.operators.inference import InferenceServiceController
     from kubeflow_tpu.operators.jobs import make_job_controllers
     from kubeflow_tpu.operators.notebooks import NotebookController
     from kubeflow_tpu.operators.pipelines import (
@@ -31,6 +32,7 @@ def make_all_controllers(client):
 
     return [
         *make_job_controllers(client),
+        InferenceServiceController(client),
         NotebookController(client),
         ProfileController(client),
         StudyJobController(client),

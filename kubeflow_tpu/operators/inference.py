@@ -1,0 +1,383 @@
+"""InferenceService controller: replicated decoder pool + autoscaler.
+
+Reconciles one :mod:`kubeflow_tpu.apis.inference` CR into
+
+- N single-replica model-server Deployments (``<name>-r<i>``) with their
+  Services — each replica individually addressable so the gateway's
+  rendezvous hash has stable members to place prefix keys on (a plain
+  scaled Deployment behind one ClusterIP would round-robin the pool and
+  shatter every replica's prefix trie);
+- one selector-less **router Service** (``<name>``) carrying the
+  ``prefix-affine`` gateway-route annotation over the live replica set —
+  membership changes rewrite the annotation, the gateway refresh picks
+  it up, and the rendezvous hash remaps only the affected keys;
+- a **metric-driven autoscaler**: each reconcile scrapes every replica's
+  ``/monitoring/prometheus/metrics`` (the PR-7 signal plane), estimates
+  queue-wait/TTFT p99 from the histogram buckets and KV fill from the
+  real-byte gauges, and scales within [minReplicas, maxReplicas] —
+  up immediately on any breach, down only when every signal sits under
+  ``target * scaleDownRatio`` (hysteresis band) AND ``cooldownSeconds``
+  have passed since the last scale event (flap damping). The reconcile
+  returns ``scrapePeriodSeconds`` as its requeue-after, so the loop IS
+  the scrape cadence.
+
+Runs on the self-healing :class:`~kubeflow_tpu.operators.base.Controller`
+runtime (workqueue, backoff, dead-watch relist) like every other
+controller in the manager.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+import time
+import urllib.request
+
+from kubeflow_tpu.apis.inference import (
+    DEFAULT_AUTOSCALE,
+    INFERENCE_API_VERSION,
+    INFERENCE_KIND,
+)
+from kubeflow_tpu.k8s import objects as k8s
+from kubeflow_tpu.manifests.core import gateway_route, generate
+from kubeflow_tpu.operators.base import Controller
+
+log = logging.getLogger(__name__)
+
+REST_PORT = 8500
+REPLICA_LABEL = "kubeflow-tpu.org/inference-replica"
+SERVICE_LABEL = "kubeflow-tpu.org/inference-service"
+
+
+# ---------------------------------------------------------------------------
+# Exposition scraping (the autoscaler's input)
+# ---------------------------------------------------------------------------
+
+
+def _parse_exposition(text: str) -> dict:
+    """Minimal Prometheus text parse: ``samples[name] -> value`` for
+    plain series and ``buckets[name] -> [(le, cum_count), ...]`` for
+    ``_bucket`` series. Labels other than ``le`` are ignored (the
+    serving histograms the autoscaler reads are unlabeled)."""
+    samples: dict[str, float] = {}
+    buckets: dict[str, list[tuple[float, float]]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            series, value_s = line.rsplit(None, 1)
+            value = float(value_s)
+        except ValueError:
+            continue
+        name, _, labels = series.partition("{")
+        if name.endswith("_bucket"):
+            le = ""
+            for part in labels.rstrip("}").split(","):
+                k, _, v = part.partition("=")
+                if k.strip() == "le":
+                    le = v.strip().strip('"')
+            try:
+                bound = float("inf") if le == "+Inf" else float(le)
+            except ValueError:
+                continue
+            buckets.setdefault(name[: -len("_bucket")], []).append(
+                (bound, value))
+        else:
+            samples[name] = value
+    for blist in buckets.values():
+        blist.sort(key=lambda b: b[0])
+    return {"samples": samples, "buckets": buckets}
+
+
+def _bucket_quantile(blist: list[tuple[float, float]], q: float) -> float:
+    """promql histogram_quantile over cumulative buckets — the same
+    linear-in-bucket interpolation observability/metrics.py uses, so an
+    operator-side estimate matches the in-process one."""
+    if not blist:
+        return 0.0
+    total = blist[-1][1]
+    if total <= 0:
+        return 0.0
+    target = q * total
+    lower = 0.0
+    prev_cum = 0.0
+    for bound, cum in blist:
+        in_bucket = cum - prev_cum
+        if cum >= target and in_bucket > 0:
+            if bound == float("inf"):
+                return lower  # top finite bound is the best estimate
+            frac = (target - prev_cum) / in_bucket
+            return lower + (bound - lower) * frac
+        prev_cum = cum
+        if bound != float("inf"):
+            lower = bound
+    return lower
+
+
+def scrape_signals(text: str) -> dict:
+    """The autoscaler's per-replica signal vector out of one exposition
+    page: latency p99s from the PR-7 histograms, KV fill from the
+    real-byte gauges, plus raw queue depth."""
+    parsed = _parse_exposition(text)
+    samples, buckets = parsed["samples"], parsed["buckets"]
+    kv_total = samples.get("serving_kv_bytes_total", 0.0)
+    return {
+        "queue_wait_p99_s": _bucket_quantile(
+            buckets.get("serving_queue_wait_seconds", []), 0.99),
+        "ttft_p99_s": _bucket_quantile(
+            buckets.get("serving_ttft_seconds", []), 0.99),
+        "kv_utilization": (samples.get("serving_kv_bytes_in_use", 0.0)
+                           / kv_total if kv_total else 0.0),
+        "queued": samples.get("serving_queued", 0.0),
+    }
+
+
+def _http_fetch_signals(addr: str, timeout: float = 2.0) -> dict | None:
+    """Default replica scrape: GET the model server's exposition and
+    reduce it to the signal vector. None on any failure — a replica
+    that cannot be scraped must not stall the reconcile."""
+    try:
+        with urllib.request.urlopen(
+                f"http://{addr}/monitoring/prometheus/metrics",
+                timeout=timeout) as resp:
+            return scrape_signals(resp.read().decode("utf-8", "replace"))
+    except (OSError, ValueError):
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Controller
+# ---------------------------------------------------------------------------
+
+
+class InferenceServiceController(Controller):
+    """InferenceService CR → replica Deployments/Services + router route
+    + autoscaler. ``fetch_metrics(addr) -> signal dict | None`` and
+    ``clock`` are injectable (tests drive synthetic breaches and
+    cooldown time)."""
+
+    api_version = INFERENCE_API_VERSION
+    kind = INFERENCE_KIND
+
+    def __init__(self, client, *, fetch_metrics=None, clock=time.monotonic):
+        super().__init__(client)
+        self.fetch_metrics = fetch_metrics or _http_fetch_signals
+        self.clock = clock
+        # (ns, name) -> {"last_scale": monotonic | None}
+        self._scale_state: dict[tuple[str, str], dict] = {}
+
+    def watched_kinds(self):
+        return [("apps/v1", "Deployment"), ("v1", "Service")]
+
+    def reconcile_deleted(self, obj: dict) -> None:
+        key = (obj["metadata"].get("namespace", ""),
+               obj["metadata"].get("name", ""))
+        self._scale_state.pop(key, None)
+
+    # -- replica addressing -------------------------------------------
+
+    @staticmethod
+    def replica_name(name: str, i: int) -> str:
+        return f"{name}-r{i}"
+
+    @staticmethod
+    def replica_addr(name: str, ns: str, i: int) -> str:
+        return (f"{InferenceServiceController.replica_name(name, i)}"
+                f".{ns}:{REST_PORT}")
+
+    # -- reconcile ----------------------------------------------------
+
+    def reconcile(self, svc: dict) -> float:
+        svc = copy.deepcopy(svc)
+        name = svc["metadata"]["name"]
+        ns = svc["metadata"]["namespace"]
+        spec = svc.get("spec", {})
+        cfg = {**DEFAULT_AUTOSCALE, **(spec.get("autoscale") or {})}
+        lo = max(1, int(spec.get("minReplicas", 1)))
+        hi = max(lo, int(spec.get("maxReplicas", lo)))
+        current = int((svc.get("status") or {}).get("replicas") or 0)
+        if current <= 0:  # first reconcile: spec.replicas seeds the pool
+            current = int(spec.get("replicas", lo) or lo)
+        current = min(max(current, lo), hi)
+
+        signals = []
+        for i in range(current):
+            sig = self.fetch_metrics(self.replica_addr(name, ns, i))
+            if sig is not None:
+                signals.append(sig)
+        desired, reason = self._decide((ns, name), current, lo, hi,
+                                       signals, cfg)
+
+        self._ensure_replicas(svc, desired)
+        self._prune_replicas(svc, desired)
+        self._ensure_router(svc, desired)
+        self._update_status(svc, desired, signals, reason, cfg)
+        return float(cfg["scrapePeriodSeconds"])
+
+    # -- autoscale policy ---------------------------------------------
+
+    @staticmethod
+    def _breaches(sig: dict, cfg: dict, ratio: float = 1.0) -> list[str]:
+        """Signal names at or over ``target * ratio`` — ratio 1.0 is the
+        breach test, ``scaleDownRatio`` the low-water test."""
+        out = []
+        if sig["queue_wait_p99_s"] * 1e3 > cfg["queueWaitP99Ms"] * ratio:
+            out.append("queue_wait_p99")
+        if sig["ttft_p99_s"] * 1e3 > cfg["ttftP99Ms"] * ratio:
+            out.append("ttft_p99")
+        if sig["kv_utilization"] > cfg["kvBytesUtilization"] * ratio:
+            out.append("kv_bytes")
+        return out
+
+    def _decide(self, key: tuple[str, str], current: int, lo: int, hi: int,
+                signals: list[dict], cfg: dict) -> tuple[int, str]:
+        """One scaling decision. Up is immediate (a breach is user-
+        visible latency, the urgent direction); down needs the whole
+        fleet inside the hysteresis band AND the cooldown elapsed, so a
+        breach → scale-up → relief sequence cannot flap back within the
+        window."""
+        now = self.clock()
+        # First sight anchors the cooldown: a freshly declared pool gets
+        # a full cooldown of observation before any scale-down (spec
+        # .replicas is the operator's intent, not a transient to erase).
+        state = self._scale_state.setdefault(key, {"last_scale": now})
+        breached = sorted({b for s in signals
+                           for b in self._breaches(s, cfg)})
+        if breached and current < hi:
+            state["last_scale"] = now
+            return current + 1, f"scale-up: {','.join(breached)} over target"
+        low = bool(signals) and not any(
+            self._breaches(s, cfg, float(cfg["scaleDownRatio"]))
+            for s in signals)
+        last = state["last_scale"]
+        cooled = last is None or (now - last) >= float(
+            cfg["cooldownSeconds"])
+        if low and current > lo and cooled:
+            state["last_scale"] = now
+            return current - 1, "scale-down: all signals under low water"
+        return current, ""
+
+    # -- children -----------------------------------------------------
+
+    def _replica_objects(self, svc: dict, i: int) -> list[dict]:
+        """One replica's Deployment + Service, rendered through the
+        tpu-serving prototype (same args/probes/scrape annotations a
+        hand-deployed model server gets) and labeled for pruning."""
+        name = svc["metadata"]["name"]
+        ns = svc["metadata"]["namespace"]
+        spec = svc.get("spec", {})
+        params = {
+            "name": self.replica_name(name, i),
+            "namespace": ns,
+            "model_path": spec.get("modelPath", ""),
+            "model_name": spec.get("model", name),
+            "replicas": 1,
+            "num_tpu_chips": int(spec.get("tpuChipsPerReplica", 1)),
+            **(spec.get("engine") or {}),
+        }
+        if spec.get("image"):
+            params["image"] = spec["image"]
+        objs = generate("tpu-serving", params)
+        ref = k8s.object_ref(svc)
+        for o in objs:
+            labels = o["metadata"].setdefault("labels", {})
+            labels[SERVICE_LABEL] = name
+            labels[REPLICA_LABEL] = str(i)
+            o["metadata"]["ownerReferences"] = [ref]
+        return objs
+
+    def _ensure_replicas(self, svc: dict, desired: int) -> None:
+        for i in range(desired):
+            for obj in self._replica_objects(svc, i):
+                existing = self.client.get_or_none(
+                    obj["apiVersion"], obj["kind"],
+                    obj["metadata"]["name"],
+                    obj["metadata"]["namespace"])
+                if existing is None:
+                    self.client.create(obj)
+                elif existing.get("spec") != obj["spec"]:
+                    existing["spec"] = obj["spec"]
+                    self.client.update(existing)
+
+    def _prune_replicas(self, svc: dict, desired: int) -> None:
+        """Delete replica children at or past the desired count — the
+        scale-down path. Highest indices go first so the rendezvous
+        ring loses members from one stable end."""
+        name = svc["metadata"]["name"]
+        ns = svc["metadata"]["namespace"]
+        for api_version, kind in (("apps/v1", "Deployment"),
+                                  ("v1", "Service")):
+            for obj in self.client.list(
+                    api_version, kind, ns,
+                    label_selector={SERVICE_LABEL: name}):
+                idx = obj["metadata"].get("labels", {}).get(REPLICA_LABEL)
+                if idx is not None and int(idx) >= desired:
+                    self.client.delete(api_version, kind,
+                                       obj["metadata"]["name"], ns)
+
+    def _ensure_router(self, svc: dict, desired: int) -> None:
+        """The selector-less router Service carrying the prefix-affine
+        route over the CURRENT membership — rewriting the annotation on
+        scale events is how the hash ring rebalances (the gateway's
+        route refresh replaces the member set; rendezvous then moves
+        only the changed members' keys)."""
+        name = svc["metadata"]["name"]
+        ns = svc["metadata"]["namespace"]
+        router_cfg = svc.get("spec", {}).get("router") or {}
+        backends = [
+            {"service": self.replica_addr(name, ns, i), "weight": 1}
+            for i in range(desired)
+        ]
+        annotations = gateway_route(
+            f"{name}-pool", f"/models/{name}/", backends[0]["service"],
+            backends=backends, strategy="prefix-affine",
+            affinity_tokens=int(router_cfg.get("affinityTokens", 32)),
+            pressure=int(router_cfg.get("pressure", 8)),
+        )
+        router = k8s.service(
+            name, ns, selector={},
+            ports=[{"name": "rest", "port": REST_PORT}],
+            labels={"app": name, SERVICE_LABEL: name},
+            annotations=annotations,
+        )
+        router["metadata"]["ownerReferences"] = [k8s.object_ref(svc)]
+        existing = self.client.get_or_none("v1", "Service", name, ns)
+        if existing is None:
+            self.client.create(router)
+        elif (existing["metadata"].get("annotations")
+              != router["metadata"]["annotations"]):
+            existing["metadata"]["annotations"] = \
+                router["metadata"]["annotations"]
+            self.client.update(existing)
+
+    def _update_status(self, svc: dict, desired: int, signals: list[dict],
+                       reason: str, cfg: dict) -> None:
+        name = svc["metadata"]["name"]
+        ns = svc["metadata"]["namespace"]
+        ready = 0
+        for i in range(desired):
+            dep = self.client.get_or_none(
+                "apps/v1", "Deployment", self.replica_name(name, i), ns)
+            ready += int((dep or {}).get("status", {})
+                         .get("readyReplicas") or 0)
+        status: dict = {
+            "replicas": desired,
+            "readyReplicas": ready,
+            "phase": "Ready" if ready >= desired else "Scaling",
+            "scrapedReplicas": len(signals),
+        }
+        if signals:
+            status["signals"] = {
+                "queueWaitP99Ms": round(max(
+                    s["queue_wait_p99_s"] for s in signals) * 1e3, 3),
+                "ttftP99Ms": round(max(
+                    s["ttft_p99_s"] for s in signals) * 1e3, 3),
+                "kvBytesUtilization": round(max(
+                    s["kv_utilization"] for s in signals), 4),
+            }
+        if reason:
+            status["lastScaleReason"] = reason
+        svc = copy.deepcopy(svc)
+        svc["status"] = {**(svc.get("status") or {}), **status}
+        self._push_status(svc)

@@ -544,6 +544,74 @@ def paged_decode_attention(q, k_pool, v_pool, table, pos, *,
     return out.reshape(b, hq, hd)
 
 
+def _paged_span_xla(qg, k_pool, v_pool, table, pos, sm_scale):
+    """Blockwise online-softmax walk for an S-wide query span. qg:
+    [B, S, Hkv, G, hd]; pools: [N, Bs, Hkv, hd] (or quantized dicts);
+    table: [B, MB]; pos: [B] — row ``b``'s span token ``s`` attends
+    virtual positions ``<= pos[b] + s`` (its own just-written K/V
+    included). Returns [B, S, Hkv, G, hd] f32; the dense
+    ``[B, MB*Bs]`` view is never built."""
+    n, bs = _kv_payload(k_pool).shape[0], _kv_payload(k_pool).shape[1]
+    b, s_w, hkv, g, hd = qg.shape
+    mb = table.shape[1]
+    q32 = qg.astype(jnp.float32)
+    # Per-(row, span-token) attention limit.
+    limit = pos[:, None] + jnp.arange(s_w)[None, :]  # [B, S]
+
+    def step(carry, j):
+        m, l, acc = carry
+        blk = jnp.clip(table[:, j], 0, n - 1)  # sentinels clamp; masked
+        k_b = _read_block(k_pool, blk)
+        v_b = _read_block(v_pool, blk)
+        s = jnp.einsum("bskgd,bzkd->bkgsz", q32, k_b,
+                       preferred_element_type=jnp.float32) * sm_scale
+        span = j * bs + jnp.arange(bs)[None, None, :]
+        mask = span <= limit[:, :, None]  # [B, S, Bs]
+        s = jnp.where(mask[:, None, None, :, :], s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * corr + jnp.einsum("bkgsz,bzkd->bkgsd", p, v_b)
+        return (m_new, l, acc), None
+
+    init = (
+        jnp.full((b, hkv, g, s_w, 1), _NEG_INF, jnp.float32),
+        jnp.zeros((b, hkv, g, s_w, 1), jnp.float32),
+        jnp.zeros((b, hkv, g, s_w, hd), jnp.float32),
+    )
+    (m, l, acc), _ = lax.scan(step, init, jnp.arange(mb))
+    ok = m > _NEG_INF / 2  # rows parked past the table see no key
+    out = jnp.where(ok, acc / jnp.where(l == 0.0, 1.0, l), 0.0)
+    return out.transpose(0, 3, 1, 2, 4)  # [B, S, Hkv, G, hd]
+
+
+def paged_span_attention(q, k_pool, v_pool, table, pos, *,
+                         n_kv_heads: int, scale: float | None = None):
+    """Fused S-wide attention over a paged KV pool — the span sibling of
+    :func:`paged_decode_attention` (verify scoring reads [slots, K]
+    spans, suffix prefill reads one [1, S] span; both previously paid
+    the dense gather every layer).
+
+    q: [B, S, Hq, hd] (already rotary-embedded, K/V for the span already
+    scattered into the pool); pools/table as in
+    :func:`paged_decode_attention`; pos: [B] — span token ``s`` of row
+    ``b`` attends virtual positions ``<= pos[b] + s``. Returns
+    [B, S, Hq, hd] f32. XLA block walk on every backend (the S-wide
+    kernel shares the decode kernel's contract and can ride the same
+    scalar-prefetch scheme later; the walk already removes the dense
+    materialization, which is the bandwidth bill)."""
+    b, s_w, hq, hd = q.shape
+    if hq % n_kv_heads:
+        raise ValueError(
+            f"query heads {hq} not a multiple of kv heads {n_kv_heads}")
+    group = hq // n_kv_heads
+    sm_scale = (hd ** -0.5) if scale is None else scale
+    qg = q.reshape(b, s_w, n_kv_heads, group, hd)
+    out = _paged_span_xla(qg, k_pool, v_pool, table, pos, sm_scale)
+    return out.reshape(b, s_w, hq, hd)
+
+
 def flash_attention(
     q,
     k,

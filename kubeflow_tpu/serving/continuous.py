@@ -540,6 +540,12 @@ class ContinuousDecoder:
         t_disp = time.perf_counter()
         with self._state_lock:
             if self._alloc is not None:
+                # Table rows go live only now, under THIS dispatch —
+                # the rows' device length/active are set by the same
+                # call, so no other dispatch can ever write through a
+                # freshly mapped row with a stale length.
+                for req, slot in pending:
+                    self._set_table_row(slot, self._slot_blocks[slot])
                 self._state["block_table"] = jnp.asarray(self._table)
                 self._state, last, tok, emit = paged_admit_rows_and_step(
                     self._state, self.params, self.cfg,
@@ -649,6 +655,10 @@ class ContinuousDecoder:
                         self._state["pool"],
                         jnp.int32(self._slot_blocks[slot][n_full]),
                         jnp.int32(entry.blocks[n_full]))
+                # Map the slot's table row only under its own dispatch
+                # (see the pop loop: a row live before its admission is
+                # a stale-length write hazard into shared blocks).
+                self._set_table_row(slot, self._slot_blocks[slot])
                 self._state["block_table"] = jnp.asarray(self._table)
                 self._state, last, tok, emit = paged_admit_prefix_and_step(
                     self._state, self.params, self.cfg, jnp.int32(slot),
@@ -1111,7 +1121,16 @@ class ContinuousDecoder:
                         req.admit_plan = plan
                         blocks = shared + own
                         self._slot_blocks[slot] = blocks
-                        self._set_table_row(slot, blocks)
+                        # The TABLE row stays sentinel until this
+                        # request's own admission dispatch uploads it
+                        # (_admit_prefix/_admit_batch). Pointing it at
+                        # the blocks now would arm a stale-row write:
+                        # an earlier admission's fused decode step in
+                        # the SAME round still sees this slot's old
+                        # device length, and its unconditional K/V
+                        # scatter would land junk inside these blocks —
+                        # including refcount-SHARED prefix blocks other
+                        # streams read.
                         self._pending.popleft()
                         self._mark_admitted(req, slot)
                         pending.append((req, slot))

@@ -1,0 +1,255 @@
+"""Replicated decoder pool with prefix-affine routing.
+
+The in-process face of the fleet layer (the InferenceService operator
+reconciles the same shape out of Deployments + the gateway's
+``prefix-affine`` route strategy): N ``ContinuousDecoder`` replicas
+behind one ``submit()``, requests placed by rendezvous hash of the
+prompt's leading tokens (serving/affinity.py) so each replica's prefix
+trie concentrates its own key range's hits.
+
+Placement policy per request:
+
+1. hash the prompt's leading ``affinity_tokens`` into a key and order
+   the LIVE replicas by rendezvous score — ``order[0]`` is the affine
+   replica;
+2. if the affine replica is over the pressure bound (queue depth at or
+   past ``pressure``, or KV pool fuller than ``kv_pressure``), spill to
+   the least-loaded live replica (deterministic: depth, then rendezvous
+   order breaks ties) — locality yields to an actual hotspot, but only
+   then;
+3. a replica whose scheduler died (submit raises, or an in-flight
+   stream fails with the decoder's crash error) is marked dead and
+   excluded: its keys remap to the next replica in THEIR rendezvous
+   order while every other key stays put.
+
+In-flight streams on a dead replica fail fast with
+:class:`ReplicaUnavailableError` (``code=502`` — the status the gateway
+relays for a dead upstream), never hang out their timeout.
+
+Host-side composition only: the fleet never touches device state, so it
+is exactly as safe as its member decoders.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+
+from kubeflow_tpu.serving.affinity import (
+    DEFAULT_AFFINITY_TOKENS,
+    prefix_affinity_key,
+    rendezvous_order,
+)
+
+
+class ReplicaUnavailableError(RuntimeError):
+    """A replica died under a request routed to it (HTTP-equivalent 502:
+    the backend, not the request, is at fault — clients may retry, and
+    the fleet has already excluded the replica)."""
+
+    code = 502
+
+    def __init__(self, replica: str, cause: Exception | None = None):
+        super().__init__(
+            f"replica {replica!r} is unavailable"
+            + (f": {cause}" if cause is not None else ""))
+        self.replica = replica
+        self.cause = cause
+
+
+class FleetHandle:
+    """Caller-side view of a fleet generation: the member decoder's
+    StreamHandle plus the replica it landed on. Replica death surfaces
+    as :class:`ReplicaUnavailableError` (and marks the replica dead in
+    the fleet) instead of the decoder's raw crash error."""
+
+    def __init__(self, fleet: "DecoderFleet", replica: str, handle):
+        self._fleet = fleet
+        self.replica = replica
+        self._handle = handle
+
+    def _translate(self, err: Exception) -> Exception:
+        if self._fleet._is_replica_death(err):
+            self._fleet.mark_dead(self.replica, cause=err)
+            return ReplicaUnavailableError(self.replica, err)
+        return err
+
+    def tokens(self, timeout: float | None = None):
+        try:
+            yield from self._handle.tokens(timeout)
+        except Exception as e:  # noqa: BLE001 — translated and re-raised
+            raise self._translate(e) from e
+
+    def result(self, timeout: float | None = None, **kw) -> dict:
+        try:
+            return self._handle.result(timeout, **kw)
+        except Exception as e:  # noqa: BLE001 — translated and re-raised
+            raise self._translate(e) from e
+
+    @property
+    def ttft_s(self):
+        return self._handle.ttft_s
+
+
+class DecoderFleet:
+    """N named decoder replicas behind prefix-affine routing.
+
+    ``replicas`` maps name → a :class:`ContinuousDecoder`-shaped object
+    (``submit``/``metrics``/``stop``). ``pressure`` bounds a replica's
+    outstanding requests (0 = unbounded, never spill); ``kv_pressure``
+    bounds its KV pool fill fraction (0 = ignore). ``router`` is
+    "affine" (rendezvous, the default) or "random" (the seeded baseline
+    the fleet bench compares against)."""
+
+    def __init__(self, replicas: dict, *,
+                 affinity_tokens: int = DEFAULT_AFFINITY_TOKENS,
+                 pressure: int = 0, kv_pressure: float = 0.0,
+                 router: str = "affine", seed: int = 0):
+        if not replicas:
+            raise ValueError("DecoderFleet needs at least one replica")
+        if router not in ("affine", "random"):
+            raise ValueError(f"unknown router {router!r}")
+        self._replicas = dict(replicas)
+        self.affinity_tokens = int(affinity_tokens)
+        self.pressure = int(pressure)
+        self.kv_pressure = float(kv_pressure)
+        self.router = router
+        self._rng = random.Random(seed)
+        self._dead: set[str] = set()
+        self._lock = threading.Lock()
+        self.routed = 0
+        self.spilled = 0
+        self.remapped = 0  # submits re-routed off a just-dead replica
+
+    # -- membership ----------------------------------------------------
+
+    def members(self) -> list[str]:
+        return sorted(self._replicas)
+
+    def live_members(self) -> list[str]:
+        with self._lock:
+            return sorted(set(self._replicas) - self._dead)
+
+    def mark_dead(self, name: str, cause: Exception | None = None) -> None:
+        with self._lock:
+            if name in self._replicas:
+                self._dead.add(name)
+
+    @staticmethod
+    def _is_replica_death(err: Exception) -> bool:
+        """The decoder's crash path (_fail_all) propagates WHATEVER
+        killed the scheduler loop into every live stream — RuntimeError
+        for a graceful stop, the loop's own exception otherwise — and a
+        TimeoutError means the replica stopped responding. The only
+        error that is the REQUEST's fault is ValueError (admission
+        validation, e.g. an over-budget prompt): that must surface to
+        the caller, not kill the replica."""
+        return not isinstance(err, (ValueError, ReplicaUnavailableError))
+
+    # -- placement -----------------------------------------------------
+
+    def _depth(self, name: str) -> int:
+        """Approximate outstanding load (queued + in slots). Reads the
+        decoder's counters without its locks — a routing heuristic, not
+        an invariant."""
+        d = self._replicas[name]
+        try:
+            return int(getattr(d, "_active_count", 0)
+                       + len(getattr(d, "_pending", ())))
+        except TypeError:  # pragma: no cover — exotic replica stubs
+            return 0
+
+    def _kv_fill(self, name: str) -> float:
+        d = self._replicas[name]
+        alloc = getattr(d, "_alloc", None)
+        if alloc is None or not getattr(alloc, "num_blocks", 0):
+            return 0.0
+        return alloc.blocks_in_use / alloc.num_blocks
+
+    def _over_pressure(self, name: str) -> bool:
+        if self.pressure > 0 and self._depth(name) >= self.pressure:
+            return True
+        return bool(self.kv_pressure > 0
+                    and self._kv_fill(name) >= self.kv_pressure)
+
+    def route(self, tokens) -> str:
+        """The replica a prompt should land on (no submission): affine
+        pick, pressure spill, dead exclusion."""
+        live = self.live_members()
+        if not live:
+            raise ReplicaUnavailableError("<none>")
+        with self._lock:
+            self.routed += 1
+        if self.router == "random":
+            with self._lock:
+                return self._rng.choice(live)
+        key = prefix_affinity_key(tokens, self.affinity_tokens)
+        order = rendezvous_order(key, live)
+        primary = order[0]
+        if len(order) > 1 and self._over_pressure(primary):
+            # Spill: least-loaded live replica; rendezvous order breaks
+            # depth ties so the choice is deterministic for a given
+            # (key, membership, load) snapshot.
+            spill = min(order[1:],
+                        key=lambda m: (self._depth(m), order.index(m)))
+            if self._depth(spill) < self._depth(primary):
+                with self._lock:
+                    self.spilled += 1
+                return spill
+        return primary
+
+    # -- serving surface ----------------------------------------------
+
+    def submit(self, tokens, max_new_tokens: int,
+               temperature: float = 0.0, *,
+               request_id: str | None = None) -> FleetHandle:
+        """Route and submit, re-routing (and marking dead) when the
+        chosen replica's scheduler is already gone — a submit never
+        fails just because one replica died."""
+        while True:
+            name = self.route(tokens)
+            try:
+                handle = self._replicas[name].submit(
+                    tokens, max_new_tokens, temperature,
+                    request_id=request_id)
+            except Exception as e:  # noqa: BLE001 — death check below
+                if not self._is_replica_death(e):
+                    raise
+                self.mark_dead(name, cause=e)
+                with self._lock:
+                    self.remapped += 1
+                if not self.live_members():
+                    raise ReplicaUnavailableError(name, e) from e
+                continue
+            return FleetHandle(self, name, handle)
+
+    def generate(self, tokens, max_new_tokens: int,
+                 temperature: float = 0.0,
+                 timeout: float | None = None) -> dict:
+        return self.submit(tokens, max_new_tokens, temperature).result(
+            timeout)
+
+    def metrics(self) -> dict:
+        """Per-replica decoder metrics plus fleet aggregates (the bench
+        and the autoscaler read the same names the single-decoder
+        metrics() exposes, summed over live replicas)."""
+        per: dict[str, dict] = {}
+        for name in self.members():
+            if name in self._dead:
+                continue
+            per[name] = self._replicas[name].metrics()
+        agg_keys = ("tokens_emitted", "requests_admitted", "prefix_hits",
+                    "prefix_misses", "kv_blocks_in_use", "in_flight",
+                    "queued")
+        agg = {k: sum(m.get(k, 0) for m in per.values()) for k in agg_keys}
+        agg.update(replicas=per, live=self.live_members(),
+                   dead=sorted(self._dead), routed=self.routed,
+                   spilled=self.spilled, remapped=self.remapped)
+        return agg
+
+    def stop(self) -> None:
+        for name, d in self._replicas.items():
+            try:
+                d.stop()
+            except Exception:  # pragma: no cover — best-effort teardown
+                pass

@@ -26,8 +26,33 @@ from concurrent import futures
 
 import grpc
 
+from kubeflow_tpu.observability.tracing import (
+    REQUEST_ID_HEADER,
+    gen_request_id,
+)
+
 SERVICE = "kubeflow.tpu.serving.PredictionService"
 DEFAULT_GRPC_PORT = 9000
+
+_RID_KEY = REQUEST_ID_HEADER.lower()  # grpc metadata keys are lowercase
+
+
+def _request_id(context) -> str:
+    """X-Request-ID for a gRPC call: honor the caller's metadata value
+    (the gateway/client-propagated id), mint one otherwise, and echo it
+    on the initial metadata — the :9000 twin of the REST handler's
+    header contract, so PR-7 tracing covers BOTH ingresses."""
+    rid = ""
+    for key, value in context.invocation_metadata() or ():
+        if key.lower() == _RID_KEY and value:
+            rid = value
+            break
+    rid = rid or gen_request_id()
+    try:
+        context.send_initial_metadata(((_RID_KEY, rid),))
+    except (grpc.RpcError, ValueError):  # pragma: no cover — echo only
+        pass
+    return rid
 
 
 def _json_bytes(obj) -> bytes:
@@ -121,7 +146,8 @@ class _Handler(grpc.GenericRpcHandler):
             body = self._parse(request, context)
             name = body.get("model") or server.engine.cfg.model
             try:
-                result = server.handle_predict(name, body)
+                result = server.handle_predict(
+                    name, body, request_id=_request_id(context))
             except KeyError as e:
                 context.abort(grpc.StatusCode.NOT_FOUND, str(e))
             except (ValueError, TimeoutError) as e:
@@ -146,7 +172,8 @@ class _Handler(grpc.GenericRpcHandler):
             body = self._parse(request, context)
             name = body.get("model") or server.engine.cfg.model
             try:
-                records = server.handle_predict_stream(name, body)
+                records = server.handle_predict_stream(
+                    name, body, request_id=_request_id(context))
             except KeyError as e:
                 context.abort(grpc.StatusCode.NOT_FOUND, str(e))
             except (ValueError, TimeoutError) as e:
@@ -207,10 +234,12 @@ def stream_stub(channel: grpc.Channel):
         response_deserializer=bytes,
     )
 
-    def do_stream(model: str, instance: dict, timeout: float = 60.0):
+    def do_stream(model: str, instance: dict, timeout: float = 60.0,
+                  metadata=None):
         for msg in predict_stream(
             _json_bytes({"model": model, "instances": [instance]}),
             timeout=timeout,
+            metadata=metadata,
         ):
             yield json.loads(msg)
 

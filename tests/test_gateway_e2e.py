@@ -1167,3 +1167,83 @@ def test_single_request_traced_gateway_server_decoder(platform):
     # The gateway hop recorded the same id on its own timeline.
     gw_recs = gw.trace.find(rid)
     assert gw_recs and all(r["status"] != "open" for r in gw_recs)
+
+
+def test_prefix_affine_routing_through_gateway(api):
+    """Replica-pool routing e2e: a prefix-affine route over two live
+    backends sends every request sharing a prompt prefix to ONE backend
+    (rendezvous by the leading tokens), spreads distinct prefixes, and
+    remaps ONLY the dead backend's keys when a replica dies — while the
+    health machinery 502s the dead pick and then ejects it."""
+    from kubeflow_tpu.gateway.resilience import UpstreamHealth
+    from kubeflow_tpu.manifests.core import gateway_route
+
+    a, b = _IdentityBackend("a"), _IdentityBackend("b")
+    svc = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": "pool", "namespace": "kubeflow",
+            "annotations": gateway_route(
+                "pool", "/models/m/", "m-r0.kubeflow:8500",
+                backends=[{"service": "m-r0.kubeflow:8500", "weight": 1},
+                          {"service": "m-r1.kubeflow:8500", "weight": 1}],
+                strategy="prefix-affine", affinity_tokens=4, pressure=0),
+        },
+    }
+    api.apply(svc)
+    table = RouteTable()
+    assert table.refresh(api) == 1
+    backends = {
+        "m-r0.kubeflow:8500": f"127.0.0.1:{a.port}",
+        "m-r1.kubeflow:8500": f"127.0.0.1:{b.port}",
+    }
+    gw = Gateway(table, port=0, admin_port=0, probe_interval=0,
+                 resolve=lambda addr: backends.get(addr, addr),
+                 health=UpstreamHealth(failure_threshold=1,
+                                       ejection_seconds=30.0))
+    gw.start()
+    try:
+        base = f"http://127.0.0.1:{gw._proxy.server_address[1]}"
+
+        def predict(tokens):
+            _, out, _ = http(
+                "POST", f"{base}/models/m/v1/models/m:predict",
+                {"instances": [{"tokens": tokens}]})
+            return out["variant"]
+
+        # Affinity: one prompt prefix → one backend, every time.
+        group1 = [predict([1, 2, 3, 4, 9 + i]) for i in range(6)]
+        assert len(set(group1)) == 1
+        # Distinct prefixes spread over the pool.
+        variants = {predict([seed, seed + 1, 5, 6]) for seed in range(16)}
+        assert variants == {"a", "b"}
+
+        # Find a prefix homed on each backend, then kill backend
+        # group1 lives on.
+        home1 = group1[0]
+        other_tokens = next(
+            [seed, seed + 1, 5, 6] for seed in range(16)
+            if predict([seed, seed + 1, 5, 6]) != home1)
+        victim = a if home1 == "a" else b
+        survivor = "b" if home1 == "a" else "a"
+        victim.close()
+
+        # First request after death: connect fails → 502 (POST bodies
+        # are never retried blind), and the failure ejects the backend.
+        with pytest.raises(urllib.error.HTTPError) as e:
+            predict([1, 2, 3, 4, 99])
+        assert e.value.code == 502
+        # Dead backend ejected → its keys remap to the survivor...
+        assert predict([1, 2, 3, 4, 100]) == survivor
+        # ...while keys whose affine home SURVIVED stay exactly where
+        # they were (only the dead replica's keys moved).
+        for _ in range(3):
+            assert predict(other_tokens) == survivor
+    finally:
+        gw.stop()
+        for be in (a, b):
+            try:
+                be.close()
+            except Exception:
+                pass

@@ -21,7 +21,10 @@ import pytest
 
 from kubeflow_tpu.observability.metrics import type_line
 import kubeflow_tpu.models.decode as decode_mod
-from kubeflow_tpu.ops.attention import paged_decode_attention
+from kubeflow_tpu.ops.attention import (
+    paged_decode_attention,
+    paged_span_attention,
+)
 from kubeflow_tpu.serving.continuous import ContinuousDecoder
 from kubeflow_tpu.serving.engine import EngineConfig
 from kubeflow_tpu.serving.kv_allocator import (
@@ -123,6 +126,107 @@ def test_pallas_kernel_matches_xla_walk():
                                      interpret=True)
         np.testing.assert_allclose(np.asarray(pal), np.asarray(xla),
                                    rtol=1e-6, atol=1e-6)
+
+
+def _ref_span_attention(q, kp, vp, table, pos, n):
+    """Dense gather reference for the S-wide span read: token ``s`` of
+    row ``b`` attends virtual positions ``<= pos[b] + s``."""
+    b, s_w = q.shape[0], q.shape[1]
+    mb = table.shape[1]
+    bs, hkv, hd = kp.shape[1], kp.shape[2], kp.shape[3]
+    g = q.shape[2] // hkv
+    k = kp[jnp.clip(table, 0, n - 1)].reshape(b, mb * bs, hkv, hd)
+    v = vp[jnp.clip(table, 0, n - 1)].reshape(b, mb * bs, hkv, hd)
+    qg = q.reshape(b, s_w, hkv, g, hd).astype(jnp.float32)
+    scores = jnp.einsum("bskgd,btkd->bkgst", qg,
+                        k.astype(jnp.float32)) * (hd ** -0.5)
+    cols = pos[:, None] + jnp.arange(s_w)[None, :]
+    mask = jnp.arange(mb * bs)[None, None, :] <= cols[:, :, None]
+    scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgst,btkd->bskgd", p, v.astype(jnp.float32))
+    return out.reshape(b, s_w, q.shape[2], hd)
+
+
+def test_span_fused_matches_gather_reference():
+    """The span block-walk (verify scoring / suffix prefill's fused
+    read) is pinned to the dense gather reference — fp AND int8,
+    sentinel table entries included."""
+    for quant in (False, True):
+        q1, kp, vp, table, pos, n, hkv = _rand_pools(quant=quant)
+        rng = np.random.RandomState(11)
+        s_w = 4
+        q = jnp.asarray(rng.randn(q1.shape[0], s_w, q1.shape[1],
+                                  q1.shape[2]).astype(np.float32))
+        if quant:
+            deq_k = kp["q"].astype(jnp.float32) * kp["scale"][..., None]
+            deq_v = vp["q"].astype(jnp.float32) * vp["scale"][..., None]
+        else:
+            deq_k, deq_v = kp, vp
+        ref = _ref_span_attention(q, deq_k, deq_v, table, pos, n)
+        out = paged_span_attention(q, kp, vp, table, pos, n_kv_heads=hkv)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_span_fused_decode_step_parity():
+    """A decode step IS a width-1 span: paged_span_attention at S=1
+    must agree with paged_decode_attention on the same pools."""
+    q, kp, vp, table, pos, n, hkv = _rand_pools(quant=False)
+    dec = paged_decode_attention(q, kp, vp, table, pos, n_kv_heads=hkv,
+                                 implementation="xla")
+    span = paged_span_attention(q[:, None], kp, vp, table, pos,
+                                n_kv_heads=hkv)[:, 0]
+    np.testing.assert_allclose(np.asarray(span), np.asarray(dec),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_fused_speculative_and_prefix_admission_trace_no_gather(
+        model, monkeypatch):
+    """ROADMAP item-4 leftover closed: with kv_fused on, the SPAN-wide
+    reads (verify scoring, suffix prefill) ride the block walk too — a
+    fused decoder running speculation + prefix hits traces ZERO dense
+    gathers, stays within the pinned tolerance of the gather reference,
+    and leaks nothing."""
+    donor = list(range(2, 22))
+    spec_prompts = [([3, 17, 29, 3, 17] * 3)[:12], [1, 2, 3]]
+    kw = dict(speculative_k=3, prefix_cache_slots=4,
+              prefix_cache_min_len=8)
+    plain = _paged(model, **kw)
+    try:
+        ref = [plain.generate(p, 6, timeout=120)["tokens"]
+               for p in spec_prompts]
+        ref_cold = plain.generate(donor, 6, timeout=120)["tokens"]
+        ref_hit = plain.generate(donor + [50, 51], 6,
+                                 timeout=120)["tokens"]
+        assert plain.metrics()["prefix_hits"] == 1
+    finally:
+        plain.stop()
+
+    calls = {"n": 0}
+    real = decode_mod._pool_gather
+
+    def counting(*a, **kws):
+        calls["n"] += 1
+        return real(*a, **kws)
+
+    monkeypatch.setattr(decode_mod, "_pool_gather", counting)
+    fused = _paged(model, kv_fused=True, **kw)
+    try:
+        out = [fused.generate(p, 6, timeout=120)["tokens"]
+               for p in spec_prompts]
+        out_cold = fused.generate(donor, 6, timeout=120)["tokens"]
+        out_hit = fused.generate(donor + [50, 51], 6,
+                                 timeout=120)["tokens"]
+        m = fused.metrics()
+    finally:
+        fused.stop()
+    assert calls["n"] == 0  # no span OR decode read materialized
+    assert m["prefix_hits"] == 1  # the suffix-prefill path really ran
+    assert m["spec_verify_dispatches"] > 0  # the verify path really ran
+    assert _agreement(out + [out_cold, out_hit],
+                      ref + [ref_cold, ref_hit]) >= 0.75
+    assert all(not blocks for blocks in fused._slot_blocks)
 
 
 def test_int8_dequant_within_quantization_error():

@@ -33,6 +33,7 @@ def test_all_prototypes_registered():
         "gateway",
         "centraldashboard",
         "tpu-serving",
+        "inference-service",
     ]:
         assert expected in protos, f"missing prototype {expected}"
 

@@ -398,3 +398,45 @@ def test_paged_counters_exported_as_prometheus(model):
     assert type_line("serving_kv_shared_blocks_total", "counter") in text
     assert "serving_kv_cow_copies_total" in text
     assert "serving_kv_defer_admissions_total 0" in text
+
+
+def test_concurrent_same_round_prefix_hits_stay_exact(model):
+    """Regression (found by the fleet bench's shared-prefix traffic): a
+    freed slot's block-table row must stay SENTINEL until the slot's
+    own admission dispatch. Pointing it at freshly shared blocks at pop
+    time let an earlier same-round hit admission's fused decode step
+    write through the reassigned row at its stale device length —
+    landing junk INSIDE refcount-shared prefix blocks, silently
+    corrupting every stream that read the donor prefix afterwards.
+    Three followers hitting the same donor concurrently (admitted in
+    one round, slots freshly recycled) is the trigger."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    prefix = [(7 * j) % 97 + 3 for j in range(24)]
+    followers = [prefix + [200, 150 + r, 11 + r, 7] for r in (1, 2, 3)]
+    gen = 8
+
+    cold = _paged(model, slots=8, max_new_tokens=gen)
+    try:
+        ref = [cold.generate(t, gen, timeout=120)["tokens"]
+               for t in followers]
+    finally:
+        cold.stop()
+
+    d = _paged(model, slots=8, max_new_tokens=gen,
+               prefix_cache_slots=8, prefix_cache_min_len=16,
+               prefill_len_buckets=2, kv_pool_blocks=40,
+               stream_timeout_s=120.0)
+    try:
+        # Leader decodes (recycling slots + publishing the prefix),
+        # then all three followers hit the donor in one burst.
+        d.generate(prefix + [200, 150, 11, 7], gen, timeout=120)
+        with ThreadPoolExecutor(3) as pool:
+            out = list(pool.map(
+                lambda t: d.generate(t, gen, timeout=120)["tokens"],
+                followers))
+        m = d.metrics()
+    finally:
+        d.stop()
+    assert m["prefix_hits"] == 3
+    assert out == ref  # byte-identical to the no-cache reference

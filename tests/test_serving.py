@@ -215,3 +215,61 @@ def test_rest_generation_request():
         assert "logits" not in pred
     finally:
         server.stop()
+
+
+def test_grpc_request_id_threads_into_decoder_timeline():
+    """The gRPC ingress satellite: a client-supplied x-request-id on
+    PredictStream metadata reaches ContinuousDecoder.submit, so the
+    stream's lifecycle timeline is keyed by the SAME id the gateway
+    would forward — and a call without metadata still gets a generated
+    id (no anonymous streams)."""
+    import grpc
+
+    from kubeflow_tpu.serving.grpc_server import stream_stub
+
+    server = ModelServer(
+        EngineConfig(model="lm-test-tiny", batch_size=4, max_seq_len=32,
+                     max_new_tokens=8),
+        port=0, grpc_port=0, batch_timeout_ms=2,
+    )
+    server.start()
+    try:
+        rid = "req-fleet-42"
+        with grpc.insecure_channel(
+                f"127.0.0.1:{server.grpc_port}") as chan:
+            do_stream = stream_stub(chan)
+            records = list(do_stream(
+                "lm-test-tiny", {"tokens": [1, 2, 3],
+                                 "max_new_tokens": 4},
+                metadata=(("x-request-id", rid),)))
+            assert records[-1]["done"] is True
+            # The decoder's trace store has a timeline under that id,
+            # with the full submit→finish lifecycle pinned to it.
+            tl = [t for t in server.decoder.trace.snapshot()["finished"]
+                  if t["request_id"] == rid]
+            assert tl, "client request id missing from the timeline"
+            phases = [e["name"] for e in tl[0]["events"]]
+            assert "submit" in phases and "first_token" in phases
+
+            # No metadata → a generated id, never an anonymous stream.
+            list(do_stream("lm-test-tiny",
+                           {"tokens": [4, 5], "max_new_tokens": 2}))
+            ids = {t["request_id"]
+                   for t in server.decoder.trace.snapshot()["finished"]}
+            assert rid in ids and len(ids) == 2
+
+            # Unary Predict rides the same contract.
+            predict = chan.unary_unary(
+                "/kubeflow.tpu.serving.PredictionService/Predict",
+                request_serializer=bytes,
+                response_deserializer=bytes,
+            )
+            predict(json.dumps({
+                "model": "lm-test-tiny",
+                "instances": [{"tokens": [1, 2], "max_new_tokens": 2}],
+            }).encode(), metadata=(("x-request-id", "req-unary-7"),))
+            ids = {t["request_id"]
+                   for t in server.decoder.trace.snapshot()["finished"]}
+            assert "req-unary-7" in ids
+    finally:
+        server.stop()
