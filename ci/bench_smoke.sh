@@ -48,6 +48,14 @@ check_json "$out"
 # greedy tokens differ across runs, or when any replica leaks blocks.
 out="$(JAX_PLATFORMS=cpu python bench_serving.py --quick --fleet-sweep)"
 check_json "$out"
+# Disaggregated prefill/decode: the marker fires when the role-split
+# fleet's TTFT p99 beats colocated by <1.3x at equal total pool bytes
+# under mixed burst traffic, when aggregate tokens/s falls under 0.95x
+# colocated, when greedy tokens differ from the single-replica
+# reference (fp or int8 — scale blocks must ride the handoff exactly),
+# or when either pool leaks blocks.
+out="$(JAX_PLATFORMS=cpu python bench_serving.py --quick --disagg-sweep)"
+check_json "$out"
 echo "bench smoke ok"
 # Training input pipeline: prefetch-on must match prefetch-off final
 # loss byte-for-byte (bench.py sets the regression marker otherwise)

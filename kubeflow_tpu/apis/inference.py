@@ -21,26 +21,45 @@ INFERENCE_API_VERSION = f"{API_GROUP}/v1"
 # Autoscale policy defaults: targets are BREACH thresholds (p99s over the
 # PR-7 histograms, KV fill over the real-byte gauges); scale-down needs
 # every signal under target * scale_down_ratio (hysteresis band) AND
-# cooldown_seconds since the last scale event (flap damping).
+# cooldown_seconds since the last scale event (flap damping). In a
+# role-split service each pool is judged ONLY on the signals that bind
+# it: prefill on queue-wait/TTFT p99, decode on KV-byte fill and
+# inter-token p99.
 DEFAULT_AUTOSCALE = {
     "queueWaitP99Ms": 500.0,
     "ttftP99Ms": 2000.0,
+    "interTokenP99Ms": 500.0,
     "kvBytesUtilization": 0.85,
     "scaleDownRatio": 0.5,
     "cooldownSeconds": 60.0,
     "scrapePeriodSeconds": 10.0,
 }
 
+# Roles a disaggregated InferenceService splits its replicas into.
+INFERENCE_ROLES = ("prefill", "decode")
+
 
 def inference_service_crd() -> dict:
     autoscale_props = {
         "queueWaitP99Ms": {"type": "number", "minimum": 0},
         "ttftP99Ms": {"type": "number", "minimum": 0},
+        "interTokenP99Ms": {"type": "number", "minimum": 0},
         "kvBytesUtilization": {"type": "number", "minimum": 0,
                                "maximum": 1},
         "scaleDownRatio": {"type": "number", "minimum": 0, "maximum": 1},
         "cooldownSeconds": {"type": "number", "minimum": 0},
         "scrapePeriodSeconds": {"type": "number", "minimum": 0},
+    }
+    # Per-role pool overrides for disaggregated prefill/decode serving:
+    # each role gets its own replica range and engine overrides (merged
+    # over the top-level engine; the operator pins serving_role and the
+    # paged KV layout the handoff needs).
+    role_props = {
+        "replicas": {"type": "integer", "minimum": 0},
+        "minReplicas": {"type": "integer", "minimum": 1},
+        "maxReplicas": {"type": "integer", "minimum": 1},
+        "engine": {"type": "object",
+                   "x-kubernetes-preserve-unknown-fields": True},
     }
     schema = {
         "type": "object",
@@ -70,6 +89,16 @@ def inference_service_crd() -> dict:
                                                "minimum": 1},
                             "pressure": {"type": "integer",
                                          "minimum": 0},
+                            "kvPressure": {"type": "number",
+                                           "minimum": 0, "maximum": 1},
+                        },
+                    },
+                    "roles": {
+                        "type": "object",
+                        "properties": {
+                            role: {"type": "object",
+                                   "properties": role_props}
+                            for role in INFERENCE_ROLES
                         },
                     },
                     "autoscale": {"type": "object",
@@ -120,20 +149,35 @@ def inference_service(
     engine: dict | None = None,
     affinity_tokens: int = 32,
     pressure: int = 8,
+    kv_pressure: float = 0.0,
+    roles: dict | None = None,
     autoscale: dict | None = None,
 ) -> dict:
     """Build an InferenceService CR. ``engine`` maps tpu-serving param
     names (batch_size, kv_layout, ...) to values; ``autoscale`` overrides
-    DEFAULT_AUTOSCALE keys."""
+    DEFAULT_AUTOSCALE keys. ``roles`` splits the service into
+    disaggregated prefill/decode pools: ``{"prefill": {"replicas": 2,
+    "engine": {...}}, "decode": {...}}`` — each pool autoscaled on the
+    signal that binds it. ``kv_pressure`` (0 disables) lets the gateway
+    spill affine picks off a backend whose KV pool fill crosses it."""
+    if roles:
+        bad = set(roles) - set(INFERENCE_ROLES)
+        if bad:
+            raise ValueError(f"unknown inference roles {sorted(bad)}")
+    router: dict = {"affinityTokens": int(affinity_tokens),
+                    "pressure": int(pressure)}
+    if kv_pressure:
+        router["kvPressure"] = float(kv_pressure)
     spec: dict = {
         "model": model,
         "replicas": int(replicas),
         "minReplicas": int(min_replicas),
         "maxReplicas": int(max_replicas),
-        "router": {"affinityTokens": int(affinity_tokens),
-                   "pressure": int(pressure)},
+        "router": router,
         "autoscale": {**DEFAULT_AUTOSCALE, **(autoscale or {})},
     }
+    if roles:
+        spec["roles"] = {r: dict(v) for r, v in roles.items()}
     if model_path:
         spec["modelPath"] = model_path
     if image:

@@ -34,14 +34,16 @@ from kubeflow_tpu.observability.tracing import TraceStore
 from kubeflow_tpu.gateway.resilience import (
     BackendLoad,
     BanditStats,
+    KvFillCache,
     OutlierStats,
     UpstreamHealth,
 )
 from kubeflow_tpu.gateway.routing import Route, RouteTable, routes_from_service
 
 __all__ = [
-    "BackendLoad", "BanditStats", "Gateway", "OutlierStats", "Route",
-    "RouteTable", "UpstreamHealth", "routes_from_service",
+    "BackendLoad", "BanditStats", "Gateway", "KvFillCache",
+    "OutlierStats", "Route", "RouteTable", "UpstreamHealth",
+    "routes_from_service",
 ]
 
 log = logging.getLogger(__name__)
@@ -137,6 +139,15 @@ class Gateway:
         # traffic this gateway carries; no scrape freshness to trust).
         self.load = BackendLoad()
         self.affine_spills = 0
+        # Gateway-side KV-fill scrape (staleness-bounded): the replica
+        # pool signal the in-flight depth can't see — a backend whose
+        # block pool is nearly full defers admissions long before its
+        # gateway-visible depth grows. Folded into the prefix-affine
+        # spill decision when the route sets kv_pressure.
+        self.kv_fill = KvFillCache()
+        # Disaggregated two-hop relay counters (prefill_backends routes).
+        self.handoffs_total = 0
+        self.handoff_failures = 0
         # Shared observability registry (served on the admin /metrics):
         # per-route upstream latency distributions — the signal a
         # metric-driven autoscaler reads per backend pool.

@@ -34,6 +34,10 @@ def make_admin_handler(gw):
                 snap = gw.health.snapshot()
                 for svc, depth in gw.load.snapshot().items():
                     snap.setdefault(svc, {})["in_flight"] = depth
+                # Last-scraped KV fill (None = no signal) alongside the
+                # depth, so operators see both spill inputs in one view.
+                for svc, fill in gw.kv_fill.snapshot().items():
+                    snap.setdefault(svc, {})["kv_fill"] = fill
                 body = json.dumps(snap).encode()
                 ctype = "application/json"
             elif self.path == "/metrics":
@@ -48,6 +52,12 @@ def make_admin_handler(gw):
                     "gateway_shadow_requests_total": gw.shadow_total,
                     "gateway_retries_total": gw.retries_total,
                     "gateway_affine_spills_total": gw.affine_spills,
+                    "gateway_handoffs_total": gw.handoffs_total,
+                    "gateway_handoff_failures_total":
+                        gw.handoff_failures,
+                    "gateway_kv_scrapes_total": gw.kv_fill.scrapes,
+                    "gateway_kv_scrape_failures_total":
+                        gw.kv_fill.scrape_failures,
                     "gateway_outliers_total": gw.outliers.totals()[0],
                     "gateway_outlier_scored_total":
                         gw.outliers.totals()[1],

@@ -38,6 +38,24 @@ _HOP_HEADERS = {
 }
 
 
+def prompt_tokens_for(body: bytes | None) -> list | None:
+    """The prompt token list out of a predict payload's first instance,
+    or None when the body isn't one. Never raises — unparseable traffic
+    simply carries no prompt."""
+    if not body:
+        return None
+    try:
+        payload = json.loads(body)
+        inst = (payload.get("instances") or [None])[0] \
+            if isinstance(payload, dict) else None
+        toks = inst.get("tokens") if isinstance(inst, dict) else None
+        if isinstance(toks, list) and toks:
+            return toks
+    except (ValueError, TypeError, UnicodeDecodeError):
+        pass
+    return None
+
+
 def affinity_key_for(body: bytes | None, path: str, width: int) -> str:
     """Routing key for a prefix-affine route: the prompt's leading
     tokens when the body is a predict payload (requests sharing a
@@ -45,15 +63,9 @@ def affinity_key_for(body: bytes | None, path: str, width: int) -> str:
     body otherwise, the path for bodyless requests. Never raises —
     unparseable traffic still routes deterministically."""
     if body:
-        try:
-            payload = json.loads(body)
-            inst = (payload.get("instances") or [None])[0] \
-                if isinstance(payload, dict) else None
-            toks = inst.get("tokens") if isinstance(inst, dict) else None
-            if isinstance(toks, list) and toks:
-                return prefix_affinity_key(toks, width)
-        except (ValueError, TypeError, UnicodeDecodeError):
-            pass
+        toks = prompt_tokens_for(body)
+        if toks is not None:
+            return prefix_affinity_key(toks, width)
         return hashlib.blake2b(body[:1024], digest_size=8).hexdigest()
     return path
 
@@ -169,6 +181,13 @@ def make_proxy_handler(gw):
                 affinity_key = affinity_key_for(
                     body, self.path, route.affinity_tokens)
             service = self._pick_backend(route, key=affinity_key)
+            if (route.prefill_backends and affinity_key is not None
+                    and self.path.endswith(":predict")):
+                # Disaggregated two-hop: have the affine prefill
+                # backend compute the prompt KV and push it to the
+                # decode backend picked above, THEN relay the predict
+                # there — where it prefix-hits the imported blocks.
+                self._prefill_hop(route, body, affinity_key, service)
             target = route.target_for(self.path, service)
             # Re-point at the resolved backend address.
             target = target.replace(service, gw.resolve(service), 1)
@@ -202,16 +221,29 @@ def make_proxy_handler(gw):
                 # for this key; excluding a dead/ejected backend remaps
                 # ONLY its keys (survivors keep their order). Spill to
                 # the least-loaded backend when the affine replica is
-                # over the in-flight pressure bound — locality yields
-                # to a real hotspot, and only then.
+                # over the in-flight pressure bound OR its KV pool is
+                # fuller than kv_pressure (staleness-bounded scrape;
+                # no signal = no KV opinion, never "empty") — locality
+                # yields to a real hotspot, and only then.
                 order = rendezvous_order(key or self.path, services)
                 picked = order[0]
-                if (route.pressure > 0 and len(order) > 1
-                        and gw.load.depth(picked) >= route.pressure):
+                over_depth = (route.pressure > 0
+                              and gw.load.depth(picked) >= route.pressure)
+                fill = None
+                if not over_depth and route.kv_pressure > 0:
+                    fill = gw.kv_fill.fill(picked, gw.resolve)
+                over_kv = (fill is not None
+                           and fill >= route.kv_pressure)
+                if (over_depth or over_kv) and len(order) > 1:
                     spill = gw.load.least_loaded(order[1:])
-                    if (spill is not None
-                            and gw.load.depth(spill)
-                            < gw.load.depth(picked)):
+                    if spill is not None and over_depth and \
+                            gw.load.depth(spill) >= gw.load.depth(picked):
+                        spill = None  # everyone is at least as deep
+                    if spill is not None and over_kv:
+                        sf = gw.kv_fill.fill(spill, gw.resolve)
+                        if sf is not None and sf >= fill:
+                            spill = None  # no less-full pool to go to
+                    if spill is not None:
                         picked = spill
                         gw.affine_spills += 1
             elif route.strategy == "epsilon-greedy":
@@ -226,6 +258,55 @@ def make_proxy_handler(gw):
             # actually takes the request.
             gw.health.begin_trial(picked)
             return picked
+
+        def _prefill_hop(self, route, body, key, decode_service) -> None:
+            """Hop 1 of the disaggregated relay: POST ``:prefill`` at
+            the affine prefill backend with ``handoff_to`` naming the
+            decode backend, so the KV payload travels server-to-server
+            and never transits the gateway. Best-effort — any failure
+            just means the decode backend prefills the prompt itself
+            (degraded, never wrong), so errors are counted, never
+            surfaced to the client."""
+            toks = prompt_tokens_for(body)
+            if toks is None:
+                return  # not a generate payload: nothing to hand off
+            healthy = gw.health.filter_healthy(
+                [b[0] for b in route.prefill_backends])
+            if not healthy:
+                gw.handoff_failures += 1
+                return
+            prefill_svc = rendezvous_order(key, healthy)[0]
+            target = route.target_for(self.path, prefill_svc)
+            target = target.replace(prefill_svc,
+                                    gw.resolve(prefill_svc), 1)
+            parts = urllib.parse.urlsplit(target)
+            hop_path = parts.path.replace(":predict", ":prefill")
+            payload = json.dumps({
+                "instances": [{"tokens": toks}],
+                "handoff_to": decode_service,
+            }).encode()
+            try:
+                conn = HTTPConnection(parts.hostname, parts.port,
+                                      timeout=gw.upstream_timeout)
+                try:
+                    conn.request(
+                        "POST", hop_path, body=payload,
+                        headers={"Content-Type": "application/json",
+                                 REQUEST_ID_HEADER: self._request_id})
+                    resp = conn.getresponse()
+                    out = json.loads(resp.read() or b"{}")
+                finally:
+                    conn.close()
+                if resp.status == 200 and out.get("handoff"):
+                    gw.handoffs_total += 1
+                    gw.health.record_success(prefill_svc)
+                else:
+                    gw.handoff_failures += 1
+                    if resp.status >= 500:
+                        gw.health.record_failure(prefill_svc)
+            except (OSError, ValueError):
+                gw.handoff_failures += 1
+                gw.health.record_failure(prefill_svc)
 
         def _is_upgrade(self) -> bool:
             conn_tokens = [

@@ -296,6 +296,100 @@ class BackendLoad:
             return dict(self._in_flight)
 
 
+class KvFillCache:
+    """Staleness-bounded KV-pool-fill signal per backend, scraped from
+    the model server's exposition (``serving_kv_bytes_in_use`` /
+    ``serving_kv_bytes_total``) — the gateway-side complement to the
+    local in-flight depth the prefix-affine spill reads (the in-process
+    ``DecoderFleet`` already honors ``kv_pressure``; this brings the
+    HTTP path to parity).
+
+    The request path only ever READS the cache: a fresh value serves
+    directly; a stale one serves while kicking off at most one
+    background refresh (the scrape's network latency never lands on a
+    client request); a backend never scraped — or whose last scrape
+    failed — yields None, which the spill policy treats as "signal
+    unavailable", NEVER as "pool empty" (an unscrapeable replica must
+    not look like the least-loaded spill target)."""
+
+    def __init__(self, *, ttl: float = 5.0, fetch=None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.ttl = float(ttl)
+        self.clock = clock
+        self.fetch = fetch or self._http_fetch
+        self._lock = threading.Lock()
+        # service -> {"fill": float | None, "at": t, "refreshing": bool}
+        self._cells: dict[str, dict] = {}
+        self.scrapes = 0
+        self.scrape_failures = 0
+
+    @staticmethod
+    def _http_fetch(addr: str, timeout: float = 2.0) -> float | None:
+        """One exposition GET reduced to in_use/total (None on any
+        failure or an unpriced pool — no bytes gauge means no signal)."""
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                    f"http://{addr}/monitoring/prometheus/metrics",
+                    timeout=timeout) as resp:
+                text = resp.read().decode("utf-8", "replace")
+        except (OSError, ValueError):
+            return None
+        vals = {}
+        for line in text.splitlines():
+            parts = line.split()
+            if len(parts) == 2 and parts[0] in ("serving_kv_bytes_in_use",
+                                                "serving_kv_bytes_total"):
+                try:
+                    vals[parts[0]] = float(parts[1])
+                except ValueError:
+                    continue
+        total = vals.get("serving_kv_bytes_total", 0.0)
+        if total <= 0:
+            return None
+        return vals.get("serving_kv_bytes_in_use", 0.0) / total
+
+    def _refresh(self, service: str, addr: str) -> None:
+        fill = self.fetch(addr)
+        with self._lock:
+            cell = self._cells.setdefault(service, {})
+            if fill is None:
+                # Keep serving the stale value inside a grace window
+                # (2x ttl); past it the signal goes dark rather than
+                # spill on ancient data.
+                self.scrape_failures += 1
+                at = cell.get("at", 0.0)
+                if self.clock() - at > 2 * self.ttl:
+                    cell["fill"] = None
+            else:
+                cell.update(fill=fill, at=self.clock())
+            cell["refreshing"] = False
+            self.scrapes += 1
+
+    def fill(self, service: str,
+             resolve: Callable[[str], str] = lambda a: a) -> float | None:
+        """Last-known fill fraction for ``service`` (None = no signal).
+        Triggers ONE background refresh when the value is stale."""
+        with self._lock:
+            cell = self._cells.setdefault(
+                service, {"fill": None, "at": 0.0, "refreshing": False})
+            fresh = self.clock() - cell.get("at", 0.0) < self.ttl \
+                and cell.get("fill") is not None
+            if not fresh and not cell["refreshing"]:
+                cell["refreshing"] = True
+                threading.Thread(
+                    target=self._refresh,
+                    args=(service, resolve(service)),
+                    daemon=True).start()
+            return cell.get("fill")
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {svc: cell.get("fill")
+                    for svc, cell in self._cells.items()}
+
+
 class BanditStats:
     """Per-(route, backend) reward averages for epsilon-greedy routes."""
 

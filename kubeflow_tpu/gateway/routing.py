@@ -49,6 +49,17 @@ class Route:
     # spills (0 = never spill).
     affinity_tokens: int = 32
     pressure: int = 0
+    # KV-fill fraction past which the affine pick spills (0 = ignore).
+    # The signal comes from the gateway's staleness-bounded scrape of
+    # each backend's serving_kv_bytes_in_use/_total; an unscrapeable
+    # backend contributes NO signal (never treated as empty).
+    kv_pressure: float = 0.0
+    # Disaggregated prefill pool: when non-empty, generate requests on
+    # this route ride the two-hop relay — the gateway affine-picks a
+    # prefill backend here, asks it to :prefill and push the prompt KV
+    # to the chosen decode backend (one of ``backends``), then relays
+    # the :predict to the decode backend as usual.
+    prefill_backends: tuple = ()  # ((host:port, weight), ...)
     # Shadow/mirror target: every request is also sent fire-and-forget to
     # this backend; its response is discarded and its failures invisible.
     shadow: str = ""
@@ -123,6 +134,18 @@ def routes_from_service(svc: dict) -> list[Route]:
             pressure = int(spec.get("pressure", 0))
             if pressure < 0:
                 raise ValueError("pressure must be >= 0")
+            kv_pressure = float(spec.get("kv_pressure", 0.0))
+            if not 0.0 <= kv_pressure <= 1.0:
+                raise ValueError("kv_pressure must be in [0, 1]")
+            prefill_backends = tuple(
+                (b["service"], float(b.get("weight", 1)))
+                for b in spec.get("prefill_backends", [])
+            )
+            if prefill_backends and strategy != "prefix-affine":
+                # The two-hop relay hashes the prompt; without the
+                # affine strategy nothing reads the prefill pool.
+                raise ValueError("prefill_backends requires the "
+                                 "prefix-affine strategy")
             if strategy == "prefix-affine" and not spec.get("backends"):
                 # One backend is nothing to hash over — surface the
                 # misconfiguration instead of silently direct-routing.
@@ -144,6 +167,8 @@ def routes_from_service(svc: dict) -> list[Route]:
                 service=service, rewrite=spec.get("rewrite", "/"),
                 backends=backends, strategy=strategy, epsilon=epsilon,
                 affinity_tokens=affinity_tokens, pressure=pressure,
+                kv_pressure=kv_pressure,
+                prefill_backends=prefill_backends,
                 shadow=spec.get("shadow", ""),
                 outlier_threshold=outlier_threshold,
                 outlier_window=outlier_window,

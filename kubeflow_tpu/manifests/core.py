@@ -152,7 +152,9 @@ def gateway_route(name: str, prefix: str, service: str, rewrite: str = "/",
                   strategy: str = "", epsilon: float | None = None,
                   outlier: dict | None = None,
                   affinity_tokens: int | None = None,
-                  pressure: int | None = None) -> dict:
+                  pressure: int | None = None,
+                  kv_pressure: float | None = None,
+                  prefill_backends: list | None = None) -> dict:
     """Gateway route annotation for a Service — the platform-wide analogue of
     the `getambassador.io/config` annotations the reference attaches to every
     web-app Service (kubeflow/common/ambassador.libsonnet route pattern). The
@@ -186,6 +188,15 @@ def gateway_route(name: str, prefix: str, service: str, rewrite: str = "/",
         spec["affinity_tokens"] = int(affinity_tokens)
     if pressure is not None:
         spec["pressure"] = int(pressure)
+    if kv_pressure is not None:
+        # KV-fill fraction past which the affine pick spills (gateway
+        # scrapes each backend's real-byte gauges, staleness-bounded).
+        spec["kv_pressure"] = float(kv_pressure)
+    if prefill_backends:
+        # Disaggregated prefill pool: the gateway two-hop relay picks
+        # the affine prefill backend here, it pushes prompt KV to the
+        # decode backend, then the predict relays to `backends`.
+        spec["prefill_backends"] = prefill_backends
     return {
         GATEWAY_ROUTE_ANNOTATION: yaml.safe_dump(spec, sort_keys=True)
     }

@@ -42,10 +42,25 @@ from kubeflow_tpu.version import DEFAULT_NAMESPACE
         ParamSpec("pressure", 8,
                   "per-replica in-flight bound past which the affine "
                   "pick spills to the least-loaded replica (0 = never)"),
+        ParamSpec("kv_pressure", 0.0,
+                  "KV-fill fraction past which the gateway spills the "
+                  "affine pick (scraped from the real-byte gauges, "
+                  "staleness-bounded; 0 = ignore)"),
+        ParamSpec("prefill_replicas", 0,
+                  "disaggregated prefill-pool size (0 = colocated). "
+                  "With a prefill pool, `replicas`/min/max size the "
+                  "decode pool and prompts ride the two-hop KV handoff"),
+        ParamSpec("prefill_max_replicas", 0,
+                  "prefill-pool autoscaler ceiling (0 = max_replicas)"),
         ParamSpec("queue_wait_p99_ms", 500.0,
-                  "scale-up breach threshold on the queue-wait p99"),
+                  "scale-up breach threshold on the queue-wait p99 "
+                  "(prefill pool in a role split)"),
         ParamSpec("ttft_p99_ms", 2000.0,
-                  "scale-up breach threshold on the TTFT p99"),
+                  "scale-up breach threshold on the TTFT p99 "
+                  "(prefill pool in a role split)"),
+        ParamSpec("inter_token_p99_ms", 500.0,
+                  "scale-up breach threshold on the inter-token p99 "
+                  "(decode pool in a role split)"),
         ParamSpec("kv_bytes_utilization", 0.85,
                   "scale-up breach threshold on KV bytes in use / total"),
         ParamSpec("scale_down_ratio", 0.5,
@@ -68,13 +83,31 @@ def inference_service_proto(
     num_tpu_chips: int,
     affinity_tokens: int,
     pressure: int,
+    kv_pressure: float,
+    prefill_replicas: int,
+    prefill_max_replicas: int,
     queue_wait_p99_ms: float,
     ttft_p99_ms: float,
+    inter_token_p99_ms: float,
     kv_bytes_utilization: float,
     scale_down_ratio: float,
     cooldown_seconds: float,
     scrape_period_seconds: float,
 ) -> list[dict]:
+    roles = None
+    if prefill_replicas > 0:
+        # Role split: `replicas`/min/max size the decode pool; the
+        # prefill pool gets its own range. Both pools ride the paged KV
+        # layout the prefill→decode block handoff requires (the
+        # operator pins kv_layout and serving_role per pool).
+        roles = {
+            "prefill": {
+                "replicas": int(prefill_replicas),
+                "maxReplicas": int(prefill_max_replicas
+                                   or max_replicas),
+            },
+            "decode": {"replicas": int(replicas)},
+        }
     cr = inference_service(
         name, namespace, model or name,
         model_path=model_path,
@@ -84,9 +117,12 @@ def inference_service_proto(
         tpu_chips_per_replica=num_tpu_chips,
         affinity_tokens=affinity_tokens,
         pressure=pressure,
+        kv_pressure=kv_pressure,
+        roles=roles,
         autoscale={
             "queueWaitP99Ms": float(queue_wait_p99_ms),
             "ttftP99Ms": float(ttft_p99_ms),
+            "interTokenP99Ms": float(inter_token_p99_ms),
             "kvBytesUtilization": float(kv_bytes_utilization),
             "scaleDownRatio": float(scale_down_ratio),
             "cooldownSeconds": float(cooldown_seconds),

@@ -61,6 +61,11 @@ REST_PORT = 8500
                   "paged KV residency precision: fp (bitwise-parity "
                   "default) or int8 (~2x blocks per HBM byte within a "
                   "pinned greedy tolerance)"),
+        ParamSpec("serving_role", "",
+                  "disaggregated-fleet role: 'prefill' (prompt "
+                  "admission only; decode peers pull finished prompt "
+                  "KV via :prefill/:import) or 'decode'; empty = "
+                  "colocated. Requires kv_layout=paged"),
         ParamSpec("kv_fused_attention", False,
                   "fuse the paged decode read into the block-table "
                   "attention kernel (no dense KV gather per step)"),
@@ -87,6 +92,7 @@ def tpu_serving(
     kv_block_size: int,
     kv_pool_blocks: int,
     kv_dtype: str,
+    serving_role: str,
     kv_fused_attention: bool,
     enable_prometheus: bool,
     dtype: str,
@@ -112,6 +118,8 @@ def tpu_serving(
         f"--kv-dtype={kv_dtype}",
         f"--dtype={dtype}",
     ]
+    if serving_role:
+        args.insert(-1, f"--serving-role={serving_role}")
     if kv_fused_attention:
         args.insert(-1, "--kv-fused-attention")
     if enable_prometheus:

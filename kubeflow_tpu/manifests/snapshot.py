@@ -53,6 +53,13 @@ SNAPSHOT_CASES: dict[str, tuple[str, dict]] = {
          "replicas": 2, "min_replicas": 1, "max_replicas": 4,
          "num_tpu_chips": 4},
     ),
+    "inference-service-disagg": (
+        "inference-service",
+        {"name": "llama", "model_path": "gs://models/llama",
+         "replicas": 3, "min_replicas": 1, "max_replicas": 6,
+         "num_tpu_chips": 4, "prefill_replicas": 2,
+         "prefill_max_replicas": 4, "kv_pressure": 0.85},
+    ),
     "nfs-volume": ("nfs-volume", {"server": "10.0.0.2"}),
     "serving-route": (
         "serving-route",

@@ -1255,6 +1255,45 @@ def store_blocks(pool, block_ids, cache):
             "v": _store(pool["v"], cache["v"])}
 
 
+@jax.jit
+def export_blocks(pool, block_ids):
+    """Gather pool blocks ``block_ids`` ([nblk]) into a standalone
+    payload — the device half of the prefill→decode KV handoff. Fp
+    pools yield ``{"k": [L, nblk, Bs, H, hd], "v": ...}``; quantized
+    pools yield the int8 codes AND the per-(position, head) scales
+    (``{"q", "scale"}`` per side), so the payload is the pool content
+    verbatim: an importer lands bit-identical values without ever
+    re-quantizing. Pure gather — the donor pool is untouched, so an
+    export never invalidates blocks in-flight readers share."""
+    def _take(kv):
+        if isinstance(kv, dict):
+            return {"q": kv["q"][:, block_ids],
+                    "scale": kv["scale"][:, block_ids]}
+        return kv[:, block_ids]
+
+    return {"k": _take(pool["k"]), "v": _take(pool["v"])}
+
+
+@functools.partial(jax.jit, donate_argnames=("pool",))
+def import_blocks(pool, block_ids, payload):
+    """Scatter an :func:`export_blocks` payload into pool blocks
+    ``block_ids`` — the receiving half of the KV handoff, the
+    cross-replica twin of :func:`store_blocks` (which quantizes a fresh
+    fp prefill; this path copies codes + scales verbatim, so a
+    quantized handoff is exact by construction, never a second
+    quantization). Layouts must match: an fp payload into an fp pool,
+    a quantized payload into a quantized pool."""
+    def _put(dst, vals):
+        if isinstance(dst, dict):
+            return {"q": dst["q"].at[:, block_ids].set(vals["q"]),
+                    "scale": dst["scale"].at[:, block_ids].set(
+                        vals["scale"])}
+        return dst.at[:, block_ids].set(vals)
+
+    return {"k": _put(pool["k"], payload["k"]),
+            "v": _put(pool["v"], payload["v"])}
+
+
 @functools.partial(jax.jit, donate_argnames=("pool",))
 def copy_block(pool, dst, src):
     """Copy one block's K/V across the pool — the copy-on-write for a

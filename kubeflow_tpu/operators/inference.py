@@ -37,6 +37,7 @@ from kubeflow_tpu.apis.inference import (
     DEFAULT_AUTOSCALE,
     INFERENCE_API_VERSION,
     INFERENCE_KIND,
+    INFERENCE_ROLES,
 )
 from kubeflow_tpu.k8s import objects as k8s
 from kubeflow_tpu.manifests.core import gateway_route, generate
@@ -47,6 +48,20 @@ log = logging.getLogger(__name__)
 REST_PORT = 8500
 REPLICA_LABEL = "kubeflow-tpu.org/inference-replica"
 SERVICE_LABEL = "kubeflow-tpu.org/inference-service"
+ROLE_LABEL = "kubeflow-tpu.org/inference-role"
+
+# Which autoscale signals bind which pool: a colocated service scales
+# on everything; a prefill pool is compute-bound on prompt admission
+# (queue wait, TTFT) and holds no long-lived KV; a decode pool is
+# memory-bound on resident KV bytes and its user-visible latency is the
+# inter-token cadence. Scoping breaches this way is what makes a
+# prefill-side burst scale ONLY the prefill pool and a KV-fill breach
+# scale ONLY the decode pool.
+ROLE_SIGNALS = {
+    "": ("queue_wait_p99", "ttft_p99", "kv_bytes"),
+    "prefill": ("queue_wait_p99", "ttft_p99"),
+    "decode": ("kv_bytes", "inter_token_p99"),
+}
 
 
 # ---------------------------------------------------------------------------
@@ -127,6 +142,8 @@ def scrape_signals(text: str) -> dict:
             buckets.get("serving_queue_wait_seconds", []), 0.99),
         "ttft_p99_s": _bucket_quantile(
             buckets.get("serving_ttft_seconds", []), 0.99),
+        "inter_token_p99_s": _bucket_quantile(
+            buckets.get("serving_inter_token_seconds", []), 0.99),
         "kv_utilization": (samples.get("serving_kv_bytes_in_use", 0.0)
                            / kv_total if kv_total else 0.0),
         "queued": samples.get("serving_queued", 0.0),
@@ -171,20 +188,57 @@ class InferenceServiceController(Controller):
         return [("apps/v1", "Deployment"), ("v1", "Service")]
 
     def reconcile_deleted(self, obj: dict) -> None:
-        key = (obj["metadata"].get("namespace", ""),
-               obj["metadata"].get("name", ""))
-        self._scale_state.pop(key, None)
+        ns = obj["metadata"].get("namespace", "")
+        name = obj["metadata"].get("name", "")
+        for key in [k for k in self._scale_state
+                    if k[0] == ns and k[1] == name]:
+            self._scale_state.pop(key, None)
 
     # -- replica addressing -------------------------------------------
 
     @staticmethod
-    def replica_name(name: str, i: int) -> str:
-        return f"{name}-r{i}"
+    def replica_name(name: str, i: int, role: str = "") -> str:
+        return f"{name}-{role}-r{i}" if role else f"{name}-r{i}"
 
     @staticmethod
-    def replica_addr(name: str, ns: str, i: int) -> str:
-        return (f"{InferenceServiceController.replica_name(name, i)}"
+    def replica_addr(name: str, ns: str, i: int, role: str = "") -> str:
+        return (f"{InferenceServiceController.replica_name(name, i, role)}"
                 f".{ns}:{REST_PORT}")
+
+    # -- pool shaping -------------------------------------------------
+
+    @staticmethod
+    def _pools(spec: dict) -> list[str]:
+        """The service's replica pools: [""] colocated, or the role
+        split when ``spec.roles`` is present."""
+        return list(INFERENCE_ROLES) if spec.get("roles") else [""]
+
+    @staticmethod
+    def _pool_spec(spec: dict, role: str) -> dict:
+        """One pool's effective (replicas, min, max, engine). Role pools
+        inherit the top-level range unless overridden, merge their
+        engine over the top-level engine, and are pinned to their
+        serving role on the paged KV layout the handoff requires."""
+        base = {
+            "replicas": int(spec.get("replicas", 1) or 1),
+            "minReplicas": max(1, int(spec.get("minReplicas", 1))),
+            "maxReplicas": int(spec.get("maxReplicas", 1) or 1),
+            "engine": dict(spec.get("engine") or {}),
+        }
+        if not role:
+            return base
+        r = (spec.get("roles") or {}).get(role) or {}
+        engine = {**base["engine"], **(r.get("engine") or {})}
+        engine.setdefault("kv_layout", "paged")
+        engine["serving_role"] = role
+        return {
+            "replicas": int(r.get("replicas", base["replicas"])),
+            "minReplicas": max(1, int(r.get("minReplicas",
+                                            base["minReplicas"]))),
+            "maxReplicas": int(r.get("maxReplicas",
+                                     base["maxReplicas"])),
+            "engine": engine,
+        }
 
     # -- reconcile ----------------------------------------------------
 
@@ -194,61 +248,85 @@ class InferenceServiceController(Controller):
         ns = svc["metadata"]["namespace"]
         spec = svc.get("spec", {})
         cfg = {**DEFAULT_AUTOSCALE, **(spec.get("autoscale") or {})}
-        lo = max(1, int(spec.get("minReplicas", 1)))
-        hi = max(lo, int(spec.get("maxReplicas", lo)))
-        current = int((svc.get("status") or {}).get("replicas") or 0)
-        if current <= 0:  # first reconcile: spec.replicas seeds the pool
-            current = int(spec.get("replicas", lo) or lo)
-        current = min(max(current, lo), hi)
+        status = svc.get("status") or {}
+        desired_by: dict[str, int] = {}
+        signals_by: dict[str, list[dict]] = {}
+        reasons: list[str] = []
+        for role in self._pools(spec):
+            pool = self._pool_spec(spec, role)
+            lo = pool["minReplicas"]
+            hi = max(lo, pool["maxReplicas"])
+            prev = ((status.get("roles") or {}).get(role, {})
+                    .get("replicas") if role else status.get("replicas"))
+            current = int(prev or 0)
+            if current <= 0:  # first reconcile: the spec seeds the pool
+                current = int(pool["replicas"] or lo)
+            current = min(max(current, lo), hi)
 
-        signals = []
-        for i in range(current):
-            sig = self.fetch_metrics(self.replica_addr(name, ns, i))
-            if sig is not None:
-                signals.append(sig)
-        desired, reason = self._decide((ns, name), current, lo, hi,
-                                       signals, cfg)
+            signals = []
+            for i in range(current):
+                sig = self.fetch_metrics(
+                    self.replica_addr(name, ns, i, role))
+                if sig is not None:
+                    signals.append(sig)
+            desired, reason = self._decide((ns, name, role), current,
+                                           lo, hi, signals, cfg, role)
+            self._ensure_replicas(svc, desired, role, pool["engine"])
+            self._prune_replicas(svc, desired, role)
+            desired_by[role] = desired
+            signals_by[role] = signals
+            if reason:
+                reasons.append(f"{role}: {reason}" if role else reason)
 
-        self._ensure_replicas(svc, desired)
-        self._prune_replicas(svc, desired)
-        self._ensure_router(svc, desired)
-        self._update_status(svc, desired, signals, reason, cfg)
+        self._ensure_router(svc, desired_by)
+        self._update_status(svc, desired_by, signals_by,
+                            "; ".join(reasons), cfg)
         return float(cfg["scrapePeriodSeconds"])
 
     # -- autoscale policy ---------------------------------------------
 
     @staticmethod
-    def _breaches(sig: dict, cfg: dict, ratio: float = 1.0) -> list[str]:
+    def _breaches(sig: dict, cfg: dict, ratio: float = 1.0,
+                  role: str = "") -> list[str]:
         """Signal names at or over ``target * ratio`` — ratio 1.0 is the
-        breach test, ``scaleDownRatio`` the low-water test."""
-        out = []
+        breach test, ``scaleDownRatio`` the low-water test. Only the
+        signals that bind ``role``'s pool count (ROLE_SIGNALS): a
+        prefill-side queue-wait burst must never scale the decode pool
+        and a decode-side KV-fill breach must never scale prefill."""
+        over = []
         if sig["queue_wait_p99_s"] * 1e3 > cfg["queueWaitP99Ms"] * ratio:
-            out.append("queue_wait_p99")
+            over.append("queue_wait_p99")
         if sig["ttft_p99_s"] * 1e3 > cfg["ttftP99Ms"] * ratio:
-            out.append("ttft_p99")
+            over.append("ttft_p99")
+        if sig.get("inter_token_p99_s", 0.0) * 1e3 > \
+                cfg["interTokenP99Ms"] * ratio:
+            over.append("inter_token_p99")
         if sig["kv_utilization"] > cfg["kvBytesUtilization"] * ratio:
-            out.append("kv_bytes")
-        return out
+            over.append("kv_bytes")
+        scoped = ROLE_SIGNALS[role]
+        return [b for b in over if b in scoped]
 
-    def _decide(self, key: tuple[str, str], current: int, lo: int, hi: int,
-                signals: list[dict], cfg: dict) -> tuple[int, str]:
-        """One scaling decision. Up is immediate (a breach is user-
-        visible latency, the urgent direction); down needs the whole
-        fleet inside the hysteresis band AND the cooldown elapsed, so a
-        breach → scale-up → relief sequence cannot flap back within the
-        window."""
+    def _decide(self, key: tuple[str, str, str], current: int, lo: int,
+                hi: int, signals: list[dict], cfg: dict,
+                role: str = "") -> tuple[int, str]:
+        """One pool's scaling decision. Up is immediate (a breach is
+        user-visible latency, the urgent direction); down needs the
+        whole pool inside the hysteresis band AND the cooldown elapsed,
+        so a breach → scale-up → relief sequence cannot flap back within
+        the window. Cooldown state is PER POOL: scaling prefill never
+        resets decode's clock."""
         now = self.clock()
         # First sight anchors the cooldown: a freshly declared pool gets
         # a full cooldown of observation before any scale-down (spec
         # .replicas is the operator's intent, not a transient to erase).
         state = self._scale_state.setdefault(key, {"last_scale": now})
         breached = sorted({b for s in signals
-                           for b in self._breaches(s, cfg)})
+                           for b in self._breaches(s, cfg, role=role)})
         if breached and current < hi:
             state["last_scale"] = now
             return current + 1, f"scale-up: {','.join(breached)} over target"
         low = bool(signals) and not any(
-            self._breaches(s, cfg, float(cfg["scaleDownRatio"]))
+            self._breaches(s, cfg, float(cfg["scaleDownRatio"]), role)
             for s in signals)
         last = state["last_scale"]
         cooled = last is None or (now - last) >= float(
@@ -260,21 +338,25 @@ class InferenceServiceController(Controller):
 
     # -- children -----------------------------------------------------
 
-    def _replica_objects(self, svc: dict, i: int) -> list[dict]:
+    def _replica_objects(self, svc: dict, i: int, role: str = "",
+                         engine: dict | None = None) -> list[dict]:
         """One replica's Deployment + Service, rendered through the
         tpu-serving prototype (same args/probes/scrape annotations a
-        hand-deployed model server gets) and labeled for pruning."""
+        hand-deployed model server gets) and labeled for pruning —
+        role pools additionally carry the role label so each pool
+        prunes and scales independently."""
         name = svc["metadata"]["name"]
         ns = svc["metadata"]["namespace"]
         spec = svc.get("spec", {})
         params = {
-            "name": self.replica_name(name, i),
+            "name": self.replica_name(name, i, role),
             "namespace": ns,
             "model_path": spec.get("modelPath", ""),
             "model_name": spec.get("model", name),
             "replicas": 1,
             "num_tpu_chips": int(spec.get("tpuChipsPerReplica", 1)),
-            **(spec.get("engine") or {}),
+            **(engine if engine is not None
+               else (spec.get("engine") or {})),
         }
         if spec.get("image"):
             params["image"] = spec["image"]
@@ -284,12 +366,15 @@ class InferenceServiceController(Controller):
             labels = o["metadata"].setdefault("labels", {})
             labels[SERVICE_LABEL] = name
             labels[REPLICA_LABEL] = str(i)
+            if role:
+                labels[ROLE_LABEL] = role
             o["metadata"]["ownerReferences"] = [ref]
         return objs
 
-    def _ensure_replicas(self, svc: dict, desired: int) -> None:
+    def _ensure_replicas(self, svc: dict, desired: int, role: str = "",
+                         engine: dict | None = None) -> None:
         for i in range(desired):
-            for obj in self._replica_objects(svc, i):
+            for obj in self._replica_objects(svc, i, role, engine):
                 existing = self.client.get_or_none(
                     obj["apiVersion"], obj["kind"],
                     obj["metadata"]["name"],
@@ -300,10 +385,13 @@ class InferenceServiceController(Controller):
                     existing["spec"] = obj["spec"]
                     self.client.update(existing)
 
-    def _prune_replicas(self, svc: dict, desired: int) -> None:
-        """Delete replica children at or past the desired count — the
-        scale-down path. Highest indices go first so the rendezvous
-        ring loses members from one stable end."""
+    def _prune_replicas(self, svc: dict, desired: int,
+                        role: str = "") -> None:
+        """Delete the POOL's replica children at or past the desired
+        count — the scale-down path. Highest indices go first so the
+        rendezvous ring loses members from one stable end; the role
+        label scopes the prune, so shrinking one pool never touches
+        the other."""
         name = svc["metadata"]["name"]
         ns = svc["metadata"]["namespace"]
         for api_version, kind in (("apps/v1", "Deployment"),
@@ -311,29 +399,45 @@ class InferenceServiceController(Controller):
             for obj in self.client.list(
                     api_version, kind, ns,
                     label_selector={SERVICE_LABEL: name}):
-                idx = obj["metadata"].get("labels", {}).get(REPLICA_LABEL)
+                labels = obj["metadata"].get("labels", {})
+                if labels.get(ROLE_LABEL, "") != role:
+                    continue
+                idx = labels.get(REPLICA_LABEL)
                 if idx is not None and int(idx) >= desired:
                     self.client.delete(api_version, kind,
                                        obj["metadata"]["name"], ns)
 
-    def _ensure_router(self, svc: dict, desired: int) -> None:
+    def _ensure_router(self, svc: dict, desired_by: dict) -> None:
         """The selector-less router Service carrying the prefix-affine
         route over the CURRENT membership — rewriting the annotation on
         scale events is how the hash ring rebalances (the gateway's
         route refresh replaces the member set; rendezvous then moves
-        only the changed members' keys)."""
+        only the changed members' keys). A role-split service routes
+        decode replicas as the predict backends and prefill replicas as
+        the two-hop relay's prefill pool."""
         name = svc["metadata"]["name"]
         ns = svc["metadata"]["namespace"]
         router_cfg = svc.get("spec", {}).get("router") or {}
+        decode_role = "decode" if "decode" in desired_by else ""
         backends = [
-            {"service": self.replica_addr(name, ns, i), "weight": 1}
-            for i in range(desired)
+            {"service": self.replica_addr(name, ns, i, decode_role),
+             "weight": 1}
+            for i in range(desired_by.get(decode_role, 0))
         ]
+        prefill_backends = [
+            {"service": self.replica_addr(name, ns, i, "prefill"),
+             "weight": 1}
+            for i in range(desired_by.get("prefill", 0))
+        ] if "prefill" in desired_by else None
+        kv_pressure = router_cfg.get("kvPressure")
         annotations = gateway_route(
             f"{name}-pool", f"/models/{name}/", backends[0]["service"],
             backends=backends, strategy="prefix-affine",
             affinity_tokens=int(router_cfg.get("affinityTokens", 32)),
             pressure=int(router_cfg.get("pressure", 8)),
+            kv_pressure=(float(kv_pressure)
+                         if kv_pressure is not None else None),
+            prefill_backends=prefill_backends,
         )
         router = k8s.service(
             name, ns, selector={},
@@ -351,28 +455,45 @@ class InferenceServiceController(Controller):
                 router["metadata"]["annotations"]
             self.client.update(existing)
 
-    def _update_status(self, svc: dict, desired: int, signals: list[dict],
-                       reason: str, cfg: dict) -> None:
+    def _update_status(self, svc: dict, desired_by: dict,
+                       signals_by: dict, reason: str, cfg: dict) -> None:
         name = svc["metadata"]["name"]
         ns = svc["metadata"]["namespace"]
-        ready = 0
-        for i in range(desired):
-            dep = self.client.get_or_none(
-                "apps/v1", "Deployment", self.replica_name(name, i), ns)
-            ready += int((dep or {}).get("status", {})
-                         .get("readyReplicas") or 0)
+        ready_by: dict[str, int] = {}
+        for role, desired in desired_by.items():
+            ready = 0
+            for i in range(desired):
+                dep = self.client.get_or_none(
+                    "apps/v1", "Deployment",
+                    self.replica_name(name, i, role), ns)
+                ready += int((dep or {}).get("status", {})
+                             .get("readyReplicas") or 0)
+            ready_by[role] = ready
+        total = sum(desired_by.values())
+        ready_total = sum(ready_by.values())
+        signals = [s for sigs in signals_by.values() for s in sigs]
         status: dict = {
-            "replicas": desired,
-            "readyReplicas": ready,
-            "phase": "Ready" if ready >= desired else "Scaling",
+            "replicas": total,
+            "readyReplicas": ready_total,
+            "phase": "Ready" if ready_total >= total else "Scaling",
             "scrapedReplicas": len(signals),
         }
+        if "" not in desired_by:
+            status["roles"] = {
+                role: {"replicas": desired_by[role],
+                       "readyReplicas": ready_by[role],
+                       "scrapedReplicas": len(signals_by[role])}
+                for role in desired_by
+            }
         if signals:
             status["signals"] = {
                 "queueWaitP99Ms": round(max(
                     s["queue_wait_p99_s"] for s in signals) * 1e3, 3),
                 "ttftP99Ms": round(max(
                     s["ttft_p99_s"] for s in signals) * 1e3, 3),
+                "interTokenP99Ms": round(max(
+                    s.get("inter_token_p99_s", 0.0)
+                    for s in signals) * 1e3, 3),
                 "kvBytesUtilization": round(max(
                     s["kv_utilization"] for s in signals), 4),
             }

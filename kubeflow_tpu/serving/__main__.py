@@ -82,6 +82,13 @@ def main(argv=None) -> int:
                         "attention kernel (no dense KV gather per step; "
                         "int8 dequantized in-register); numerics are "
                         "f32-equivalent, not bitwise")
+    p.add_argument("--serving-role", default="",
+                   choices=["", "prefill", "decode"],
+                   help="disaggregated-fleet role: 'prefill' runs "
+                        "prompt admission only (decode peers pull "
+                        "finished prompt KV via :prefill/:import), "
+                        "'decode' resumes imported prompts; empty = "
+                        "colocated. Requires --kv-layout=paged")
     p.add_argument("--stream-timeout-s", type=float, default=60.0,
                    help="default wait for generation results/streams; "
                         "raise under heavy load so memory-deferred "
@@ -119,6 +126,10 @@ def main(argv=None) -> int:
         # The fused kernel reads through the block table; dense rows
         # have no table to walk.
         p.error("--kv-fused-attention requires --kv-layout=paged")
+    if args.serving_role and args.kv_layout != "paged":
+        # The prefill→decode handoff rides the paged block pool; a
+        # dense replica has no blocks to export or import.
+        p.error("--serving-role requires --kv-layout=paged")
     if args.kv_layout == "paged":
         if args.decode_mode != "continuous":
             # Only the continuous decoder carries the block pool;
@@ -156,6 +167,7 @@ def main(argv=None) -> int:
             kv_dtype=args.kv_dtype,
             kv_fused=args.kv_fused_attention,
             stream_timeout_s=args.stream_timeout_s,
+            serving_role=args.serving_role,
             dtype=args.dtype,
         ),
         port=args.rest_port,

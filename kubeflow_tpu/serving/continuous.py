@@ -60,6 +60,8 @@ from kubeflow_tpu.models.decode import (
     copy_block,
     decode_chunk,
     decode_step,
+    export_blocks,
+    import_blocks,
     init_decode_state,
     init_paged_state,
     init_prefix_pool,
@@ -202,7 +204,8 @@ class ContinuousDecoder:
                  kv_block_size: int = 16, kv_pool_blocks: int = 0,
                  kv_low_watermark: int = 0, kv_dtype: str = "fp",
                  kv_fused: bool = False,
-                 stream_timeout_s: float = 60.0):
+                 stream_timeout_s: float = 60.0,
+                 role: str = ""):
         self.params = params
         self.cfg = cfg
         self.slots = slots
@@ -239,6 +242,15 @@ class ContinuousDecoder:
         if kv_fused and kv_layout != "paged":
             raise ValueError("kv_fused requires kv_layout='paged'")
         self.kv_fused = bool(kv_fused)
+        # Disaggregated-fleet role: "" (colocated, the default),
+        # "prefill" (prompt admission only — peers pull finished prompt
+        # KV via export_prompt) or "decode" (resumes imported prompts).
+        # The handoff rides the paged block pool, so a role requires it.
+        if role not in ("", "prefill", "decode"):
+            raise ValueError(f"unknown role {role!r}")
+        if role and kv_layout != "paged":
+            raise ValueError("a fleet role requires kv_layout='paged'")
+        self.role = role
         self.prefix_cache = (
             PrefixCache(prefix_cache_slots, min_len=prefix_cache_min_len)
             if prefix_cache_slots > 0 else None
@@ -354,6 +366,10 @@ class ContinuousDecoder:
         self.kv_cow_copies = 0       # tail-block copy-on-writes
         self.kv_shared_blocks = 0    # blocks mapped by refcount on hits
         self.kv_defer_admissions = 0  # rounds deferred for memory
+        # Disaggregated handoff counters (zero outside a role split).
+        self.kv_handoff_exports = 0   # prompts exported to a decode peer
+        self.kv_handoff_imports = 0   # prompts imported from a prefill peer
+        self.kv_handoff_tokens = 0    # prefix tokens that rode a handoff
         self.kv_blocks_peak = 0      # high-water blocks_in_use
         self.peak_in_flight = 0      # high-water concurrent requests
         # Counter mutations and metrics() reads go through this lock so
@@ -386,6 +402,13 @@ class ContinuousDecoder:
         self._h_occupancy = self.registry.histogram(
             "serving_batch_occupancy",
             "Active slots per decode dispatch", buckets=occ_bounds)
+        # Role label on the exposition so per-pool dashboards and the
+        # operator's scrape can tell prefill from decode replicas
+        # without inspecting Deployment names.
+        self.registry.gauge(
+            "serving_role",
+            "Replica role in a disaggregated fleet (1 = this role)",
+            labels=("role",)).labels(self.role or "colocated").set(1)
         # Per-stream lifecycle timelines, bounded ring, served at the
         # model server's /debug/requests (JSON + chrome-trace export).
         self.trace = TraceStore()
@@ -806,6 +829,233 @@ class ContinuousDecoder:
             self.prefix_inserts += 1
             self.prefill_tokens += len(toks)  # priming IS a prefill
             return True
+
+    # -- disaggregated prefill/decode handoff --------------------------
+
+    @staticmethod
+    def _payload_nblk(payload: dict) -> int:
+        """Block count a handoff payload carries (fp arrays and int8
+        {"q","scale"} dicts share the [L, nblk, ...] leading layout)."""
+        k = payload["k"]
+        arr = k["q"] if isinstance(k, dict) else k
+        return int(arr.shape[1])
+
+    def _export_ids(self, ids: list[int]) -> dict:
+        """Fetch pool blocks ``ids`` to the host as a handoff payload.
+        The gather is padded to a power-of-two block count (repeating
+        the last id — duplicate reads are free) so the number of
+        compiled export shapes stays logarithmic, then trimmed."""
+        nblk = len(ids)
+        padded = ids + [ids[-1]] * (pow2_bucket(nblk) - nblk)
+        with self._state_lock:
+            out = jax.device_get(export_blocks(
+                self._state["pool"], jnp.asarray(padded, np.int32)))
+
+        def _trim(node):
+            if isinstance(node, dict):
+                return {k: v[:, :nblk] for k, v in node.items()}
+            return node[:, :nblk]
+
+        return {side: _trim(out[side]) for side in ("k", "v")}
+
+    def _export_cold(self, prefix_toks: list[int]) -> dict:
+        """Cache-less export source: prefill the prefix into scratch
+        blocks, export them, free them — nothing outlives the call."""
+        nblk = self._alloc.blocks_for(len(prefix_toks))
+        with self._prefix_lock:
+            self._reclaim_blocks(nblk)
+            if not self._alloc.can_alloc(nblk):
+                raise ValueError(
+                    f"prompt export needs {nblk} free KV blocks; "
+                    f"{self._alloc.free_blocks} available")
+            blocks = self._alloc.alloc(nblk)
+            self.kv_blocks_peak = max(self.kv_blocks_peak,
+                                      self._alloc.blocks_in_use)
+        try:
+            w = nblk * self.kv_block_size
+            arr = np.zeros((1, w), np.int32)
+            arr[0, : len(prefix_toks)] = prefix_toks
+            cache, _last = prefill(
+                self.params, jnp.asarray(arr),
+                jnp.asarray([len(prefix_toks)], np.int32), self.cfg,
+                total_len=w)
+            with self._state_lock:
+                self._state["pool"] = store_blocks(
+                    self._state["pool"], jnp.asarray(blocks, np.int32),
+                    cache)
+            return self._export_ids(blocks)
+        finally:
+            with self._prefix_lock:
+                for b in blocks:
+                    self._alloc.free(b)
+
+    def export_prompt(self, tokens: list[int],
+                      timeout: float | None = None) -> dict:
+        """Prefill-role handoff: compute the prompt's KV on THIS replica
+        and export the blocks backing its leading positions as a payload
+        a decode replica can :meth:`import_prompt` — the prefill half of
+        disaggregated serving.
+
+        The exported prefix is the prompt minus its last token: the
+        importer re-prefills that one token through the imported blocks
+        (exactly the suffix math a colocated prefix-cache hit runs), so
+        its admission recovers the true last-position logits and greedy
+        output stays pinned against a colocated replica. Int8 pools
+        export codes AND scales verbatim — a quantized handoff is never
+        re-quantized, so it is exact by construction.
+
+        With the prefix cache on, the prefix rides the NORMAL pure-
+        prefill admission (``want=0`` through the scheduler: suffix
+        reuse against this replica's trie — prefix-affine routing
+        concentrates shared prefixes here — queue-wait accounting,
+        publish-on-finish), and the published entry's blocks are the
+        export source. Without it, the prefix is prefilled into scratch
+        blocks and freed after the export."""
+        if self._alloc is None:
+            raise ValueError("prompt handoff requires kv_layout='paged'")
+        toks = [int(t) for t in tokens][: self.prefill_len]
+        if len(toks) < 2:
+            raise ValueError("prompt handoff needs a >=2-token prompt")
+        plen = len(toks) - 1
+        key = tuple(toks[:plen])
+        cache = self.prefix_cache
+        entry = None
+        if cache is not None and plen >= cache.min_len:
+            with self._prefix_lock:
+                known = cache.has(key)
+            if not known:
+                # Pure prefill through the scheduler; publish-on-finish
+                # pools the prompt's blocks for the export below (and
+                # for the next same-prefix export).
+                self.submit(list(key), 0).result(timeout)
+            with self._prefix_lock:
+                m = cache.match(toks)  # pins the entry against eviction
+                if m is not None:
+                    entry, depth = m
+                    # Cap at the positions the entry's blocks actually
+                    # back (publish can cap), and keep min_len useful.
+                    depth = min(depth, len(entry.blocks or ())
+                                * self.kv_block_size)
+                    if depth >= cache.min_len:
+                        plen = depth
+                    else:
+                        cache.release(entry)
+                        entry = None
+        try:
+            if entry is not None:
+                ids = list(entry.blocks[: self._alloc.blocks_for(plen)])
+                payload = self._export_ids(ids)
+            else:
+                payload = self._export_cold(toks[:plen])
+        finally:
+            if entry is not None:
+                with self._prefix_lock:
+                    cache.release(entry)
+        with self._mlock:
+            self.kv_handoff_exports += 1
+            self.kv_handoff_tokens += plen
+        return {"tokens": toks, "prefix_len": plen,
+                "block_size": self.kv_block_size,
+                "kv_dtype": self.kv_dtype, "payload": payload}
+
+    def import_prompt(self, handoff: dict) -> bool:
+        """Decode-role handoff receive: allocate local blocks, scatter
+        the exported payload in VERBATIM (int8 codes + scales included),
+        and register the prefix in this replica's trie — the subsequent
+        ``submit()`` of the full prompt rides the ordinary prefix-hit
+        admission (full blocks refcount-shared, at most one tail CoW),
+        which is pinned byte-identical to a colocated decode.
+
+        Returns False when the import cannot be registered (no prefix
+        cache, prefix under ``min_len``, every cache slot pinned, or no
+        free blocks) — the caller falls back to a plain submit and this
+        replica prefills the prompt itself: degraded, never wrong.
+        Raises ``ValueError`` on a payload whose block size, kv dtype,
+        or block count does not match this pool (importing it would
+        corrupt KV)."""
+        if self._alloc is None:
+            raise ValueError("prompt handoff requires kv_layout='paged'")
+        if int(handoff["block_size"]) != self.kv_block_size:
+            raise ValueError(
+                f"handoff block_size {handoff['block_size']} != "
+                f"pool block_size {self.kv_block_size}")
+        if str(handoff.get("kv_dtype", "fp")) != self.kv_dtype:
+            raise ValueError(
+                f"handoff kv_dtype {handoff.get('kv_dtype')!r} != "
+                f"pool kv_dtype {self.kv_dtype!r}")
+        toks = [int(t) for t in handoff["tokens"]]
+        plen = int(handoff["prefix_len"])
+        if not 0 < plen <= min(len(toks), self.prefill_len):
+            raise ValueError(f"bad handoff prefix_len {plen}")
+        payload = handoff["payload"]
+        cache = self.prefix_cache
+        if cache is None or plen < cache.min_len:
+            return False
+        nblk = self._alloc.blocks_for(plen)
+        if self._payload_nblk(payload) != nblk:
+            raise ValueError(
+                f"handoff payload carries {self._payload_nblk(payload)} "
+                f"blocks; prefix_len {plen} needs {nblk}")
+        key = tuple(toks[:plen])
+        with self._prefix_lock:
+            if cache.has(key):
+                cache.touch(key)
+                return True
+            self._reclaim_blocks(nblk)
+            if not self._alloc.can_alloc(nblk):
+                return False
+            blocks = self._alloc.alloc(nblk)
+            self.kv_blocks_peak = max(self.kv_blocks_peak,
+                                      self._alloc.blocks_in_use)
+        # Device scatter OUTSIDE the prefix lock: the dispatch must
+        # wait out any in-flight decode chunk (state lock), and holding
+        # the prefix lock across that wait would stall the scheduler's
+        # pop path — every import would freeze admissions for a chunk.
+        # The blocks are ours alone until registered, so nothing reads
+        # them early.
+        try:
+            # Same power-of-two padding as the export (duplicate
+            # scatter of identical data is deterministic), so the
+            # import executables stay bounded too.
+            pad = pow2_bucket(nblk) - nblk
+            ids = blocks + [blocks[-1]] * pad
+
+            def _pad(node):
+                if isinstance(node, dict):
+                    return {k: _pad(v) for k, v in node.items()}
+                if pad == 0:
+                    return jnp.asarray(node)
+                return jnp.asarray(np.concatenate(
+                    [node] + [node[:, -1:]] * pad, axis=1))
+
+            with self._state_lock:
+                self._state["pool"] = import_blocks(
+                    self._state["pool"], jnp.asarray(ids, np.int32),
+                    {s: _pad(payload[s]) for s in ("k", "v")})
+        except Exception:
+            with self._prefix_lock:
+                for b in blocks:
+                    self._alloc.free(b)
+            raise
+        with self._prefix_lock:
+            entry = cache.reserve(key)
+            if entry is None:
+                # A peer import won the reserve race (its blocks carry
+                # identical content — the key IS the data), or every
+                # cache slot is pinned. Either way our blocks are
+                # surplus.
+                for b in blocks:
+                    self._alloc.free(b)
+                imported = cache.has(key)
+            else:
+                entry.blocks = tuple(blocks)
+                self.prefix_inserts += 1
+                imported = True
+        if imported:
+            with self._mlock:
+                self.kv_handoff_imports += 1
+                self.kv_handoff_tokens += plen
+        return imported
 
     def _mark_admitted(self, req: _Request, slot: int) -> None:
         """Record the pop→slot transition: queue-wait histogram + the
@@ -1274,6 +1524,10 @@ class ContinuousDecoder:
                 "kv_cow_copies": self.kv_cow_copies,
                 "kv_shared_blocks": self.kv_shared_blocks,
                 "kv_defer_admissions": self.kv_defer_admissions,
+                "kv_handoff_exports": self.kv_handoff_exports,
+                "kv_handoff_imports": self.kv_handoff_imports,
+                "kv_handoff_tokens": self.kv_handoff_tokens,
+                "role": self.role,
             }
         # Allocator / trie stats live under the prefix lock — taken in a
         # SEPARATE scope (never nested with the metrics lock) so the two

@@ -122,6 +122,13 @@ class EngineConfig:
     # caller passes none — raise it when memory-deferred admissions
     # under load would spuriously time callers out.
     stream_timeout_s: float = 60.0
+    # Disaggregated-fleet role: "" (colocated), "prefill" (prompt
+    # admission only — decode peers pull finished prompt KV via the
+    # :prefill/:import handoff endpoints) or "decode" (resumes imported
+    # prompts). Requires kv_layout="paged"; surfaces as the
+    # `serving_role` exposition label so per-pool dashboards and the
+    # operator scrape can tell the pools apart.
+    serving_role: str = ""
     # Compute dtype override ("bfloat16"/"float32"); empty keeps the
     # model preset's dtype. The tpu-serving manifest's --dtype arg.
     dtype: str = ""

@@ -99,7 +99,23 @@ class DecoderFleet:
     outstanding requests (0 = unbounded, never spill); ``kv_pressure``
     bounds its KV pool fill fraction (0 = ignore). ``router`` is
     "affine" (rendezvous, the default) or "random" (the seeded baseline
-    the fleet bench compares against)."""
+    the fleet bench compares against).
+
+    **Disaggregated mode**: replicas carrying ``role == "prefill"``
+    (the decoder's own attribute — the same knob the CRD's role
+    overrides set) form a prefill pool that runs prompt admission only.
+    A submit then becomes the two-hop relay: affine-pick a prefill
+    replica and ``export_prompt`` the prompt's KV there, place the
+    decode leg on the least-KV-loaded decode replica, ``import_prompt``
+    the blocks, and submit the full prompt — which rides the ordinary
+    prefix-hit admission against the imported entry, so long prompts
+    never stall the decode pool's token cadence behind compute-bound
+    prefill dispatches. A failed import (cache full, pool pressure)
+    degrades to a plain submit — the decode replica prefills the prompt
+    itself: slower, never wrong. A prefill replica dying mid-handoff
+    fails that submit fast with the 502-coded error (the fleet excludes
+    it; only its affinity keys remap); with the whole prefill pool dead
+    the fleet degrades to colocated submits on the decode pool."""
 
     def __init__(self, replicas: dict, *,
                  affinity_tokens: int = DEFAULT_AFFINITY_TOKENS,
@@ -110,6 +126,14 @@ class DecoderFleet:
         if router not in ("affine", "random"):
             raise ValueError(f"unknown router {router!r}")
         self._replicas = dict(replicas)
+        self._roles = {
+            name: getattr(d, "role", "") or ""
+            for name, d in self._replicas.items()
+        }
+        if any(r == "prefill" for r in self._roles.values()) and not any(
+                r != "prefill" for r in self._roles.values()):
+            raise ValueError(
+                "a disaggregated fleet needs at least one decode replica")
         self.affinity_tokens = int(affinity_tokens)
         self.pressure = int(pressure)
         self.kv_pressure = float(kv_pressure)
@@ -120,6 +144,9 @@ class DecoderFleet:
         self.routed = 0
         self.spilled = 0
         self.remapped = 0  # submits re-routed off a just-dead replica
+        self.handoffs = 0           # prefill→decode KV relays completed
+        self.handoff_fallbacks = 0  # degraded to a plain decode submit
+        self.handoff_skipped = 0    # prompts too short to register
 
     # -- membership ----------------------------------------------------
 
@@ -129,6 +156,19 @@ class DecoderFleet:
     def live_members(self) -> list[str]:
         with self._lock:
             return sorted(set(self._replicas) - self._dead)
+
+    def role_of(self, name: str) -> str:
+        return self._roles.get(name, "")
+
+    @property
+    def disaggregated(self) -> bool:
+        return any(r == "prefill" for r in self._roles.values())
+
+    def _live_pool(self, prefill: bool) -> list[str]:
+        """Live members of one role pool. Decode pool = every non-
+        prefill replica (colocated replicas can take decode legs)."""
+        return [m for m in self.live_members()
+                if (self._roles[m] == "prefill") == prefill]
 
     def mark_dead(self, name: str, cause: Exception | None = None) -> None:
         with self._lock:
@@ -172,10 +212,7 @@ class DecoderFleet:
         return bool(self.kv_pressure > 0
                     and self._kv_fill(name) >= self.kv_pressure)
 
-    def route(self, tokens) -> str:
-        """The replica a prompt should land on (no submission): affine
-        pick, pressure spill, dead exclusion."""
-        live = self.live_members()
+    def _route_among(self, tokens, live: list[str]) -> str:
         if not live:
             raise ReplicaUnavailableError("<none>")
         with self._lock:
@@ -198,17 +235,99 @@ class DecoderFleet:
                 return spill
         return primary
 
+    def route(self, tokens) -> str:
+        """The replica a prompt should land on (no submission): affine
+        pick, pressure spill, dead exclusion. In a disaggregated fleet
+        this is the PREFILL hop — the affinity-bearing placement (the
+        decode leg is load-placed, see :meth:`route_decode`)."""
+        if self.disaggregated:
+            return self.route_prefill(tokens)
+        return self._route_among(tokens, self.live_members())
+
+    def route_prefill(self, tokens) -> str:
+        """Affine pick over the live prefill pool (disaggregated
+        fleets): shared prefixes keep concentrating on one trie, whose
+        replica now does nothing but prefill them."""
+        return self._route_among(tokens, self._live_pool(prefill=True))
+
+    def route_decode(self) -> str:
+        """The decode leg's placement: least-KV-loaded live decode
+        replica (real-byte fill is what binds a decode pool), depth then
+        name breaking ties deterministically."""
+        live = self._live_pool(prefill=False)
+        if not live:
+            raise ReplicaUnavailableError("<none>")
+        return min(live, key=lambda m: (self._kv_fill(m),
+                                        self._depth(m), m))
+
     # -- serving surface ----------------------------------------------
+
+    def _handoff_viable(self, tokens) -> bool:
+        """A handoff is worth attempting only when some live decode
+        replica could register it — the exported prefix (prompt minus
+        one token) must clear the decode trie's ``min_len``. Short
+        long-decode prompts skip the relay entirely instead of paying
+        an export that the import would refuse."""
+        n = len(list(tokens)) - 1
+        for m in self._live_pool(prefill=False):
+            cache = getattr(self._replicas[m], "prefix_cache", None)
+            if cache is not None and n >= cache.min_len:
+                return True
+        return False
+
+    def _prefill_handoff(self, tokens):
+        """Hop 1 of a disaggregated submit: export the prompt's KV on
+        the affine prefill replica. Returns the handoff dict, or None
+        when the fleet must degrade to a plain decode-side prefill
+        (prefill pool entirely dead, or the export was refused).
+        A replica dying UNDER the export fails this submit fast with
+        the 502-coded error — the in-flight handoff is lost, the
+        replica is excluded, and only its keys remap on the next
+        submit."""
+        if not self._live_pool(prefill=True):
+            with self._lock:
+                self.handoff_fallbacks += 1
+            return None
+        name = self.route_prefill(tokens)
+        try:
+            return self._replicas[name].export_prompt(tokens)
+        except Exception as e:  # noqa: BLE001 — death check below
+            if not self._is_replica_death(e):
+                # The request's fault (e.g. a 1-token prompt): prefill
+                # it on the decode side instead of failing the submit.
+                with self._lock:
+                    self.handoff_fallbacks += 1
+                return None
+            self.mark_dead(name, cause=e)
+            raise ReplicaUnavailableError(name, e) from e
 
     def submit(self, tokens, max_new_tokens: int,
                temperature: float = 0.0, *,
                request_id: str | None = None) -> FleetHandle:
         """Route and submit, re-routing (and marking dead) when the
         chosen replica's scheduler is already gone — a submit never
-        fails just because one replica died."""
+        fails just because one replica died. Disaggregated fleets run
+        the two-hop relay first: prefill-pool export, decode-pool
+        import, then the decode submit below (which prefix-hits the
+        imported blocks)."""
+        handoff = None
+        if self.disaggregated:
+            if self._handoff_viable(tokens):
+                handoff = self._prefill_handoff(tokens)
+            else:
+                with self._lock:
+                    self.handoff_skipped += 1
         while True:
-            name = self.route(tokens)
+            name = (self.route_decode() if self.disaggregated
+                    else self.route(tokens))
             try:
+                if handoff is not None:
+                    if self._replicas[name].import_prompt(handoff):
+                        with self._lock:
+                            self.handoffs += 1
+                    else:
+                        with self._lock:
+                            self.handoff_fallbacks += 1
                 handle = self._replicas[name].submit(
                     tokens, max_new_tokens, temperature,
                     request_id=request_id)
@@ -245,6 +364,15 @@ class DecoderFleet:
         agg.update(replicas=per, live=self.live_members(),
                    dead=sorted(self._dead), routed=self.routed,
                    spilled=self.spilled, remapped=self.remapped)
+        if self.disaggregated:
+            agg.update(
+                roles=dict(self._roles),
+                prefill_pool=self._live_pool(prefill=True),
+                decode_pool=self._live_pool(prefill=False),
+                handoffs=self.handoffs,
+                handoff_fallbacks=self.handoff_fallbacks,
+                handoff_skipped=self.handoff_skipped,
+            )
         return agg
 
     def stop(self) -> None:

@@ -1,0 +1,117 @@
+"""Prompt-KV handoff payloads for disaggregated prefill/decode serving.
+
+A prefill replica computes a prompt's KV once and hands the finished
+blocks to a decode replica (models/decode.py:export_blocks /
+import_blocks); this module is the host-side envelope around that
+transfer:
+
+- in process (DecoderFleet), the handoff dict travels as plain numpy
+  arrays — zero copies beyond the device→host fetch;
+- across the HTTP fleet (the gateway's two-hop relay), :func:`pack`
+  base64-encodes each array into a JSON-safe dict and :func:`unpack`
+  restores it, with shapes/dtypes carried explicitly so a corrupt or
+  mismatched payload fails loudly at the boundary instead of scattering
+  junk into the receiving pool.
+
+The payload layout mirrors the block pool: fp pools ship ``{"k", "v"}``
+arrays ``[L, nblk, Bs, H, hd]``; int8 pools ship ``{"q", "scale"}`` per
+side — codes and scales travel together, so a quantized handoff is
+exact (the importer never re-quantizes).
+
+Pure host logic — numpy only, no jax — importable by the gateway
+without touching the serving stack's device deps.
+"""
+
+from __future__ import annotations
+
+import base64
+
+import numpy as np
+
+# Envelope schema version: receivers reject anything else rather than
+# guess at a layout (a silent mis-parse would corrupt a KV pool).
+HANDOFF_VERSION = 1
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """Resolve a dtype NAME (not struct string — ``bfloat16`` has no
+    portable struct code) — accelerator dtypes come from ml_dtypes."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # jax dependency, always present here
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _pack_array(arr) -> dict:
+    a = np.asarray(arr)
+    return {
+        "dtype": a.dtype.name,
+        "shape": list(a.shape),
+        "data": base64.b64encode(np.ascontiguousarray(a).tobytes())
+        .decode("ascii"),
+    }
+
+
+def _unpack_array(d: dict) -> np.ndarray:
+    if not isinstance(d, dict) or "data" not in d:
+        raise ValueError("malformed handoff array")
+    raw = base64.b64decode(d["data"])
+    arr = np.frombuffer(raw, dtype=_np_dtype(d["dtype"]))
+    return arr.reshape([int(s) for s in d["shape"]])
+
+
+def _map_tree(tree, fn):
+    if isinstance(tree, dict) and not ("dtype" in tree or "data" in tree
+                                      or hasattr(tree, "shape")):
+        return {k: _map_tree(v, fn) for k, v in tree.items()}
+    return fn(tree)
+
+
+def pack(handoff: dict) -> dict:
+    """JSON-safe envelope for a decoder ``export_prompt`` result: the
+    block payload's arrays (k/v, or k.q/k.scale/... when quantized)
+    become base64 strings; tokens/prefix_len/block metadata ride
+    alongside for receiver-side validation."""
+    payload = handoff["payload"]
+
+    def _enc(node):
+        if isinstance(node, dict):  # quantized side: {"q", "scale"}
+            return {k: _pack_array(v) for k, v in node.items()}
+        return _pack_array(node)
+
+    return {
+        "version": HANDOFF_VERSION,
+        "tokens": [int(t) for t in handoff["tokens"]],
+        "prefix_len": int(handoff["prefix_len"]),
+        "block_size": int(handoff["block_size"]),
+        "kv_dtype": handoff["kv_dtype"],
+        "payload": {side: _enc(payload[side]) for side in ("k", "v")},
+    }
+
+
+def unpack(env: dict) -> dict:
+    """Inverse of :func:`pack`. Raises ``ValueError`` on a malformed or
+    version-mismatched envelope — the decode server answers that with a
+    4xx instead of importing garbage."""
+    if not isinstance(env, dict) or env.get("version") != HANDOFF_VERSION:
+        raise ValueError(
+            f"unsupported handoff envelope "
+            f"version={env.get('version') if isinstance(env, dict) else env!r}")
+    payload = env.get("payload")
+    if not isinstance(payload, dict) or set(payload) != {"k", "v"}:
+        raise ValueError("handoff payload must carry 'k' and 'v'")
+
+    def _dec(node):
+        if isinstance(node, dict) and "data" not in node:
+            return {k: _unpack_array(v) for k, v in node.items()}
+        return _unpack_array(node)
+
+    return {
+        "tokens": [int(t) for t in env["tokens"]],
+        "prefix_len": int(env["prefix_len"]),
+        "block_size": int(env["block_size"]),
+        "kv_dtype": str(env.get("kv_dtype", "fp")),
+        "payload": {side: _dec(payload[side]) for side in ("k", "v")},
+    }

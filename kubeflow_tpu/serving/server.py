@@ -16,6 +16,7 @@ from __future__ import annotations
 import json
 import threading
 import time
+from http.client import HTTPConnection
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from kubeflow_tpu.observability.metrics import (
@@ -112,6 +113,7 @@ class ModelServer:
                     kv_dtype=self.engine.cfg.kv_dtype,
                     kv_fused=self.engine.cfg.kv_fused,
                     stream_timeout_s=self.engine.cfg.stream_timeout_s,
+                    role=self.engine.cfg.serving_role,
                 )
             return self._decoder
 
@@ -209,6 +211,74 @@ class ModelServer:
             }
 
         return _records()
+
+    # -- disaggregated prefill/decode handoff --------------------------
+    #
+    # The HTTP face of ContinuousDecoder.export_prompt/import_prompt:
+    # a PREFILL-pool server answers ``:prefill`` by computing the
+    # prompt's KV and (when ``handoff_to`` names a decode server)
+    # pushing the packed block payload server-to-server at that peer's
+    # ``:import`` — the KV bytes never transit the gateway, which only
+    # orchestrates the two hops and then relays the ordinary
+    # ``:predict`` to the decode server, where it prefix-hits the
+    # imported blocks.
+
+    def handle_prefill(self, name: str, body: dict,
+                       request_id: str | None = None) -> dict:
+        from kubeflow_tpu.serving import handoff as handoff_mod
+
+        if name != self.engine.cfg.model:
+            raise KeyError(f"model {name!r} not served")
+        instances = body.get("instances")
+        if not isinstance(instances, list) or len(instances) != 1:
+            raise ValueError("prefill handoff needs exactly one instance")
+        inst = instances[0]
+        self.engine.validate_instance(inst)
+        if self.decoder is None:
+            raise ValueError("model does not support generation")
+        h = self.decoder.export_prompt(inst["tokens"])
+        env = handoff_mod.pack(h)
+        target = str(body.get("handoff_to", "") or "")
+        if target:
+            pushed = self._push_handoff(name, target, env, request_id)
+            return {"handoff": pushed, "prefix_len": h["prefix_len"]}
+        # No destination: hand the envelope back to the caller (tests /
+        # out-of-band relays).
+        return {"handoff": False, "prefix_len": h["prefix_len"],
+                "envelope": env}
+
+    def _push_handoff(self, name: str, target: str, env: dict,
+                      request_id: str | None = None) -> bool:
+        """POST the packed payload at the decode server's ``:import``.
+        Best-effort: any failure returns False — the decode server will
+        simply prefill the prompt itself (degraded, never wrong)."""
+        host, _, port_s = target.partition(":")
+        data = json.dumps(env).encode()
+        headers = {"Content-Type": "application/json"}
+        if request_id:
+            headers[REQUEST_ID_HEADER] = request_id
+        try:
+            conn = HTTPConnection(host, int(port_s or 80), timeout=30.0)
+            try:
+                conn.request("POST", f"/v1/models/{name}:import",
+                             body=data, headers=headers)
+                resp = conn.getresponse()
+                out = json.loads(resp.read() or b"{}")
+                return resp.status == 200 and bool(out.get("imported"))
+            finally:
+                conn.close()
+        except (OSError, ValueError):
+            return False
+
+    def handle_import(self, name: str, body: dict) -> dict:
+        from kubeflow_tpu.serving import handoff as handoff_mod
+
+        if name != self.engine.cfg.model:
+            raise KeyError(f"model {name!r} not served")
+        if self.decoder is None:
+            raise ValueError("model does not support generation")
+        h = handoff_mod.unpack(body)  # ValueError on garbage -> 400
+        return {"imported": bool(self.decoder.import_prompt(h))}
 
     def handle_metadata(self, name: str) -> dict:
         if name != self.engine.cfg.model:
@@ -309,6 +379,15 @@ class ModelServer:
                                 d["kv_shared_blocks"],
                             "serving_kv_defer_admissions_total":
                                 d["kv_defer_admissions"],
+                            # Disaggregated handoff counters (the role
+                            # itself rides the serving_role gauge on
+                            # the decoder registry above).
+                            "serving_kv_handoff_exports_total":
+                                d["kv_handoff_exports"],
+                            "serving_kv_handoff_imports_total":
+                                d["kv_handoff_imports"],
+                            "serving_kv_handoff_tokens_total":
+                                d["kv_handoff_tokens"],
                             "serving_in_flight": d["in_flight"],
                             "serving_queued": d["queued"],
                         })
@@ -392,6 +471,15 @@ class ModelServer:
                             self._send(200, server.handle_predict(
                                 name, body,
                                 request_id=self._request_id))
+                    elif self.path.startswith("/v1/models/") and \
+                            self.path.endswith(":prefill"):
+                        name = self.path[len("/v1/models/"):-len(":prefill")]
+                        self._send(200, server.handle_prefill(
+                            name, body, request_id=self._request_id))
+                    elif self.path.startswith("/v1/models/") and \
+                            self.path.endswith(":import"):
+                        name = self.path[len("/v1/models/"):-len(":import")]
+                        self._send(200, server.handle_import(name, body))
                     else:
                         error = True
                         self._send(404, {"error": f"no route {self.path}"})

@@ -316,6 +316,86 @@ def test_replica_kill_mid_stream_fails_fast_and_remaps(tiny):
         fleet.stop()
 
 
+def test_prefill_replica_kill_mid_handoff(tiny):
+    """Disaggregated chaos: a prefill replica's scheduler dies while
+    decode streams are in flight. The handoff routed at it 502s
+    fail-fast, the decode pool's streams are untouched, ONLY the dead
+    replica's affinity keys remap inside the prefill pool, and neither
+    pool leaks a block."""
+    reps = {"p0": _decoder(tiny, role="prefill",
+                           prefix_cache_slots=8, prefix_cache_min_len=8),
+            "p1": _decoder(tiny, role="prefill",
+                           prefix_cache_slots=8, prefix_cache_min_len=8),
+            "d0": _decoder(tiny, role="decode",
+                           prefix_cache_slots=8, prefix_cache_min_len=8),
+            "d1": _decoder(tiny, role="decode",
+                           prefix_cache_slots=8, prefix_cache_min_len=8)}
+    fleet = DecoderFleet(reps, affinity_tokens=8)
+    try:
+        # Prompts whose affine PREFILL home covers both prefill
+        # replicas (>= 10 tokens so the handoff prefix clears min_len).
+        home_of = {}
+        probe = 0
+        while set(home_of) != {"p0", "p1"} and probe < 200:
+            toks = [3 + probe % 11, 5, 7, probe % 13 + 2] + \
+                [11 + probe % 3] * 8
+            home_of.setdefault(fleet.route_prefill(toks), toks)
+            probe += 1
+        assert set(home_of) == {"p0", "p1"}
+        victim, survivor = "p0", "p1"
+
+        # Long decode streams in flight on the decode pool (submitted
+        # through the two-hop while every prefill replica is healthy).
+        streams = [fleet.submit(home_of[survivor][:-1] + [50 + i], 64)
+                   for i in range(2)]
+        assert {h.replica for h in streams} <= {"d0", "d1"}
+
+        # Kill the victim's scheduler mid-life: poison the device state
+        # under the state lock so its next dispatch raises.
+        with reps[victim]._state_lock:
+            reps[victim]._state = None
+
+        # A submit whose affine prefill home is the victim: the
+        # in-flight handoff fails FAST with the 502-coded error.
+        t0 = time.perf_counter()
+        with pytest.raises(ReplicaUnavailableError) as err:
+            fleet.submit(home_of[victim], 4)
+        elapsed = time.perf_counter() - t0
+        assert err.value.code == 502
+        assert elapsed < 10, f"dead-prefill handoff hung {elapsed:.1f}s"
+        assert victim not in fleet.live_members()
+
+        # Decode-pool streams are unaffected by the prefill death.
+        for h in streams:
+            assert len(h.result(timeout=120)["tokens"]) == 64
+
+        # The victim's keys remap to the surviving prefill replica;
+        # the survivor's keys never move. New submits succeed (handoff
+        # rides the survivor).
+        assert fleet.route_prefill(home_of[victim]) == survivor
+        assert fleet.route_prefill(home_of[survivor]) == survivor
+        out = fleet.submit(home_of[victim], 4)
+        assert len(out.result(timeout=120)["tokens"]) == 4
+        m = fleet.metrics()
+        assert m["prefill_pool"] == [survivor]
+        assert sorted(m["decode_pool"]) == ["d0", "d1"]
+        assert m["dead"] == [victim]
+
+        # Zero leaked blocks on BOTH pools: no slot holds blocks after
+        # drain (the victim's _fail_all freed its reservations too),
+        # and every surviving replica's residual refs are cache-held.
+        for name, rep in reps.items():
+            assert all(not blks for blks in rep._slot_blocks), name
+        for name in ("p1", "d0", "d1"):
+            rep = reps[name]
+            with rep._prefix_lock:
+                while rep.prefix_cache.evict_lru():
+                    pass
+            assert rep._alloc.blocks_in_use == 0, name
+    finally:
+        fleet.stop()
+
+
 def test_fleet_metrics_aggregate_live_replicas(tiny):
     reps = {"a": _decoder(tiny), "b": _decoder(tiny)}
     fleet = DecoderFleet(reps, affinity_tokens=4)

@@ -1169,6 +1169,147 @@ def test_single_request_traced_gateway_server_decoder(platform):
     assert gw_recs and all(r["status"] != "open" for r in gw_recs)
 
 
+def test_kv_fill_cache_staleness_and_no_signal_semantics():
+    """The gateway's KV-fill scrape: fresh values serve from cache, a
+    stale value serves WHILE one background refresh runs, and a backend
+    that cannot be scraped yields None (signal unavailable) — never
+    0.0 (an empty pool it might not have)."""
+    import time as _time
+
+    from kubeflow_tpu.gateway.resilience import KvFillCache
+
+    clock = {"t": 0.0}
+    fills = {"b1": 0.9}
+
+    def fetch(addr):
+        return fills.get(addr)
+
+    cache = KvFillCache(ttl=5.0, fetch=fetch, clock=lambda: clock["t"])
+
+    def settle(service, deadline=5.0):
+        t0 = _time.monotonic()
+        while _time.monotonic() - t0 < deadline:
+            with cache._lock:
+                if not cache._cells[service]["refreshing"]:
+                    return
+            _time.sleep(0.01)
+        raise AssertionError("refresh never settled")
+
+    # Never scraped: no signal yet, but the miss kicks a refresh.
+    assert cache.fill("b1") is None
+    settle("b1")
+    assert cache.fill("b1") == 0.9          # fresh → cached value
+    assert cache.scrapes == 1
+    # Within ttl: served from cache, no second scrape.
+    clock["t"] += 2
+    assert cache.fill("b1") == 0.9
+    assert cache.scrapes == 1
+    # Past ttl: the STALE value serves immediately; the background
+    # refresh picks up the new truth.
+    clock["t"] += 10
+    fills["b1"] = 0.2
+    assert cache.fill("b1") == 0.9
+    settle("b1")
+    assert cache.fill("b1") == 0.2
+    # Backend goes unscrapeable: inside the grace window the last value
+    # serves; past it the signal goes dark (None), never 0.0.
+    fills.pop("b1")
+    clock["t"] += 10
+    assert cache.fill("b1") == 0.2
+    settle("b1")
+    clock["t"] += 11  # past 2x ttl grace
+    cache.fill("b1")
+    settle("b1")
+    assert cache.fill("b1") is None
+    assert cache.scrape_failures >= 1
+    # A backend that never answered: always None.
+    assert cache.fill("b2") is None
+    settle("b2")
+    assert cache.fill("b2") is None
+
+
+def test_affine_kv_pressure_spills_to_less_full_backend(api):
+    """Gateway-side KV pressure: the affine pick spills when the
+    target's scraped pool fill crosses kv_pressure AND a less-full
+    backend exists; an unscrapeable target (no signal) never spills."""
+    from kubeflow_tpu.manifests.core import gateway_route
+
+    a, b = _IdentityBackend("a"), _IdentityBackend("b")
+    svc = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": "pool", "namespace": "kubeflow",
+            "annotations": gateway_route(
+                "pool", "/models/m/", "m-r0.kubeflow:8500",
+                backends=[{"service": "m-r0.kubeflow:8500", "weight": 1},
+                          {"service": "m-r1.kubeflow:8500", "weight": 1}],
+                strategy="prefix-affine", affinity_tokens=4,
+                pressure=0, kv_pressure=0.8),
+        },
+    }
+    api.apply(svc)
+    table = RouteTable()
+    assert table.refresh(api) == 1
+    route = table.match("/models/m/x")
+    assert route.kv_pressure == 0.8
+    backends = {
+        "m-r0.kubeflow:8500": f"127.0.0.1:{a.port}",
+        "m-r1.kubeflow:8500": f"127.0.0.1:{b.port}",
+    }
+    gw = Gateway(table, port=0, admin_port=0, probe_interval=0,
+                 resolve=lambda addr: backends.get(addr, addr))
+    fills: dict = {}
+
+    class _StubFill:
+        scrapes = 0
+        scrape_failures = 0
+
+        def fill(self, service, resolve=None):
+            return fills.get(service)
+
+        def snapshot(self):
+            return dict(fills)
+
+    gw.kv_fill = _StubFill()
+    gw.start()
+    try:
+        base = f"http://127.0.0.1:{gw._proxy.server_address[1]}"
+
+        def predict(tokens):
+            _, out, _ = http(
+                "POST", f"{base}/models/m/v1/models/m:predict",
+                {"instances": [{"tokens": tokens}]})
+            return out["variant"]
+
+        toks = [1, 2, 3, 4]
+        home = predict(toks)
+        other = "b" if home == "a" else "a"
+        home_svc = ("m-r0.kubeflow:8500" if home == "a"
+                    else "m-r1.kubeflow:8500")
+        other_svc = ("m-r0.kubeflow:8500" if other == "a"
+                     else "m-r1.kubeflow:8500")
+        # No signal anywhere: no spill (None is never "empty").
+        assert predict(toks) == home
+        assert gw.affine_spills == 0
+        # Affine target over the bound, spill target less full → spill.
+        fills[home_svc] = 0.95
+        fills[other_svc] = 0.3
+        assert predict(toks) == other
+        assert gw.affine_spills == 1
+        # Spill target just as full → stay home (nowhere better).
+        fills[other_svc] = 0.97
+        assert predict(toks) == home
+        # Pressure relieved → the key returns home (no sticky spill).
+        fills[home_svc] = 0.2
+        fills[other_svc] = 0.3
+        assert predict(toks) == home
+    finally:
+        gw.stop()
+        for be in (a, b):
+            be.close()
+
+
 def test_prefix_affine_routing_through_gateway(api):
     """Replica-pool routing e2e: a prefix-affine route over two live
     backends sends every request sharing a prompt prefix to ONE backend

@@ -1,0 +1,470 @@
+"""Disaggregated prefill/decode handoff tests.
+
+The contract under test: a prompt prefilled on replica A and resumed on
+replica B via ``export_blocks``/``import_blocks`` decodes BYTE-IDENTICAL
+to a single colocated replica — for fp pools against plain colocated
+greedy, for int8 pools against a colocated replica riding the same
+dequantized-prefix admission (scale blocks must travel with their
+codes). Plus the bookkeeping invariants: exports leak nothing, shared
+prefix blocks stay refcounted with the donor intact, mismatched pools
+are rejected loudly, and a refused import degrades to a plain submit
+instead of failing the request.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.serving import handoff as handoff_mod
+from kubeflow_tpu.serving.fleet import DecoderFleet
+
+PROMPT = list(range(3, 3 + 20))
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    import jax
+
+    from kubeflow_tpu.models.registry import get_model
+
+    spec = get_model("lm-test-tiny")
+    return spec, spec.init(jax.random.PRNGKey(0), spec.config)
+
+
+def _decoder(tiny, **kw):
+    from kubeflow_tpu.serving.continuous import ContinuousDecoder
+
+    spec, params = tiny
+    base = dict(slots=4, prefill_len=32, max_new_tokens=16,
+                kv_layout="paged", kv_block_size=8,
+                prefix_cache_slots=8, prefix_cache_min_len=8,
+                prefill_len_buckets=2, stream_timeout_s=120.0)
+    base.update(kw)
+    return ContinuousDecoder(params, spec.config, **base)
+
+
+# ---------------------------------------------------------------------------
+# Envelope pack/unpack
+# ---------------------------------------------------------------------------
+
+
+def test_pack_unpack_round_trips_fp_and_int8(tiny):
+    for extra in ({}, {"kv_dtype": "int8"}):
+        a = _decoder(tiny, role="prefill", **extra)
+        try:
+            h = a.export_prompt(PROMPT, timeout=120)
+            env = json.loads(json.dumps(handoff_mod.pack(h)))
+            h2 = handoff_mod.unpack(env)
+        finally:
+            a.stop()
+        assert h2["tokens"] == h["tokens"]
+        assert h2["prefix_len"] == h["prefix_len"]
+        assert h2["kv_dtype"] == h["kv_dtype"]
+        for side in ("k", "v"):
+            orig, back = h["payload"][side], h2["payload"][side]
+            if isinstance(orig, dict):  # int8: codes AND scales
+                assert np.array_equal(np.asarray(back["q"]),
+                                      np.asarray(orig["q"]))
+                assert np.array_equal(back["scale"], orig["scale"])
+            else:
+                assert np.asarray(back).tobytes() == \
+                    np.asarray(orig).tobytes()
+
+
+def test_unpack_rejects_garbage():
+    with pytest.raises(ValueError):
+        handoff_mod.unpack({"version": 99, "payload": {}})
+    with pytest.raises(ValueError):
+        handoff_mod.unpack({"version": 1, "tokens": [1, 2],
+                            "prefix_len": 1, "block_size": 8,
+                            "payload": {"k": "nope"}})
+    with pytest.raises(ValueError):
+        handoff_mod.unpack("not even a dict")
+
+
+# ---------------------------------------------------------------------------
+# Byte-identity across the handoff
+# ---------------------------------------------------------------------------
+
+
+def test_fp_handoff_byte_identical_to_colocated(tiny):
+    """prefill A → export/import → decode B == single colocated replica,
+    bitwise (the fp paged prefix-hit path is pinned bitwise to dense, so
+    the handoff must not perturb it)."""
+    ref = _decoder(tiny)
+    try:
+        want = ref.generate(PROMPT, 8, timeout=120)["tokens"]
+    finally:
+        ref.stop()
+    a, b = _decoder(tiny, role="prefill"), _decoder(tiny, role="decode")
+    try:
+        h = a.export_prompt(PROMPT, timeout=120)
+        assert b.import_prompt(h)
+        out = b.generate(PROMPT, 8, timeout=120)["tokens"]
+        assert out == want
+        mb = b.metrics()
+        assert mb["prefix_hits"] == 1       # the submit rode the import
+        assert mb["kv_handoff_imports"] == 1
+        assert a.metrics()["kv_handoff_exports"] == 1
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_int8_handoff_scales_ride_and_pin_byte_identity(tiny):
+    """Quantized handoff: codes + scale blocks travel together, so the
+    decode replica's dequantized prefix reads are bit-identical to a
+    colocated replica that admitted through the SAME dequantized-prefix
+    path (primed with the identical n-1 prefix)."""
+    ref = _decoder(tiny, kv_dtype="int8")
+    try:
+        assert ref.prime_prefix(PROMPT[:-1])
+        want = ref.generate(PROMPT, 8, timeout=120)["tokens"]
+    finally:
+        ref.stop()
+    a = _decoder(tiny, role="prefill", kv_dtype="int8")
+    b = _decoder(tiny, role="decode", kv_dtype="int8")
+    try:
+        h = a.export_prompt(PROMPT, timeout=120)
+        # Scale pool rides the same block ids as the payload.
+        assert isinstance(h["payload"]["k"], dict)
+        assert h["payload"]["k"]["scale"].shape[:2] == \
+            h["payload"]["k"]["q"].shape[:2]
+        # Round-trip the JSON envelope too — the HTTP path must not
+        # perturb the bits either.
+        h = handoff_mod.unpack(json.loads(json.dumps(
+            handoff_mod.pack(h))))
+        assert b.import_prompt(h)
+        out = b.generate(PROMPT, 8, timeout=120)["tokens"]
+        assert out == want
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_prefix_hit_prompt_shares_blocks_and_donor_survives(tiny):
+    """Two prompts sharing a leading prefix through the handoff: the
+    second import hits the decode trie's imported entry (full blocks
+    refcount-shared, zero new payload scatter needed for the shared
+    part), the streams diverge correctly, and the donor entry's blocks
+    are intact afterwards — a follower's CoW never scribbles the
+    shared blocks."""
+    shared = list(range(5, 5 + 16))
+    p1 = shared + [201, 17, 11, 3]
+    p2 = shared + [202, 19, 13, 7]
+    ref = _decoder(tiny)
+    try:
+        w1 = ref.generate(p1, 8, timeout=120)["tokens"]
+        w2 = ref.generate(p2, 8, timeout=120)["tokens"]
+    finally:
+        ref.stop()
+    a, b = _decoder(tiny, role="prefill"), _decoder(tiny, role="decode")
+    try:
+        h1 = a.export_prompt(p1, timeout=120)
+        assert b.import_prompt(h1)
+        imported_key = tuple(p1[:h1["prefix_len"]])
+        entry = b.prefix_cache._by_key[imported_key]
+        donor_blocks = entry.blocks
+        refs_before = [b._alloc.ref_count(blk) for blk in donor_blocks]
+        o1 = b.generate(p1, 8, timeout=120)["tokens"]
+        o2 = b.generate(p2, 8, timeout=120)["tokens"]
+        assert o1 == w1 and o2 == w2
+        m = b.metrics()
+        assert m["kv_shared_blocks"] > 0   # refcount sharing, not copies
+        # Donor entry intact: same blocks, and every remaining
+        # reference is cache-accounted (publish-on-finish legitimately
+        # adds entry refs to shared blocks) — evicting the whole trie
+        # must return the pool to zero, i.e. the streams leaked nothing.
+        assert entry.blocks == donor_blocks
+        assert all(b._alloc.ref_count(blk) >= r
+                   for blk, r in zip(donor_blocks, refs_before))
+        assert all(not blks for blks in b._slot_blocks)  # zero slot-held
+        with b._prefix_lock:
+            while b.prefix_cache.evict_lru():
+                pass
+        assert b._alloc.blocks_in_use == 0
+    finally:
+        a.stop()
+        b.stop()
+
+
+# ---------------------------------------------------------------------------
+# Bookkeeping invariants
+# ---------------------------------------------------------------------------
+
+
+def test_cold_export_leaks_nothing(tiny):
+    """Cache-less prefill replicas export through scratch blocks that
+    are freed before the call returns."""
+    a = _decoder(tiny, role="prefill", prefix_cache_slots=0)
+    try:
+        h = a.export_prompt(PROMPT, timeout=120)
+        assert h["prefix_len"] == len(PROMPT) - 1
+        assert a.metrics()["kv_blocks_in_use"] == 0
+    finally:
+        a.stop()
+
+
+def test_import_rejects_mismatched_pool(tiny):
+    a = _decoder(tiny, role="prefill")
+    b4 = _decoder(tiny, role="decode", kv_block_size=4)
+    b8 = _decoder(tiny, role="decode", kv_dtype="int8")
+    try:
+        h = a.export_prompt(PROMPT, timeout=120)
+        with pytest.raises(ValueError):
+            b4.import_prompt(h)   # block-size mismatch
+        with pytest.raises(ValueError):
+            b8.import_prompt(h)   # dtype mismatch
+    finally:
+        a.stop()
+        b4.stop()
+        b8.stop()
+
+
+def test_import_refused_degrades_to_plain_submit(tiny):
+    """A decode replica without a prefix cache cannot register the
+    import — it must refuse (False), and the fleet's two-hop submit
+    must still produce the correct stream by plain prefill."""
+    ref = _decoder(tiny)
+    try:
+        want = ref.generate(PROMPT, 8, timeout=120)["tokens"]
+    finally:
+        ref.stop()
+    a = _decoder(tiny, role="prefill")
+    b = _decoder(tiny, role="decode", prefix_cache_slots=0)
+    fleet = DecoderFleet({"p0": a, "d0": b}, affinity_tokens=16)
+    try:
+        h = a.export_prompt(PROMPT, timeout=120)
+        assert b.import_prompt(h) is False
+        out = fleet.generate(PROMPT, 8, timeout=120)["tokens"]
+        assert out == want
+        m = fleet.metrics()
+        # The fleet saw no decode replica that could register the
+        # prefix and skipped the relay — the export was never wasted.
+        assert m["handoff_skipped"] >= 1
+        assert m["handoffs"] == 0
+    finally:
+        fleet.stop()
+
+
+def test_export_requires_paged_and_enough_tokens(tiny):
+    dense = _decoder(tiny, kv_layout="dense", kv_block_size=16)
+    paged = _decoder(tiny)
+    try:
+        with pytest.raises(ValueError):
+            dense.export_prompt(PROMPT)
+        with pytest.raises(ValueError):
+            paged.export_prompt([7])  # nothing left after the split
+    finally:
+        dense.stop()
+        paged.stop()
+
+
+# ---------------------------------------------------------------------------
+# Fleet two-hop placement
+# ---------------------------------------------------------------------------
+
+
+def test_disagg_fleet_two_hop_byte_identity_and_counters(tiny):
+    prompts = [list(range(3 + i, 3 + i + 18)) for i in range(4)]
+    ref = _decoder(tiny)
+    try:
+        want = [ref.generate(p, 6, timeout=120)["tokens"]
+                for p in prompts]
+    finally:
+        ref.stop()
+    fleet = DecoderFleet({
+        "p0": _decoder(tiny, role="prefill"),
+        "p1": _decoder(tiny, role="prefill"),
+        "d0": _decoder(tiny, role="decode"),
+        "d1": _decoder(tiny, role="decode")}, affinity_tokens=16)
+    try:
+        assert fleet.disaggregated
+        out = [fleet.generate(p, 6, timeout=120)["tokens"]
+               for p in prompts]
+        assert out == want
+        m = fleet.metrics()
+        assert m["handoffs"] == len(prompts)
+        assert m["handoff_fallbacks"] == 0
+        assert sorted(m["prefill_pool"]) == ["p0", "p1"]
+        assert sorted(m["decode_pool"]) == ["d0", "d1"]
+        # Zero slot-held blocks anywhere after drain.
+        for name in ("p0", "p1", "d0", "d1"):
+            rep = fleet._replicas[name]
+            assert all(not blks for blks in rep._slot_blocks), name
+    finally:
+        fleet.stop()
+
+
+def test_route_decode_places_least_kv_loaded():
+    class _Alloc:
+        def __init__(self, used, total=10):
+            self.num_blocks = total
+            self.blocks_in_use = used
+
+    class _Stub:
+        def __init__(self, role, used=0):
+            self.role = role
+            self._alloc = _Alloc(used)
+            self._active_count = 0
+            self._pending: list = []
+
+        def submit(self, *a, **kw):
+            return object()
+
+        def metrics(self):
+            return {}
+
+        def stop(self):
+            pass
+
+    reps = {"p0": _Stub("prefill"), "d0": _Stub("decode", used=8),
+            "d1": _Stub("decode", used=2), "d2": _Stub("decode", used=5)}
+    fleet = DecoderFleet(reps, affinity_tokens=4)
+    assert fleet.route_decode() == "d1"
+    fleet.mark_dead("d1")
+    assert fleet.route_decode() == "d2"
+    # route() on a disaggregated fleet is the prefill hop.
+    assert fleet.route([1, 2, 3]) == "p0"
+
+
+def test_http_handoff_endpoints_round_trip(tiny):
+    """:prefill (envelope back) → :import on a second server → predict
+    rides the imported prefix, byte-identical to a colocated server."""
+    from kubeflow_tpu.serving.engine import EngineConfig
+    from kubeflow_tpu.serving.server import ModelServer
+
+    common = dict(model="lm-test-tiny", batch_size=4, max_seq_len=32,
+                  max_new_tokens=8, kv_layout="paged", kv_block_size=8,
+                  prefix_cache_slots=8, prefix_cache_min_len=8)
+    pre = ModelServer(EngineConfig(serving_role="prefill", **common),
+                      port=0, batch_timeout_ms=2)
+    dec = ModelServer(EngineConfig(serving_role="decode", **common),
+                      port=0, batch_timeout_ms=2)
+    ref = ModelServer(EngineConfig(**common), port=0, batch_timeout_ms=2)
+    pre.start()
+    dec.start()
+    ref.start()
+    try:
+        body = {"instances": [{"tokens": PROMPT, "max_new_tokens": 6}]}
+        want = ref.handle_predict("lm-test-tiny", body)
+        out = pre.handle_prefill(
+            "lm-test-tiny", {"instances": [{"tokens": PROMPT}]})
+        assert out["handoff"] is False and "envelope" in out
+        # The envelope is JSON-safe end to end.
+        env = json.loads(json.dumps(out["envelope"]))
+        assert dec.handle_import("lm-test-tiny", env)["imported"]
+        got = dec.handle_predict("lm-test-tiny", body)
+        assert got["predictions"][0]["tokens"] == \
+            want["predictions"][0]["tokens"]
+        # handoff_to pushes server-to-server.
+        out2 = pre.handle_prefill(
+            "lm-test-tiny",
+            {"instances": [{"tokens": [9] + PROMPT}],
+             "handoff_to": f"127.0.0.1:{dec.port}"})
+        assert out2["handoff"] is True
+        assert dec._decoder.metrics()["kv_handoff_imports"] == 2
+        # Bad envelope → ValueError (the HTTP layer maps it to 400).
+        with pytest.raises(ValueError):
+            dec.handle_import("lm-test-tiny", {"version": 7})
+    finally:
+        pre.stop()
+        dec.stop()
+        ref.stop()
+
+
+def test_gateway_two_hop_relay_end_to_end(tiny):
+    """Gateway orchestration of the disaggregated relay: a predict on a
+    prefix-affine route with a prefill pool rides :prefill at the
+    prefill server, a server-to-server :import push at the decode
+    server, then the relayed :predict — byte-identical to a colocated
+    server, with the KV payload never transiting the gateway."""
+    import urllib.request
+
+    from kubeflow_tpu.gateway import Gateway
+    from kubeflow_tpu.gateway.routing import (
+        RouteTable,
+        routes_from_service,
+    )
+    from kubeflow_tpu.manifests.core import gateway_route
+    from kubeflow_tpu.serving.engine import EngineConfig
+    from kubeflow_tpu.serving.server import ModelServer
+
+    common = dict(model="lm-test-tiny", batch_size=4, max_seq_len=32,
+                  max_new_tokens=8, kv_layout="paged", kv_block_size=8,
+                  prefix_cache_slots=8, prefix_cache_min_len=8)
+    pre = ModelServer(EngineConfig(serving_role="prefill", **common),
+                      port=0, batch_timeout_ms=2)
+    dec = ModelServer(EngineConfig(serving_role="decode", **common),
+                      port=0, batch_timeout_ms=2)
+    ref = ModelServer(EngineConfig(**common), port=0, batch_timeout_ms=2)
+    for s in (pre, dec, ref):
+        s.start()
+    pre_addr = f"127.0.0.1:{pre.port}"
+    dec_addr = f"127.0.0.1:{dec.port}"
+    ann = gateway_route(
+        "llm-pool", "/models/llm/", dec_addr,
+        backends=[{"service": dec_addr, "weight": 1}],
+        strategy="prefix-affine", affinity_tokens=16, pressure=0,
+        prefill_backends=[{"service": pre_addr, "weight": 1}])
+    table = RouteTable()
+    table.set_routes(routes_from_service(
+        {"metadata": {"name": "llm", "annotations": ann}}))
+    route = table.match("/models/llm/x")
+    assert route.prefill_backends == ((pre_addr, 1.0),)
+    gw = Gateway(table, port=0, admin_port=0, probe_interval=0)
+    gw.start()
+    try:
+        body = json.dumps({"instances": [
+            {"tokens": PROMPT, "max_new_tokens": 6}]}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{gw.port}/models/llm/v1/models/"
+            "lm-test-tiny:predict",
+            data=body, headers={"Content-Type": "application/json"})
+        out = json.loads(urllib.request.urlopen(req, timeout=120).read())
+        want = ref.handle_predict("lm-test-tiny", {"instances": [
+            {"tokens": PROMPT, "max_new_tokens": 6}]})
+        assert out["predictions"][0]["tokens"] == \
+            want["predictions"][0]["tokens"]
+        assert gw.handoffs_total == 1
+        assert gw.handoff_failures == 0
+        dm = dec._decoder.metrics()
+        assert dm["kv_handoff_imports"] == 1
+        assert dm["prefix_hits"] == 1  # the predict rode the import
+        assert pre._decoder.metrics()["kv_handoff_exports"] == 1
+        # A dead prefill pool degrades: the predict still answers
+        # (decode server prefills itself), the failure is counted.
+        pre.stop()
+        body2 = json.dumps({"instances": [
+            {"tokens": [9] + PROMPT, "max_new_tokens": 4}]}).encode()
+        req2 = urllib.request.Request(
+            f"http://127.0.0.1:{gw.port}/models/llm/v1/models/"
+            "lm-test-tiny:predict",
+            data=body2, headers={"Content-Type": "application/json"})
+        out2 = json.loads(
+            urllib.request.urlopen(req2, timeout=120).read())
+        assert len(out2["predictions"][0]["tokens"]) == 4
+        assert gw.handoff_failures == 1
+    finally:
+        gw.stop()
+        for s in (dec, ref):
+            s.stop()
+
+
+def test_serving_role_rides_the_exposition(tiny):
+    """The `serving_role` gauge labels the pool so the operator scrape
+    and dashboards can tell prefill from decode replicas."""
+    d = _decoder(tiny, role="decode")
+    p = _decoder(tiny, role="prefill")
+    c = _decoder(tiny)
+    try:
+        assert 'serving_role{role="decode"} 1' in d.registry.render()
+        assert 'serving_role{role="prefill"} 1' in p.registry.render()
+        assert 'serving_role{role="colocated"} 1' in c.registry.render()
+    finally:
+        d.stop()
+        p.stop()
+        c.stop()

@@ -246,6 +246,163 @@ def test_replica_label_indices_prune_highest_first(env):
 
 
 # ---------------------------------------------------------------------------
+# Disaggregated roles: per-pool reconcile + role-scoped autoscaling
+# ---------------------------------------------------------------------------
+
+ROLES = {"prefill": {"replicas": 2, "maxReplicas": 4},
+         "decode": {"replicas": 2, "maxReplicas": 4,
+                    "engine": {"kv_dtype": "int8"}}}
+
+
+def _role_cr(name="llm", **kw):
+    kw.setdefault("roles", {r: dict(v) for r, v in ROLES.items()})
+    kw.setdefault("kv_pressure", 0.85)
+    return _cr(name, **kw)
+
+
+def _role_status(api, name="llm"):
+    return _status(api, name).get("roles", {})
+
+
+def test_role_reconcile_materializes_both_pools_and_router(env):
+    api, ctrl, _clock, _signals, scraped = env
+    api.create(_role_cr())
+    ctrl.reconcile_all()
+    deps = {d["metadata"]["name"]:
+            d["metadata"]["labels"].get("kubeflow-tpu.org/inference-role")
+            for d in api.list("apps/v1", "Deployment", NS)}
+    assert deps == {"llm-prefill-r0": "prefill", "llm-prefill-r1":
+                    "prefill", "llm-decode-r0": "decode",
+                    "llm-decode-r1": "decode"}
+    # Role engine overrides land in the replica args, the role itself
+    # is pinned, and the handoff's paged layout is forced.
+    c = api.get("apps/v1", "Deployment", "llm-prefill-r0",
+                NS)["spec"]["template"]["spec"]["containers"][0]
+    assert "--serving-role=prefill" in c["args"]
+    assert "--kv-layout=paged" in c["args"]
+    c = api.get("apps/v1", "Deployment", "llm-decode-r0",
+                NS)["spec"]["template"]["spec"]["containers"][0]
+    assert "--serving-role=decode" in c["args"]
+    assert "--kv-dtype=int8" in c["args"]
+    # Router: decode replicas are the predict backends, prefill
+    # replicas the two-hop pool, kv_pressure folds into the spill.
+    route = _route(api)
+    assert [b["service"] for b in route["backends"]] == \
+        ["llm-decode-r0.kubeflow:8500", "llm-decode-r1.kubeflow:8500"]
+    assert [b["service"] for b in route["prefill_backends"]] == \
+        ["llm-prefill-r0.kubeflow:8500", "llm-prefill-r1.kubeflow:8500"]
+    assert route["kv_pressure"] == 0.85
+    # Both pools were scraped at their own addresses.
+    assert "llm-prefill-r0.kubeflow:8500" in scraped
+    assert "llm-decode-r1.kubeflow:8500" in scraped
+    st = _status(api)
+    assert st["replicas"] == 4
+    assert st["roles"]["prefill"]["replicas"] == 2
+    assert st["roles"]["decode"]["replicas"] == 2
+
+
+def test_prefill_breach_scales_only_prefill_pool(env):
+    """A queue-wait p99 breach is prefill-bound: the prefill pool grows
+    by one within one period, the decode pool holds."""
+    api, ctrl, clock, signals, _ = env
+    api.create(_role_cr())
+    ctrl.reconcile_all()
+    signals["value"] = dict(BREACH)  # queue_wait over, kv calm
+    clock["t"] += 5
+    ctrl.reconcile_all()
+    roles = _role_status(api)
+    assert roles["prefill"]["replicas"] == 3
+    assert roles["decode"]["replicas"] == 2
+    assert "prefill: scale-up: queue_wait_p99" in \
+        _status(api)["lastScaleReason"]
+    assert api.get("apps/v1", "Deployment", "llm-prefill-r2", NS)
+    assert api.get_or_none("apps/v1", "Deployment", "llm-decode-r2",
+                           NS) is None
+    # The router's prefill pool grew with it; decode backends held.
+    route = _route(api)
+    assert len(route["prefill_backends"]) == 3
+    assert len(route["backends"]) == 2
+
+
+def test_decode_kv_breach_scales_only_decode_pool(env):
+    """A KV real-byte fill breach is decode-bound: the decode pool
+    grows, the prefill pool holds (it keeps no resident KV)."""
+    api, ctrl, clock, signals, _ = env
+    api.create(_role_cr())
+    ctrl.reconcile_all()
+    signals["value"] = {"queue_wait_p99_s": 0.01, "ttft_p99_s": 0.01,
+                        "inter_token_p99_s": 0.01,
+                        "kv_utilization": 0.95, "queued": 0.0}
+    clock["t"] += 5
+    ctrl.reconcile_all()
+    roles = _role_status(api)
+    assert roles["decode"]["replicas"] == 3
+    assert roles["prefill"]["replicas"] == 2
+    assert "decode: scale-up: kv_bytes" in \
+        _status(api)["lastScaleReason"]
+
+
+def test_decode_inter_token_breach_scales_only_decode_pool(env):
+    api, ctrl, clock, signals, _ = env
+    api.create(_role_cr())
+    ctrl.reconcile_all()
+    signals["value"] = {"queue_wait_p99_s": 0.01, "ttft_p99_s": 0.01,
+                        "inter_token_p99_s": 2.0,
+                        "kv_utilization": 0.1, "queued": 0.0}
+    clock["t"] += 5
+    ctrl.reconcile_all()
+    roles = _role_status(api)
+    assert roles["decode"]["replicas"] == 3
+    assert roles["prefill"]["replicas"] == 2
+    assert "inter_token_p99" in _status(api)["lastScaleReason"]
+
+
+def test_role_cooldown_and_hysteresis_are_per_pool(env):
+    """Cooldown/hysteresis semantics are unchanged, per pool: after a
+    prefill scale-up, relief scales prefill back down only once ITS
+    cooldown elapses — and scaling prefill never blocks a decode
+    decision."""
+    api, ctrl, clock, signals, _ = env
+    api.create(_role_cr())
+    ctrl.reconcile_all()
+    signals["value"] = dict(BREACH)
+    clock["t"] += 5
+    ctrl.reconcile_all()
+    assert _role_status(api)["prefill"]["replicas"] == 3
+
+    # Relief inside the 30s cooldown: no flap in either pool.
+    signals["value"] = dict(LOW)
+    for _ in range(3):
+        clock["t"] += 5
+        ctrl.reconcile_all()
+        roles = _role_status(api)
+        assert roles["prefill"]["replicas"] == 3
+        assert roles["decode"]["replicas"] == 2
+    # Cooldown elapsed → prefill steps down; decode (whose own cooldown
+    # anchored at first sight) steps down on its own clock.
+    clock["t"] += 30
+    ctrl.reconcile_all()
+    roles = _role_status(api)
+    assert roles["prefill"]["replicas"] == 2
+    # Per-pool pruning: the highest prefill index went, decode children
+    # untouched by that prune.
+    assert api.get_or_none("apps/v1", "Deployment", "llm-prefill-r2",
+                           NS) is None
+    assert api.get("apps/v1", "Deployment", "llm-decode-r0", NS)
+
+
+def test_role_state_cleared_on_delete(env):
+    api, ctrl, *_ = env
+    api.create(_role_cr())
+    ctrl.reconcile_all()
+    assert any(k == (NS, "llm", "prefill") for k in ctrl._scale_state)
+    obj = api.get("kubeflow-tpu.org/v1", "InferenceService", "llm", NS)
+    api.delete("kubeflow-tpu.org/v1", "InferenceService", "llm", NS)
+    ctrl.reconcile_deleted(obj)
+    assert not any(k[1] == "llm" for k in ctrl._scale_state)
+
+
+# ---------------------------------------------------------------------------
 # Exposition scraping
 # ---------------------------------------------------------------------------
 
@@ -261,6 +418,9 @@ def test_scrape_signals_reads_histograms_and_gauges():
         "serving_queue_wait_seconds_count 100",
         'serving_ttft_seconds_bucket{le="0.5"} 100',
         'serving_ttft_seconds_bucket{le="+Inf"} 100',
+        'serving_inter_token_seconds_bucket{le="0.25"} 90',
+        'serving_inter_token_seconds_bucket{le="1.0"} 99',
+        'serving_inter_token_seconds_bucket{le="+Inf"} 100',
         "serving_kv_bytes_in_use 750",
         "serving_kv_bytes_total 1000",
         "serving_queued 4",
@@ -269,6 +429,8 @@ def test_scrape_signals_reads_histograms_and_gauges():
     # p99 rank 99 sits exactly at the 1.0 bucket's upper edge.
     assert 0.9 <= sig["queue_wait_p99_s"] <= 1.0
     assert sig["ttft_p99_s"] <= 0.5
+    # p99 rank 99 sits exactly at the 1.0 bucket's upper edge.
+    assert 0.9 <= sig["inter_token_p99_s"] <= 1.0
     assert sig["kv_utilization"] == 0.75
     assert sig["queued"] == 4.0
 
