@@ -1,7 +1,9 @@
 #!/bin/sh
 # CI exposition lint (ci/pipeline.yaml `metrics-lint` stage): boot every
 # /metrics surface in-process — model server (decoder driven), gateway
-# admin, availability prober, operator HealthServer — scrape each over
+# admin, availability prober, operator HealthServer (with one real
+# scheduling round driven so the scheduler_* decision families carry
+# samples and their names are asserted present) — scrape each over
 # real HTTP, and validate TYPE lines, label escaping and histogram
 # bucket ordering with the pure-python promtool-style checker. Exactly
 # one renderer (kubeflow_tpu/observability/metrics.py) may know the

@@ -212,6 +212,14 @@ def job_schema(kind: str, *, api_version: str | None = None) -> dict:
                             "ttlSecondsAfterFinished": {"type": "integer", "minimum": 0},
                         },
                     },
+                    # Cluster-scheduler fields (apis/scheduling.py): a
+                    # priority or queue opts the job into scheduler-managed
+                    # gang placement; profile names a measured-throughput
+                    # entry for heterogeneity-aware pool choice.
+                    "priority": {"type": "integer"},
+                    "queue": {"type": "string"},
+                    "profile": {"type": "string"},
+                    "preemptible": {"type": "boolean"},
                 },
                 "x-kubernetes-preserve-unknown-fields": True,
             },
@@ -387,3 +395,9 @@ def validate_job(job: Mapping) -> None:
     cpp = rp.get("cleanPodPolicy")
     if cpp is not None and cpp not in CLEAN_POD_POLICIES:
         raise JobValidationError(f"{kind}: invalid cleanPodPolicy {cpp!r}")
+    priority = spec.get("priority")
+    if priority is not None and not isinstance(priority, int):
+        raise JobValidationError(f"{kind}: priority must be an integer")
+    queue = spec.get("queue")
+    if queue is not None and not isinstance(queue, str):
+        raise JobValidationError(f"{kind}: queue must be a string")

@@ -72,6 +72,7 @@ _BUILTIN_KINDS: dict[str, tuple[str, bool]] = {
     "ConfigMap": ("configmaps", True),
     "Secret": ("secrets", True),
     "Namespace": ("namespaces", False),
+    "Node": ("nodes", False),
     "PersistentVolumeClaim": ("persistentvolumeclaims", True),
     "ResourceQuota": ("resourcequotas", True),
     "ServiceAccount": ("serviceaccounts", True),

@@ -201,7 +201,8 @@ class FakeKubelet:
 
     def _set_phase(self, pod: dict, phase: str,
                    exit_code: int | None = None, log: str = "",
-                   reason: str | None = None) -> None:
+                   reason: str | None = None,
+                   disruption_target: bool = False) -> None:
         name = pod["metadata"]["name"]
         ns = pod["metadata"]["namespace"]
         try:
@@ -212,6 +213,14 @@ class FakeKubelet:
         status["phase"] = phase
         if reason is not None:
             status["reason"] = reason
+        if disruption_target:
+            # The condition the eviction API sets on a real cluster —
+            # one of the signals JobController._is_preempted keys on.
+            conds = [c for c in status.get("conditions", [])
+                     if c.get("type") != "DisruptionTarget"]
+            conds.append({"type": "DisruptionTarget", "status": "True",
+                          "reason": reason or "EvictionByEvictionAPI"})
+            status["conditions"] = conds
         if exit_code is not None:
             container = current["spec"]["containers"][0]
             status["containerStatuses"] = [{
@@ -272,17 +281,26 @@ class FakeKubelet:
             self._prune_gang_ports(gang)
         return len(self._running)
 
+    # A real kubelet's default grace when neither the eviction request nor
+    # the pod spec names one.
+    DEFAULT_GRACE_SECONDS = 30.0
+
     def evict(self, name: str, namespace: str = "kubeflow",
               reason: str = "Preempted",
-              grace_seconds: float = 10.0) -> bool:
-        """Node-pressure eviction, delivered the way a real kubelet does:
-        SIGTERM first, up to ``grace_seconds`` for the workload to finish
-        its in-flight step and save (the train loop's graceful-shutdown
-        path), then SIGKILL. The pod is marked Failed with ``reason`` —
-        the signal the JobController's gang logic keys preemption
-        handling on (restart without burning backoffLimit) — regardless
-        of how the process exited, matching the phase a reclaimed node
-        reports.
+              grace_seconds: float | None = None) -> bool:
+        """Eviction delivered the way a real kubelet does: SIGTERM first,
+        then a grace window for the workload to finish its in-flight step
+        and save (the train loop's graceful-shutdown path), then SIGKILL.
+        ``grace_seconds=None`` honors the pod's own
+        ``spec.terminationGracePeriodSeconds`` (default 30) — so the
+        gang-coordinated checkpoint path is exercised by eviction exactly
+        as the pod requested it, not by a hand-picked test constant.
+
+        The pod is marked Failed with ``reason`` plus a DisruptionTarget
+        condition — the signals the JobController's gang logic keys
+        preemption handling on (restart without burning backoffLimit) —
+        regardless of how the process exited, matching what a reclaimed
+        node reports.
 
         Returns False without killing anything if the pod is not actively
         running (already finished or never started): fabricating a
@@ -295,6 +313,15 @@ class FakeKubelet:
         run = self._running.get(key)
         if run is None or run.proc.poll() is not None:
             return False
+        if grace_seconds is None:
+            try:
+                pod_spec = self.client.get(POD_API, "Pod", name,
+                                           namespace).get("spec", {})
+            except ApiError:
+                pod_spec = {}
+            grace_seconds = float(pod_spec.get(
+                "terminationGracePeriodSeconds",
+                self.DEFAULT_GRACE_SECONDS))
         del self._running[key]
         self._prune_gang_ports(run.gang)
         run.proc.terminate()  # SIGTERM: the grace window starts
@@ -310,8 +337,28 @@ class FakeKubelet:
         except ApiError:
             return True  # evicted; pod object deleted concurrently
         self._set_phase(pod, "Failed", exit_code=rc, log=log,
-                        reason=reason)
+                        reason=reason, disruption_target=True)
         return True
+
+    def evict_node(self, node_name: str, *,
+                   grace_seconds: float | None = None,
+                   reason: str = "NodeShutdown") -> list[str]:
+        """Node-kill churn helper: evict every running pod bound to
+        ``node_name`` (spec.nodeName), the way a reclaimed host takes its
+        whole gang share down at once. Returns the evicted pod names."""
+        evicted = []
+        for key, run in list(self._running.items()):
+            try:
+                pod = self.client.get(POD_API, "Pod", run.pod_name,
+                                      run.namespace)
+            except ApiError:
+                continue
+            if pod.get("spec", {}).get("nodeName") != node_name:
+                continue
+            if self.evict(run.pod_name, run.namespace, reason=reason,
+                          grace_seconds=grace_seconds):
+                evicted.append(run.pod_name)
+        return evicted
 
     def run_until_idle(self, *, reconcile=None, deadline: float = 180.0,
                        poll: float = 0.2) -> None:

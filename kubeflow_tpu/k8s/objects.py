@@ -331,6 +331,28 @@ def namespace_obj(name: str, labels: Mapping[str, str] | None = None) -> dict:
     return {"apiVersion": "v1", "kind": "Namespace", "metadata": metadata(name, labels=labels)}
 
 
+def node(name: str, labels: Mapping[str, str] | None = None, *,
+         tpu_chips: int = 0, unschedulable: bool = False,
+         ready: bool = True) -> dict:
+    """A Node object the scheduler's capacity model reads: TPU hosts carry
+    the GKE accelerator/topology labels plus a slice label grouping hosts
+    into one contiguous slice, and advertise their chips in
+    status.capacity (tests and the fake cluster mint these)."""
+    obj: dict = {
+        "apiVersion": "v1", "kind": "Node",
+        "metadata": metadata(name, labels=labels),
+        "status": {
+            "conditions": [{"type": "Ready",
+                            "status": "True" if ready else "False"}],
+        },
+    }
+    if tpu_chips:
+        obj["status"]["capacity"] = {"google.com/tpu": tpu_chips}
+    if unschedulable:
+        obj["spec"] = {"unschedulable": True}
+    return obj
+
+
 def pvc(name: str, namespace: str, storage: str,
         access_modes: Sequence[str] = ("ReadWriteOnce",),
         storage_class: str | None = None) -> dict:

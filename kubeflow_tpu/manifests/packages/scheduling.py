@@ -1,0 +1,116 @@
+"""Scheduling package: the cluster scheduler's CRD, policy, RBAC and
+Deployment.
+
+The scheduler (``python -m kubeflow_tpu.scheduler``) is the placement
+authority for every training-job kind: capacity model over heterogeneous
+TPU slice pools, weighted-fair priority queue with starvation aging,
+all-or-nothing gang admission, and priority preemption riding the
+gang-coordinated SIGTERM checkpoint path (docs/scheduling.md).
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.apis import jobs as jobs_api
+from kubeflow_tpu.apis import scheduling as sched_api
+from kubeflow_tpu.k8s import objects as k8s
+from kubeflow_tpu.manifests import images
+from kubeflow_tpu.manifests.core import ParamSpec, prototype
+from kubeflow_tpu.version import API_GROUP, DEFAULT_NAMESPACE
+
+
+@prototype(
+    "scheduler",
+    "SchedulingPolicy CRD + default policy + the cluster-scheduler "
+    "Deployment and RBAC (gang placement, priorities, preemption)",
+    params=[
+        ParamSpec("namespace", DEFAULT_NAMESPACE),
+        ParamSpec("image", images.PLATFORM),
+        ParamSpec("replicas", 1, "scheduler replicas (leader-elected)"),
+        ParamSpec("scheduling_period_seconds", 5,
+                  "queue scan cadence when no event fires"),
+        ParamSpec("aging_seconds", 300,
+                  "queue-wait seconds worth one priority point "
+                  "(starvation aging; 0 disables)"),
+        ParamSpec("preemption_enabled", True,
+                  "higher-priority gangs may evict lower-priority ones"),
+        ParamSpec("requeue_backoff_seconds", 10,
+                  "delay before a preempted gang is eligible again"),
+        ParamSpec("grace_period_seconds", 30,
+                  "SIGTERM→SIGKILL eviction grace (the checkpoint window)"),
+    ],
+)
+def scheduler(
+    namespace: str,
+    image: str,
+    replicas: int,
+    scheduling_period_seconds: int,
+    aging_seconds: int,
+    preemption_enabled: bool,
+    requeue_backoff_seconds: int,
+    grace_period_seconds: int,
+) -> list[dict]:
+    name = "scheduler"
+    labels = {"app": name, "app.kubernetes.io/part-of": "kubeflow-tpu"}
+    objs: list[dict] = [sched_api.scheduling_policy_crd()]
+    objs.append(sched_api.scheduling_policy(
+        "default", namespace,
+        schedulingPeriodSeconds=scheduling_period_seconds,
+        agingSeconds=aging_seconds,
+        preemption={
+            "enabled": preemption_enabled,
+            "requeueBackoffSeconds": requeue_backoff_seconds,
+            "gracePeriodSeconds": grace_period_seconds,
+        },
+    ))
+    objs.append(k8s.service_account(name, namespace, labels))
+    rules = [
+        # Placement decisions: annotation patches + status.scheduling
+        # mirrors on every job kind, and the policy it reconciles.
+        k8s.policy_rule(
+            [API_GROUP],
+            [p for p in jobs_api.PLURALS.values()]
+            + [f"{p}/status" for p in jobs_api.PLURALS.values()]
+            + [sched_api.SCHEDULING_POLICY_PLURAL,
+               f"{sched_api.SCHEDULING_POLICY_PLURAL}/status"],
+            ["*"],
+        ),
+        # Victim marking + evictions; nodes feed the capacity model.
+        k8s.policy_rule([""], ["pods", "pods/status", "pods/eviction",
+                               "events"], ["*"]),
+        k8s.policy_rule([""], ["nodes"], ["get", "list", "watch"]),
+        # Leader election holds a Lease when running replicated.
+        k8s.policy_rule(["coordination.k8s.io"], ["leases"],
+                        ["get", "list", "watch", "create", "update"]),
+    ]
+    objs.append(k8s.cluster_role(name, rules, labels))
+    objs.append(k8s.cluster_role_binding(name, name, name, namespace))
+    objs.append(
+        k8s.deployment(
+            name,
+            namespace,
+            containers=[
+                k8s.container(
+                    name,
+                    image,
+                    command=["python", "-m", "kubeflow_tpu.scheduler"],
+                    args=["--alsologtostderr", "-v=1"]
+                    + (["--leader-elect", "--leader-elect-name", name]
+                       if replicas > 1 else []),
+                    ports={"metrics": 8444},
+                )
+            ],
+            replicas=replicas,
+            labels=labels,
+            service_account=name,
+            # The manager's HealthServer exposes the scheduler decision
+            # metrics (queue depth/wait by queue, placement latency,
+            # preemptions/requeues by reason) next to the operator
+            # runtime registry on :8444.
+            pod_annotations={
+                "prometheus.io/scrape": "true",
+                "prometheus.io/path": "/metrics",
+                "prometheus.io/port": "8444",
+            },
+        )
+    )
+    return objs

@@ -20,6 +20,7 @@ from kubeflow_tpu.manifests.core import generate
 # case name -> (prototype, params)
 SNAPSHOT_CASES: dict[str, tuple[str, dict]] = {
     "training-operator": ("training-operator", {}),
+    "scheduler": ("scheduler", {}),
     "jax-job-simple": (
         "jax-job-simple",
         {"name": "smoke", "num_workers": 4, "accelerator": "v5litepod-16", "topology": "4x4"},

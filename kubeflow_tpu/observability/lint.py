@@ -268,7 +268,9 @@ def _self_check() -> int:
         failures += lint_url(f"http://127.0.0.1:{pport}/metrics")
 
         # 4. An operator HealthServer over the shared runtime registry —
-        # one reconcile observed so the histogram has samples.
+        # one reconcile observed so the histogram has samples, plus one
+        # REAL scheduling round (fake cluster: nodes + a queued gang) so
+        # the scheduler decision families carry samples too.
         class _LintProbe(Controller):
             api_version = "kubeflow-tpu.org/v1"
             kind = "LintProbe"
@@ -279,18 +281,62 @@ def _self_check() -> int:
         ctrl = _LintProbe(client=None)
         ctrl._safe_reconcile({"metadata": {"name": "probe"}})
         ctrl._enqueue(("ns", "probe"))
+
+        from kubeflow_tpu.apis import jobs as jobs_api
+        from kubeflow_tpu.apis import scheduling as sched_api
+        from kubeflow_tpu.k8s import objects as k8s_objects
+        from kubeflow_tpu.k8s.fake import FakeApiServer
+        from kubeflow_tpu.scheduler.controller import SchedulerController
+
+        fake = FakeApiServer()
+        fake.ensure_namespace("kubeflow")
+        for crd in jobs_api.all_job_crds():
+            fake.apply(crd)
+        fake.apply(sched_api.scheduling_policy_crd())
+        fake.create(sched_api.scheduling_policy(namespace="kubeflow"))
+        fake.create(k8s_objects.node("lint-n0", labels={
+            sched_api.NODE_ACCEL_LABEL: "v5e",
+            sched_api.NODE_SLICE_LABEL: "v5e-0"}, tpu_chips=4))
+        fake.create({
+            "apiVersion": jobs_api.JOBS_API_VERSION, "kind": "JaxJob",
+            "metadata": {"name": "lint-gang", "namespace": "kubeflow"},
+            "spec": {"priority": 1, "replicaSpecs": {"Worker": {
+                "replicas": 1, "template": {"spec": {"containers": [
+                    {"name": "main", "image": "i"}]}}}}},
+        })
+        SchedulerController(fake).reconcile_all()
+
         health = HealthServer(
             0, lambda: {"kubeflow_tpu_controllers_running": 1},
             registry=OPERATOR_METRICS)
         health.start()
         stops.append(health.stop)
-        failures += lint_url(f"http://127.0.0.1:{health.port}/metrics")
+        operator_url = f"http://127.0.0.1:{health.port}/metrics"
+        failures += lint_url(operator_url)
+        # The scheduler decision families (the autoscaler/dashboards'
+        # contract) must be present in the operator scrape — a rename
+        # or a registry split breaks this, not just an empty gauge.
+        from kubeflow_tpu.observability.metrics import type_line
+
+        with urllib.request.urlopen(operator_url, timeout=10) as resp:
+            operator_body = resp.read().decode()
+        for family, kind in (
+                ("scheduler_queue_depth", "gauge"),
+                ("scheduler_queue_wait_seconds", "histogram"),
+                ("scheduler_placement_seconds", "histogram"),
+                ("scheduler_admissions_total", "counter"),
+                ("scheduler_preemptions_total", "counter"),
+                ("scheduler_requeues_total", "counter"),
+                ("scheduler_unschedulable_jobs", "gauge")):
+            if type_line(family, kind) not in operator_body:
+                failures.append(
+                    f"{operator_url}: scheduler family {family} missing")
     finally:
         for stop in reversed(stops):
             stop()
     for failure in failures:
         print(f"FAIL {failure}")
-    surfaces = "model-server, gateway-admin, prober, operator"
+    surfaces = "model-server, gateway-admin, prober, operator+scheduler"
     if failures:
         print(f"metrics lint: {len(failures)} violation(s) across "
               f"{surfaces}")

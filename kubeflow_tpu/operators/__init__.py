@@ -14,6 +14,9 @@ themselves live here instead:
   (components/notebook-controller port).
 - :mod:`~kubeflow_tpu.operators.profiles` — Profile → namespace+RBAC
   (components/profile-controller port).
+
+The cluster scheduler (gang placement, priorities, preemption) lives in
+:mod:`kubeflow_tpu.scheduler` and runs on the same runtime.
 """
 
 from kubeflow_tpu.operators.base import (

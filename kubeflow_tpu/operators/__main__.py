@@ -28,10 +28,12 @@ def make_all_controllers(client):
         WorkflowController,
     )
     from kubeflow_tpu.operators.profiles import ProfileController
+    from kubeflow_tpu.scheduler.controller import SchedulerController
     from kubeflow_tpu.tuning.controller import StudyJobController
 
     return [
         *make_job_controllers(client),
+        SchedulerController(client),
         InferenceServiceController(client),
         NotebookController(client),
         ProfileController(client),

@@ -28,6 +28,7 @@ import datetime
 import json
 
 from kubeflow_tpu.apis import jobs as api
+from kubeflow_tpu.apis import scheduling as sched_api
 from kubeflow_tpu.k8s import objects as k8s
 from kubeflow_tpu.k8s.client import ApiError
 from kubeflow_tpu.operators.base import Controller
@@ -179,8 +180,18 @@ class JobController(Controller):
             for i in range(rspec.get("replicas", 1)):
                 desired.append((rt, i, rspec))
 
+        # Scheduler-managed jobs (spec.priority/queue) create NO pods until
+        # the cluster scheduler has reserved a full slice for the gang —
+        # the placement annotation IS the reservation, so a gang is either
+        # fully creatable or fully parked (all-or-nothing admission).
+        managed = sched_api.is_managed(job)
+        decided = sched_api.placement(job) if managed else None
+        if (managed and decided is not None
+                and len(decided.get("nodes", [])) != len(desired)):
+            decided = None  # stale reservation (gang size changed): park
+
         pods = []
-        for rt, i, rspec in desired:
+        for idx, (rt, i, rspec) in enumerate(desired):
             pod_name = self._pod_name(name, rt, i)
             pod = existing.get(pod_name)
             if pod is not None:
@@ -192,7 +203,7 @@ class JobController(Controller):
                 if (phase == "Failed" and self.kind != api.JAX_JOB_KIND
                         and self._should_restart(pod, restart)):
                     self.client.delete(POD_API, "Pod", pod_name, ns)
-                    self._bump_restarts(job)
+                    self._bump_restarts(job, preempted=self._is_preempted(pod))
                     self._set_condition(
                         job, api.COND_RESTARTING, "PodRestarting",
                         f"replica {rt}/{i} restarting",
@@ -201,7 +212,12 @@ class JobController(Controller):
                 else:
                     pods.append(pod)
                     continue
-            pod = self._build_pod(job, rt, i, rspec)
+            if managed and decided is None:
+                continue  # queued: awaiting (re-)admission
+            pod = self._build_pod(job, rt, i, rspec,
+                                  placement=decided,
+                                  node=(decided["nodes"][idx]
+                                        if decided else None))
             try:
                 pods.append(self.client.create(pod))
             except ApiError as e:
@@ -223,10 +239,17 @@ class JobController(Controller):
         if status.get("reason") in ("Preempted", "Shutdown", "Terminated",
                                     "NodeShutdown"):
             return True
-        return any(
+        if any(
             c.get("type") == "DisruptionTarget" and c.get("status") == "True"
             for c in status.get("conditions", [])
-        )
+        ):
+            return True
+        # Scheduler-initiated eviction: the cluster scheduler marks each
+        # victim pod BEFORE delivering the SIGTERM, so the accounting
+        # (preemptionCount, backoffLimit untouched) is correct even when
+        # the pod's final phase carries no kubelet reason string.
+        return bool(pod.get("metadata", {}).get("annotations", {}).get(
+            sched_api.ANN_PREEMPTED_BY))
 
     def _should_restart(self, pod: dict, restart_policy: str) -> bool:
         if self._is_preempted(pod):
@@ -254,7 +277,9 @@ class JobController(Controller):
     # pod construction + env injection
     # ------------------------------------------------------------------
 
-    def _build_pod(self, job: dict, rt: str, index: int, rspec: dict) -> dict:
+    def _build_pod(self, job: dict, rt: str, index: int, rspec: dict,
+                   placement: dict | None = None,
+                   node: str | None = None) -> dict:
         name = job["metadata"]["name"]
         ns = job["metadata"]["namespace"]
         pod = copy.deepcopy(rspec["template"])
@@ -277,12 +302,26 @@ class JobController(Controller):
         spec["subdomain"] = name
         spec.setdefault("restartPolicy", "Never")
 
-        tpu = job["spec"].get("tpu", {})
-        if tpu.get("accelerator"):
+        if placement is not None:
+            # Cluster-scheduler decision: this pod is pinned to its
+            # reserved host on the reserved slice — the scheduler's
+            # placement replaces the bare GKE nodeSelector path.
+            ann = meta.setdefault("annotations", {})
+            ann[sched_api.ANN_POOL] = placement.get("pool", "")
+            ann[sched_api.ANN_SLICE] = placement.get("slice", "")
+            if node:
+                spec["nodeName"] = node
             sel = spec.setdefault("nodeSelector", {})
-            sel[GKE_TPU_ACCEL_SELECTOR] = tpu["accelerator"]
-            if tpu.get("topology"):
-                sel[GKE_TPU_TOPO_SELECTOR] = tpu["topology"]
+            sel[GKE_TPU_ACCEL_SELECTOR] = placement.get("pool", "")
+            if placement.get("topology"):
+                sel[GKE_TPU_TOPO_SELECTOR] = placement["topology"]
+        else:
+            tpu = job["spec"].get("tpu", {})
+            if tpu.get("accelerator"):
+                sel = spec.setdefault("nodeSelector", {})
+                sel[GKE_TPU_ACCEL_SELECTOR] = tpu["accelerator"]
+                if tpu.get("topology"):
+                    sel[GKE_TPU_TOPO_SELECTOR] = tpu["topology"]
 
         env = self._rendezvous_env(job, rt, index)
         for container in spec.get("containers", []):
@@ -516,10 +555,17 @@ class JobController(Controller):
     # on conflict): a reconcile racing the watch-driven requeue must not
     # park the job until the next resync.
 
+    # Condition types the cluster scheduler owns: the lifecycle flip
+    # below must not clobber them (the scheduler sets/clears its own).
+    _SCHEDULER_CONDITIONS = (sched_api.COND_QUEUED,
+                             sched_api.COND_UNSCHEDULABLE)
+
     def _set_condition(self, job: dict, ctype: str, reason: str,
                        message: str) -> None:
         conds = job["status"].setdefault("conditions", [])
         for c in conds:
+            if c["type"] in self._SCHEDULER_CONDITIONS:
+                continue
             c["status"] = "False" if c["type"] != ctype else c["status"]
         existing = next((c for c in conds if c["type"] == ctype), None)
         if existing and existing["status"] == "True":

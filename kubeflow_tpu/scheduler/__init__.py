@@ -1,0 +1,28 @@
+"""Cluster scheduler: heterogeneity-aware gang placement with priority
+preemption (ROADMAP item 2, in the spirit of Gavel — PAPERS.md).
+
+- :mod:`~kubeflow_tpu.scheduler.capacity` — pools of contiguous TPU
+  slices from Node objects + measured-throughput profiles.
+- :mod:`~kubeflow_tpu.scheduler.queue` — weighted-fair priority queue
+  with starvation aging.
+- :mod:`~kubeflow_tpu.scheduler.controller` — the policy loop as a
+  controller over SchedulingPolicy: all-or-nothing gang admission,
+  priority preemption riding the gang-coordinated SIGTERM checkpoint.
+"""
+
+from kubeflow_tpu.scheduler.capacity import (
+    ClusterCapacity,
+    Slice,
+    ThroughputBook,
+)
+from kubeflow_tpu.scheduler.controller import SchedulerController
+from kubeflow_tpu.scheduler.queue import QueueEntry, order_queue
+
+__all__ = [
+    "ClusterCapacity",
+    "Slice",
+    "ThroughputBook",
+    "SchedulerController",
+    "QueueEntry",
+    "order_queue",
+]

@@ -1,0 +1,30 @@
+"""Scheduler manager: ``python -m kubeflow_tpu.scheduler``.
+
+The binary the scheduler Deployment runs — one SchedulerController
+(leader-elected when replicated) against the in-cluster apiserver. The
+training-operator manager also embeds the controller
+(:mod:`kubeflow_tpu.operators.__main__`) for single-manager deployments;
+this entrypoint is the split-out deployment the scheduler manifest
+renders, so placement policy can roll independently of the operators.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.runtime import controller_main
+
+
+def make_controllers(client):
+    from kubeflow_tpu.scheduler.controller import SchedulerController
+
+    return [SchedulerController(client)]
+
+
+def main(argv=None) -> int:
+    return controller_main(
+        argv, make_controllers,
+        "kubeflow-tpu cluster scheduler (gang placement + preemption)",
+    )
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

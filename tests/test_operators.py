@@ -447,6 +447,52 @@ def test_jaxjob_preemption_reschedules_without_burning_backoff(jaxjob_env):
     assert len(api.list("v1", "Pod", "kubeflow")) == 2  # rescheduled
 
 
+def test_preemption_recognized_by_disruption_target_condition(jaxjob_env):
+    """Regression: a Failed pod carrying ONLY the DisruptionTarget
+    condition (no kubelet reason string) still counts as preemption —
+    preemptionCount bumps, backoffLimit untouched."""
+    api, ctrl = jaxjob_env
+    api.create(make_job(replicas=2, runPolicy={"backoffLimit": 0}))
+    ctrl.reconcile_all()
+    pod = api.get("v1", "Pod", "train-worker-0", "kubeflow")
+    pod["status"] = {"phase": "Failed",
+                     "conditions": [{"type": "DisruptionTarget",
+                                     "status": "True",
+                                     "reason": "EvictionByEvictionAPI"}]}
+    api.update_status(pod)
+    ctrl.reconcile_all()
+    ctrl.reconcile_all()
+    got = api.get(jobs_api.JOBS_API_VERSION, "JaxJob", "train", "kubeflow")
+    assert got["status"].get("preemptionCount", 0) == 1
+    assert got["status"].get("restartCount", 0) == 0
+    assert got["status"]["state"] != "Failed"
+
+
+def test_preemption_recognized_by_scheduler_annotation(jaxjob_env):
+    """Regression: a Failed pod whose ONLY preemption signal is the
+    scheduler-set kubeflow-tpu.org/preempted-by annotation (no reason,
+    no condition) is accounted as a preemption, not a workload failure —
+    the contract for scheduler-initiated evictions."""
+    from kubeflow_tpu.apis import scheduling as sched_api
+
+    api, ctrl = jaxjob_env
+    api.create(make_job(replicas=2, runPolicy={"backoffLimit": 0}))
+    ctrl.reconcile_all()
+    pod = api.get("v1", "Pod", "train-worker-0", "kubeflow")
+    pod["metadata"].setdefault("annotations", {})[
+        sched_api.ANN_PREEMPTED_BY] = "JaxJob/kubeflow/vip"
+    api.update(pod)
+    pod = api.get("v1", "Pod", "train-worker-0", "kubeflow")
+    pod["status"] = {"phase": "Failed"}  # no reason, no conditions
+    api.update_status(pod)
+    ctrl.reconcile_all()
+    ctrl.reconcile_all()
+    got = api.get(jobs_api.JOBS_API_VERSION, "JaxJob", "train", "kubeflow")
+    assert got["status"].get("preemptionCount", 0) == 1
+    assert got["status"].get("restartCount", 0) == 0
+    assert got["status"]["state"] != "Failed"  # backoffLimit=0 untouched
+
+
 def test_jaxjob_unknown_phase_counts_as_gang_failure(jaxjob_env):
     """A pod stuck in Unknown (node unreachable) triggers the gang restart
     path instead of hanging the collective."""
