@@ -1,0 +1,17 @@
+#!/bin/sh
+# CI static-analysis gate (ci/pipeline.yaml `static-analysis` stage):
+# run the tpu-lint semantic checkers (kubeflow_tpu/analysis — lock
+# discipline, thread lifecycle, resource pairing, JAX hygiene, metrics
+# exposition) over the whole package against the checked-in baseline.
+#
+# The run fails on ANY non-baselined finding, and — the ratchet — on
+# any baseline entry that no longer fires (stale entries must be
+# deleted, so the baseline only ever shrinks). Suppressions in source
+# (`# tpu-lint: disable=<rule> -- <reason>`) require a reason; a
+# reason-less one is itself a finding. See docs/static-analysis.md.
+set -e
+
+python -m kubeflow_tpu.analysis kubeflow_tpu/ \
+    --baseline ci/tpu_lint_baseline.json
+
+echo "static analysis ok"

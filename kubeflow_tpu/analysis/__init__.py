@@ -1,0 +1,47 @@
+"""tpu-lint — AST-based semantic analysis gating CI.
+
+The style tier (:mod:`kubeflow_tpu.utils.lint`) keeps the tree
+flake8-clean; this package is the semantic tier — the ``go vet`` /
+race-detector analogue the source platform's Go layers get for free and
+our Python/JAX reproduction did not. Every checker targets a bug class
+this repo has actually shipped and then fixed:
+
+- **lock-discipline** (:mod:`.locks`): the PR-9 stall (a prefix lock
+  held across a state-lock device wait), PR-4 torn metric reads, and
+  deadlock-shaped lock-order cycles;
+- **thread-lifecycle** (:mod:`.threads`): threads without an explicit
+  ``daemon=`` choice or any reachable join/stop path;
+- **resource-pairing** (:mod:`.resources`): allocator ``alloc``/
+  ``share`` without a ``free`` on the exception path — the KV-block
+  leak class;
+- **JAX hygiene** (:mod:`.jax_hygiene`): host syncs and impure calls
+  inside jitted/scanned/shard_mapped functions;
+- **metrics exposition** (:mod:`.exposition`): the single-renderer
+  invariant plus metric-name and label-vocabulary conventions,
+  absorbing the old grep gate in ``ci/metrics_lint.sh``.
+
+``python -m kubeflow_tpu.analysis <paths>`` runs the suite;
+``ci/static_analysis.sh`` gates release-tag on it. Intentional
+violations carry per-line suppressions with mandatory reasons
+(``# tpu-lint: disable=<rule> -- <why>``); a checked-in findings
+baseline (``ci/tpu_lint_baseline.json``) makes adoption incremental
+without letting new findings in. See docs/static-analysis.md.
+"""
+
+from kubeflow_tpu.analysis.core import (
+    ALL_CHECKERS,
+    Baseline,
+    Finding,
+    all_rules,
+    analyze_paths,
+    checker_for_rule,
+)
+
+__all__ = [
+    "ALL_CHECKERS",
+    "Baseline",
+    "Finding",
+    "all_rules",
+    "analyze_paths",
+    "checker_for_rule",
+]

@@ -1,0 +1,115 @@
+"""tpu-lint CLI: ``python -m kubeflow_tpu.analysis [paths...]``.
+
+Exit codes: 0 clean (every finding suppressed-with-reason or
+baselined, no stale baseline entries), 1 findings or stale baseline,
+2 usage error.
+
+Flags:
+  --json               machine-readable report on stdout
+  --baseline FILE      accept findings recorded in FILE; entries that
+                       no longer fire are STALE and fail the run
+                       (disable with --no-stale-check)
+  --write-baseline FILE  write current findings as the new baseline
+                       and exit 0 (adoption bootstrap)
+  --rules r1,r2        run only these rules
+  --list-rules         print the checker catalog and exit
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from kubeflow_tpu.analysis.core import (
+    ALL_CHECKERS,
+    Baseline,
+    _load_checkers,
+    all_rules,
+    analyze_paths,
+)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m kubeflow_tpu.analysis",
+        description="tpu-lint: AST-based concurrency, resource-"
+                    "lifecycle, JAX-hygiene and exposition analysis")
+    parser.add_argument("paths", nargs="*", default=["kubeflow_tpu"])
+    parser.add_argument("--json", action="store_true")
+    parser.add_argument("--baseline")
+    parser.add_argument("--write-baseline")
+    parser.add_argument("--no-stale-check", action="store_true")
+    parser.add_argument("--rules")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    _load_checkers()
+    if args.list_rules:
+        for checker in ALL_CHECKERS:
+            print(f"{checker.name}: {checker.doc}")
+            for rule in checker.rules:
+                print(f"  - {rule}")
+        return 0
+
+    rules = None
+    if args.rules:
+        rules = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = rules - set(all_rules())
+        if unknown:
+            print(f"unknown rules: {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+
+    paths = [Path(p) for p in args.paths]
+    missing = [p for p in paths if not p.exists()]
+    if missing:
+        print(f"no such path: {', '.join(map(str, missing))}",
+              file=sys.stderr)
+        return 2
+    results = analyze_paths(paths, rules=rules)
+    findings = [f for r in results for f in r.findings]
+    suppressed = [f for r in results for f in r.suppressed]
+
+    if args.write_baseline:
+        Path(args.write_baseline).write_text(
+            Baseline.from_findings(findings).dump())
+        print(f"wrote {len(findings)} finding(s) to "
+              f"{args.write_baseline}")
+        return 0
+
+    stale: list[dict] = []
+    baselined: list = []
+    if args.baseline:
+        baseline = Baseline.load(Path(args.baseline))
+        findings, baselined, stale = baseline.apply(findings)
+        if args.no_stale_check:
+            stale = []
+
+    if args.json:
+        print(json.dumps({
+            "files": len(results),
+            "findings": [f.to_json() for f in findings],
+            "baselined": [f.to_json() for f in baselined],
+            "stale_baseline": stale,
+            "suppressed": len(suppressed),
+        }, indent=2))
+    else:
+        for f in sorted(findings, key=lambda f: (f.path, f.line)):
+            print(f)
+        for entry in stale:
+            print(f"STALE baseline entry no longer fires: "
+                  f"{entry['rule']} {entry['path']} "
+                  f"[{entry.get('symbol', '')}] — remove it")
+        print(f"tpu-lint: {len(results)} file(s), "
+              f"{len(findings)} finding(s), "
+              f"{len(baselined)} baselined, "
+              f"{len(suppressed)} suppressed, "
+              f"{len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'}")
+    return 1 if findings or stale else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,305 @@
+"""tpu-lint framework: findings, suppressions, baseline, driver.
+
+The checker modules are pure AST visitors; this module owns everything
+around them — the :class:`Finding` record, the per-line suppression
+grammar (reasons are MANDATORY: an excuse-free suppression is itself a
+finding), the incremental-adoption :class:`Baseline` (entries that stop
+firing are *stale* and fail CI, so the baseline only ever shrinks), and
+the path walker that feeds each file to every registered checker.
+
+Checkers register a :class:`Checker` in :data:`ALL_CHECKERS`; each owns
+a disjoint set of rule names and yields raw ``(rule, line, symbol,
+message)`` tuples from ``fn(ctx)``. The driver attaches file identity
+and applies suppressions, so checkers never deal with either.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Iterable, Iterator
+
+# ``# tpu-lint: disable=rule-a,rule-b -- reason`` — the reason is part
+# of the grammar, not a convention: a match without one is reported as
+# bad-suppression and suppresses nothing.
+_SUPPRESS_RE = re.compile(
+    r"#\s*tpu-lint:\s*disable=(?P<rules>[a-z0-9,-]+)"
+    r"(?:\s+--\s*(?P<reason>\S.*))?")
+
+# Rule name for malformed/reason-less suppressions. Not suppressible.
+BAD_SUPPRESSION = "bad-suppression"
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One checker hit, anchored to a file/line/symbol."""
+
+    rule: str
+    path: str          # repo-relative (or as-given) posix path
+    line: int
+    symbol: str        # enclosing ``Class.method`` / ``function`` / ""
+    message: str
+
+    def key(self) -> tuple[str, str, str]:
+        """Baseline identity: line-number-insensitive so routine edits
+        above a baselined finding don't churn the baseline."""
+        return (self.rule, self.path, self.symbol)
+
+    def __str__(self) -> str:
+        where = f" [{self.symbol}]" if self.symbol else ""
+        return f"{self.path}:{self.line}: {self.rule}{where}: {self.message}"
+
+    def to_json(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "symbol": self.symbol, "message": self.message}
+
+
+@dataclass(frozen=True)
+class Checker:
+    """One named checker owning one or more rule names.
+
+    ``fn(ctx)`` yields ``(rule, line, symbol, message)`` tuples; the
+    driver wraps them into :class:`Finding` and applies suppressions.
+    """
+
+    name: str
+    rules: tuple[str, ...]
+    doc: str
+    fn: Callable[["FileContext"], Iterable[tuple[str, int, str, str]]]
+
+
+@dataclass
+class _Suppression:
+    rules: tuple[str, ...]
+    reason: str
+    line: int          # the line the suppression comment sits on
+    target: int        # the line it suppresses
+    used: bool = False
+
+
+class FileContext:
+    """Everything a checker may look at for one file: source, AST, and
+    the pre-parsed suppression table."""
+
+    def __init__(self, path: Path, relpath: str, source: str,
+                 tree: ast.AST):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.suppressions = _parse_suppressions(self.lines)
+
+
+def _parse_suppressions(lines: list[str]) -> list[_Suppression]:
+    out: list[_Suppression] = []
+    for lineno, line in enumerate(lines, 1):
+        m = _SUPPRESS_RE.search(line)
+        if m is None:
+            continue
+        rules = tuple(r for r in m.group("rules").split(",") if r)
+        reason = (m.group("reason") or "").strip()
+        # A comment-only line suppresses the NEXT line; a trailing
+        # comment suppresses its own line.
+        target = lineno + 1 if line.lstrip().startswith("#") else lineno
+        out.append(_Suppression(rules=rules, reason=reason,
+                                line=lineno, target=target))
+    return out
+
+
+# -- checker registry ---------------------------------------------------
+
+ALL_CHECKERS: list[Checker] = []
+
+
+def register(checker: Checker) -> Checker:
+    taken = {r for c in ALL_CHECKERS for r in c.rules}
+    dup = taken.intersection(checker.rules)
+    if dup:
+        raise ValueError(f"rules {sorted(dup)} already registered")
+    ALL_CHECKERS.append(checker)
+    return checker
+
+
+def all_rules() -> list[str]:
+    return sorted(r for c in ALL_CHECKERS for r in c.rules)
+
+
+def checker_for_rule(rule: str) -> Checker | None:
+    for c in ALL_CHECKERS:
+        if rule in c.rules:
+            return c
+    return None
+
+
+def _load_checkers() -> None:
+    """Import the checker modules (each registers itself on import).
+    Deferred so ``core`` carries no import cycle with them."""
+    if ALL_CHECKERS:
+        return
+    from kubeflow_tpu.analysis import (  # noqa: F401 — import registers
+        exposition,
+        jax_hygiene,
+        locks,
+        resources,
+        threads,
+    )
+
+
+# -- driver -------------------------------------------------------------
+
+@dataclass
+class FileResult:
+    """Findings for one file, post-suppression."""
+
+    relpath: str
+    findings: list[Finding] = field(default_factory=list)
+    suppressed: list[Finding] = field(default_factory=list)
+
+
+def analyze_file(path: Path, relpath: str | None = None,
+                 rules: set[str] | None = None) -> FileResult:
+    """Run every registered checker over one file. ``rules`` narrows to
+    a subset (CLI ``--rules``); suppression bookkeeping still runs so a
+    reason-less suppression is reported regardless of the subset."""
+    _load_checkers()
+    rel = relpath if relpath is not None else path.as_posix()
+    result = FileResult(relpath=rel)
+    source = path.read_text()
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError as e:
+        # The style tier (utils.lint E999) owns syntax errors; here it
+        # just means no semantic analysis is possible.
+        result.findings.append(Finding(
+            rule="parse-error", path=rel, line=e.lineno or 0, symbol="",
+            message=f"file does not parse: {e.msg}"))
+        return result
+    ctx = FileContext(path, rel, source, tree)
+    raw: list[Finding] = []
+    seen: set[Finding] = set()
+    for checker in ALL_CHECKERS:
+        if rules is not None and not rules.intersection(checker.rules):
+            continue
+        for rule, line, symbol, message in checker.fn(ctx):
+            if rules is not None and rule not in rules:
+                continue
+            finding = Finding(rule=rule, path=rel, line=line,
+                              symbol=symbol, message=message)
+            if finding not in seen:  # e.g. one expr read twice
+                seen.add(finding)
+                raw.append(finding)
+    by_target: dict[int, list[_Suppression]] = {}
+    for sup in ctx.suppressions:
+        by_target.setdefault(sup.target, []).append(sup)
+    for finding in raw:
+        sup = next(
+            (s for s in by_target.get(finding.line, ())
+             if finding.rule in s.rules), None)
+        if sup is None:
+            result.findings.append(finding)
+        elif not sup.reason:
+            sup.used = True
+            result.findings.append(finding)
+        else:
+            sup.used = True
+            result.suppressed.append(finding)
+    if rules is None or BAD_SUPPRESSION in rules:
+        for sup in ctx.suppressions:
+            if not sup.reason:
+                result.findings.append(Finding(
+                    rule=BAD_SUPPRESSION, path=rel, line=sup.line,
+                    symbol="",
+                    message=("suppression must carry a reason: "
+                             "# tpu-lint: disable=<rule> -- <why>")))
+    return result
+
+
+def iter_python_files(paths: Iterable[Path]) -> Iterator[Path]:
+    for p in paths:
+        if p.is_dir():
+            yield from sorted(p.rglob("*.py"))
+        elif p.suffix == ".py":
+            yield p
+
+
+def analyze_paths(paths: Iterable[Path], root: Path | None = None,
+                  rules: set[str] | None = None) -> list[FileResult]:
+    """Analyze every ``*.py`` under ``paths``; relpaths are taken
+    relative to ``root`` (default: cwd) when possible so findings and
+    baselines are machine-independent."""
+    base = root or Path.cwd()
+    out = []
+    for f in iter_python_files(paths):
+        try:
+            rel = f.resolve().relative_to(base.resolve()).as_posix()
+        except ValueError:
+            rel = f.as_posix()
+        out.append(analyze_file(f, rel, rules))
+    return out
+
+
+# -- baseline -----------------------------------------------------------
+
+class Baseline:
+    """Checked-in set of accepted findings, keyed line-insensitively.
+
+    ``apply`` splits current findings into new-vs-baselined and reports
+    the *stale* entries — baseline keys that no longer fire. Stale
+    entries fail CI (``ci/static_analysis.sh``): the baseline is a
+    ratchet that only shrinks, never a place findings quietly live
+    forever."""
+
+    VERSION = 1
+
+    def __init__(self, entries: Iterable[dict] = ()):
+        self.entries = [dict(e) for e in entries]
+
+    @classmethod
+    def load(cls, path: Path) -> "Baseline":
+        data = json.loads(path.read_text())
+        if data.get("version") != cls.VERSION:
+            raise ValueError(
+                f"{path}: unsupported baseline version "
+                f"{data.get('version')!r}")
+        return cls(data.get("findings", []))
+
+    @classmethod
+    def from_findings(cls, findings: Iterable[Finding]) -> "Baseline":
+        seen: dict[tuple, dict] = {}
+        for f in findings:
+            seen.setdefault(f.key(), {
+                "rule": f.rule, "path": f.path, "symbol": f.symbol,
+                "message": f.message})
+        return cls(seen.values())
+
+    def dump(self) -> str:
+        entries = sorted(
+            self.entries,
+            key=lambda e: (e["path"], e["rule"], e.get("symbol", "")))
+        return json.dumps({"version": self.VERSION, "findings": entries},
+                          indent=2) + "\n"
+
+    def _keys(self) -> set[tuple[str, str, str]]:
+        return {(e["rule"], e["path"], e.get("symbol", ""))
+                for e in self.entries}
+
+    def apply(self, findings: Iterable[Finding]
+              ) -> tuple[list[Finding], list[Finding], list[dict]]:
+        """→ (new findings, baselined findings, stale entries)."""
+        keys = self._keys()
+        new, old = [], []
+        fired: set[tuple] = set()
+        for f in findings:
+            if f.key() in keys:
+                old.append(f)
+                fired.add(f.key())
+            else:
+                new.append(f)
+        stale = [e for e in self.entries
+                 if (e["rule"], e["path"], e.get("symbol", ""))
+                 not in fired]
+        return new, old, stale

@@ -1,0 +1,131 @@
+"""Metrics-exposition checker: the scrape contract as rules.
+
+Absorbs the hand-rolled grep half of ``ci/metrics_lint.sh`` and adds
+the conventions the scrape consumers (autoscaler, dashboards) rely on:
+
+- ``metrics-type-literal``: the single-renderer invariant. No string
+  literal containing ``# TYPE`` may exist outside
+  ``observability/metrics.py`` — every exposition surface must render
+  through the one shared renderer (the bug class: a fifth hand-rolled
+  renderer that types every gauge as a counter).
+
+- ``metrics-name-convention``: every family registered via
+  ``registry.counter/gauge/histogram("name", ...)`` follows
+  ``{subsystem}_{name}[_{unit}]``: lowercase snake_case, at least two
+  segments, a known subsystem prefix, counters ending ``_total``,
+  and seconds/bytes units spelled out (no ``_ms``/``_secs``).
+
+- ``metrics-label-vocab``: label names come from the bounded shared
+  vocabulary — ad-hoc labels are how cardinality explosions and
+  join-impossible dashboards start.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from kubeflow_tpu.analysis.core import Checker, FileContext, register
+
+# Modules allowed to spell the exposition text format: the one
+# renderer, the promtool-style scrape validator, and this checker.
+EXEMPT_PATHS = ("observability/metrics.py", "observability/lint.py",
+                "analysis/exposition.py")
+
+SUBSYSTEMS = ("serving", "gateway", "operator", "scheduler", "train",
+              "probe", "kubeflow", "analysis")
+
+LABEL_VOCAB = frozenset({
+    "kind", "route", "queue", "pool", "reason", "role", "model",
+    "code", "status", "service", "replica", "rule", "stage",
+})
+
+_NAME_RE = re.compile(r"^[a-z][a-z0-9]*(_[a-z0-9]+)+$")
+_BAD_UNITS = ("_ms", "_msec", "_msecs", "_secs", "_sec", "_kb", "_mb")
+_REGISTRY_METHODS = {"counter", "gauge", "histogram"}
+
+
+def _dotted(node: ast.AST) -> str | None:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _check(ctx: FileContext):
+    is_renderer = ctx.relpath.endswith(EXEMPT_PATHS)
+    for node in ast.walk(ctx.tree):
+        if (not is_renderer and isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and "# TYPE" in node.value):
+            yield ("metrics-type-literal", node.lineno, "",
+                   "'# TYPE' literal outside observability/metrics.py "
+                   "— render through the shared MetricRegistry/"
+                   "type_line(), never hand-roll the text format")
+        if not isinstance(node, ast.Call):
+            continue
+        if not isinstance(node.func, ast.Attribute):
+            continue
+        if node.func.attr not in _REGISTRY_METHODS:
+            continue
+        recv = (_dotted(node.func.value) or "").lower()
+        if "registr" not in recv and "metrics" not in recv:
+            continue
+        if not node.args or not isinstance(node.args[0], ast.Constant) \
+                or not isinstance(node.args[0].value, str):
+            continue
+        name = node.args[0].value
+        kind = node.func.attr
+        line = node.lineno
+        if not _NAME_RE.match(name):
+            yield ("metrics-name-convention", line, "",
+                   f"metric name {name!r} is not snake_case "
+                   "{subsystem}_{name}[_{unit}]")
+        else:
+            if name.split("_", 1)[0] not in SUBSYSTEMS:
+                yield ("metrics-name-convention", line, "",
+                       f"metric {name!r} has unknown subsystem prefix "
+                       f"{name.split('_', 1)[0]!r} (known: "
+                       f"{', '.join(SUBSYSTEMS)})")
+            if kind == "counter" and not name.endswith("_total"):
+                yield ("metrics-name-convention", line, "",
+                       f"counter {name!r} must end in _total")
+            if kind != "counter" and name.endswith("_total"):
+                yield ("metrics-name-convention", line, "",
+                       f"{kind} {name!r} must not end in _total "
+                       "(reserved for counters)")
+            if any(name.endswith(u) for u in _BAD_UNITS):
+                yield ("metrics-name-convention", line, "",
+                       f"metric {name!r} uses an abbreviated unit — "
+                       "spell out _seconds/_bytes (base units)")
+        for kw in node.keywords:
+            if kw.arg != "labels":
+                continue
+            for elt in ast.walk(kw.value):
+                if isinstance(elt, ast.Constant) and isinstance(
+                        elt.value, str):
+                    if elt.value == "le":
+                        yield ("metrics-label-vocab", line, "",
+                               "label 'le' is reserved for histogram "
+                               "buckets")
+                    elif elt.value not in LABEL_VOCAB:
+                        yield ("metrics-label-vocab", line, "",
+                               f"label {elt.value!r} outside the "
+                               "bounded vocabulary "
+                               f"({', '.join(sorted(LABEL_VOCAB))}) — "
+                               "extend LABEL_VOCAB deliberately "
+                               "instead of ad hoc")
+
+
+register(Checker(
+    name="metrics-exposition",
+    rules=("metrics-type-literal", "metrics-name-convention",
+           "metrics-label-vocab"),
+    doc="Single-renderer invariant, metric naming convention, bounded "
+        "label vocabulary",
+    fn=_check,
+))

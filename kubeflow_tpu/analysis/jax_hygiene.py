@@ -1,0 +1,175 @@
+"""JAX-hygiene checker: no host syncs or impurity inside traced code.
+
+Traced contexts are found structurally: functions decorated
+``@jax.jit`` / ``@functools.partial(jax.jit, ...)`` (static argnames
+parsed from the decorator), and local functions passed to
+``lax.scan`` / ``lax.cond`` / ``lax.while_loop`` / ``shard_map`` /
+``jax.jit(f)``. Inside them:
+
+- ``jit-host-sync``: ``.item()`` / ``.tolist()`` / ``np.asarray`` /
+  ``np.array`` / ``jax.device_get`` / ``.block_until_ready()`` — each
+  forces a device→host transfer at trace time (or fails under real
+  tracing) and silently serializes the dispatch pipeline;
+
+- ``jit-impure-call``: ``print`` / ``time.*`` / stdlib ``random.*`` /
+  ``np.random.*`` / ``open`` — runs once at trace time, then never
+  again; the classic "my debug print only fired once" and
+  "every retrace reseeds differently" traps;
+
+- ``jit-traced-branch``: a Python ``if``/``while`` whose test reads a
+  *traced* parameter (not listed in ``static_argnames``/``argnums``)
+  — under tracing this raises ``TracerBoolConversionError`` or, worse,
+  bakes in one branch. ``is None`` / ``is not None`` tests are exempt
+  (argument-structure dispatch is static per trace).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kubeflow_tpu.analysis.core import Checker, FileContext, register
+
+_HOST_SYNC_ATTRS = {"item", "tolist", "block_until_ready"}
+_HOST_SYNC_CALLS = {"np.asarray", "np.array", "numpy.asarray",
+                    "numpy.array", "jax.device_get"}
+_IMPURE_PREFIXES = ("time.", "random.", "np.random.", "numpy.random.")
+_IMPURE_NAMES = {"print", "open", "input"}
+_TRACING_WRAPPERS = {"scan", "cond", "while_loop", "fori_loop", "jit",
+                     "shard_map", "pmap", "vmap", "grad",
+                     "value_and_grad", "checkpoint", "remat"}
+
+
+def _dotted(node: ast.AST) -> str | None:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _jit_decorator(fn: ast.FunctionDef) -> tuple[bool, set[str]]:
+    """(is jitted, static param names) from the decorator list."""
+    for dec in fn.decorator_list:
+        name = _dotted(dec) or ""
+        if name in ("jax.jit", "jit"):
+            return True, set()
+        if isinstance(dec, ast.Call):
+            cname = _dotted(dec.func) or ""
+            if cname in ("jax.jit", "jit"):
+                return True, _static_names(dec, fn)
+            if cname.endswith("partial"):
+                if dec.args and (_dotted(dec.args[0]) or "") in (
+                        "jax.jit", "jit"):
+                    return True, _static_names(dec, fn)
+    return False, set()
+
+
+def _static_names(call: ast.Call, fn: ast.FunctionDef) -> set[str]:
+    params = [a.arg for a in fn.args.args]
+    out: set[str] = set()
+    for kw in call.keywords:
+        if kw.arg in ("static_argnames", "donate_argnames"):
+            for node in ast.walk(kw.value):
+                if isinstance(node, ast.Constant) and isinstance(
+                        node.value, str):
+                    if kw.arg == "static_argnames":
+                        out.add(node.value)
+        elif kw.arg in ("static_argnums",):
+            for node in ast.walk(kw.value):
+                if isinstance(node, ast.Constant) and isinstance(
+                        node.value, int) and node.value < len(params):
+                    out.add(params[node.value])
+    return out
+
+
+def _local_traced_fns(tree: ast.AST) -> set[str]:
+    """Names of local ``def``s passed to lax.scan/cond/shard_map/jit —
+    traced even without a decorator."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        leaf = (_dotted(node.func) or "").rsplit(".", 1)[-1]
+        if leaf not in _TRACING_WRAPPERS:
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(arg, ast.Name):
+                out.add(arg.id)
+    return out
+
+
+def _check_body(fn: ast.FunctionDef, static: set[str], symbol: str):
+    params = {a.arg for a in fn.args.args
+              if a.arg not in ("self", "cls")}
+    traced = params - static
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = _dotted(node.func) or ""
+            leaf = name.rsplit(".", 1)[-1]
+            if name in _HOST_SYNC_CALLS or (
+                    isinstance(node.func, ast.Attribute)
+                    and leaf in _HOST_SYNC_ATTRS):
+                yield ("jit-host-sync", node.lineno, symbol,
+                       f"host sync {name or '.' + leaf}() inside a "
+                       "traced function — forces a device round-trip "
+                       "at trace time or fails under jit")
+            elif name in _IMPURE_NAMES or any(
+                    name.startswith(p) for p in _IMPURE_PREFIXES):
+                yield ("jit-impure-call", node.lineno, symbol,
+                       f"impure call {name}() inside a traced function "
+                       "— runs at trace time only, not per step")
+        if isinstance(node, (ast.If, ast.While)):
+            bad = _traced_test_name(node.test, traced)
+            if bad is not None:
+                yield ("jit-traced-branch", node.lineno, symbol,
+                       f"Python branch on traced parameter {bad!r} — "
+                       "TracerBoolConversionError under jit (use "
+                       "lax.cond/jnp.where, or mark it static)")
+
+
+def _traced_test_name(test: ast.AST, traced: set[str]) -> str | None:
+    # ``x is None`` / ``x is not None`` — structural dispatch, static
+    # per trace, legal.
+    for node in ast.walk(test):
+        if isinstance(node, ast.Compare) and any(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            return None
+    for node in ast.walk(test):
+        if isinstance(node, ast.Name) and node.id in traced:
+            return node.id
+    return None
+
+
+def _check(ctx: FileContext):
+    traced_names = _local_traced_fns(ctx.tree)
+
+    def visit(node, stack):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                symbol = ".".join(stack + [child.name])
+                jitted, static = _jit_decorator(child)
+                if not jitted and child.name in traced_names:
+                    jitted, static = True, set()
+                if jitted:
+                    yield from _check_body(child, static, symbol)
+                else:
+                    yield from visit(child, stack + [child.name])
+            elif isinstance(child, ast.ClassDef):
+                yield from visit(child, stack + [child.name])
+            else:
+                yield from visit(child, stack)
+
+    yield from visit(ctx.tree, [])
+
+
+register(Checker(
+    name="jax-hygiene",
+    rules=("jit-host-sync", "jit-impure-call", "jit-traced-branch"),
+    doc="No host syncs, impure calls, or Python branches on traced "
+        "values inside jitted/scanned functions",
+    fn=_check,
+))

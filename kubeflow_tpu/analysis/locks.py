@@ -1,0 +1,588 @@
+"""Lock-discipline checker: the shipped-bug classes made rules.
+
+Three rules over each module's inferred lock-acquisition structure
+(``with self._lock:`` / ``.acquire()``–``.release()`` pairs, with
+entry-guard propagation through private ``self._m()`` calls):
+
+- ``lock-blocking-call`` — a call that can block the host (queue
+  ``get``/``put`` that can wait, socket/HTTP, thread ``join``,
+  ``time.sleep``, untimed ``Event.wait``, ``jax.device_get`` /
+  ``block_until_ready`` device syncs) while any lock is held. This is
+  the PR-9 stall as a rule: an import held the prefix lock across the
+  state-lock device wait and froze the scheduler's pop path.
+  ``Condition.wait`` on the *held* condition is exempt — waiting
+  releases it (the false-positive fixture the checker must pass).
+
+- ``lock-order-cycle`` — two locks acquired in both nesting orders
+  anywhere in the module (classic deadlock), or a non-reentrant lock
+  re-acquired while already held (self-deadlock).
+
+- ``lock-inconsistent-guard`` — one attribute written under a lock at
+  some sites but not others, or written consistently under a lock and
+  read elsewhere without it: the PR-4 torn-metrics class (and the
+  PR-8 early-table-arm repro lands here — the block-table row armed in
+  the pop path under a different guard than its owning dispatch).
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+from kubeflow_tpu.analysis.core import Checker, FileContext, register
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+_MUTATORS = {
+    "append", "appendleft", "extend", "insert", "add", "update",
+    "remove", "discard", "pop", "popleft", "popitem", "clear",
+    "setdefault", "sort", "reverse",
+}
+_INIT_METHODS = {"__init__", "__post_init__", "__enter__"}
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """``self._alloc.free`` → ``"self._alloc.free"`` (None when the
+    chain bottoms out in anything but a Name)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """``self.X`` → ``X`` (exactly one attribute hop)."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _write_root(target: ast.AST) -> str | None:
+    """The ``self`` attribute a store ultimately mutates:
+    ``self.X = / self.X[...] = / self.X.y = `` all root at ``X``."""
+    node = target
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        name = _self_attr(node)
+        if name is not None:
+            return name
+        node = node.value
+    return None
+
+
+@dataclass
+class _Site:
+    line: int
+    symbol: str
+    guards: frozenset  # lock names held lexically at the site
+    method: str        # enclosing class method ("" at module level)
+
+
+@dataclass
+class _ClassModel:
+    name: str
+    locks: dict[str, str] = field(default_factory=dict)   # attr → kind
+    queues: dict[str, bool] = field(default_factory=dict)  # attr → bounded
+    threads: set[str] = field(default_factory=set)
+    events: set[str] = field(default_factory=set)
+    containers: set[str] = field(default_factory=set)
+    writes: dict[str, list[_Site]] = field(
+        default_factory=lambda: defaultdict(list))
+    reads: dict[str, list[_Site]] = field(
+        default_factory=lambda: defaultdict(list))
+    # method → [(caller_method, guards_at_call)]
+    calls: dict[str, list[tuple[str, frozenset]]] = field(
+        default_factory=lambda: defaultdict(list))
+    # method → locks it acquires directly in its own body
+    acquires: dict[str, set[str]] = field(
+        default_factory=lambda: defaultdict(set))
+    methods: set[str] = field(default_factory=set)
+
+
+def _lock_kind(value: ast.AST) -> str | None:
+    """``threading.Lock()`` / ``Lock()`` → kind, else None."""
+    if isinstance(value, ast.Call):
+        name = _dotted(value.func) or ""
+        leaf = name.rsplit(".", 1)[-1]
+        if leaf in _LOCK_CTORS:
+            return leaf.lower()
+        if leaf == "Event":
+            return "event"
+    return None
+
+
+def _queue_bounded(value: ast.AST) -> bool | None:
+    """``queue.Queue(...)``-shaped constructor → is it bounded? None
+    when the value is not a queue constructor."""
+    if not isinstance(value, ast.Call):
+        return None
+    name = _dotted(value.func) or ""
+    if name.rsplit(".", 1)[-1] not in ("Queue", "LifoQueue",
+                                       "PriorityQueue", "SimpleQueue"):
+        return None
+    maxsize = None
+    if value.args:
+        maxsize = value.args[0]
+    for kw in value.keywords:
+        if kw.arg == "maxsize":
+            maxsize = kw.value
+    if maxsize is None:
+        return False
+    if isinstance(maxsize, ast.Constant) and not maxsize.value:
+        return False
+    return True
+
+
+def _is_thread_ctor(value: ast.AST) -> bool:
+    return (isinstance(value, ast.Call)
+            and (_dotted(value.func) or "").endswith("Thread"))
+
+
+_CONTAINER_CTORS = {"dict", "set", "list", "deque", "defaultdict",
+                    "OrderedDict", "Counter"}
+
+
+def _is_container(value: ast.AST) -> bool:
+    """Literals/constructors of plain mutable containers — the attrs
+    whose ``.append()``/``.add()``/… calls count as writes. Arbitrary
+    objects (a PrefixCache, a client) own their own thread-safety; a
+    method call on them is not a write to the attribute."""
+    if isinstance(value, (ast.Dict, ast.Set, ast.List, ast.ListComp,
+                          ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(value, ast.Call):
+        leaf = (_dotted(value.func) or "").rsplit(".", 1)[-1]
+        return leaf in _CONTAINER_CTORS
+    return False
+
+
+def _assign_targets(node) -> list[ast.AST]:
+    """Assignment targets with tuple/list unpacking flattened."""
+    targets = (node.targets if isinstance(node, ast.Assign)
+               else [node.target])
+    out: list[ast.AST] = []
+    for t in targets:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            out.extend(t.elts)
+        else:
+            out.append(t)
+    return out
+
+
+class _LockChecker:
+    """Per-file analysis driver; produces raw finding tuples."""
+
+    def __init__(self, ctx: FileContext):
+        self.ctx = ctx
+        self.findings: list[tuple[int, str, str, str]] = []
+        self.module_locks: dict[str, str] = {}
+        # (lockA → lockB) nesting edges with a representative site.
+        self.edges: dict[tuple[str, str], _Site] = {}
+
+    def run(self):
+        for node in self.ctx.tree.body:
+            if isinstance(node, ast.Assign) and _lock_kind(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.module_locks[t.id] = _lock_kind(node.value)
+        for node in ast.walk(self.ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                self._check_class(node)
+        # Module-level functions (not inside classes).
+        for node in self.ctx.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                model = _ClassModel(name="")
+                self._walk_block(node.body, frozenset(), node.name,
+                                 "", model, {})
+        self._report_cycles()
+        for line, rule, symbol, message in self.findings:
+            yield rule, line, symbol, message
+
+    # -- per-class ------------------------------------------------------
+
+    def _check_class(self, cls: ast.ClassDef):
+        model = _ClassModel(name=cls.name)
+        methods: dict[str, ast.FunctionDef] = {}
+        for node in cls.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                methods[node.name] = node
+                model.methods.add(node.name)
+                for sub in ast.walk(node):
+                    if isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                        if sub.value is None:
+                            continue
+                        for t in _assign_targets(sub):
+                            attr = _self_attr(t)
+                            if attr is None:
+                                continue
+                            kind = _lock_kind(sub.value)
+                            if kind == "event":
+                                model.events.add(attr)
+                            elif kind is not None:
+                                model.locks[attr] = kind
+                            bounded = _queue_bounded(sub.value)
+                            if bounded is not None:
+                                model.queues[attr] = bounded
+                            if _is_thread_ctor(sub.value):
+                                model.threads.add(attr)
+                            if _is_container(sub.value):
+                                model.containers.add(attr)
+        for name, node in methods.items():
+            local_threads = {
+                t.id for sub in ast.walk(node)
+                if isinstance(sub, ast.Assign) and _is_thread_ctor(sub.value)
+                for t in sub.targets if isinstance(t, ast.Name)}
+            self._walk_block(node.body, frozenset(), f"{cls.name}.{name}",
+                             name, model, local_threads)
+        entry = self._entry_guards(model)
+        self._apply_entry_guards(model, entry)
+        self._guard_rules(model)
+
+    def _entry_guards(self, model: _ClassModel) -> dict[str, frozenset]:
+        """Locks provably held at EVERY intra-class call site of each
+        private method (public methods are callable from anywhere, so
+        their entry set is empty). Optimistic fixpoint — private
+        methods with call sites start at ⊤ (all locks) and shrink to
+        the intersection — so a recursive helper always called under a
+        lock (FakeApiServer._cascade_delete under its RLock) keeps the
+        guard instead of losing it to its own recursive call site."""
+        top = frozenset(
+            [f"self.{a}" for a in model.locks] + list(self.module_locks))
+        entry = {}
+        for m in model.methods:
+            private = m.startswith("_") and not m.startswith("__")
+            entry[m] = top if private and model.calls.get(m) \
+                else frozenset()
+        for _ in range(20):
+            changed = False
+            for m in model.methods:
+                sites = model.calls.get(m)
+                if not sites or not entry[m]:
+                    continue
+                if not m.startswith("_") or m.startswith("__"):
+                    continue
+                new = frozenset.intersection(
+                    *[guards | entry.get(caller, frozenset())
+                      for caller, guards in sites])
+                if new != entry[m]:
+                    entry[m] = new
+                    changed = True
+            if not changed:
+                break
+        return entry
+
+    def _apply_entry_guards(self, model: _ClassModel,
+                            entry: dict[str, frozenset]):
+        for sites in list(model.writes.values()) + list(
+                model.reads.values()):
+            for site in sites:
+                site.guards = site.guards | entry.get(site.method,
+                                                      frozenset())
+        # Entry guards also complete the nesting edges: a method that
+        # acquires L and is only ever called under G nests G → L.
+        for m, acquired in model.acquires.items():
+            for held in entry.get(m, frozenset()):
+                for lock in acquired:
+                    self._edge(held, lock, _Site(0, m, frozenset(), m))
+
+    # -- statement walker ----------------------------------------------
+
+    def _lock_name(self, expr: ast.AST, model: _ClassModel) -> str | None:
+        attr = _self_attr(expr)
+        if attr is not None and attr in model.locks:
+            return f"self.{attr}"
+        if isinstance(expr, ast.Name) and expr.id in self.module_locks:
+            return expr.id
+        return None
+
+    def _lock_kind_of(self, lock: str, model: _ClassModel) -> str:
+        if lock.startswith("self."):
+            return model.locks.get(lock[5:], "lock")
+        return self.module_locks.get(lock, "lock")
+
+    def _edge(self, a: str, b: str, site: _Site):
+        if (a, b) not in self.edges:
+            self.edges[(a, b)] = site
+
+    def _walk_block(self, stmts: list[ast.stmt], held: frozenset,
+                    symbol: str, method: str, model: _ClassModel,
+                    local_threads: set[str]):
+        held = frozenset(held)
+        for stmt in stmts:
+            held = self._walk_stmt(stmt, held, symbol, method, model,
+                                   local_threads)
+
+    def _walk_stmt(self, stmt: ast.stmt, held: frozenset, symbol: str,
+                   method: str, model: _ClassModel,
+                   local_threads: set[str]) -> frozenset:
+        """Process one statement under ``held``; returns the held set
+        for the NEXT statement (``.acquire()``/``.release()`` mutate
+        it, ``with`` does not outlive its body)."""
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # Nested defs inherit the held set at their definition site:
+            # in this tree they are inline helpers called under the
+            # same lock (the BanditStats.mean false-positive fixture).
+            # A helper stashed for deferred execution may over-report;
+            # that is what suppressions are for.
+            self._walk_block(stmt.body, held,
+                             f"{symbol}.{stmt.name}", method, model,
+                             local_threads)
+            return held
+        if isinstance(stmt, ast.ClassDef):
+            return held
+        if isinstance(stmt, ast.With):
+            acquired = []
+            for item in stmt.items:
+                self._scan_exprs([item.context_expr], held, symbol,
+                                 method, model, local_threads)
+                lock = self._lock_name(item.context_expr, model)
+                if lock is not None:
+                    kind = self._lock_kind_of(lock, model)
+                    if lock in held and kind != "rlock":
+                        self._finding(
+                            stmt.lineno, "lock-order-cycle", symbol,
+                            f"{lock} re-acquired while already held "
+                            "(self-deadlock on a non-reentrant lock)")
+                    for other in held:
+                        self._edge(other, lock,
+                                   _Site(stmt.lineno, symbol,
+                                         held, method))
+                    if method:
+                        model.acquires[method].add(lock)
+                    acquired.append(lock)
+            self._walk_block(stmt.body, held | frozenset(acquired),
+                             symbol, method, model, local_threads)
+            return held
+        # Expression parts of compound statements, then their blocks.
+        blocks: list[list[ast.stmt]] = []
+        exprs: list[ast.AST] = []
+        if isinstance(stmt, (ast.If, ast.While)):
+            exprs.append(stmt.test)
+            blocks = [stmt.body, stmt.orelse]
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            exprs += [stmt.target, stmt.iter]
+            blocks = [stmt.body, stmt.orelse]
+        elif isinstance(stmt, ast.Try):
+            blocks = [stmt.body, stmt.orelse, stmt.finalbody]
+            for handler in stmt.handlers:
+                blocks.append(handler.body)
+        else:
+            exprs.append(stmt)
+        held_out = self._scan_exprs(exprs, held, symbol, method, model,
+                                    local_threads)
+        for block in blocks:
+            self._walk_block(block, held, symbol, method, model,
+                             local_threads)
+        return held_out
+
+    def _scan_exprs(self, exprs: list[ast.AST], held: frozenset,
+                    symbol: str, method: str, model: _ClassModel,
+                    local_threads: set[str]) -> frozenset:
+        write_nodes: set[int] = set()
+        for expr in exprs:
+            for node in ast.walk(expr):
+                if isinstance(node, (ast.Assign, ast.AugAssign,
+                                     ast.AnnAssign)):
+                    for t in _assign_targets(node):
+                        root = _write_root(t)
+                        if root is not None and method:
+                            self._record_access(
+                                model.writes, root, node.lineno, symbol,
+                                held, method, model)
+                            for sub in ast.walk(t):
+                                write_nodes.add(id(sub))
+        held_out = held
+        for expr in exprs:
+            for node in ast.walk(expr):
+                if isinstance(node, ast.Call):
+                    held_out = self._scan_call(
+                        node, held, held_out, symbol, method, model,
+                        local_threads, write_nodes)
+                attr = _self_attr(node)
+                if (attr is not None and method
+                        and isinstance(node.ctx, ast.Load)
+                        and id(node) not in write_nodes):
+                    self._record_access(model.reads, attr, node.lineno,
+                                        symbol, held, method, model)
+        return held_out
+
+    def _record_access(self, table, attr, line, symbol, held, method,
+                       model: _ClassModel):
+        if attr in model.locks or attr in model.events:
+            return
+        table[attr].append(_Site(line, symbol, held, method))
+
+    def _scan_call(self, node: ast.Call, held: frozenset,
+                   held_out: frozenset, symbol: str, method: str,
+                   model: _ClassModel, local_threads: set[str],
+                   write_nodes: set[int]) -> frozenset:
+        func = node.func
+        dotted = _dotted(func) or ""
+        leaf = dotted.rsplit(".", 1)[-1]
+        recv = func.value if isinstance(func, ast.Attribute) else None
+        recv_dotted = _dotted(recv) if recv is not None else None
+        # .acquire()/.release() on a known lock: explicit span.
+        lock = self._lock_name(recv, model) if recv is not None else None
+        if lock is not None and leaf == "acquire":
+            for other in held_out:
+                self._edge(other, lock,
+                           _Site(node.lineno, symbol, held_out, method))
+            if method:
+                model.acquires[method].add(lock)
+            return held_out | {lock}
+        if lock is not None and leaf == "release":
+            return held_out - {lock}
+        # Mutator method call on a container-valued self.X == a write
+        # to X (non-container objects own their own thread-safety).
+        if (recv is not None and leaf in _MUTATORS and method):
+            root = _write_root(func)
+            if root is not None and root in model.containers:
+                self._record_access(model.writes, root, node.lineno,
+                                    symbol, held, method, model)
+                for sub in ast.walk(recv):
+                    write_nodes.add(id(sub))
+        # Intra-class call: record for entry-guard/edge propagation.
+        if (recv is not None and isinstance(recv, ast.Name)
+                and recv.id == "self" and method):
+            model.calls[leaf].append((method, held))
+        if held:
+            blocked = self._blocking_reason(node, dotted, leaf, recv,
+                                            recv_dotted, held, model,
+                                            local_threads)
+            if blocked:
+                locks_held = ", ".join(sorted(held))
+                self._finding(
+                    node.lineno, "lock-blocking-call", symbol,
+                    f"{blocked} while holding {locks_held} — a blocked "
+                    "holder stalls every thread contending for the "
+                    "lock (PR-9 bug class)")
+        return held_out
+
+    def _blocking_reason(self, node: ast.Call, dotted: str, leaf: str,
+                         recv, recv_dotted, held: frozenset,
+                         model: _ClassModel,
+                         local_threads: set[str]) -> str | None:
+        kwargs = {kw.arg for kw in node.keywords}
+        if dotted in ("time.sleep",) or leaf == "sleep" and \
+                (recv_dotted or "") == "time":
+            return "time.sleep()"
+        if dotted in ("jax.device_get", "jax.block_until_ready"):
+            return f"device sync {dotted}()"
+        if leaf == "block_until_ready":
+            return "device sync .block_until_ready()"
+        if leaf in ("urlopen", "create_connection"):
+            return f"network call {leaf}()"
+        if leaf in ("recv", "accept") and any(
+                s in (recv_dotted or "").lower()
+                for s in ("sock", "conn")):
+            return f"socket .{leaf}()"
+        if leaf == "join":
+            attr = _self_attr(recv) if recv is not None else None
+            is_thread = (attr in model.threads
+                         or (isinstance(recv, ast.Name)
+                             and recv.id in local_threads))
+            if is_thread:
+                return "thread .join()"
+        if leaf == "result" and not isinstance(recv, ast.Constant):
+            return "handle/future .result() wait"
+        if leaf == "wait" and not node.args and not kwargs:
+            lock = (self._lock_name(recv, model)
+                    if recv is not None else None)
+            if lock is not None and lock in held and \
+                    self._lock_kind_of(lock, model) == "condition":
+                return None  # Condition.wait releases the held lock
+            return "untimed .wait()"
+        if leaf in ("get", "put"):
+            attr = _self_attr(recv) if recv is not None else None
+            if attr in model.queues:
+                if "timeout" in kwargs:
+                    return None
+                for kw in node.keywords:
+                    if (kw.arg == "block"
+                            and isinstance(kw.value, ast.Constant)
+                            and kw.value.value is False):
+                        return None
+                if (node.args and isinstance(node.args[-1], ast.Constant)
+                        and node.args[-1].value is False):
+                    return None
+                if leaf == "put" and not model.queues[attr]:
+                    return None  # unbounded queue: put never blocks
+                return f"queue .{leaf}() that can block"
+        return None
+
+    # -- guard-consistency rules ---------------------------------------
+
+    def _guard_rules(self, model: _ClassModel):
+        for attr, writes in sorted(model.writes.items()):
+            sites = [s for s in writes if s.method not in _INIT_METHODS]
+            if not sites:
+                continue
+            guard_sets = [s.guards for s in sites]
+            common = frozenset.intersection(*guard_sets)
+            if len(sites) >= 2 and not common:
+                counts: dict[str, int] = defaultdict(int)
+                for g in guard_sets:
+                    for lock in g:
+                        counts[lock] += 1
+                if counts:
+                    lock = max(sorted(counts), key=lambda k: counts[k])
+                    n = counts[lock]
+                    for site in sites:
+                        if lock not in site.guards:
+                            self._finding(
+                                site.line, "lock-inconsistent-guard",
+                                site.symbol,
+                                f"self.{attr} is written under {lock} at "
+                                f"{n} of {len(sites)} sites but not here "
+                                "— torn/lost updates (PR-4/PR-8 class)")
+                continue
+            if common:
+                reads = [s for s in model.reads.get(attr, ())
+                         if s.method not in _INIT_METHODS]
+                lock = sorted(common)[0]
+                for site in reads:
+                    if not common & site.guards:
+                        self._finding(
+                            site.line, "lock-inconsistent-guard",
+                            site.symbol,
+                            f"self.{attr} is always written under "
+                            f"{lock} but read here without it — torn "
+                            "read (PR-4 class)")
+
+    def _report_cycles(self):
+        seen = set()
+        for (a, b), site in sorted(self.edges.items(),
+                                   key=lambda kv: kv[1].line):
+            if a == b or (b, a) not in self.edges or (b, a) in seen:
+                continue
+            seen.add((a, b))
+            other = self.edges[(b, a)]
+            self._finding(
+                site.line or other.line, "lock-order-cycle", site.symbol,
+                f"{a} and {b} are acquired in both orders (here "
+                f"{a}→{b}; {b}→{a} at line {other.line}) — deadlock "
+                "risk")
+
+    def _finding(self, line, rule, symbol, message):
+        self.findings.append((line, rule, symbol, message))
+
+
+def _check(ctx: FileContext):
+    checker = _LockChecker(ctx)
+    for rule, line, symbol, message in checker.run():
+        yield rule, line, symbol, message
+
+
+register(Checker(
+    name="lock-discipline",
+    rules=("lock-blocking-call", "lock-order-cycle",
+           "lock-inconsistent-guard"),
+    doc="Lock-acquisition graph: blocking calls under locks, order "
+        "cycles, inconsistently guarded attributes",
+    fn=_check,
+))

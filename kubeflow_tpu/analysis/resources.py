@@ -1,0 +1,196 @@
+"""Resource-pairing checker: allocator claims must be release-safe.
+
+``alloc-no-release``: a function that claims pool resources —
+``.alloc(...)`` / ``.share(...)`` on an allocator-shaped receiver
+(the name chain contains ``alloc``) — must make the claim impossible
+to strand on an exception path. Accepted shapes, in the order real
+code uses them:
+
+- a ``.free(...)`` call inside a ``try/finally`` or ``except`` handler
+  of the same function (the scratch-blocks pattern);
+- ownership transfer: the claimed value (or a name it flows into)
+  is stored into non-local state — ``self.X[...] = blocks`` /
+  ``entry.blocks = blocks`` — whose owner frees it later (the
+  slot-table / trie-entry pattern);
+- the claim is returned to the caller (the caller owns it).
+
+This is the PR-4/PR-8 KV-block-leak class: a stream that died between
+``alloc`` and slot registration stranded its blocks until the leak
+checker — not the allocator — noticed.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kubeflow_tpu.analysis.core import Checker, FileContext, register
+
+
+def _dotted(node: ast.AST) -> str | None:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _alloc_recv(node: ast.Call) -> str | None:
+    """Receiver chain for ``X.alloc()`` / ``X.share()`` when X looks
+    like an allocator (name chain contains ``alloc``)."""
+    if not isinstance(node.func, ast.Attribute):
+        return None
+    if node.func.attr not in ("alloc", "share"):
+        return None
+    recv = _dotted(node.func.value) or ""
+    return recv if "alloc" in recv.lower() else None
+
+
+class _FnScan:
+    """One function's claim/release facts (nested defs NOT descended —
+    they are their own functions with their own obligations)."""
+
+    def __init__(self, fn: ast.AST):
+        self.claims: list[ast.Call] = []
+        self.free_in_cleanup = False
+        self.has_return_value = False
+        # Name-level dataflow facts, resolved to a fixpoint afterwards:
+        # a "claim name" is any name the claimed blocks flow through —
+        # seeded from ``x = ...alloc(...)`` targets and ``share(b)``
+        # args, propagated through assignments and for-loop bindings.
+        self._flow: set[str] = set()
+        self._assigns: list[tuple[set[str], set[str]]] = []
+        self._links: list[tuple[set[str], set[str]]] = []
+        self._stores: list[set[str]] = []  # nonlocal-store read names
+        for stmt in fn.body:
+            self._stmt(stmt, in_cleanup=False)
+
+    def _stmt(self, stmt: ast.stmt, in_cleanup: bool):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(stmt, ast.Try):
+            for s in stmt.body + stmt.orelse:
+                self._stmt(s, in_cleanup)
+            for s in stmt.finalbody:
+                self._stmt(s, True)
+            for handler in stmt.handlers:
+                for s in handler.body:
+                    self._stmt(s, True)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            targets = {n.id for n in ast.walk(stmt.target)
+                       if isinstance(n, ast.Name)}
+            reads = {n.id for n in ast.walk(stmt.iter)
+                     if isinstance(n, ast.Name)}
+            self._links.append((targets, reads))
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.stmt):
+                self._stmt(child, in_cleanup)
+        self._exprs(stmt, in_cleanup)
+
+    def _exprs(self, stmt: ast.stmt, in_cleanup: bool):
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.stmt) and node is not stmt:
+                continue
+            if isinstance(node, ast.Call):
+                if _alloc_recv(node) is not None:
+                    self.claims.append(node)
+                    for arg in node.args:
+                        for sub in ast.walk(arg):
+                            if isinstance(sub, ast.Name):
+                                self._flow.add(sub.id)
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "free" and in_cleanup):
+                    self.free_in_cleanup = True
+        if isinstance(stmt, ast.Return) and stmt.value is not None:
+            self.has_return_value = True
+        if isinstance(stmt, ast.Assign):
+            claimed = any(_alloc_recv(n) is not None
+                          for n in ast.walk(stmt.value)
+                          if isinstance(n, ast.Call))
+            reads = {n.id for n in ast.walk(stmt.value)
+                     if isinstance(n, ast.Name)}
+            for t in stmt.targets:
+                flat = t.elts if isinstance(t, (ast.Tuple, ast.List)) \
+                    else [t]
+                for el in flat:
+                    if isinstance(el, ast.Name):
+                        if claimed:
+                            self._flow.add(el.id)
+                        else:
+                            self._assigns.append(({el.id}, reads))
+                    elif isinstance(el, (ast.Attribute, ast.Subscript)):
+                        self._stores.append(reads)
+
+    def transferred(self) -> bool:
+        """Fixpoint: do the claimed blocks reach a nonlocal store?"""
+        flow = set(self._flow)
+        for _ in range(10):
+            grew = False
+            for targets, reads in self._assigns:
+                if reads & flow and not targets <= flow:
+                    flow |= targets
+                    grew = True
+            for targets, reads in self._links:
+                if targets & flow and not reads <= flow:
+                    flow |= reads
+                    grew = True
+                if reads & flow and not targets <= flow:
+                    flow |= targets
+                    grew = True
+            if not grew:
+                break
+        return any(reads & flow for reads in self._stores)
+
+
+def _check(ctx: FileContext):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        scan = _FnScan(node)
+        if not scan.claims:
+            continue
+        safe = (scan.free_in_cleanup or scan.has_return_value
+                or scan.transferred())
+        if safe:
+            continue
+        symbol = _enclosing(ctx.tree, node)
+        first = scan.claims[0]
+        recv = _alloc_recv(first)
+        yield ("alloc-no-release", first.lineno, symbol,
+               f"{recv}.{first.func.attr}() has no free() on an "
+               "exception path, no ownership transfer, and no return "
+               "— blocks leak if anything below raises (KV-leak "
+               "class)")
+
+
+def _enclosing(tree: ast.AST, target: ast.AST) -> str:
+    path: list[str] = []
+
+    def visit(node, stack):
+        if node is target:
+            path.extend(stack + [target.name])
+            return True
+        for child in ast.iter_child_nodes(node):
+            name = child.name if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                        ast.ClassDef)) else None
+            if visit(child, stack + [name] if name and child is not
+                     target else stack):
+                return True
+        return False
+
+    visit(tree, [])
+    return ".".join(p for p in path if p)
+
+
+register(Checker(
+    name="resource-pairing",
+    rules=("alloc-no-release",),
+    doc="Allocator alloc/share calls must free on exception paths, "
+        "transfer ownership, or return the claim",
+    fn=_check,
+))

@@ -1,0 +1,177 @@
+"""Thread-lifecycle checker.
+
+Every ``threading.Thread(...)`` started in the tree must make two
+explicit choices, or the reviewer can't tell leak from design:
+
+- ``thread-no-daemon``: the constructor passes ``daemon=`` (or the
+  bound name gets a ``.daemon =`` assignment before ``start()``).
+  Python's default (inherit the creator's daemonness) is how shutdown
+  hangs ship.
+
+- ``thread-no-join``: somewhere in the module there is a reachable way
+  for the thread to END — a ``.join()`` on the name/attribute the
+  thread is bound to, or (for daemon loops) a recognizable stop signal:
+  a ``threading.Event`` that gets ``.set()``, a ``*stop*``/``*closed*``
+  flag assigned truthy, a server ``.shutdown()``/``.close()`` call, or
+  a ``serve_forever`` target (whose stop IS ``shutdown()``, often owned
+  by the caller holding the returned server). A non-daemon thread must
+  have a join path; "the process will exit eventually" is not one.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kubeflow_tpu.analysis.core import Checker, FileContext, register
+
+
+def _dotted(node: ast.AST) -> str | None:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_thread_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = _dotted(node.func) or ""
+    return name in ("threading.Thread", "Thread") or name.endswith(
+        ".Thread")
+
+
+def _module_stop_paths(tree: ast.AST) -> dict[str, bool]:
+    """Signals that some thread in this module can be told to stop."""
+    event_attrs: set[str] = set()
+    facts = {"event_set": False, "stop_flag": False, "shutdown": False}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                       ast.Call):
+            ctor = _dotted(node.value.func) or ""
+            if ctor.rsplit(".", 1)[-1] == "Event":
+                for t in node.targets:
+                    name = _dotted(t)
+                    if name:
+                        event_attrs.add(name.rsplit(".", 1)[-1])
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                name = (_dotted(t) or "").rsplit(".", 1)[-1].lower()
+                if ("stop" in name or "closed" in name
+                        or "shutdown" in name):
+                    facts["stop_flag"] = True
+        if isinstance(node, ast.Call):
+            name = _dotted(node.func) or ""
+            leaf = name.rsplit(".", 1)[-1]
+            if leaf == "set" and name.split(".")[-2:-1] and \
+                    name.split(".")[-2] in event_attrs:
+                facts["event_set"] = True
+            if leaf in ("shutdown", "close", "stop"):
+                facts["shutdown"] = True
+    return facts
+
+
+def _joined_names(tree: ast.AST) -> set[str]:
+    """Leaf names ``X`` for every ``X.join(...)`` / ``self.X.join(...)``
+    in the module (thread bindings are matched by leaf name)."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "join"):
+            recv = _dotted(node.func.value)
+            if recv:
+                out.add(recv.rsplit(".", 1)[-1])
+    return out
+
+
+def _daemon_assigned(tree: ast.AST, binding: str | None) -> bool:
+    if binding is None:
+        return False
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                name = _dotted(t) or ""
+                if name.endswith(f"{binding}.daemon"):
+                    return True
+    return False
+
+
+def _check(ctx: FileContext):
+    stop = _module_stop_paths(ctx.tree)
+    joined = _joined_names(ctx.tree)
+    # Thread ctor sites with their binding (assignment target leaf name,
+    # or None for anonymous ``threading.Thread(...).start()``).
+    for node in ast.walk(ctx.tree):
+        binding = None
+        call = None
+        if isinstance(node, ast.Assign) and _is_thread_ctor(node.value):
+            call = node.value
+            for t in node.targets:
+                name = _dotted(t)
+                if name:
+                    binding = name.rsplit(".", 1)[-1]
+        elif isinstance(node, ast.Call) and _is_thread_ctor(node):
+            parent_handled = False  # assignments handled above
+            call = node
+            for holder in ast.walk(ctx.tree):
+                if isinstance(holder, ast.Assign) and holder.value is node:
+                    parent_handled = True
+            if parent_handled:
+                continue
+        if call is None:
+            continue
+        symbol = _enclosing(ctx.tree, call)
+        kwargs = {kw.arg: kw.value for kw in call.keywords}
+        daemon = kwargs.get("daemon")
+        if daemon is None and not _daemon_assigned(ctx.tree, binding):
+            yield ("thread-no-daemon", call.lineno, symbol,
+                   "threading.Thread without an explicit daemon= "
+                   "choice — inherited daemonness is how shutdown "
+                   "hangs ship")
+        target = kwargs.get("target")
+        target_name = (_dotted(target) or "") if target is not None \
+            else ""
+        serve_forever = target_name.endswith("serve_forever")
+        has_join = binding is not None and binding in joined
+        daemon_true = (isinstance(daemon, ast.Constant)
+                       and daemon.value is True)
+        has_stop = (stop["event_set"] or stop["stop_flag"]
+                    or stop["shutdown"] or serve_forever)
+        if not has_join and not (daemon_true and has_stop):
+            yield ("thread-no-join", call.lineno, symbol,
+                   "started thread has no reachable join()/stop path "
+                   "in this module (join the binding, or daemon=True "
+                   "plus an Event/stop-flag/shutdown signal)")
+
+
+def _enclosing(tree: ast.AST, target: ast.AST) -> str:
+    """Qualname of the def/class lexically containing ``target``."""
+    path: list[str] = []
+
+    def visit(node, stack):
+        for child in ast.iter_child_nodes(node):
+            if child is target:
+                path.extend(stack)
+                return True
+            name = getattr(child, "name", None) if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                        ast.ClassDef)) else None
+            if visit(child, stack + [name] if name else stack):
+                return True
+        return False
+
+    visit(tree, [])
+    return ".".join(path)
+
+
+register(Checker(
+    name="thread-lifecycle",
+    rules=("thread-no-daemon", "thread-no-join"),
+    doc="Threads must choose daemon= explicitly and have a reachable "
+        "join()/stop path",
+    fn=_check,
+))

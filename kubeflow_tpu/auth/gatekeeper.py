@@ -351,6 +351,7 @@ def main(argv=None) -> int:
                 ring.rotate()
                 ring.prune()
 
+        # tpu-lint: disable=thread-no-join -- process-lifetime rotation loop; dies with the process
         threading.Thread(target=rotate_loop, daemon=True).start()
     httpd = make_server(auth, args.port, ring=ring,
                         audience=args.audience, token_ttl=args.token_ttl)

@@ -157,9 +157,13 @@ class BootstrapService:
     def metrics(self) -> str:
         deployed = sum(1 for s in self._status.values()
                        if s.get("phase") == "Deployed")
+        # Snapshot both counters under their lock so the rendered pair
+        # is consistent (requests >= errors must hold in every scrape).
+        with self._counter_lock:
+            requests, errors = self.requests, self.errors
         return render_prometheus({
-            "bootstrap_requests_total": self.requests,
-            "bootstrap_errors_total": self.errors,
+            "bootstrap_requests_total": requests,
+            "bootstrap_errors_total": errors,
             "bootstrap_apps_deployed": deployed,
         })
 

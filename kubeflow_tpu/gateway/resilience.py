@@ -378,6 +378,7 @@ class KvFillCache:
                 and cell.get("fill") is not None
             if not fresh and not cell["refreshing"]:
                 cell["refreshing"] = True
+                # tpu-lint: disable=thread-no-join -- one-shot refresh; exits after a single scrape
                 threading.Thread(
                     target=self._refresh,
                     args=(service, resolve(service)),

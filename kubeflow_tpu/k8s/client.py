@@ -167,7 +167,8 @@ class KindRegistry:
 
     def plural(self, kind: str) -> str:
         try:
-            return self._kinds[kind][0]
+            with self._lock:
+                return self._kinds[kind][0]
         except KeyError:
             raise ApiError.not_found(f"no REST mapping for kind {kind}")
 
@@ -181,7 +182,8 @@ class KindRegistry:
 
     def namespaced(self, kind: str) -> bool:
         try:
-            return self._kinds[kind][1]
+            with self._lock:
+                return self._kinds[kind][1]
         except KeyError:
             raise ApiError.not_found(f"no REST mapping for kind {kind}")
 

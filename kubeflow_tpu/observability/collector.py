@@ -61,31 +61,39 @@ class TokenClient:
     def token(self) -> str:
         """Current id-token, fetching/refreshing as needed. Raises
         OSError/ValueError on exchange failure (a probe through a broken
-        token path must count as DOWN, not silently go unauthenticated)."""
+        token path must count as DOWN, not silently go unauthenticated).
+
+        The exchange itself runs OUTSIDE the lock: holding it across
+        the HTTP round-trip made every concurrent token() caller queue
+        behind one slow/hung gatekeeper for up to ``timeout`` seconds
+        (tpu-lint lock-blocking-call, the PR-9 stall class). Two
+        racing callers may both exchange; both land valid tokens and
+        last-writer-wins is harmless."""
         with self._lock:
             if self._token and time.time() < (self._expires_at
                                               - self.refresh_margin):
                 return self._token
-            body = {"service_account": self.service_account,
-                    "key": self.key}
-            if self.audience:
-                body["audience"] = self.audience
-            req = urllib.request.Request(
-                self.token_url, method="POST",
-                data=json.dumps(body).encode(),
-                headers={"Content-Type": "application/json"},
-            )
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                grant = json.loads(resp.read())
-            token = grant.get("id_token") if isinstance(grant, dict) \
-                else None
-            if not token:
-                raise ValueError("token response missing id_token")
+        body = {"service_account": self.service_account,
+                "key": self.key}
+        if self.audience:
+            body["audience"] = self.audience
+        req = urllib.request.Request(
+            self.token_url, method="POST",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            grant = json.loads(resp.read())
+        token = grant.get("id_token") if isinstance(grant, dict) \
+            else None
+        if not token:
+            raise ValueError("token response missing id_token")
+        try:
+            ttl = float(grant.get("expires_in", 3600))
+        except (TypeError, ValueError):
+            ttl = 3600.0
+        with self._lock:
             self._token = token
-            try:
-                ttl = float(grant.get("expires_in", 3600))
-            except (TypeError, ValueError):
-                ttl = 3600.0
             self._expires_at = time.time() + ttl
             return self._token
 

@@ -53,7 +53,8 @@ class Timeline:
 
     @property
     def open(self) -> bool:
-        return self.status is None
+        with self._lock:
+            return self.status is None
 
     def event(self, name: str, **attrs) -> None:
         t = time.perf_counter() - self.start
@@ -102,14 +103,21 @@ class Timeline:
         return events[-1][1] - events[0][1]
 
     def to_dict(self) -> dict:
+        # One consistent snapshot: /debug/requests renders on an HTTP
+        # thread while the decoder closes the timeline — status, error
+        # and the drop count must come from the same moment.
+        with self._lock:
+            status = self.status
+            error = self.error
+            dropped = self._dropped
         events = self.events()
         return {
             "request_id": self.request_id,
             "start_unix": self.start_wall,
-            "status": self.status or "open",
-            "error": self.error,
+            "status": status or "open",
+            "error": error,
             "duration_ms": round(1e3 * self.duration_s, 3),
-            "dropped_events": self._dropped,
+            "dropped_events": dropped,
             "events": [
                 {"name": name, "t_ms": round(1e3 * t, 3), **attrs}
                 for name, t, attrs in events

@@ -314,6 +314,14 @@ class Controller:
                 if key is not None:
                     self._process(key)
         finally:
+            # The reconcile loop is the controller's lifetime: on ANY
+            # exit — stop() or an escaped error — the stop flag must be
+            # set, or the pump threads (whose only termination path is
+            # this flag) keep reopening watches and delivering events
+            # to a closed queue forever. Surfaced by the tpu-lint
+            # thread-lifecycle triage: the pumps' stop signal existed
+            # but was unreachable from the loop's own failure exit.
+            self._stop.set()
             self._queue.close()
             with self._streams_lock:
                 streams, self._streams = list(self._streams), []

@@ -454,10 +454,14 @@ class ContinuousDecoder:
     def stop(self) -> None:
         with self._cv:
             self._stopped = True
+            # Snapshot under the cv: the scheduler thread may be
+            # mid-pop, and join() below can time out — after which
+            # iterating the live deque would race its popleft.
+            queued = list(self._pending)
             self._cv.notify()
         self._thread.join(timeout=5)
         err = RuntimeError("decoder stopped")
-        for req in list(self._pending) + self._slot_req:
+        for req in queued + self._slot_req:
             if req is not None and not req.done.is_set():
                 self._finish(req, error=err)
 
@@ -502,10 +506,12 @@ class ContinuousDecoder:
         if self._alloc is None:
             return
         with self._prefix_lock:
+            # tpu-lint: disable=lock-inconsistent-guard -- scheduler-thread-owned slot state
             blocks, self._slot_blocks[slot] = self._slot_blocks[slot], []
             for b in blocks:
                 self._alloc.free(b)
             if blocks:
+                # tpu-lint: disable=lock-inconsistent-guard -- row arms under own dispatch (PR-8)
                 self._table[slot, :] = self._alloc.num_blocks
 
     def _reclaim_blocks(self, need: int, timeline=None) -> None:
@@ -603,7 +609,8 @@ class ContinuousDecoder:
         # The fused decode step's tokens (new rows' first token AND
         # every peer row's next token) — routed after _post_admit so
         # the new rows are registered.
-        self.steps += 1
+        with self._mlock:
+            self.steps += 1
         self._dispatch(tok_np, emit_np)
 
     def _seq_bucket(self, n: int) -> int:
@@ -719,7 +726,8 @@ class ContinuousDecoder:
             req.timeline.event("prefill", tokens=len(suffix),
                                prefix_reused=prefix_len, bucket=s)
         self._post_admit(req, slot)
-        self.steps += 1
+        with self._mlock:
+            self.steps += 1
         self._dispatch(tok_np, emit_np)
 
     def _publish_prefix(self, req: _Request, slot: int) -> None:
@@ -753,9 +761,12 @@ class ContinuousDecoder:
                 entry.blocks = blocks
             else:
                 self._prefix_pool = store_prefix_row(
-                    self._prefix_pool, jnp.int32(entry.slot), self._state,
+                    self._prefix_pool, jnp.int32(entry.slot),
+                    # tpu-lint: disable=lock-inconsistent-guard -- dense _state scheduler-confined
+                    self._state,
                     jnp.int32(slot))
-            self.prefix_inserts += 1
+            with self._mlock:
+                self.prefix_inserts += 1
 
     def _release_pin(self, req: _Request) -> None:
         if req.pinned_prefix is not None and self.prefix_cache is not None:
@@ -826,8 +837,9 @@ class ContinuousDecoder:
                 except Exception:
                     self.prefix_cache.remove(entry)
                     raise
-            self.prefix_inserts += 1
-            self.prefill_tokens += len(toks)  # priming IS a prefill
+            with self._mlock:
+                self.prefix_inserts += 1
+                self.prefill_tokens += len(toks)  # priming IS a prefill
             return True
 
     # -- disaggregated prefill/decode handoff --------------------------
@@ -847,9 +859,17 @@ class ContinuousDecoder:
         compiled export shapes stays logarithmic, then trimmed."""
         nblk = len(ids)
         padded = ids + [ids[-1]] * (pow2_bucket(nblk) - nblk)
+        # Dispatch the gather under the state lock, but fetch OUTSIDE
+        # it: device_get blocks the host for the whole device→host
+        # payload copy, and holding the state lock across that wait
+        # would stall the scheduler's pop path for every export — the
+        # same PR-9 stall class the import path already avoids. The
+        # gather's result buffers are ours alone, so the fetch needs no
+        # lock. (Surfaced by tpu-lint lock-blocking-call.)
         with self._state_lock:
-            out = jax.device_get(export_blocks(
-                self._state["pool"], jnp.asarray(padded, np.int32)))
+            out_dev = export_blocks(
+                self._state["pool"], jnp.asarray(padded, np.int32))
+        out = jax.device_get(out_dev)
 
         def _trim(node):
             if isinstance(node, dict):
@@ -1049,7 +1069,8 @@ class ContinuousDecoder:
                 imported = cache.has(key)
             else:
                 entry.blocks = tuple(blocks)
-                self.prefix_inserts += 1
+                with self._mlock:
+                    self.prefix_inserts += 1
                 imported = True
         if imported:
             with self._mlock:
@@ -1299,12 +1320,11 @@ class ContinuousDecoder:
 
     def _run(self) -> None:
         while True:
+            idled = False
             with self._cv:
                 while (not self._stopped and not self._pending
                        and self._active_count == 0):
-                    # Idle: the streak cap must not outlive the burst that
-                    # set it — the next admission deserves its ramp round.
-                    self._ramp_streak = 0
+                    idled = True
                     self._cv.wait(timeout=0.5)
                 if self._stopped:
                     return
@@ -1388,6 +1408,13 @@ class ContinuousDecoder:
                 if deferred:
                     with self._mlock:
                         self.kv_defer_admissions += 1
+            if idled:
+                # Coming out of idle: the streak cap must not outlive
+                # the burst that set it — the next admission deserves
+                # its ramp round. Reset OUTSIDE the cv so every
+                # _ramp_streak access stays scheduler-thread-plain
+                # (one site under the cv made the guard inconsistent).
+                self._ramp_streak = 0
             try:
                 if pending:
                     # Admission fuses prefill + insert + one decode step
@@ -1418,7 +1445,8 @@ class ContinuousDecoder:
                                     if self._alloc is not None
                                     else self._plan_prefix(req))
                             if plan is None:
-                                self.prefix_misses += 1
+                                with self._mlock:
+                                    self.prefix_misses += 1
                                 misses.append((req, slot))
                             else:
                                 hits.append((req, slot, plan))
@@ -1487,6 +1515,10 @@ class ContinuousDecoder:
 
     def metrics(self) -> dict:
         cache = self.prefix_cache
+        # Queue depth is cv-guarded state: snapshot it under the cv in
+        # its own scope (never nested with the metrics lock).
+        with self._cv:
+            queued = len(self._pending)
         # One lock-guarded snapshot of every counter the scheduler
         # mutates, so derived ratios (ttft_avg_s, spec_acceptance_rate)
         # are computed from matching sum/count pairs — never from a
@@ -1505,7 +1537,7 @@ class ContinuousDecoder:
                 "trace_open": self.trace.open_count,
                 "in_flight": self._active_count,
                 "peak_in_flight": self.peak_in_flight,
-                "queued": len(self._pending),
+                "queued": queued,
                 "prefix_hits": self.prefix_hits,
                 "prefix_misses": self.prefix_misses,
                 "prefix_tokens_reused": self.prefix_tokens_reused,

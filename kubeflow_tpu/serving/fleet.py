@@ -352,26 +352,39 @@ class DecoderFleet:
         """Per-replica decoder metrics plus fleet aggregates (the bench
         and the autoscaler read the same names the single-decoder
         metrics() exposes, summed over live replicas)."""
+        # Snapshot the mutable fleet state under its lock: mark_dead()
+        # runs on caller threads mid-submit, and iterating the live set
+        # while it grows is a torn read at best, a RuntimeError at
+        # worst (surfaced by tpu-lint lock-inconsistent-guard).
+        with self._lock:
+            dead = sorted(self._dead)
+            counters = {
+                "routed": self.routed, "spilled": self.spilled,
+                "remapped": self.remapped, "handoffs": self.handoffs,
+                "handoff_fallbacks": self.handoff_fallbacks,
+                "handoff_skipped": self.handoff_skipped,
+            }
         per: dict[str, dict] = {}
         for name in self.members():
-            if name in self._dead:
+            if name in dead:
                 continue
             per[name] = self._replicas[name].metrics()
         agg_keys = ("tokens_emitted", "requests_admitted", "prefix_hits",
                     "prefix_misses", "kv_blocks_in_use", "in_flight",
                     "queued")
         agg = {k: sum(m.get(k, 0) for m in per.values()) for k in agg_keys}
-        agg.update(replicas=per, live=self.live_members(),
-                   dead=sorted(self._dead), routed=self.routed,
-                   spilled=self.spilled, remapped=self.remapped)
+        agg.update(replicas=per, live=sorted(per),
+                   dead=dead, routed=counters["routed"],
+                   spilled=counters["spilled"],
+                   remapped=counters["remapped"])
         if self.disaggregated:
             agg.update(
                 roles=dict(self._roles),
                 prefill_pool=self._live_pool(prefill=True),
                 decode_pool=self._live_pool(prefill=False),
-                handoffs=self.handoffs,
-                handoff_fallbacks=self.handoff_fallbacks,
-                handoff_skipped=self.handoff_skipped,
+                handoffs=counters["handoffs"],
+                handoff_fallbacks=counters["handoff_fallbacks"],
+                handoff_skipped=counters["handoff_skipped"],
             )
         return agg
 

@@ -1,0 +1,33 @@
+"""JAX-hygiene BAD fixture: host syncs, impurity, and a Python branch
+on a traced value inside jitted/scanned functions."""
+
+import functools
+import time
+
+import jax
+import numpy as np
+from jax import lax
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def bad_step(state, cfg, x):
+    # BUG: ``x`` is traced — this is a TracerBoolConversionError.
+    if x > cfg:
+        # BUG: host syncs inside the traced function.
+        host = np.asarray(state)
+        fetched = jax.device_get(host)
+        # BUG: impure calls run once at trace time.
+        print(fetched)
+        time.sleep(0.1)
+        return fetched
+    return state
+
+
+def scan_driver(xs):
+    """Passes a host-syncing body to lax.scan."""
+
+    def body(carry, x):
+        # BUG: .item() inside the scanned body.
+        return carry + x.item(), x
+
+    return lax.scan(body, 0.0, xs)

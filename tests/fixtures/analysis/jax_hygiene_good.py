@@ -1,0 +1,31 @@
+"""JAX-hygiene GOOD fixture: the legal shapes the checker must pass.
+
+- branching on a ``static_argnames`` parameter (compiled per value);
+- ``is None`` argument-structure dispatch (static per trace);
+- host syncs OUTSIDE the jitted function, on fetched results;
+- jnp work and lax control flow inside the trace.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "table"))
+def good_step(state, cfg, x, table=None):
+    if cfg > 1:
+        x = x * cfg
+    if table is not None:
+        x = x + jnp.sum(state)
+    return lax.select(x > 0, x, -x)
+
+
+def driver(state, cfg, x):
+    """Host work belongs on the host side of the dispatch."""
+    out = good_step(state, cfg, x)
+    fetched = np.asarray(jax.device_get(out))
+    print(fetched.shape)
+    return fetched

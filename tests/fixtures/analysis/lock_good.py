@@ -1,0 +1,108 @@
+"""Lock-discipline GOOD fixture: the fixed shapes plus the known
+false-positive cases the checker must stay silent on.
+
+- the PR-9 FIX: device work dispatched under the state lock alone, the
+  prefix lock released before the wait (mirrors ``import_prompt``);
+- consistent one-lock guarding of counters (PR-4 fix shape);
+- ``Condition.wait`` on the held condition (waiting RELEASES it);
+- a recursive private helper always called under an RLock (the
+  ``FakeApiServer._cascade_delete`` shape — optimistic entry-guard
+  propagation must keep the guard through the recursive call site);
+- an inline closure called under the lock that defined it (the
+  ``BanditStats.mean`` shape).
+"""
+
+import threading
+
+import jax
+
+
+class GoodImporter:
+    """PR-9 fixed: no lock spans the device wait."""
+
+    def __init__(self, state):
+        self._prefix_lock = threading.Lock()
+        self._state_lock = threading.Lock()
+        self._state = state
+        self._registered = []
+
+    def import_blocks(self, payload):
+        with self._state_lock:
+            self._state = payload
+        fetched = jax.device_get(payload)
+        with self._prefix_lock:
+            self._registered.append(fetched)
+
+
+class GoodCounters:
+    """Every write and read of the counter holds the same lock."""
+
+    def __init__(self):
+        self._mlock = threading.Lock()
+        self.emitted = 0
+
+    def hot_path(self, n):
+        with self._mlock:
+            self.emitted += n
+
+    def snapshot(self):
+        with self._mlock:
+            return self.emitted
+
+
+class GoodCondition:
+    """Condition.wait under its own ``with`` releases the lock — not a
+    blocking call under a held lock."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self.items = []
+
+    def get(self):
+        with self._cond:
+            while not self.items:
+                self._cond.wait()
+            return self.items.pop()
+
+    def put(self, item):
+        with self._cond:
+            self.items.append(item)
+            self._cond.notify()
+
+
+class GoodRecursive:
+    """Recursive private helper always entered under the RLock."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._store = {}
+
+    def delete(self, key):
+        with self._lock:
+            self._cascade(key)
+
+    def _cascade(self, key):
+        child = self._store.pop(key, None)
+        if child is not None:
+            self._cascade(child)
+
+
+class GoodClosure:
+    """Inline closure reading guarded state, called under the lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats = {}
+
+    def best(self, names):
+        with self._lock:
+            def mean(name):
+                total, n = self._stats.get(name, (0.0, 0))
+                return total / n if n else 1.0
+
+            return max(names, key=mean)
+
+    def record(self, name, value):
+        with self._lock:
+            total, n = self._stats.get(name, (0.0, 0))
+            self._stats[name] = (total + value, n + 1)

@@ -1,0 +1,27 @@
+"""Two locks acquired in both orders — the classic deadlock shape the
+``lock-order-cycle`` rule exists for."""
+
+import threading
+
+
+class BadOrdering:
+    """Transfers between two accounts, each direction nesting the other
+    way around."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.left = 0
+        self.right = 0
+
+    def a_to_b(self, n):
+        with self._a:
+            with self._b:
+                self.left -= n
+                self.right += n
+
+    def b_to_a(self, n):
+        with self._b:
+            with self._a:
+                self.right -= n
+                self.left += n

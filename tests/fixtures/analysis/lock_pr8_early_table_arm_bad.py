@@ -1,0 +1,38 @@
+"""Minimized PR-8 reproduction: block-table row armed before its owning
+dispatch, under a different guard.
+
+The shipped bug: the paged pop loop pointed a freshly reassigned slot's
+table row at its blocks at POP time (under the scheduler condition),
+while dispatches upload and scatter through the table under the state
+lock — an earlier admission's fused decode step in the same round then
+scattered junk through the stale-length row into refcount-shared
+prefix blocks. The write sites disagree on their guard, which is what
+``lock-inconsistent-guard`` flags.
+"""
+
+import threading
+
+
+class BadTableArm:
+    """Pop path arms the row; dispatch path owns the table."""
+
+    def __init__(self, table, blocks):
+        self._cv = threading.Condition()
+        self._state_lock = threading.Lock()
+        self._table = table
+        self._blocks = blocks
+
+    def pop(self, slot):
+        with self._cv:
+            # BUG: the row goes live here, before the owning admission
+            # dispatch — under the cv, not the state lock.
+            self._table[slot] = self._blocks[slot]
+
+    def dispatch(self, slot):
+        with self._state_lock:
+            self._table[slot] = self._blocks[slot]
+            return list(self._table)
+
+    def retire(self, slot):
+        with self._state_lock:
+            self._table[slot] = -1

@@ -1,0 +1,32 @@
+"""Minimized PR-9 reproduction: prefix lock held across the state-lock
+device wait.
+
+This is the shape that froze the scheduler's pop path before PR 9 fixed
+it: ``import_prompt`` held ``_prefix_lock`` while the device scatter
+waited out an in-flight decode chunk behind ``_state_lock`` — every
+import stalled admissions for a whole chunk. The lock-discipline
+checker must flag the ``jax.device_get`` under the nested locks
+(``lock-blocking-call``).
+"""
+
+import threading
+
+import jax
+
+
+class BadImporter:
+    """Importer that blocks the pop path the PR-9 way."""
+
+    def __init__(self, state):
+        self._prefix_lock = threading.Lock()
+        self._state_lock = threading.Lock()
+        self._state = state
+        self._registered = []
+
+    def import_blocks(self, payload):
+        with self._prefix_lock:
+            # BUG: the device round-trip runs while BOTH locks are
+            # held; the pop path contends on _prefix_lock and stalls.
+            with self._state_lock:
+                self._state = jax.device_get(payload)
+            self._registered.append(payload)

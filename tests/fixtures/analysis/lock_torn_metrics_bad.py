@@ -1,0 +1,26 @@
+"""Minimized PR-4 reproduction: a counter guarded at one write site,
+bare at another, and read unguarded — torn metrics.
+
+Before PR 4, ``ContinuousDecoder.metrics()`` computed derived ratios
+from sum/count pairs read mid-update. ``lock-inconsistent-guard`` must
+flag both the unguarded write and (once writes agree) unguarded reads.
+"""
+
+import threading
+
+
+class BadCounters:
+    """Counter written under the lock on the hot path, bare elsewhere."""
+
+    def __init__(self):
+        self._mlock = threading.Lock()
+        self.emitted = 0
+
+    def hot_path(self, n):
+        with self._mlock:
+            self.emitted += n
+
+    def cold_path(self):
+        # BUG: same counter, no lock — a concurrent hot_path increment
+        # can be lost entirely.
+        self.emitted += 1

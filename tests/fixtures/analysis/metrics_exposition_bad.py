@@ -1,0 +1,20 @@
+"""Metrics-exposition BAD fixture: a hand-rolled renderer, bad names,
+and ad-hoc labels — each convention violated once."""
+
+
+def render(values):
+    """BUG: a fifth renderer spelling the text format by hand."""
+    out = []
+    for name, value in values.items():
+        out.append(f"# TYPE {name} gauge\n{name} {value}\n")
+    return "".join(out)
+
+
+def build(registry):
+    """BUG: every registration violates a naming/label rule."""
+    registry.counter("serving_requests")          # counter, no _total
+    registry.gauge("queueDepth")                  # not snake_case
+    registry.gauge("frobnicator_depth")           # unknown subsystem
+    registry.histogram("serving_latency_ms")      # abbreviated unit
+    registry.counter("serving_hits_total",
+                     labels=("shard_uuid",))      # ad-hoc label

@@ -1,0 +1,16 @@
+"""Metrics-exposition GOOD fixture: conventional registrations, no
+hand-rolled exposition text."""
+
+
+def build(registry):
+    """Names follow {subsystem}_{name}[_{unit}]; labels stay in the
+    shared vocabulary; counters end _total."""
+    requests = registry.counter(
+        "serving_requests_total", "Requests handled",
+        labels=("model", "code"))
+    latency = registry.histogram(
+        "gateway_upstream_latency_seconds", "Upstream latency",
+        labels=("route",))
+    depth = registry.gauge(
+        "scheduler_queue_depth", "Gangs queued", labels=("queue",))
+    return requests, latency, depth

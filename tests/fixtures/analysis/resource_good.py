@@ -1,0 +1,38 @@
+"""Resource-pairing GOOD fixture: the three release-safe shapes.
+
+- scratch blocks freed in ``try/finally`` (the cold-export shape);
+- ownership transfer of shared blocks into a trie entry (the
+  publish-on-finish shape — ``share`` claims flow through the loop
+  variable into a nonlocal store);
+- the claim returned to the caller (the caller owns it).
+"""
+
+
+class SafeAllocUser:
+    """Every claim has an owner or a cleanup."""
+
+    def __init__(self, allocator, pool):
+        self._alloc = allocator
+        self._pool = pool
+        self._slot_blocks = {}
+
+    def scratch(self, request, n):
+        blocks = self._alloc.alloc(n)
+        try:
+            return self._pool.scatter(request, len(blocks))
+        finally:
+            for b in blocks:
+                self._alloc.free(b)
+
+    def publish(self, entry, donor_blocks):
+        blocks = tuple(donor_blocks)
+        for b in blocks:
+            self._alloc.share(b)
+        entry.blocks = blocks
+
+    def reserve(self, slot, n):
+        own = self._alloc.alloc(n)
+        self._slot_blocks[slot] = own
+
+    def claim_for_caller(self, n):
+        return self._alloc.alloc(n)

@@ -1,0 +1,20 @@
+"""Resource-pairing BAD fixture: the KV-block-leak class.
+
+``alloc`` claims blocks, then work that can raise runs with no
+``try/finally``, no ownership transfer and no return of the claim —
+an exception strands the blocks until a leak checker notices.
+"""
+
+
+class LeakyAdmission:
+    """Claims blocks and loses them on any scatter failure."""
+
+    def __init__(self, allocator, pool):
+        self._alloc = allocator
+        self._pool = pool
+
+    def admit(self, request, n):
+        blocks = self._alloc.alloc(n)
+        # BUG: if scatter raises, ``blocks`` leaks — nothing frees
+        # them, owns them, or returns them.
+        self._pool.scatter(request, len(blocks))

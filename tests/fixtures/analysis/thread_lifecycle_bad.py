@@ -1,0 +1,21 @@
+"""Thread-lifecycle BAD fixture: no daemon choice, no join/stop path.
+
+The module deliberately contains no Event ``.set()``, no stop flag, no
+``shutdown()``/``close()``/``stop()`` call and no ``join()`` — both
+rules must fire on the constructor.
+"""
+
+import threading
+
+
+class Spinner:
+    """Starts a forever-thread nothing can end."""
+
+    def __init__(self):
+        self.count = 0
+        self._thread = threading.Thread(target=self._loop)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            self.count += 1

@@ -1,0 +1,51 @@
+"""Thread-lifecycle GOOD fixture: explicit daemon choices and real
+termination paths.
+
+- a joined worker (non-daemon is fine when join is reachable);
+- a daemon loop guarded by an Event that ``stop()`` sets;
+- an anonymous daemon ``serve_forever`` thread (its stop is the
+  server's ``shutdown()``, called here).
+"""
+
+import threading
+
+
+class JoinedWorker:
+    """Worker joined on stop."""
+
+    def __init__(self):
+        self._thread = threading.Thread(target=self._work, daemon=False)
+        self._thread.start()
+
+    def _work(self):
+        return 1 + 1
+
+    def stop(self):
+        self._thread.join()
+
+
+class EventLoop:
+    """Daemon loop with a stop Event."""
+
+    def __init__(self):
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self._stop.wait(0.1)
+
+    def stop(self):
+        self._stop.set()
+
+
+def serve(httpd):
+    """Server thread whose stop is the shutdown below."""
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd
+
+
+def teardown(httpd):
+    """The reachable stop path for :func:`serve`."""
+    httpd.shutdown()

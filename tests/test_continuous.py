@@ -486,3 +486,61 @@ def test_chunked_mixed_lengths_all_complete(model):
             assert len(h.result(timeout=120)["tokens"]) == w
     finally:
         d.stop()
+
+
+def test_metrics_snapshot_consistent_under_load(model):
+    """PR-11 regression (tpu-lint lock-inconsistent-guard): several
+    counters (steps, prefix_misses, prefix_inserts, queue depth) were
+    mutated outside the metrics lock while metrics() snapshotted under
+    it — torn reads, the PR-4 bug class. Hammer metrics() from a side
+    thread during live traffic and assert the snapshots stay sane."""
+    import threading
+
+    spec, params = model
+    d = ContinuousDecoder(params, spec.config, slots=4, prefill_len=16,
+                          max_new_tokens=8, prefix_cache_slots=4,
+                          prefix_cache_min_len=4, kv_layout="paged",
+                          kv_block_size=4)
+    errors: list[Exception] = []
+    stop = threading.Event()
+
+    def hammer():
+        last_steps = 0
+        try:
+            while not stop.is_set():
+                m = d.metrics()
+                # Monotone under the lock-guarded snapshot; a torn
+                # read could observe a lost update going backwards.
+                assert m["decode_steps"] >= last_steps
+                last_steps = m["decode_steps"]
+                assert m["queued"] >= 0
+                assert m["prefill_tokens"] >= 0
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    t = threading.Thread(target=hammer, daemon=True)
+    t.start()
+    try:
+        handles = [d.submit([1 + i, 2, 3, 4, 5], 6) for i in range(12)]
+        for h in handles:
+            h.result(timeout=60)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        d.stop()
+    assert not errors, errors
+
+
+def test_stop_with_queued_requests_fails_them_cleanly(model):
+    """PR-11 regression: stop() iterated the live pending deque after a
+    bounded join — racing the scheduler's popleft. It now snapshots the
+    queue under the cv; every queued request still gets its terminal
+    error."""
+    spec, params = model
+    d = ContinuousDecoder(params, spec.config, slots=2, prefill_len=16,
+                          max_new_tokens=8)
+    handles = [d.submit([1, 2, 3], 8) for _ in range(6)]
+    d.stop()
+    for h in handles:
+        with pytest.raises((RuntimeError, TimeoutError)):
+            h.result(timeout=5)

@@ -408,3 +408,39 @@ def test_fleet_metrics_aggregate_live_replicas(tiny):
         assert m["routed"] == 1
     finally:
         fleet.stop()
+
+
+def test_metrics_snapshot_consistent_under_concurrent_mark_dead():
+    """PR-11 regression (tpu-lint lock-inconsistent-guard): metrics()
+    iterated the mutable dead set and read the routing counters without
+    the fleet lock while mark_dead() ran on caller threads — a torn
+    read at best, a set-changed-size RuntimeError at worst. It now
+    snapshots under the lock: live/dead always partition the
+    membership."""
+    import threading
+
+    reps = {f"r{i:02d}": _StubReplica() for i in range(24)}
+    fleet = DecoderFleet(reps, affinity_tokens=4)
+    errors: list[Exception] = []
+    stop = threading.Event()
+
+    def hammer():
+        try:
+            while not stop.is_set():
+                m = fleet.metrics()
+                live, dead = set(m["live"]), set(m["dead"])
+                assert live | dead == set(reps)
+                assert not live & dead
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    t = threading.Thread(target=hammer, daemon=True)
+    t.start()
+    try:
+        for name in sorted(reps)[:-1]:  # keep one live member
+            fleet.mark_dead(name)
+            time.sleep(0.002)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    assert not errors, errors

@@ -468,3 +468,29 @@ def test_serving_role_rides_the_exposition(tiny):
         d.stop()
         p.stop()
         c.stop()
+
+
+def test_export_fetch_runs_outside_state_lock(tiny, monkeypatch):
+    """PR-11 regression (tpu-lint lock-blocking-call, the PR-9 stall
+    class): _export_ids held the state lock across jax.device_get, so
+    every export blocked the scheduler's pop path for the whole
+    device→host payload copy. The gather now dispatches under the lock
+    and fetches outside it — device_get must never observe the state
+    lock held."""
+    import jax as _jax
+
+    dec = _decoder(tiny, role="prefill")
+    held: list[bool] = []
+    real = _jax.device_get
+
+    def spy(x):
+        held.append(dec._state_lock.locked())
+        return real(x)
+
+    monkeypatch.setattr(_jax, "device_get", spy)
+    try:
+        dec.export_prompt(list(range(5, 18)), timeout=60)
+    finally:
+        dec.stop()
+    assert held, "export never fetched?"
+    assert not any(held), "device_get ran under the state lock"

@@ -323,3 +323,69 @@ def test_default_latency_buckets_are_log_spaced():
     assert b[0] == pytest.approx(1e-4) and b[-1] == pytest.approx(1e2)
     ratios = [y / x for x, y in zip(b, b[1:])]
     assert all(r == pytest.approx(ratios[0], rel=1e-6) for r in ratios)
+
+
+def test_timeline_to_dict_consistent_with_concurrent_close():
+    """PR-11 regression (tpu-lint lock-inconsistent-guard): to_dict()
+    read status/error/dropped without the timeline lock while close()
+    wrote them — /debug/requests could render status "error" with the
+    error text missing. The snapshot is now taken under the lock: the
+    pair is always consistent, whichever side of close() it lands."""
+    for i in range(50):
+        store = TraceStore()
+        tl = store.start(f"rid{i:03d}")
+        tl.event("submit")
+        out: list[dict] = []
+        t = threading.Thread(target=lambda: out.append(tl.to_dict()))
+        t.start()
+        tl.close(error=RuntimeError("boom"))
+        t.join(timeout=10)
+        d = out[0]
+        if d["status"] == "error":
+            assert d["error"] == "boom"
+        else:
+            assert d["status"] == "open" and d["error"] is None
+    assert tl.open is False
+
+
+def test_token_exchange_runs_outside_client_lock():
+    """PR-11 regression (tpu-lint lock-blocking-call, the PR-9 stall
+    class): TokenClient.token() held the client lock across the HTTP
+    exchange, serializing every concurrent caller behind one slow
+    gatekeeper for up to the full timeout. The exchange now runs
+    unlocked — the lock must be acquirable while a refresh is in
+    flight."""
+    import time as _time
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from kubeflow_tpu.observability.collector import TokenClient
+
+    class SlowIssuer(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            body = json.dumps({"id_token": "tok",
+                               "expires_in": 3600}).encode()
+            _time.sleep(0.6)
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), SlowIssuer)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        tc = TokenClient(
+            f"http://127.0.0.1:{httpd.server_address[1]}/token",
+            "prober", "sa-key")
+        refresher = threading.Thread(target=tc.token, daemon=True)
+        refresher.start()
+        _time.sleep(0.2)  # exchange now in flight on the refresher
+        got = tc._lock.acquire(timeout=0.2)
+        assert got, "client lock held across the network exchange"
+        tc._lock.release()
+        refresher.join(timeout=10)
+        assert tc.token() == "tok"  # cached — no second slow exchange
+    finally:
+        httpd.shutdown()

@@ -689,3 +689,23 @@ def test_leader_elected_manager_exits_on_leadership_loss(api):
         if proc.poll() is None:
             proc.kill()
         httpd.shutdown()
+
+
+def test_run_loop_failure_exit_stops_pumps(api):
+    """PR-11 regression (tpu-lint thread-lifecycle triage): a reconcile
+    loop that died by exception closed its workqueue but never set the
+    stop flag — the pump threads' only termination signal — so they
+    kept reopening watches and delivering events forever. ANY exit of
+    run() now sets the flag and the pumps wind down."""
+    ctrl = NotebookController(api)
+
+    def boom(*a, **kw):
+        raise RuntimeError("loop death")
+
+    ctrl._queue.get = boom
+    with pytest.raises(RuntimeError, match="loop death"):
+        ctrl.run()
+    assert ctrl._stop.is_set()
+    for pump in ctrl._pumps:
+        pump.join(timeout=10)
+        assert not pump.is_alive(), "pump thread survived loop death"
