@@ -69,9 +69,19 @@ when aggregate tokens/s falls under 0.95x colocated, when greedy
 tokens are not byte-identical to the single-replica reference (fp, and
 int8 across the scale-carrying handoff), or on leaked blocks.
 
+``--tp-sweep`` benchmarks model-parallel serving: the same engine at
+tp=1/2/4 tensor-mesh shapes at equal total pool bytes. The regression
+marker fires when greedy tokens differ across mesh shapes (including
+shared-prefix admissions with block sharing + tail CoW, and the int8
+leg whose scales ride the sharded pool), when a tp=2 export fails to
+import byte-identically into a tp=1 pool through the JSON envelope,
+when per-chip tokens/s falls under 0.8x single-chip on TPU (aggregate
+retention under 0.6x on the shared-core CPU emulation), or on leaked
+blocks.
+
 Usage: python bench_serving.py [--quick] [--requests N] [--generate]
        [--prefix-reuse] [--speculative] [--concurrency-sweep]
-       [--kv-dtype-sweep] [--fleet-sweep] [--disagg-sweep]
+       [--kv-dtype-sweep] [--fleet-sweep] [--disagg-sweep] [--tp-sweep]
 """
 
 from __future__ import annotations
@@ -79,6 +89,7 @@ from __future__ import annotations
 import argparse
 import json
 import math
+import os
 import time
 from concurrent.futures import ThreadPoolExecutor
 
@@ -715,6 +726,175 @@ def _bench_kv_dtype_sweep(args, model) -> dict:
     }
 
 
+def _bench_tp_sweep(args, model) -> dict:
+    """Model-parallel serving sweep: ONE engine served at tp=1/2/4 mesh
+    shapes at equal TOTAL pool bytes (the block pool is one host-global
+    array sharded over the KV-head axis, so the block count — and the
+    summed bytes — never move with tp; only the per-chip share does).
+
+    Gates riding the regression marker:
+
+    - **Byte-identity**: greedy tokens identical across every mesh
+      shape, including shared-prefix admissions (refcount block sharing
+      + one tail CoW) — compute dtype is pinned f32, where the per-layer
+      output-projection psum reorders too little to flip an argmax.
+    - **Int8 scales ride the sharded pool**: int8 tp=2 greedy tokens
+      byte-identical to int8 tp=1 (codes and scales shard by the same
+      block ids).
+    - **Handoff across mesh shapes**: a tp=2 ``export_prompt`` packs,
+      JSON-round-trips, and imports into a tp=1 pool byte-identically
+      to a colocated decode — the export's device_get gathers the
+      sharded pool into a host-global payload, so the importer's own
+      pool sharding IS the reshard.
+    - **Throughput**: on TPU, the tp mesh's per-chip tokens/s must hold
+      >= 0.8x the single-chip engine. The CPU CI emulation's "chips"
+      are XLA host devices sharing one socket's cores, so per-chip
+      normalization is meaningless there; the CPU gate is aggregate
+      retention >= 0.6x at tp=2 (a collapsed sharded engine lands far
+      below it — measured 0.77-0.86x here).
+    - **Zero leaked blocks**: every shape drains to zero slot-held
+      blocks (cache-held prefix blocks are live on purpose).
+    """
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models.registry import get_model
+    from kubeflow_tpu.serving import handoff as handoff_mod
+    from kubeflow_tpu.serving.continuous import ContinuousDecoder
+
+    on_tpu = jax.default_backend() == "tpu"
+    # f32 compute: under tp the row-parallel projections psum per-shard
+    # partials, and bf16 rounds them before the reduce — f32 keeps the
+    # reorder ~1e-6, which is what lets greedy stay bitwise across mesh
+    # shapes (the same reason the fp gather path is the parity pin).
+    overrides = {"dtype": jnp.float32}
+    if model == "lm-test-tiny":
+        overrides["n_kv_heads"] = 4  # shardable over the tp=4 leg
+    spec = get_model(model, **overrides)
+    cfg = spec.config
+    params = spec.init(jax.random.PRNGKey(0), cfg)
+    gen = min(args.max_new_tokens, 16)
+    prefill_len, block, slots = 32, 8, 16
+    # 12 shared tokens = one refcount-shared full block + a 4-token
+    # partial tail, so every follower admission pays exactly one CoW.
+    shared = [5, 11, 7, 3, 13, 2, 17, 9, 4, 6, 19, 8]
+    probes = ([shared + [23 + i, 29, 31 + i] for i in range(3)]
+              + [[1, 2, 3], [7, 5, 11, 4], [9] * 9, list(range(4, 24))])
+    ladder = [tp for tp in (1, 2, 4)
+              if tp <= len(jax.devices()) and cfg.n_kv_heads % tp == 0]
+
+    def decoder(tp, **kw):
+        return ContinuousDecoder(
+            params, cfg, slots=kw.pop("slots", slots),
+            prefill_len=prefill_len, max_new_tokens=gen,
+            prefill_len_buckets=2, kv_layout="paged", kv_block_size=block,
+            prefix_cache_slots=8, prefix_cache_min_len=4,
+            stream_timeout_s=300.0, tp_shards=tp, **kw)
+
+    runs = {}
+    for tp in ladder:
+        d = decoder(tp)
+        try:
+            toks = [d.generate(p, 8, timeout=300)["tokens"]
+                    for p in probes]
+            tps = _decode_burst_tps(d, gen)
+            m = d.metrics()
+            leaked = sum(len(b) for b in d._slot_blocks)
+        finally:
+            d.stop()
+        runs[tp] = {
+            "tokens": toks, "tokens_per_sec": round(tps, 1),
+            "prefix_hits": m["prefix_hits"],
+            "kv_shared_blocks": m["kv_shared_blocks"],
+            "kv_cow_copies": m["kv_cow_copies"],
+            "kv_bytes_per_token_per_chip": m["kv_bytes_per_token"],
+            "kv_bytes_total_per_chip": m["kv_bytes_total"],
+            "leaked_blocks": leaked,
+        }
+    identical = all(runs[tp]["tokens"] == runs[ladder[0]]["tokens"]
+                    for tp in ladder)
+    sharing_exercised = all(
+        runs[tp]["kv_shared_blocks"] > 0 and runs[tp]["kv_cow_copies"] > 0
+        for tp in ladder)
+    # Equal total bytes across shapes: per-chip bytes scale down exactly
+    # as tp scales up.
+    total_bytes = {tp: runs[tp]["kv_bytes_total_per_chip"] * tp
+                   for tp in ladder}
+    equal_bytes = len(set(total_bytes.values())) == 1
+
+    # Int8 leg: quantized codes + scales ride the same sharded pool.
+    int8_toks = {}
+    for tp in ladder[:2]:
+        d = decoder(tp, kv_dtype="int8")
+        try:
+            int8_toks[tp] = [d.generate(p, 8, timeout=300)["tokens"]
+                             for p in probes]
+        finally:
+            d.stop()
+    int8_identical = (len(int8_toks) < 2
+                      or int8_toks[ladder[0]] == int8_toks[ladder[1]])
+
+    # Handoff leg: tp=2 prefill export → JSON envelope → tp=1 import.
+    handoff_identical = True
+    if len(ladder) > 1:
+        hp = shared + [23, 29, 31]
+        ref = decoder(1)
+        try:
+            ref_toks = ref.generate(hp, 8, timeout=300)["tokens"]
+        finally:
+            ref.stop()
+        exporter = decoder(ladder[1])
+        importer = decoder(1)
+        try:
+            env = json.loads(json.dumps(
+                handoff_mod.pack(exporter.export_prompt(hp))))
+            imported = importer.import_prompt(handoff_mod.unpack(env))
+            got = importer.generate(hp, 8, timeout=300)["tokens"]
+            handoff_identical = imported and got == ref_toks
+        finally:
+            exporter.stop()
+            importer.stop()
+
+    tps1 = runs[ladder[0]]["tokens_per_sec"]
+    tp_hi = ladder[1] if len(ladder) > 1 else ladder[0]
+    retention = runs[tp_hi]["tokens_per_sec"] / max(tps1, 1e-9)
+    per_chip_ratio = retention / tp_hi
+    throughput_ok = (per_chip_ratio >= 0.8 if on_tpu
+                     else retention >= 0.6 or tp_hi == 1)
+    leaked = sum(runs[tp]["leaked_blocks"] for tp in ladder)
+    return {
+        "metric": ("serving_tp_per_chip_tokens_ratio" if on_tpu
+                   else "serving_tp_aggregate_retention"),
+        "value": round(per_chip_ratio if on_tpu else retention, 3),
+        "unit": "x",
+        "vs_baseline": 1.0,
+        "mesh_ladder": ladder,
+        "cpu_emulated_mesh": not on_tpu,
+        "tokens_per_sec_by_tp": {str(tp): runs[tp]["tokens_per_sec"]
+                                 for tp in ladder},
+        "per_chip_ratio": round(per_chip_ratio, 3),
+        "aggregate_retention": round(retention, 3),
+        "kv_bytes_total_by_tp": {str(tp): total_bytes[tp]
+                                 for tp in ladder},
+        "kv_bytes_per_token_per_chip_by_tp": {
+            str(tp): runs[tp]["kv_bytes_per_token_per_chip"]
+            for tp in ladder},
+        "equal_total_pool_bytes": equal_bytes,
+        "greedy_tokens_identical": identical,
+        "int8_tokens_identical": int8_identical,
+        "prefix_sharing_exercised": sharing_exercised,
+        "kv_cow_copies_by_tp": {str(tp): runs[tp]["kv_cow_copies"]
+                                for tp in ladder},
+        "handoff_cross_mesh_identical": handoff_identical,
+        "kv_blocks_in_use_after_drain": leaked,
+        "regression": (not identical or not int8_identical
+                       or not handoff_identical or not sharing_exercised
+                       or not equal_bytes or not throughput_ok
+                       or leaked != 0 or len(ladder) < 2),
+        "config": f"{model} f32 block{block} slots{slots} "
+                  f"prefill{prefill_len} gen{gen} ladder{ladder}",
+    }
+
+
 def _bench_fleet_sweep(args, model) -> dict:
     """Replica-pool scaling + routing-locality scenario.
 
@@ -1164,10 +1344,28 @@ def main() -> int:
                          "parity, int8/fused within pinned tolerance) "
                          "plus the fused block-table attention decode "
                          "path (no dense KV gather traced)")
+    ap.add_argument("--tp-sweep", action="store_true",
+                    help="benchmark model-parallel serving: tp=1/2/4 "
+                         "mesh shapes at equal total pool bytes "
+                         "(byte-identical greedy incl. prefix sharing "
+                         "+ CoW + int8 + cross-mesh handoff, per-chip "
+                         "tokens/s gate, zero leaked blocks)")
     args = ap.parse_args()
 
+    if args.tp_sweep and "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        # The tp ladder needs a multi-device mesh. On the CPU CI host
+        # the backend is virtualized to 8 devices — this must land
+        # before the first jax backend query; on TPU the flag only
+        # touches the (unused) host platform.
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8").strip()
     on_tpu = jax.default_backend() == "tpu"
-    if args.disagg_sweep:
+    if args.tp_sweep:
+        model = "llama-1b" if on_tpu and not args.quick else "lm-test-tiny"
+        result = _bench_tp_sweep(args, model)
+    elif args.disagg_sweep:
         model = "llama-1b" if on_tpu and not args.quick else "lm-test-tiny"
         result = _bench_disagg_sweep(args, model)
     elif args.fleet_sweep:

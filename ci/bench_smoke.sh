@@ -56,6 +56,15 @@ check_json "$out"
 # or when either pool leaks blocks.
 out="$(JAX_PLATFORMS=cpu python bench_serving.py --quick --disagg-sweep)"
 check_json "$out"
+# Model-parallel serving: the marker fires when greedy tokens differ
+# across tp=1/2/4 mesh shapes at equal total pool bytes (including
+# shared-prefix block sharing + CoW and the int8 scale-carrying leg),
+# when a tp=2 export fails to import byte-identically into a tp=1 pool
+# through the JSON envelope, when the sharded engine's throughput
+# collapses (CPU aggregate retention < 0.6x; per-chip >= 0.8x gates on
+# real chips), or on leaked blocks.
+out="$(JAX_PLATFORMS=cpu python bench_serving.py --quick --tp-sweep)"
+check_json "$out"
 echo "bench smoke ok"
 # Training input pipeline: prefetch-on must match prefetch-off final
 # loss byte-for-byte (bench.py sets the regression marker otherwise)

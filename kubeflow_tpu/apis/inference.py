@@ -50,6 +50,18 @@ def inference_service_crd() -> dict:
         "cooldownSeconds": {"type": "number", "minimum": 0},
         "scrapePeriodSeconds": {"type": "number", "minimum": 0},
     }
+    # Engine knobs pass through to the model-server args verbatim, but
+    # tpShards is declared explicitly: the operator reads it to size
+    # each replica's chip request (a tp=4 replica is a 4-chip pod), and
+    # a role-level override lets a disaggregated service run a big
+    # prefill mesh next to small decode meshes.
+    engine_schema = {
+        "type": "object",
+        "properties": {
+            "tpShards": {"type": "integer", "minimum": 1},
+        },
+        "x-kubernetes-preserve-unknown-fields": True,
+    }
     # Per-role pool overrides for disaggregated prefill/decode serving:
     # each role gets its own replica range and engine overrides (merged
     # over the top-level engine; the operator pins serving_role and the
@@ -58,8 +70,7 @@ def inference_service_crd() -> dict:
         "replicas": {"type": "integer", "minimum": 0},
         "minReplicas": {"type": "integer", "minimum": 1},
         "maxReplicas": {"type": "integer", "minimum": 1},
-        "engine": {"type": "object",
-                   "x-kubernetes-preserve-unknown-fields": True},
+        "engine": engine_schema,
     }
     schema = {
         "type": "object",
@@ -77,11 +88,9 @@ def inference_service_crd() -> dict:
                     "tpuChipsPerReplica": {"type": "integer",
                                            "minimum": 0},
                     # Engine knobs passed verbatim to the model-server
-                    # args (the tpu-serving param surface).
-                    "engine": {
-                        "type": "object",
-                        "x-kubernetes-preserve-unknown-fields": True,
-                    },
+                    # args (the tpu-serving param surface); tpShards
+                    # additionally sizes the replica's chip request.
+                    "engine": engine_schema,
                     "router": {
                         "type": "object",
                         "properties": {

@@ -35,6 +35,12 @@ from kubeflow_tpu.version import DEFAULT_NAMESPACE
         ParamSpec("max_replicas", 4, "autoscaler ceiling"),
         ParamSpec("num_tpu_chips", 1,
                   "google.com/tpu chips per replica (0 = CPU)"),
+        ParamSpec("tp_shards", 1,
+                  "tensor-parallel shards per replica "
+                  "(spec.engine.tpShards): >1 serves the model over a "
+                  "tp-chip mesh so it no longer has to fit one chip's "
+                  "HBM; the operator sizes each replica pod to tp "
+                  "chips unless num_tpu_chips pins it"),
         ParamSpec("affinity_tokens", 32,
                   "leading prompt tokens hashed into the rendezvous "
                   "routing key (>= the prefix cache min length, so "
@@ -81,6 +87,7 @@ def inference_service_proto(
     min_replicas: int,
     max_replicas: int,
     num_tpu_chips: int,
+    tp_shards: int,
     affinity_tokens: int,
     pressure: int,
     kv_pressure: float,
@@ -108,6 +115,7 @@ def inference_service_proto(
             },
             "decode": {"replicas": int(replicas)},
         }
+    engine = {"tpShards": int(tp_shards)} if tp_shards > 1 else None
     cr = inference_service(
         name, namespace, model or name,
         model_path=model_path,
@@ -115,6 +123,7 @@ def inference_service_proto(
         min_replicas=min_replicas,
         max_replicas=max_replicas,
         tpu_chips_per_replica=num_tpu_chips,
+        engine=engine,
         affinity_tokens=affinity_tokens,
         pressure=pressure,
         kv_pressure=kv_pressure,

@@ -66,6 +66,11 @@ REST_PORT = 8500
                   "admission only; decode peers pull finished prompt "
                   "KV via :prefill/:import) or 'decode'; empty = "
                   "colocated. Requires kv_layout=paged"),
+        ParamSpec("tp_shards", 1,
+                  "tensor-parallel shards per replica: >1 runs the "
+                  "decoder over a tp-chip mesh (weights Megatron-"
+                  "split, KV pool sharded by KV head); size "
+                  "num_tpu_chips to match"),
         ParamSpec("kv_fused_attention", False,
                   "fuse the paged decode read into the block-table "
                   "attention kernel (no dense KV gather per step)"),
@@ -93,6 +98,7 @@ def tpu_serving(
     kv_pool_blocks: int,
     kv_dtype: str,
     serving_role: str,
+    tp_shards: int,
     kv_fused_attention: bool,
     enable_prometheus: bool,
     dtype: str,
@@ -116,6 +122,7 @@ def tpu_serving(
         f"--kv-block-size={kv_block_size}",
         f"--kv-pool-blocks={kv_pool_blocks}",
         f"--kv-dtype={kv_dtype}",
+        f"--tp-shards={tp_shards}",
         f"--dtype={dtype}",
     ]
     if serving_role:

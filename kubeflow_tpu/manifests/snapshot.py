@@ -52,7 +52,7 @@ SNAPSHOT_CASES: dict[str, tuple[str, dict]] = {
         "inference-service",
         {"name": "llama", "model_path": "gs://models/llama",
          "replicas": 2, "min_replicas": 1, "max_replicas": 4,
-         "num_tpu_chips": 4},
+         "num_tpu_chips": 4, "tp_shards": 4},
     ),
     "inference-service-disagg": (
         "inference-service",

@@ -63,6 +63,17 @@ ROLE_SIGNALS = {
     "decode": ("kv_bytes", "inter_token_p99"),
 }
 
+# CRD engine keys that differ from their tpu-serving param spelling:
+# the CRD surface is camelCase (tpShards), the prototype params are the
+# CLI flag names (tp_shards). Normalized once at pool-spec time so the
+# role-override merge and the replica render both see one spelling.
+_ENGINE_KEY_ALIASES = {"tpShards": "tp_shards"}
+
+
+def _normalize_engine(engine: dict | None) -> dict:
+    return {_ENGINE_KEY_ALIASES.get(k, k): v
+            for k, v in (engine or {}).items()}
+
 
 # ---------------------------------------------------------------------------
 # Exposition scraping (the autoscaler's input)
@@ -223,12 +234,15 @@ class InferenceServiceController(Controller):
             "replicas": int(spec.get("replicas", 1) or 1),
             "minReplicas": max(1, int(spec.get("minReplicas", 1))),
             "maxReplicas": int(spec.get("maxReplicas", 1) or 1),
-            "engine": dict(spec.get("engine") or {}),
+            "engine": _normalize_engine(spec.get("engine")),
         }
         if not role:
             return base
         r = (spec.get("roles") or {}).get(role) or {}
-        engine = {**base["engine"], **(r.get("engine") or {})}
+        # Role engine merges over the top level AFTER normalization, so
+        # a role-level tpShards override (big prefill mesh, small
+        # decode meshes) wins regardless of spelling.
+        engine = {**base["engine"], **_normalize_engine(r.get("engine"))}
         engine.setdefault("kv_layout", "paged")
         engine["serving_role"] = role
         return {
@@ -348,15 +362,21 @@ class InferenceServiceController(Controller):
         name = svc["metadata"]["name"]
         ns = svc["metadata"]["namespace"]
         spec = svc.get("spec", {})
+        eng = (engine if engine is not None
+               else _normalize_engine(spec.get("engine")))
+        # A tp-sharded replica is a tp-chip pod: tpShards sizes the chip
+        # request unless the spec pins it explicitly (0 = CPU stays 0).
+        chips_spec = spec.get("tpuChipsPerReplica")
+        chips = (max(1, int(eng.get("tp_shards", 1) or 1))
+                 if chips_spec is None else int(chips_spec))
         params = {
             "name": self.replica_name(name, i, role),
             "namespace": ns,
             "model_path": spec.get("modelPath", ""),
             "model_name": spec.get("model", name),
             "replicas": 1,
-            "num_tpu_chips": int(spec.get("tpuChipsPerReplica", 1)),
-            **(engine if engine is not None
-               else (spec.get("engine") or {})),
+            "num_tpu_chips": chips,
+            **eng,
         }
         if spec.get("image"):
             params["image"] = spec["image"]

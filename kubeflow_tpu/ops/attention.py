@@ -508,10 +508,53 @@ def _paged_kernel_supported(k_pool) -> bool:
     return hd % 128 == 0 and bs % 8 == 0
 
 
+def _paged_decode_local(qg, k_pool, v_pool, table, pos, sm_scale,
+                        implementation, interpret):
+    """Single-shard dispatch of the block walk (also the per-shard body
+    of the mesh twin): qg [B, Hkv, G, hd] against [N, Bs, Hkv, hd]
+    pools."""
+    if implementation is None:
+        implementation = ("pallas" if _paged_kernel_supported(k_pool)
+                          else "xla")
+    if implementation == "pallas":
+        return _paged_decode_pallas(qg, k_pool, v_pool, table, pos,
+                                    sm_scale, interpret=interpret)
+    if implementation == "xla":
+        return _paged_decode_xla(qg, k_pool, v_pool, table, pos, sm_scale)
+    raise ValueError(f"unknown implementation {implementation!r}")
+
+
+def _pool_head_specs(pool, axis: str, lead: int = 2):
+    """PartitionSpec pytree sharding a block pool on its KV-head dim
+    (``lead`` dims before it: [N, Bs] here, [L, N, Bs] for stacked
+    pools). Quantized pools shard codes AND scales by the same axis —
+    they ride the same block ids, so the split is one move."""
+    from jax.sharding import PartitionSpec as P
+
+    head = [None] * lead + [axis]
+    if isinstance(pool, dict):
+        return {"q": P(*head, None), "scale": P(*head)}
+    return P(*head, None)
+
+
+def _shard_heads(mesh, axis: str, n_kv_heads: int) -> int:
+    """Validate the KV-head axis divides over ``axis`` and return the
+    shard count (1 = mesh absent or axis unsplit)."""
+    if mesh is None:
+        return 1
+    shards = int(mesh.shape.get(axis, 1))
+    if shards > 1 and n_kv_heads % shards:
+        raise ValueError(
+            f"{n_kv_heads} kv heads not divisible by {shards} shards "
+            f"on mesh axis {axis!r}")
+    return shards
+
+
 def paged_decode_attention(q, k_pool, v_pool, table, pos, *,
                            n_kv_heads: int, scale: float | None = None,
                            implementation: str | None = None,
-                           interpret: bool = False):
+                           interpret: bool = False,
+                           mesh=None, axis: str = "tensor"):
     """Fused single-token attention over a paged KV pool.
 
     q: [B, Hq, hd] (one decode token per row, already rotary-embedded);
@@ -523,7 +566,16 @@ def paged_decode_attention(q, k_pool, v_pool, table, pos, *,
     ``implementation``: None (auto: pallas on TPU for supported shapes,
     else xla), "pallas", or "xla". Both walk the block table with an
     online softmax — the gathered ``[B, MB*Bs, Hkv, hd]`` view is never
-    materialized, which is the point."""
+    materialized, which is the point.
+
+    ``mesh`` (with ``axis`` sized > 1) selects the tensor-parallel twin:
+    the pool is sharded over the KV-head dim and each shard walks the
+    SAME block table over its local heads under ``shard_map``. The
+    online-softmax state (m/l/acc) is per-head, so the walk needs no
+    cross-shard collective at all — the output stays head-sharded for
+    the row-parallel ``wo`` matmul, whose psum is the block's one
+    reduction. Per-shard results are bitwise-equal to the single-device
+    kernel's corresponding head slices."""
     b, hq, hd = q.shape
     if hq % n_kv_heads:
         raise ValueError(
@@ -531,16 +583,26 @@ def paged_decode_attention(q, k_pool, v_pool, table, pos, *,
     group = hq // n_kv_heads
     sm_scale = (hd ** -0.5) if scale is None else scale
     qg = q.reshape(b, n_kv_heads, group, hd)
-    if implementation is None:
-        implementation = ("pallas" if _paged_kernel_supported(k_pool)
-                          else "xla")
-    if implementation == "pallas":
-        out = _paged_decode_pallas(qg, k_pool, v_pool, table, pos,
-                                   sm_scale, interpret=interpret)
-    elif implementation == "xla":
-        out = _paged_decode_xla(qg, k_pool, v_pool, table, pos, sm_scale)
+    if _shard_heads(mesh, axis, n_kv_heads) > 1:
+        from jax.sharding import PartitionSpec as P
+
+        from kubeflow_tpu.parallel.collectives import shard_map
+
+        def _local(qg_l, k_l, v_l, tbl, pos_l):
+            return _paged_decode_local(qg_l, k_l, v_l, tbl, pos_l,
+                                       sm_scale, implementation, interpret)
+
+        out = shard_map(
+            _local, mesh=mesh,
+            in_specs=(P(None, axis, None, None),
+                      _pool_head_specs(k_pool, axis),
+                      _pool_head_specs(v_pool, axis), P(), P()),
+            out_specs=P(None, axis, None, None),
+            axis_names=frozenset({axis}),
+        )(qg, k_pool, v_pool, table, pos)
     else:
-        raise ValueError(f"unknown implementation {implementation!r}")
+        out = _paged_decode_local(qg, k_pool, v_pool, table, pos, sm_scale,
+                                  implementation, interpret)
     return out.reshape(b, hq, hd)
 
 
@@ -587,7 +649,8 @@ def _paged_span_xla(qg, k_pool, v_pool, table, pos, sm_scale):
 
 
 def paged_span_attention(q, k_pool, v_pool, table, pos, *,
-                         n_kv_heads: int, scale: float | None = None):
+                         n_kv_heads: int, scale: float | None = None,
+                         mesh=None, axis: str = "tensor"):
     """Fused S-wide attention over a paged KV pool — the span sibling of
     :func:`paged_decode_attention` (verify scoring reads [slots, K]
     spans, suffix prefill reads one [1, S] span; both previously paid
@@ -600,7 +663,12 @@ def paged_span_attention(q, k_pool, v_pool, table, pos, *,
     [B, S, Hq, hd] f32. XLA block walk on every backend (the S-wide
     kernel shares the decode kernel's contract and can ride the same
     scalar-prefetch scheme later; the walk already removes the dense
-    materialization, which is the bandwidth bill)."""
+    materialization, which is the bandwidth bill).
+
+    ``mesh``/``axis``: the tensor-parallel twin, identical contract to
+    :func:`paged_decode_attention`'s — each shard walks the same table
+    over its local KV heads, no collective until the output
+    projection."""
     b, s_w, hq, hd = q.shape
     if hq % n_kv_heads:
         raise ValueError(
@@ -608,7 +676,24 @@ def paged_span_attention(q, k_pool, v_pool, table, pos, *,
     group = hq // n_kv_heads
     sm_scale = (hd ** -0.5) if scale is None else scale
     qg = q.reshape(b, s_w, n_kv_heads, group, hd)
-    out = _paged_span_xla(qg, k_pool, v_pool, table, pos, sm_scale)
+    if _shard_heads(mesh, axis, n_kv_heads) > 1:
+        from jax.sharding import PartitionSpec as P
+
+        from kubeflow_tpu.parallel.collectives import shard_map
+
+        def _local(qg_l, k_l, v_l, tbl, pos_l):
+            return _paged_span_xla(qg_l, k_l, v_l, tbl, pos_l, sm_scale)
+
+        out = shard_map(
+            _local, mesh=mesh,
+            in_specs=(P(None, None, axis, None, None),
+                      _pool_head_specs(k_pool, axis),
+                      _pool_head_specs(v_pool, axis), P(), P()),
+            out_specs=P(None, None, axis, None, None),
+            axis_names=frozenset({axis}),
+        )(qg, k_pool, v_pool, table, pos)
+    else:
+        out = _paged_span_xla(qg, k_pool, v_pool, table, pos, sm_scale)
     return out.reshape(b, s_w, hq, hd)
 
 

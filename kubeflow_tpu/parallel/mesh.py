@@ -188,3 +188,20 @@ def single_device_mesh(device: jax.Device | None = None) -> Mesh:
     """A 1×1×1×1×1 mesh — lets the same pjit code path run on one chip."""
     device = device or jax.devices()[0]
     return build_mesh(MeshConfig(data=1), devices=[device])
+
+
+def serving_mesh(tp: int, *, devices: Sequence[jax.Device] | None = None
+                 ) -> Mesh:
+    """A pure tensor-parallel mesh over the first ``tp`` devices — the
+    model-parallel serving layout (one replica == one ``tp``-chip mesh;
+    every other axis is 1, so the tensor split lands on the innermost
+    ICI dimension). Serving replicates nothing across data/fsdp: the
+    fleet layer scales replicas, the mesh scales the model."""
+    if tp < 1:
+        raise ValueError(f"tp must be >= 1, got {tp}")
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < tp:
+        raise ValueError(
+            f"tp={tp} needs {tp} devices but only {len(devices)} are "
+            "visible")
+    return build_mesh(MeshConfig(data=1, tensor=tp), devices=devices[:tp])

@@ -89,6 +89,13 @@ def main(argv=None) -> int:
                         "finished prompt KV via :prefill/:import), "
                         "'decode' resumes imported prompts; empty = "
                         "colocated. Requires --kv-layout=paged")
+    p.add_argument("--tp-shards", type=int, default=1,
+                   help="tensor-parallel shards per replica (continuous "
+                        "mode): >1 runs the decoder over a tp-wide "
+                        "tensor mesh — weights Megatron-split, the KV "
+                        "pool sharded over the KV-head axis; must "
+                        "divide the model's kv heads / heads / d_ff "
+                        "and the pod needs that many chips")
     p.add_argument("--stream-timeout-s", type=float, default=60.0,
                    help="default wait for generation results/streams; "
                         "raise under heavy load so memory-deferred "
@@ -130,6 +137,13 @@ def main(argv=None) -> int:
         # The prefill→decode handoff rides the paged block pool; a
         # dense replica has no blocks to export or import.
         p.error("--serving-role requires --kv-layout=paged")
+    if args.tp_shards < 1:
+        p.error("--tp-shards must be >= 1")
+    if args.tp_shards > 1 and args.decode_mode != "continuous":
+        # Only the continuous decoder builds the tensor mesh; silently
+        # ignoring the flag would report single-chip numbers as
+        # model-parallel ones.
+        p.error("--tp-shards requires --decode-mode=continuous")
     if args.kv_layout == "paged":
         if args.decode_mode != "continuous":
             # Only the continuous decoder carries the block pool;
@@ -168,6 +182,7 @@ def main(argv=None) -> int:
             kv_fused=args.kv_fused_attention,
             stream_timeout_s=args.stream_timeout_s,
             serving_role=args.serving_role,
+            tp_shards=args.tp_shards,
             dtype=args.dtype,
         ),
         port=args.rest_port,

@@ -68,6 +68,7 @@ from kubeflow_tpu.models.decode import (
     paged_admit_prefix_and_step,
     paged_admit_rows_and_step,
     prefill,
+    shard_decode_state,
     store_blocks,
     store_prefix_cache,
     store_prefix_row,
@@ -205,7 +206,37 @@ class ContinuousDecoder:
                  kv_low_watermark: int = 0, kv_dtype: str = "fp",
                  kv_fused: bool = False,
                  stream_timeout_s: float = 60.0,
-                 role: str = ""):
+                 role: str = "", tp_shards: int = 1):
+        # Tensor-parallel serving: tp_shards > 1 runs THIS replica's
+        # decode executables over a tp-wide tensor mesh — weights carry
+        # the Megatron column/row split from the model's partition
+        # rules, and the KV storage is sharded over the KV-HEAD axis.
+        # Block ids index the unsharded block dim, so the allocator,
+        # prefix trie, refcount/CoW, and export/import handoff all run
+        # unchanged on host-global ids; only bytes-per-token (per-chip
+        # HBM) and the fused kernel's read path know about the split.
+        self.tp_shards = max(1, int(tp_shards))
+        if self.tp_shards > 1:
+            if cfg.n_kv_heads % self.tp_shards:
+                raise ValueError(
+                    f"tp_shards {self.tp_shards} must divide n_kv_heads "
+                    f"{cfg.n_kv_heads} (the KV pool shards by head)")
+            if cfg.n_heads % self.tp_shards:
+                raise ValueError(
+                    f"tp_shards {self.tp_shards} must divide n_heads "
+                    f"{cfg.n_heads}")
+            if cfg.d_ff % self.tp_shards:
+                raise ValueError(
+                    f"tp_shards {self.tp_shards} must divide d_ff "
+                    f"{cfg.d_ff}")
+            from kubeflow_tpu.models.transformer import partition_rules
+            from kubeflow_tpu.parallel.mesh import serving_mesh
+            from kubeflow_tpu.parallel.sharding import shard_pytree
+
+            self.mesh = serving_mesh(self.tp_shards)
+            params = shard_pytree(params, self.mesh, partition_rules(cfg))
+        else:
+            self.mesh = None
         self.params = params
         self.cfg = cfg
         self.slots = slots
@@ -312,11 +343,15 @@ class ContinuousDecoder:
                 raise ValueError(
                     f"kv_pool_blocks {num_blocks} cannot back even one "
                     f"worst-case sequence ({mb} blocks)")
+            # Bytes are priced PER CHIP: a tp-sharded pool holds
+            # Hkv / tp heads per position on each chip, and the fill
+            # gauges must reflect the HBM a chip actually spends.
             self._alloc = BlockAllocator(
                 num_blocks, self.kv_block_size,
                 bytes_per_token=kv_bytes_per_token(
                     cfg.n_layers, cfg.n_kv_heads, cfg.head_dim,
-                    jnp.dtype(cfg.dtype).itemsize, kv_dtype))
+                    jnp.dtype(cfg.dtype).itemsize, kv_dtype,
+                    tp_shards=self.tp_shards))
             self._max_blocks_per_seq = mb
             # Host mirror of the device block table; sentinel
             # ``num_blocks`` marks unallocated entries (writes through
@@ -330,6 +365,17 @@ class ContinuousDecoder:
             self.kv_block_size = int(kv_block_size)
             self._alloc = None
             self._state = init_decode_state(cfg, slots, self.total_len, seed)
+        if self.mesh is not None:
+            # KV payload onto the mesh, head-sharded; scalars/tables/RNG
+            # replicated. Every jitted step's computation then follows
+            # its committed inputs onto the mesh.
+            self._state = shard_decode_state(self._state, self.mesh)
+            if self._prefix_pool is not None:
+                self._prefix_pool = shard_decode_state(self._prefix_pool,
+                                                       self.mesh)
+        # The fused block-table kernel walks its mesh twin only under a
+        # tensor mesh; the gather path partitions under plain GSPMD.
+        self._kmesh = self.mesh if self.kv_fused else None
         self.kv_low_watermark = max(0, int(kv_low_watermark))
         # Serializes device access to self._state between the scheduler
         # thread and caller-thread prime_prefix (which, in paged mode,
@@ -409,6 +455,10 @@ class ContinuousDecoder:
             "serving_role",
             "Replica role in a disaggregated fleet (1 = this role)",
             labels=("role",)).labels(self.role or "colocated").set(1)
+        self.registry.gauge(
+            "serving_tp_shards",
+            "Tensor-parallel mesh width of this replica (1 = "
+            "single-chip)").set(self.tp_shards)
         # Per-stream lifecycle timelines, bounded ring, served at the
         # model server's /debug/requests (JSON + chrome-trace export).
         self.trace = TraceStore()
@@ -581,7 +631,7 @@ class ContinuousDecoder:
                     jnp.asarray(slots), jnp.asarray(toks),
                     jnp.asarray(lengths), jnp.asarray(wants),
                     jnp.asarray(temps), self.top_k, self.eos_id,
-                    self.kv_fused)
+                    self.kv_fused, self._kmesh)
             else:
                 self._state, last, tok, emit = admit_rows_and_step(
                     self._state, self.params, self.cfg,
@@ -695,7 +745,7 @@ class ContinuousDecoder:
                     jnp.int32(prefix_len), jnp.asarray(toks),
                     jnp.int32(len(req.tokens)), jnp.int32(req.want),
                     jnp.float32(req.temperature), self.top_k, self.eos_id,
-                    self.kv_fused)
+                    self.kv_fused, self._kmesh)
             with self._mlock:
                 self.kv_shared_blocks += n_full
                 if prefix_len % bs:
@@ -974,9 +1024,14 @@ class ContinuousDecoder:
         with self._mlock:
             self.kv_handoff_exports += 1
             self.kv_handoff_tokens += plen
+        # tp_shards records the exporter's mesh shape. The payload is
+        # already host-global (the sharded pool gathers on device_get),
+        # so a differently-sharded importer scatters it with ITS pool
+        # sharding — the reshard is the import itself.
         return {"tokens": toks, "prefix_len": plen,
                 "block_size": self.kv_block_size,
-                "kv_dtype": self.kv_dtype, "payload": payload}
+                "kv_dtype": self.kv_dtype, "tp_shards": self.tp_shards,
+                "payload": payload}
 
     def import_prompt(self, handoff: dict) -> bool:
         """Decode-role handoff receive: allocate local blocks, scatter
@@ -1259,7 +1314,7 @@ class ContinuousDecoder:
             self._state, outs, emits = verify_chunk(
                 self._state, self.params, self.cfg, jnp.asarray(drafts),
                 jnp.asarray(dlens), self.top_k, self.eos_id,
-                self.kv_fused)
+                self.kv_fused, self._kmesh)
         with self._mlock:
             self.dispatches += 1
             self.spec_verify_dispatches += 1
@@ -1473,7 +1528,7 @@ class ContinuousDecoder:
                         self._state, toks, emitted = decode_chunk(
                             self._state, self.params, self.cfg,
                             self.chunk_size, self.top_k, self.eos_id,
-                            self.kv_fused,
+                            self.kv_fused, self._kmesh,
                         )
                     with self._mlock:
                         self.steps += self.chunk_size
@@ -1488,7 +1543,7 @@ class ContinuousDecoder:
                     with self._state_lock:
                         self._state, toks, emitted = decode_step(
                             self._state, self.params, self.cfg, self.top_k,
-                            self.eos_id, self.kv_fused,
+                            self.eos_id, self.kv_fused, self._kmesh,
                         )
                     with self._mlock:
                         self.steps += 1
@@ -1560,6 +1615,7 @@ class ContinuousDecoder:
                 "kv_handoff_imports": self.kv_handoff_imports,
                 "kv_handoff_tokens": self.kv_handoff_tokens,
                 "role": self.role,
+                "tp_shards": self.tp_shards,
             }
         # Allocator / trie stats live under the prefix lock — taken in a
         # SEPARATE scope (never nested with the metrics lock) so the two

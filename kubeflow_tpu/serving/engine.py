@@ -129,6 +129,15 @@ class EngineConfig:
     # `serving_role` exposition label so per-pool dashboards and the
     # operator scrape can tell the pools apart.
     serving_role: str = ""
+    # Tensor-parallel shards per replica (continuous mode): >1 runs the
+    # decoder over a tp-wide tensor mesh — weights Megatron-split by the
+    # model's partition rules, the KV pool sharded over the KV-head
+    # axis (block ids stay host-global, so the prefix trie, allocator
+    # refcount/CoW, and the prefill→decode handoff are unchanged). Must
+    # divide the model's n_kv_heads / n_heads / d_ff; the serving pod
+    # needs tp chips. The `serving_kv_bytes_*` gauges then price the
+    # pool PER CHIP.
+    tp_shards: int = 1
     # Compute dtype override ("bfloat16"/"float32"); empty keeps the
     # model preset's dtype. The tpu-serving manifest's --dtype arg.
     dtype: str = ""

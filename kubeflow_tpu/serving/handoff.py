@@ -28,9 +28,17 @@ import base64
 
 import numpy as np
 
-# Envelope schema version: receivers reject anything else rather than
+# Envelope schema version: receivers reject anything newer rather than
 # guess at a layout (a silent mis-parse would corrupt a KV pool).
-HANDOFF_VERSION = 1
+# Version 2 added the exporter's mesh shape (``mesh.tpShards``); the
+# payload itself stayed host-global — export_blocks device_gets the
+# SHARDED pool into one full-KV-head host array, so a sharded export is
+# already gathered and any mesh shape can import it (the importer's
+# device_put with its own pool sharding IS the reshard). Version-1
+# envelopes (no mesh field) therefore stay importable: they are exactly
+# a tp=1 export.
+HANDOFF_VERSION = 2
+_ACCEPTED_VERSIONS = (1, 2)
 
 
 def _np_dtype(name: str) -> np.dtype:
@@ -72,8 +80,9 @@ def _map_tree(tree, fn):
 def pack(handoff: dict) -> dict:
     """JSON-safe envelope for a decoder ``export_prompt`` result: the
     block payload's arrays (k/v, or k.q/k.scale/... when quantized)
-    become base64 strings; tokens/prefix_len/block metadata ride
-    alongside for receiver-side validation."""
+    become base64 strings; tokens/prefix_len/block metadata — and the
+    exporter's mesh shape — ride alongside for receiver-side
+    validation."""
     payload = handoff["payload"]
 
     def _enc(node):
@@ -87,6 +96,11 @@ def pack(handoff: dict) -> dict:
         "prefix_len": int(handoff["prefix_len"]),
         "block_size": int(handoff["block_size"]),
         "kv_dtype": handoff["kv_dtype"],
+        # The exporter's mesh shape. Informational for the importer —
+        # the payload arrives host-gathered across every mesh shape —
+        # but a future envelope that ships per-shard payloads would bump
+        # the version, and dashboards read it to attribute handoffs.
+        "mesh": {"tpShards": int(handoff.get("tp_shards", 1) or 1)},
         "payload": {side: _enc(payload[side]) for side in ("k", "v")},
     }
 
@@ -94,14 +108,20 @@ def pack(handoff: dict) -> dict:
 def unpack(env: dict) -> dict:
     """Inverse of :func:`pack`. Raises ``ValueError`` on a malformed or
     version-mismatched envelope — the decode server answers that with a
-    4xx instead of importing garbage."""
-    if not isinstance(env, dict) or env.get("version") != HANDOFF_VERSION:
+    4xx (and the fleet path degrades to a plain submit) instead of
+    importing garbage. Version-1 envelopes (pre-mesh) unpack as tp=1
+    exports; the payload layout never changed."""
+    if not isinstance(env, dict) or env.get("version") not in \
+            _ACCEPTED_VERSIONS:
         raise ValueError(
             f"unsupported handoff envelope "
             f"version={env.get('version') if isinstance(env, dict) else env!r}")
     payload = env.get("payload")
     if not isinstance(payload, dict) or set(payload) != {"k", "v"}:
         raise ValueError("handoff payload must carry 'k' and 'v'")
+    mesh = env.get("mesh") or {}
+    if not isinstance(mesh, dict):
+        raise ValueError("handoff mesh field must be an object")
 
     def _dec(node):
         if isinstance(node, dict) and "data" not in node:
@@ -113,5 +133,6 @@ def unpack(env: dict) -> dict:
         "prefix_len": int(env["prefix_len"]),
         "block_size": int(env["block_size"]),
         "kv_dtype": str(env.get("kv_dtype", "fp")),
+        "tp_shards": int(mesh.get("tpShards", 1) or 1),
         "payload": {side: _dec(payload[side]) for side in ("k", "v")},
     }

@@ -35,22 +35,38 @@ KV_SCALE_BYTES = 4
 
 
 def kv_bytes_per_token(n_layers: int, n_kv_heads: int, head_dim: int,
-                       fp_bytes: int, kv_dtype: str = "fp") -> int:
-    """HBM bytes one resident K+V position costs in the paged pool.
+                       fp_bytes: int, kv_dtype: str = "fp",
+                       tp_shards: int = 1) -> int:
+    """HBM bytes one resident K+V position costs in the paged pool,
+    PER CHIP.
 
     ``fp``: ``2 * L * Hkv * hd * fp_bytes``. ``int8``: the payload drops
     to one byte per element but each (position, head) carries a
     :data:`KV_SCALE_BYTES` scale, so the per-head cost is
     ``hd + KV_SCALE_BYTES`` — the honest number an autoscaler must see
     (scale overhead is why int8 is ~``fp_bytes * hd / (hd + 4)``x, not
-    exactly ``fp_bytes``x, denser)."""
+    exactly ``fp_bytes``x, denser).
+
+    ``tp_shards``: a tensor-parallel replica shards the pool over the
+    KV-head axis, so each of its chips holds ``Hkv / tp`` heads per
+    position. The pool-fill gauges priced off this number must reflect
+    real per-chip HBM — a tp=4 replica whose gauges reported the
+    host-global (summed) bytes would look 4x fuller than any of its
+    chips actually is, and the autoscaler and gateway spill would
+    misread the pool."""
+    if tp_shards < 1:
+        raise ValueError(f"tp_shards must be >= 1, got {tp_shards}")
+    if n_kv_heads % tp_shards:
+        raise ValueError(
+            f"{n_kv_heads} kv heads not divisible by tp_shards "
+            f"{tp_shards}")
     if kv_dtype == "int8":
         per_head = head_dim + KV_SCALE_BYTES
     elif kv_dtype in ("", "fp"):
         per_head = head_dim * fp_bytes
     else:
         raise ValueError(f"unknown kv_dtype {kv_dtype!r}")
-    return 2 * n_layers * n_kv_heads * per_head
+    return 2 * n_layers * (n_kv_heads // tp_shards) * per_head
 
 
 class BlockAllocator:

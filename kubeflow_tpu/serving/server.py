@@ -114,6 +114,7 @@ class ModelServer:
                     kv_fused=self.engine.cfg.kv_fused,
                     stream_timeout_s=self.engine.cfg.stream_timeout_s,
                     role=self.engine.cfg.serving_role,
+                    tp_shards=self.engine.cfg.tp_shards,
                 )
             return self._decoder
 
@@ -390,6 +391,9 @@ class ModelServer:
                                 d["kv_handoff_tokens"],
                             "serving_in_flight": d["in_flight"],
                             "serving_queued": d["queued"],
+                            # serving_tp_shards rides the decoder
+                            # registry above; the kv_bytes gauges here
+                            # are PER CHIP under tp (real per-chip HBM).
                         })
                     self._send(200, text, content_type="text/plain")
                 elif self.path.partition("?")[0] == "/debug/requests":

@@ -1,0 +1,31 @@
+"""JAX-hygiene BAD fixture: Python branch on a traced value inside a
+``shard_map`` body — the hygiene class a tensor-parallel serving kernel
+is most likely to ship. EVERY operand of the mapped body is a per-shard
+tracer; host-side mesh logic (shard counts, head splits) must resolve
+OUTSIDE the body, because Python truthiness on a tracer raises
+``TracerBoolConversionError`` under tracing — or, through a caching
+wrapper, silently bakes one branch into the executable."""
+
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from kubeflow_tpu.parallel.collectives import shard_map
+
+
+def sharded_decode_read(mesh, qg, pool, pos):
+    """Walks a sharded KV pool with the per-shard body below."""
+
+    def body(qg_l, pool_l, pos_l):
+        # BUG: ``pos_l`` is a traced per-shard operand — branching on
+        # it in Python is a TracerBoolConversionError (the mask belongs
+        # in jnp.where / lax.cond, or the test must be host-static).
+        if pos_l > 0:
+            return jnp.einsum("bkgd,bskd->bkgd", qg_l, pool_l)
+        return qg_l
+
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(P(None, "tensor", None, None),
+                  P(None, None, "tensor", None), P()),
+        out_specs=P(None, "tensor", None, None),
+    )(qg, pool, pos)

@@ -1,0 +1,28 @@
+"""JAX-hygiene GOOD twin of jax_hygiene_shard_map_bad.py: the same
+per-shard pool walk with the data-dependent choice expressed as
+``jnp.where`` (traced-safe) and the host-static mesh question (shard
+count) resolved OUTSIDE the mapped body."""
+
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from kubeflow_tpu.parallel.collectives import shard_map
+
+
+def sharded_decode_read(mesh, qg, pool, pos):
+    """Walks a sharded KV pool with the per-shard body below."""
+    shards = mesh.shape["tensor"]  # host-static: legal out here
+
+    def body(qg_l, pool_l, pos_l):
+        out = jnp.einsum("bkgd,bskd->bkgd", qg_l, pool_l)
+        # Data-dependent select stays in the traced domain.
+        return jnp.where(pos_l > 0, out, qg_l)
+
+    if shards == 1:
+        return body(qg, pool, pos)
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(P(None, "tensor", None, None),
+                  P(None, None, "tensor", None), P()),
+        out_specs=P(None, "tensor", None, None),
+    )(qg, pool, pos)

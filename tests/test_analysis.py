@@ -94,6 +94,17 @@ def test_jax_hygiene_detected():
     assert any(f.symbol == "scan_driver.body" for f in found)
 
 
+def test_jax_hygiene_shard_map_branch_detected():
+    """A Python branch on a traced value inside a shard_map body — the
+    hygiene class the tensor-parallel serving kernels are most exposed
+    to (every body operand is a per-shard tracer)."""
+    found = _findings(FIXTURES / "jax_hygiene_shard_map_bad.py")
+    hits = [f for f in found if f.rule == "jit-traced-branch"]
+    assert hits, found
+    assert hits[0].symbol == "sharded_decode_read.body"
+    assert "pos_l" in hits[0].message
+
+
 def test_metrics_exposition_detected():
     found = _findings(FIXTURES / "metrics_exposition_bad.py")
     rules = {f.rule for f in found}
@@ -113,6 +124,7 @@ def test_metrics_exposition_detected():
 def test_good_fixtures_are_clean():
     for name in ("lock_good.py", "thread_lifecycle_good.py",
                  "resource_good.py", "jax_hygiene_good.py",
+                 "jax_hygiene_shard_map_good.py",
                  "metrics_exposition_good.py"):
         found = _findings(FIXTURES / name)
         assert not found, (name, found)
