@@ -1296,6 +1296,232 @@ def _bench_disagg_sweep(args, model) -> dict:
     }
 
 
+def _bench_qos_sweep(args, model) -> dict:
+    """Multi-tenant QoS + tiered KV vs FIFO at EQUAL device HBM under
+    overloaded mixed two-tenant traffic.
+
+    Traffic: a backlog of low-priority "free" long-decode requests
+    saturates the pool, then latency-sensitive high-priority "gold"
+    shorts arrive. FIFO serves arrival order — gold TTFT pays the whole
+    free drain. The QoS run (same pool bytes) orders the queue by
+    weighted fair share + priority and, when a gold admission blocks on
+    memory, SUSPENDS a live free stream to the host tier (export KV,
+    free blocks, park) and resumes it later through the ordinary
+    prefix-hit admission. The host tier also gives evicted prefix
+    entries a second chance: both tenants share per-tenant system
+    prefixes whose trie entries are evicted under pool pressure, so the
+    tier turns later arrivals' cold prefills back into suffix-only hits.
+
+    Gates (regression marker):
+    - gold TTFT p99 improves >= 1.5x under QoS at equal HBM;
+    - no starvation: every free request completes in BOTH runs;
+    - byte-identity: every stream's greedy tokens — including each
+      suspended-and-resumed one — match the undisturbed sequential
+      reference;
+    - zero leaked blocks after drain in the DEVICE pool and zero
+      pinned bytes left in the host tier;
+    - second chance is real: host-tier hits > 0 and the QoS run's
+      prefill volume is below the no-tier FIFO baseline's.
+    """
+    import threading
+
+    from kubeflow_tpu.models.registry import get_model
+    from kubeflow_tpu.serving.continuous import ContinuousDecoder
+    from kubeflow_tpu.serving.qos import QosPolicy, TenantSpec
+
+    spec = get_model(model)
+    params = spec.init(jax.random.PRNGKey(0), spec.config)
+    prefill_len, gen_long, gen_short = 64, 32, 4
+    block, slots = 8, 8
+    # ~2.5 worst-case free streams: pressure is the point.
+    pool_blocks = 20
+    # The free backlog must outlast the HoL-bypass window: bypass (the
+    # satellite fix, on in BOTH runs) lets a fitting gold jump a
+    # deferred free head for a few rounds, but the aged head's shield
+    # then closes the window — with a deep backlog FIFO golds spend
+    # most of their wait behind shielded free heads while QoS golds
+    # jump the ORDER itself (and suspension makes room).
+    n_free = 12 if args.quick else 20
+    n_gold = 6 if args.quick else 12
+    free_pfx = [3 + (j % 89) for j in range(24)]
+    gold_pfx = [7 + (j % 61) for j in range(24)]
+
+    def request(tenant, i):
+        if tenant == "free":
+            return free_pfx + [11 + i] * 8, gen_long
+        return gold_pfx + [13 + i] * 4, gen_short
+
+    reqs = ([("free", i) for i in range(n_free)]
+            + [("gold", i) for i in range(n_gold)])
+    # Revisit wave: same tenant prefixes AFTER the storm and a full
+    # trie eviction — the deterministic hit-after-evict probe. With
+    # the host tier these ride suffix-only promotions; without it each
+    # pays a cold full-prompt prefill again.
+    revisit = [("free", n_free), ("free", n_free + 1),
+               ("gold", n_gold), ("gold", n_gold + 1)]
+
+    def mk(qos=None, host_kv_bytes=0):
+        return ContinuousDecoder(
+            params, spec.config, slots=slots, prefill_len=prefill_len,
+            max_new_tokens=gen_long, prefix_cache_slots=8,
+            prefix_cache_min_len=16, prefill_len_buckets=2,
+            kv_layout="paged", kv_block_size=block,
+            kv_pool_blocks=pool_blocks, kv_low_watermark=2,
+            stream_timeout_s=600.0, qos=qos,
+            host_kv_bytes=host_kv_bytes)
+
+    # Undisturbed sequential reference: the byte-identity oracle for
+    # every (tenant, i) request, big pool so nothing defers.
+    ref = ContinuousDecoder(
+        params, spec.config, slots=slots, prefill_len=prefill_len,
+        max_new_tokens=gen_long, prefix_cache_slots=8,
+        prefix_cache_min_len=16, prefill_len_buckets=2,
+        kv_layout="paged", kv_block_size=block, kv_pool_blocks=0,
+        stream_timeout_s=600.0)
+    try:
+        want = {key: ref.generate(*request(*key), timeout=600)["tokens"]
+                for key in reqs + revisit}
+    finally:
+        ref.stop()
+
+    def run(mode):
+        if mode == "qos":
+            qos = QosPolicy(
+                {"gold": TenantSpec("gold", weight=8, priority=10),
+                 "free": TenantSpec("free", weight=1, priority=0)},
+                aging_seconds=30.0)
+            d = mk(qos=qos, host_kv_bytes=64 << 20)
+        else:
+            d = mk()
+        results, ttfts = {}, {}
+        threads = []
+
+        def one(key):
+            toks, w = request(*key)
+            t0 = time.perf_counter()
+            h = d.submit(toks, w, tenant=key[0])
+            out = []
+            for tok in h.tokens(timeout=600):
+                if not out:
+                    ttfts[key] = (time.perf_counter() - t0) * 1e3
+                out.append(tok)
+            results[key] = out
+
+        try:
+            t_run = time.perf_counter()
+            # Free backlog first; gold arrives into the saturated pool.
+            for key in reqs[:n_free]:
+                th = threading.Thread(target=one, args=(key,))
+                th.start()
+                threads.append(th)
+            # Let the backlog reach the pool before gold shows up.
+            deadline = time.perf_counter() + 5.0
+            while (d.metrics()["in_flight"] < 2
+                   and time.perf_counter() < deadline):
+                time.sleep(0.01)
+            for key in reqs[n_free:]:
+                th = threading.Thread(target=one, args=(key,))
+                th.start()
+                threads.append(th)
+            for th in threads:
+                th.join(timeout=600)
+            elapsed = time.perf_counter() - t_run
+
+            def evict_all():
+                with d._prefix_lock:
+                    while d.prefix_cache.evict_lru():
+                        pass
+
+            # Hit-after-evict probe: wipe the trie (demoting to the
+            # host tier when one exists), then revisit the prefixes.
+            evict_all()
+            for key in revisit:
+                results[key] = d.generate(*request(*key),
+                                          timeout=600)["tokens"]
+            # Leak check: cache-held blocks are residency, not leaks —
+            # drain the trie so anything still claimed is a real leak.
+            evict_all()
+            m = d.metrics()
+        finally:
+            d.stop()
+        gold_ttfts = sorted(v for k, v in ttfts.items()
+                            if k[0] == "gold")
+        total_toks = sum(len(v) for v in results.values())
+        return {
+            "results": results,
+            "completed": len(results),
+            "gold_ttft_p99_ms": (percentile(gold_ttfts, 99)
+                                 if gold_ttfts else float("inf")),
+            "tokens_per_sec": total_toks / max(elapsed, 1e-9),
+            "prefill_tokens": m["prefill_tokens"],
+            # Cold volume = prompt tokens prefilled on trie MISSES
+            # (hits only pay their suffix, which prefill_tokens also
+            # counts — subtracting it isolates the cold prefills the
+            # host tier exists to remove).
+            "cold_prefill_tokens": (m["prefill_tokens"]
+                                    - m["prefix_suffix_tokens"]),
+            "suspends": m["kv_suspends"],
+            "resumes": m["kv_resumes"],
+            "host_hits": m["kv_host_hits"],
+            "deadline_shed": m["qos_deadline_shed"],
+            "leaked_blocks": m["kv_blocks_in_use"],
+            "host_pinned_bytes": m["kv_host_tier_pinned_bytes"],
+            "defer_rounds": m["kv_defer_admissions"],
+        }
+
+    # Untimed warmup: absorb every executable both timed runs will
+    # touch (admission buckets, suffix shapes, suspend export/import)
+    # so the FIFO-first ordering doesn't bill compilation to FIFO and
+    # flatter the QoS ratio.
+    run("qos")
+    fifo = run("fifo")
+    qos = run("qos")
+
+    identical_fifo = all(fifo["results"].get(k) == v
+                         for k, v in want.items())
+    identical_qos = all(qos["results"].get(k) == v
+                        for k, v in want.items())
+    all_complete = (fifo["completed"] == len(reqs) + len(revisit)
+                    and qos["completed"] == len(reqs) + len(revisit))
+    ttft_ratio = fifo["gold_ttft_p99_ms"] / max(qos["gold_ttft_p99_ms"],
+                                                1e-9)
+    leaked = (fifo["leaked_blocks"] + qos["leaked_blocks"]
+              + qos["host_pinned_bytes"])
+    second_chance = (qos["host_hits"] > 0
+                     and qos["cold_prefill_tokens"]
+                     < fifo["cold_prefill_tokens"])
+    return {
+        "benchmark": "serving_qos_sweep",
+        "model": model,
+        "requests": len(reqs),
+        "gold_ttft_p99_fifo_ms": round(fifo["gold_ttft_p99_ms"], 3),
+        "gold_ttft_p99_qos_ms": round(qos["gold_ttft_p99_ms"], 3),
+        "gold_ttft_p99_ratio": round(ttft_ratio, 3),
+        "fifo_tokens_per_sec": round(fifo["tokens_per_sec"], 1),
+        "qos_tokens_per_sec": round(qos["tokens_per_sec"], 1),
+        "suspends": qos["suspends"],
+        "resumes": qos["resumes"],
+        "host_tier_hits": qos["host_hits"],
+        "prefill_tokens_fifo": fifo["prefill_tokens"],
+        "prefill_tokens_qos": qos["prefill_tokens"],
+        "cold_prefill_tokens_fifo": fifo["cold_prefill_tokens"],
+        "cold_prefill_tokens_qos": qos["cold_prefill_tokens"],
+        "all_complete": all_complete,
+        "tokens_identical": identical_fifo and identical_qos,
+        "kv_blocks_in_use_after_drain": (fifo["leaked_blocks"]
+                                         + qos["leaked_blocks"]),
+        "host_tier_pinned_after_drain": qos["host_pinned_bytes"],
+        "regression": (not identical_fifo or not identical_qos
+                       or not all_complete or leaked != 0
+                       or ttft_ratio < 1.5
+                       or qos["suspends"] < 1 or qos["resumes"] < 1
+                       or not second_chance),
+        "config": f"{model} free{n_free}x{gen_long} gold{n_gold}"
+                  f"x{gen_short} prefill{prefill_len} block{block} "
+                  f"pool{pool_blocks} slots{slots} watermark2",
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
@@ -1344,6 +1570,14 @@ def main() -> int:
                          "parity, int8/fused within pinned tolerance) "
                          "plus the fused block-table attention decode "
                          "path (no dense KV gather traced)")
+    ap.add_argument("--qos-sweep", action="store_true",
+                    help="benchmark multi-tenant QoS + tiered KV vs "
+                         "FIFO at equal HBM under overloaded "
+                         "two-tenant traffic (>=1.5x high-priority "
+                         "TTFT p99, no starvation, byte-identical "
+                         "suspended streams, zero leaked blocks in "
+                         "device pool and host tier, host-tier "
+                         "second-chance hits)")
     ap.add_argument("--tp-sweep", action="store_true",
                     help="benchmark model-parallel serving: tp=1/2/4 "
                          "mesh shapes at equal total pool bytes "
@@ -1362,7 +1596,10 @@ def main() -> int:
             os.environ.get("XLA_FLAGS", "")
             + " --xla_force_host_platform_device_count=8").strip()
     on_tpu = jax.default_backend() == "tpu"
-    if args.tp_sweep:
+    if args.qos_sweep:
+        model = "llama-1b" if on_tpu and not args.quick else "lm-test-tiny"
+        result = _bench_qos_sweep(args, model)
+    elif args.tp_sweep:
         model = "llama-1b" if on_tpu and not args.quick else "lm-test-tiny"
         result = _bench_tp_sweep(args, model)
     elif args.disagg_sweep:

@@ -56,6 +56,16 @@ check_json "$out"
 # or when either pool leaks blocks.
 out="$(JAX_PLATFORMS=cpu python bench_serving.py --quick --disagg-sweep)"
 check_json "$out"
+# Multi-tenant QoS + tiered KV: the marker fires when high-priority
+# TTFT p99 improves by <1.5x over FIFO at equal HBM under overloaded
+# two-tenant traffic, when any stream (including each suspended-and-
+# resumed one) is not byte-identical to the undisturbed reference,
+# when a low-priority request starves (not all complete), when the
+# host tier's second chance never fires (no hit-after-evict or no
+# cold-prefill reduction vs the no-tier baseline), or when the device
+# pool leaks blocks / the host tier leaks pinned bytes after drain.
+out="$(JAX_PLATFORMS=cpu python bench_serving.py --quick --qos-sweep)"
+check_json "$out"
 # Model-parallel serving: the marker fires when greedy tokens differ
 # across tp=1/2/4 mesh shapes at equal total pool bytes (including
 # shared-prefix block sharing + CoW and the int8 scale-carrying leg),

@@ -38,6 +38,10 @@ SUBSYSTEMS = ("serving", "gateway", "operator", "scheduler", "train",
 LABEL_VOCAB = frozenset({
     "kind", "route", "queue", "pool", "reason", "role", "model",
     "code", "status", "service", "replica", "rule", "stage",
+    # Multi-tenant QoS: label VALUES are hash-bucketed tenant ids
+    # (serving/qos.py:tenant_bucket — a bounded t00..tNN set), never
+    # raw client-supplied tenant strings.
+    "tenant",
 })
 
 _NAME_RE = re.compile(r"^[a-z][a-z0-9]*(_[a-z0-9]+)+$")

@@ -59,8 +59,33 @@ def inference_service_crd() -> dict:
         "type": "object",
         "properties": {
             "tpShards": {"type": "integer", "minimum": 1},
+            # Host-RAM KV tier budget (bytes): declared explicitly so
+            # operators sizing pod memory see it in the schema — the
+            # tier's bytes come out of the pod's RAM, not HBM.
+            "hostKvBytes": {"type": "integer", "minimum": 0},
         },
         "x-kubernetes-preserve-unknown-fields": True,
+    }
+    # Multi-tenant QoS: per-tenant weights/rates threaded to the model
+    # server's fair-share pop loop AND to the gateway route's shedding
+    # buckets.
+    tenant_schema = {
+        "type": "object",
+        "properties": {
+            "weight": {"type": "number", "minimum": 0},
+            "rate": {"type": "number", "minimum": 0},
+            "burst": {"type": "number", "minimum": 0},
+            "priority": {"type": "integer"},
+        },
+    }
+    qos_schema = {
+        "type": "object",
+        "properties": {
+            "agingSeconds": {"type": "number", "minimum": 0},
+            "tenants": {"type": "object",
+                        "additionalProperties": tenant_schema},
+            "default": tenant_schema,
+        },
     }
     # Per-role pool overrides for disaggregated prefill/decode serving:
     # each role gets its own replica range and engine overrides (merged
@@ -110,6 +135,7 @@ def inference_service_crd() -> dict:
                             for role in INFERENCE_ROLES
                         },
                     },
+                    "qos": qos_schema,
                     "autoscale": {"type": "object",
                                   "properties": autoscale_props},
                 },
@@ -160,6 +186,7 @@ def inference_service(
     pressure: int = 8,
     kv_pressure: float = 0.0,
     roles: dict | None = None,
+    qos: dict | None = None,
     autoscale: dict | None = None,
 ) -> dict:
     """Build an InferenceService CR. ``engine`` maps tpu-serving param
@@ -168,7 +195,10 @@ def inference_service(
     disaggregated prefill/decode pools: ``{"prefill": {"replicas": 2,
     "engine": {...}}, "decode": {...}}`` — each pool autoscaled on the
     signal that binds it. ``kv_pressure`` (0 disables) lets the gateway
-    spill affine picks off a backend whose KV pool fill crosses it."""
+    spill affine picks off a backend whose KV pool fill crosses it.
+    ``qos`` ({tenants: {name: {weight, rate, burst, priority}},
+    agingSeconds, default}) turns on multi-tenant fair-share admission
+    in every replica and 429 shedding at the gateway route."""
     if roles:
         bad = set(roles) - set(INFERENCE_ROLES)
         if bad:
@@ -187,6 +217,8 @@ def inference_service(
     }
     if roles:
         spec["roles"] = {r: dict(v) for r, v in roles.items()}
+    if qos:
+        spec["qos"] = dict(qos)
     if model_path:
         spec["modelPath"] = model_path
     if image:

@@ -22,6 +22,7 @@ from __future__ import annotations
 import logging
 import random
 import threading
+import time
 import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -148,6 +149,13 @@ class Gateway:
         # Disaggregated two-hop relay counters (prefill_backends routes).
         self.handoffs_total = 0
         self.handoff_failures = 0
+        # Per-tenant overload shedding (routes carrying a qos spec):
+        # token buckets keyed (route, tenant), and how many requests
+        # were answered 429 + Retry-After instead of queued into a
+        # collapsing upstream.
+        self.qos_shed_total = 0
+        self._qos_buckets: dict = {}
+        self._qos_lock = threading.Lock()
         # Shared observability registry (served on the admin /metrics):
         # per-route upstream latency distributions — the signal a
         # metric-driven autoscaler reads per backend pool.
@@ -171,6 +179,25 @@ class Gateway:
         return (self.retries_total + 1) <= self.retry_budget * max(
             self.requests_total, 1
         )
+
+    def qos_admit(self, route, tenant: str) -> tuple[bool, float]:
+        """Token-bucket admission for one request on a qos-carrying
+        route: (admitted, retry_after_s). Buckets refill continuously
+        at the route's per-tenant rate; an unknown tenant gets its own
+        bucket at the route default (so one abusive id cannot drain a
+        shared bucket for everyone else)."""
+        from kubeflow_tpu.serving.qos import TokenBucket
+
+        rate, burst = route.qos_for(tenant)
+        if rate <= 0:
+            return True, 0.0
+        now = time.monotonic()
+        with self._qos_lock:
+            bucket = self._qos_buckets.get((route.name, tenant))
+            if bucket is None:
+                bucket = self._qos_buckets[(route.name, tenant)] = \
+                    TokenBucket(rate, burst, now)
+            return bucket.try_take(now)
 
     # -- auth ---------------------------------------------------------------
 

@@ -162,6 +162,41 @@ def make_proxy_handler(gw):
                                      "login": "/login"}).encode(),
                 )
                 return
+            # Overload shedding (multi-tenant QoS routes): an over-rate
+            # tenant — or a fully saturated upstream pool — answers 429
+            # + Retry-After HERE, before any upstream work, so overload
+            # degrades to fast, actionable backpressure instead of a
+            # queue collapsing behind the gateway. The tenant is the
+            # X-Tenant header, else the authenticated identity, else
+            # one implicit tenant.
+            if route.qos_active:
+                tenant = (self.headers.get("X-Tenant")
+                          or self._identity or "default")
+                ok, retry_after = gw.qos_admit(route, tenant)
+                if not ok:
+                    gw.qos_shed_total += 1
+                    self._respond(429, json.dumps(
+                        {"error": f"tenant {tenant!r} over admission "
+                                  f"rate"}).encode(),
+                        {"Retry-After":
+                         str(max(1, int(retry_after + 0.999)))})
+                    self.close_connection = True  # unread body desyncs
+                    return
+                if route.pressure > 0 and route.backends:
+                    healthy = gw.health.filter_healthy(
+                        [b[0] for b in route.backends])
+                    if healthy and all(gw.load.depth(s) >= route.pressure
+                                       for s in healthy):
+                        # Every healthy backend is at its in-flight
+                        # bound: queuing more here only stretches every
+                        # tenant's tail. Retry-After 1s — depth drains
+                        # on token timescales, not bucket refills.
+                        gw.qos_shed_total += 1
+                        self._respond(429, json.dumps(
+                            {"error": "upstream pool saturated"}
+                        ).encode(), {"Retry-After": "1"})
+                        self.close_connection = True
+                        return
             # Prefix-affine routes hash the request BODY (the prompt's
             # leading tokens), so it must be read before the pick — the
             # other strategies keep the lazy read in _proxy_http.

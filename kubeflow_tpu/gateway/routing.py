@@ -74,6 +74,27 @@ class Route:
     # exempt (the per-route face of iap.libsonnet:600's bypass_jwt),
     # "required" = bearer token only, no session fallback.
     jwt: str = ""
+    # Per-tenant overload shedding: ((tenant, rate, burst), ...) token
+    # buckets — a request whose tenant (X-Tenant header, else the
+    # authenticated identity, else "default") is over rate answers 429
+    # with a computed Retry-After at the GATEWAY, before any upstream
+    # work. qos_default_rate/burst cover tenants without their own
+    # entry (0 = unlimited). Bucket state lives on the Gateway, keyed
+    # (route, tenant).
+    qos_tenants: tuple = ()  # ((tenant, rate, burst), ...)
+    qos_default_rate: float = 0.0
+    qos_default_burst: float = 0.0
+
+    def qos_for(self, tenant: str) -> tuple[float, float]:
+        """(rate, burst) governing ``tenant`` on this route."""
+        for name, rate, burst in self.qos_tenants:
+            if name == tenant:
+                return rate, burst
+        return self.qos_default_rate, self.qos_default_burst
+
+    @property
+    def qos_active(self) -> bool:
+        return bool(self.qos_tenants) or self.qos_default_rate > 0
 
     def pick_service(self, rng) -> str:
         if not self.backends:
@@ -161,6 +182,21 @@ def routes_from_service(svc: dict) -> list[Route]:
             if jwt not in ("", "off", "required"):
                 raise ValueError(f"jwt must be 'off' or 'required', "
                                  f"got {jwt!r}")
+            qos = spec.get("qos", {}) or {}
+            qos_tenants = tuple(
+                (str(name),
+                 float((t or {}).get("rate", 0)),
+                 float((t or {}).get("burst", 0)))
+                for name, t in sorted(
+                    (qos.get("tenants", {}) or {}).items())
+            )
+            if any(r < 0 or b < 0 for _n, r, b in qos_tenants):
+                raise ValueError("qos rate/burst must be >= 0")
+            qos_default = qos.get("default", {}) or {}
+            qos_default_rate = float(qos_default.get("rate", 0))
+            qos_default_burst = float(qos_default.get("burst", 0))
+            if qos_default_rate < 0 or qos_default_burst < 0:
+                raise ValueError("qos default rate/burst must be >= 0")
             routes.append(Route(
                 jwt=jwt,
                 name=spec["name"], prefix=spec["prefix"],
@@ -172,6 +208,9 @@ def routes_from_service(svc: dict) -> list[Route]:
                 shadow=spec.get("shadow", ""),
                 outlier_threshold=outlier_threshold,
                 outlier_window=outlier_window,
+                qos_tenants=qos_tenants,
+                qos_default_rate=qos_default_rate,
+                qos_default_burst=qos_default_burst,
             ))
         except (KeyError, TypeError, ValueError) as e:
             log.warning("bad route spec in %s: %s",

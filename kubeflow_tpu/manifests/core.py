@@ -154,7 +154,8 @@ def gateway_route(name: str, prefix: str, service: str, rewrite: str = "/",
                   affinity_tokens: int | None = None,
                   pressure: int | None = None,
                   kv_pressure: float | None = None,
-                  prefill_backends: list | None = None) -> dict:
+                  prefill_backends: list | None = None,
+                  qos: dict | None = None) -> dict:
     """Gateway route annotation for a Service — the platform-wide analogue of
     the `getambassador.io/config` annotations the reference attaches to every
     web-app Service (kubeflow/common/ambassador.libsonnet route pattern). The
@@ -197,6 +198,12 @@ def gateway_route(name: str, prefix: str, service: str, rewrite: str = "/",
         # the affine prefill backend here, it pushes prompt KV to the
         # decode backend, then the predict relays to `backends`.
         spec["prefill_backends"] = prefill_backends
+    if qos:
+        # Per-tenant overload shedding at the gateway:
+        # {tenants: {name: {rate, burst}}, default: {rate, burst}} —
+        # over-rate requests answer 429 + Retry-After before any
+        # upstream work.
+        spec["qos"] = qos
     return {
         GATEWAY_ROUTE_ANNOTATION: yaml.safe_dump(spec, sort_keys=True)
     }

@@ -58,6 +58,20 @@ from kubeflow_tpu.version import DEFAULT_NAMESPACE
                   "decode pool and prompts ride the two-hop KV handoff"),
         ParamSpec("prefill_max_replicas", 0,
                   "prefill-pool autoscaler ceiling (0 = max_replicas)"),
+        ParamSpec("host_kv_bytes", 0,
+                  "host-RAM KV tier budget per replica in bytes "
+                  "(spec.engine.hostKvBytes; 0 disables): evictions "
+                  "demote KV blocks to host memory, misses re-import "
+                  "them, QoS suspensions park live streams there"),
+        ParamSpec("qos_tenants", "",
+                  "multi-tenant QoS spec 'name=weight[:rate[:burst"
+                  "[:priority]]]' comma-separated (spec.qos.tenants; "
+                  "empty disables): fair-share admission in every "
+                  "replica + per-tenant 429 shedding at the gateway "
+                  "route"),
+        ParamSpec("qos_aging_s", 30.0,
+                  "seconds of queue wait worth one priority point "
+                  "(spec.qos.agingSeconds)"),
         ParamSpec("queue_wait_p99_ms", 500.0,
                   "scale-up breach threshold on the queue-wait p99 "
                   "(prefill pool in a role split)"),
@@ -93,6 +107,9 @@ def inference_service_proto(
     kv_pressure: float,
     prefill_replicas: int,
     prefill_max_replicas: int,
+    host_kv_bytes: int,
+    qos_tenants: str,
+    qos_aging_s: float,
     queue_wait_p99_ms: float,
     ttft_p99_ms: float,
     inter_token_p99_ms: float,
@@ -115,7 +132,26 @@ def inference_service_proto(
             },
             "decode": {"replicas": int(replicas)},
         }
-    engine = {"tpShards": int(tp_shards)} if tp_shards > 1 else None
+    engine = {}
+    if tp_shards > 1:
+        engine["tpShards"] = int(tp_shards)
+    if host_kv_bytes > 0:
+        # The tier rides the paged block pool; pin the layout so a
+        # hand-rendered CR can't silently ask for an impossible tier.
+        engine["hostKvBytes"] = int(host_kv_bytes)
+        engine.setdefault("kv_layout", "paged")
+    qos = None
+    if qos_tenants:
+        from kubeflow_tpu.serving.qos import parse_tenants
+
+        qos = {
+            "agingSeconds": float(qos_aging_s),
+            "tenants": {
+                t.name: {"weight": t.weight, "rate": t.rate,
+                         "burst": t.burst, "priority": t.priority}
+                for t in parse_tenants(qos_tenants).values()
+            },
+        }
     cr = inference_service(
         name, namespace, model or name,
         model_path=model_path,
@@ -123,11 +159,12 @@ def inference_service_proto(
         min_replicas=min_replicas,
         max_replicas=max_replicas,
         tpu_chips_per_replica=num_tpu_chips,
-        engine=engine,
+        engine=engine or None,
         affinity_tokens=affinity_tokens,
         pressure=pressure,
         kv_pressure=kv_pressure,
         roles=roles,
+        qos=qos,
         autoscale={
             "queueWaitP99Ms": float(queue_wait_p99_ms),
             "ttftP99Ms": float(ttft_p99_ms),

@@ -74,6 +74,19 @@ REST_PORT = 8500
         ParamSpec("kv_fused_attention", False,
                   "fuse the paged decode read into the block-table "
                   "attention kernel (no dense KV gather per step)"),
+        ParamSpec("host_kv_bytes", 0,
+                  "host-RAM KV tier budget in bytes (paged layout; 0 "
+                  "disables): evictions demote blocks to host memory, "
+                  "misses re-import them, QoS suspensions park live "
+                  "streams' KV there — size the pod's memory request "
+                  "to cover it"),
+        ParamSpec("qos_tenants", "",
+                  "multi-tenant QoS: 'name=weight[:rate[:burst"
+                  "[:priority]]]' comma-separated (empty disables); "
+                  "requests carry X-Tenant/X-Priority/X-Deadline-Ms"),
+        ParamSpec("qos_aging_s", 30.0,
+                  "seconds of queue wait worth one priority point "
+                  "(starvation aging)"),
         ParamSpec("enable_prometheus", True),
         ParamSpec("dtype", "bfloat16"),
     ],
@@ -100,6 +113,9 @@ def tpu_serving(
     serving_role: str,
     tp_shards: int,
     kv_fused_attention: bool,
+    host_kv_bytes: int,
+    qos_tenants: str,
+    qos_aging_s: float,
     enable_prometheus: bool,
     dtype: str,
 ) -> list[dict]:
@@ -129,6 +145,11 @@ def tpu_serving(
         args.insert(-1, f"--serving-role={serving_role}")
     if kv_fused_attention:
         args.insert(-1, "--kv-fused-attention")
+    if host_kv_bytes:
+        args.insert(-1, f"--host-kv-bytes={host_kv_bytes}")
+    if qos_tenants:
+        args.insert(-1, f"--qos-tenants={qos_tenants}")
+        args.insert(-1, f"--qos-aging-s={qos_aging_s}")
     if enable_prometheus:
         args.append("--enable-prometheus")
     pod_annotations = (

@@ -562,11 +562,14 @@ def admit_prefix_and_step(state, params, cfg: TransformerConfig, slot, pool,
 
 @functools.partial(jax.jit, donate_argnames=("state",))
 def retire_row(state, slot):
-    """Host-initiated early stop (EOS): clear ``active`` and park the row's
-    write position at ``total`` so the next ``decode_step`` neither samples
-    for it nor lands its cache scatter (out-of-bounds scatter updates are
-    dropped). ``insert_row`` resets ``length`` on readmission."""
-    total = state["cache"]["k"].shape[2]
+    """Host-initiated early stop (EOS, or a QoS suspension): clear
+    ``active`` and park the row's write position at ``total`` so the next
+    ``decode_step`` neither samples for it nor lands its cache scatter
+    (out-of-bounds scatter updates are dropped — same parking the fused
+    EOS path uses on device). Works on either KV layout via
+    :func:`_state_kv`; ``insert_row``/admission resets ``length`` on
+    readmission."""
+    total = _state_kv(state)[3]
     return {**state,
             "active": state["active"].at[slot].set(False),
             "length": state["length"].at[slot].set(total)}

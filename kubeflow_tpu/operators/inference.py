@@ -67,7 +67,26 @@ ROLE_SIGNALS = {
 # the CRD surface is camelCase (tpShards), the prototype params are the
 # CLI flag names (tp_shards). Normalized once at pool-spec time so the
 # role-override merge and the replica render both see one spelling.
-_ENGINE_KEY_ALIASES = {"tpShards": "tp_shards"}
+_ENGINE_KEY_ALIASES = {"tpShards": "tp_shards",
+                       "hostKvBytes": "host_kv_bytes"}
+
+
+def _qos_params(spec: dict) -> dict:
+    """spec.qos -> tpu-serving params: the structured per-tenant
+    weights/rates serialize to the flat --qos-tenants string every
+    replica's pop loop parses (one policy, N replicas)."""
+    qos = spec.get("qos") or {}
+    tenants = dict(qos.get("tenants") or {})
+    if qos.get("default"):
+        tenants.setdefault("default", qos["default"])
+    if not tenants:
+        return {}
+    from kubeflow_tpu.serving.qos import render_tenants
+
+    params = {"qos_tenants": render_tenants(tenants)}
+    if qos.get("agingSeconds") is not None:
+        params["qos_aging_s"] = float(qos["agingSeconds"])
+    return params
 
 
 def _normalize_engine(engine: dict | None) -> dict:
@@ -376,6 +395,9 @@ class InferenceServiceController(Controller):
             "model_name": spec.get("model", name),
             "replicas": 1,
             "num_tpu_chips": chips,
+            # spec.qos reaches every pool's replicas (an engine-level
+            # qos_tenants override still wins via **eng below).
+            **_qos_params(spec),
             **eng,
         }
         if spec.get("image"):
@@ -450,6 +472,23 @@ class InferenceServiceController(Controller):
             for i in range(desired_by.get("prefill", 0))
         ] if "prefill" in desired_by else None
         kv_pressure = router_cfg.get("kvPressure")
+        # spec.qos also arms the GATEWAY's per-tenant shedding buckets
+        # on this route (rate/burst only — fair-share weights live in
+        # the replicas' pop loops).
+        qos_spec = svc.get("spec", {}).get("qos") or {}
+        route_qos = None
+        if qos_spec.get("tenants") or qos_spec.get("default"):
+            route_qos = {}
+            if qos_spec.get("tenants"):
+                route_qos["tenants"] = {
+                    str(t): {"rate": float((v or {}).get("rate", 0)),
+                             "burst": float((v or {}).get("burst", 0))}
+                    for t, v in qos_spec["tenants"].items()}
+            if qos_spec.get("default"):
+                d = qos_spec["default"]
+                route_qos["default"] = {
+                    "rate": float(d.get("rate", 0)),
+                    "burst": float(d.get("burst", 0))}
         annotations = gateway_route(
             f"{name}-pool", f"/models/{name}/", backends[0]["service"],
             backends=backends, strategy="prefix-affine",
@@ -458,6 +497,7 @@ class InferenceServiceController(Controller):
             kv_pressure=(float(kv_pressure)
                          if kv_pressure is not None else None),
             prefill_backends=prefill_backends,
+            qos=route_qos,
         )
         router = k8s.service(
             name, ns, selector={},

@@ -10,14 +10,6 @@ preemption (ROADMAP item 2, in the spirit of Gavel — PAPERS.md).
   priority preemption riding the gang-coordinated SIGTERM checkpoint.
 """
 
-from kubeflow_tpu.scheduler.capacity import (
-    ClusterCapacity,
-    Slice,
-    ThroughputBook,
-)
-from kubeflow_tpu.scheduler.controller import SchedulerController
-from kubeflow_tpu.scheduler.queue import QueueEntry, order_queue
-
 __all__ = [
     "ClusterCapacity",
     "Slice",
@@ -26,3 +18,24 @@ __all__ = [
     "QueueEntry",
     "order_queue",
 ]
+
+# Lazy attribute resolution (PEP 562): the serving QoS layer imports
+# kubeflow_tpu.scheduler.queue for the shared fair-share/aging policy,
+# and that import must not drag the controller's k8s/operator stack
+# into the model-server process.
+_HOMES = {
+    "ClusterCapacity": "capacity", "Slice": "capacity",
+    "ThroughputBook": "capacity",
+    "SchedulerController": "controller",
+    "QueueEntry": "queue", "order_queue": "queue",
+}
+
+
+def __getattr__(name: str):
+    if name in _HOMES:
+        import importlib
+
+        mod = importlib.import_module(
+            f"kubeflow_tpu.scheduler.{_HOMES[name]}")
+        return getattr(mod, name)
+    raise AttributeError(name)

@@ -24,6 +24,29 @@ def parse_time(ts: str) -> datetime.datetime:
     return datetime.datetime.fromisoformat(ts.replace("Z", "+00:00"))
 
 
+def aged_priority(priority: float, waited_seconds: float,
+                  aging_seconds: float) -> float:
+    """Effective priority after starvation aging: every
+    ``aging_seconds`` of wait is worth one priority point, so a
+    low-priority entry behind a stream of high-priority arrivals is
+    eventually first in line. ``aging_seconds <= 0`` disables aging.
+
+    Pure float math (no datetimes, no k8s imports) so the SAME policy
+    serves the cluster scheduler's gang queue and the serving QoS
+    admission queue (serving/qos.py) — one aging rule, two consumers.
+    """
+    if aging_seconds <= 0:
+        return float(priority)
+    return float(priority) + max(waited_seconds, 0.0) / aging_seconds
+
+
+def fairness_ratio(used_share: float, weight: float) -> float:
+    """Weighted-fair ordering key: the queue/tenant with the LOWEST
+    used-share/weight ratio goes first (Gavel's fairness round), so
+    service converges to the configured weights under backlog."""
+    return float(used_share) / max(float(weight), 1e-9)
+
+
 @dataclass
 class QueueEntry:
     """One queued (unplaced) gang."""
@@ -41,10 +64,8 @@ class QueueEntry:
 
     def effective_priority(self, now: datetime.datetime,
                            aging_seconds: float) -> float:
-        if aging_seconds <= 0:
-            return float(self.priority)
-        waited = max((now - self.queued_at).total_seconds(), 0.0)
-        return self.priority + waited / aging_seconds
+        waited = (now - self.queued_at).total_seconds()
+        return aged_priority(self.priority, waited, aging_seconds)
 
 
 def order_queue(entries: list[QueueEntry], now: datetime.datetime, *,
@@ -59,8 +80,8 @@ def order_queue(entries: list[QueueEntry], now: datetime.datetime, *,
     capacity may still reach them once eligible)."""
 
     def fairness(entry: QueueEntry) -> float:
-        weight = float(queue_weights.get(entry.queue, 1.0))
-        return used_share.get(entry.queue, 0.0) / max(weight, 1e-9)
+        return fairness_ratio(used_share.get(entry.queue, 0.0),
+                              queue_weights.get(entry.queue, 1.0))
 
     def sort_key(entry: QueueEntry):
         backoff = (entry.eligible_at is not None

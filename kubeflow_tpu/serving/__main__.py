@@ -96,6 +96,24 @@ def main(argv=None) -> int:
                         "pool sharded over the KV-head axis; must "
                         "divide the model's kv heads / heads / d_ff "
                         "and the pod needs that many chips")
+    p.add_argument("--host-kv-bytes", type=int, default=0,
+                   help="host-RAM KV tier budget in bytes (paged "
+                        "layout; 0 disables): prefix evictions demote "
+                        "blocks to host memory instead of freeing, "
+                        "misses re-import them (second-chance cache), "
+                        "and QoS suspensions park live streams' KV "
+                        "there until resume")
+    p.add_argument("--qos-tenants", default="",
+                   help="multi-tenant QoS spec: 'name=weight[:rate"
+                        "[:burst[:priority]]]' comma-separated (empty "
+                        "disables QoS); requests carry X-Tenant/"
+                        "X-Priority/X-Deadline-Ms headers, buckets "
+                        "answer 429 + Retry-After, the pop loop "
+                        "orders by weighted fair share + aged "
+                        "priority")
+    p.add_argument("--qos-aging-s", type=float, default=30.0,
+                   help="seconds of queue wait worth one priority "
+                        "point (starvation aging; <=0 disables)")
     p.add_argument("--stream-timeout-s", type=float, default=60.0,
                    help="default wait for generation results/streams; "
                         "raise under heavy load so memory-deferred "
@@ -139,6 +157,24 @@ def main(argv=None) -> int:
         p.error("--serving-role requires --kv-layout=paged")
     if args.tp_shards < 1:
         p.error("--tp-shards must be >= 1")
+    if args.host_kv_bytes < 0:
+        p.error("--host-kv-bytes must be >= 0")
+    if args.host_kv_bytes and args.kv_layout != "paged":
+        # The tier stores exported BLOCK payloads; dense rows have no
+        # blocks to demote or re-import.
+        p.error("--host-kv-bytes requires --kv-layout=paged")
+    if args.qos_tenants:
+        if args.decode_mode != "continuous":
+            # QoS ordering lives in the continuous pop loop; silently
+            # ignoring the flag would serve FIFO while the operator
+            # believes fair-share is on.
+            p.error("--qos-tenants requires --decode-mode=continuous")
+        from kubeflow_tpu.serving.qos import parse_tenants
+
+        try:
+            parse_tenants(args.qos_tenants)
+        except ValueError as e:
+            p.error(f"--qos-tenants: {e}")
     if args.tp_shards > 1 and args.decode_mode != "continuous":
         # Only the continuous decoder builds the tensor mesh; silently
         # ignoring the flag would report single-chip numbers as
@@ -183,6 +219,9 @@ def main(argv=None) -> int:
             stream_timeout_s=args.stream_timeout_s,
             serving_role=args.serving_role,
             tp_shards=args.tp_shards,
+            host_kv_bytes=args.host_kv_bytes,
+            qos_tenants=args.qos_tenants,
+            qos_aging_s=args.qos_aging_s,
             dtype=args.dtype,
         ),
         port=args.rest_port,

@@ -34,6 +34,19 @@ rejection-resample so their distribution is unchanged. Per-slot draft
 length auto-tunes (shrinks while a row's drafts keep missing, recovers
 on clean sweeps), and accept/draft counters land in :meth:`metrics`.
 
+Two multi-tenant levers ride the paged pool (both off by default):
+a **host-RAM KV tier** (``host_kv_bytes``, serving/kv_tier.py) that
+demotes evicted prefix blocks to host memory instead of freeing them
+outright — a later trie miss re-imports them through the ordinary
+prefix-hit admission, so the effective pool rises past HBM at equal
+device bytes — and **QoS admission** (``qos``, serving/qos.py):
+per-tenant token buckets at submit, weighted-fair + priority + aging
+ordering of the pending queue, deadline shedding, and — under
+low-watermark pressure — SUSPENSION of the lowest-priority live stream
+(export its KV to the host tier, free its slot and blocks, park the
+request) instead of deferring the whole queue; the parked stream
+resumes byte-identically through the same prefix-hit admission.
+
 Tokens surface through per-request queues as each step's sample lands —
 the REST server streams them as JSON lines over chunked transfer-encoding
 and gRPC as a server-streaming method. The reference serves generation
@@ -68,6 +81,7 @@ from kubeflow_tpu.models.decode import (
     paged_admit_prefix_and_step,
     paged_admit_rows_and_step,
     prefill,
+    retire_row,
     shard_decode_state,
     store_blocks,
     store_prefix_cache,
@@ -81,7 +95,15 @@ from kubeflow_tpu.serving.kv_allocator import (
     BlockAllocator,
     kv_bytes_per_token,
 )
+from kubeflow_tpu.serving.kv_tier import HostKvTier
 from kubeflow_tpu.serving.prefix_cache import PrefixCache
+from kubeflow_tpu.serving.qos import (
+    DEFAULT_TENANT,
+    DeadlineExceeded,
+    QosPolicy,
+    order_key,
+    tenant_bucket,
+)
 from kubeflow_tpu.serving.speculative import make_proposer
 
 _DONE = object()
@@ -120,6 +142,29 @@ class _Request:
     request_id: str = ""
     timeline: object | None = None
     last_emit_t: float | None = None
+    # QoS: owning tenant, base priority (tenant default unless the
+    # request carried its own), and an absolute shed deadline (None =
+    # never shed). ``defer_rounds`` counts rounds this request sat at
+    # the head of admission blocked on memory — the HoL-bypass aging
+    # counter. ``host_key`` is set while the stream is SUSPENDED: the
+    # pinned host-tier entry its resume re-imports.
+    tenant: str = DEFAULT_TENANT
+    priority: int = 0
+    deadline_t: float | None = None
+    defer_rounds: int = 0
+    host_key: tuple | None = None
+    # Emitted tokens already folded into ``tokens`` by an earlier
+    # suspension — a later suspension must append only out[folded:],
+    # never double-count the first park's fold.
+    folded: int = 0
+
+    @property
+    def want_left(self) -> int:
+        """Tokens still owed. Equals ``want`` for a fresh request; a
+        resumed (previously suspended) request already emitted
+        ``len(out)`` of its budget, and the device row must only be
+        armed for the remainder."""
+        return max(self.want - len(self.out), 0)
 
     def resolve_prefill_logits(self) -> np.ndarray | None:
         if self.prefill_logits is None and self.prefill_src is not None:
@@ -206,7 +251,11 @@ class ContinuousDecoder:
                  kv_low_watermark: int = 0, kv_dtype: str = "fp",
                  kv_fused: bool = False,
                  stream_timeout_s: float = 60.0,
-                 role: str = "", tp_shards: int = 1):
+                 role: str = "", tp_shards: int = 1,
+                 qos: QosPolicy | None = None,
+                 host_kv_bytes: int = 0,
+                 hol_bypass_limit: int = 4,
+                 hol_shield_rounds: int = 8):
         # Tensor-parallel serving: tp_shards > 1 runs THIS replica's
         # decode executables over a tp-wide tensor mesh — weights carry
         # the Megatron column/row split from the model's partition
@@ -377,6 +426,31 @@ class ContinuousDecoder:
         # tensor mesh; the gather path partitions under plain GSPMD.
         self._kmesh = self.mesh if self.kv_fused else None
         self.kv_low_watermark = max(0, int(kv_low_watermark))
+        # Multi-tenant QoS: token-bucket admission at submit, weighted-
+        # fair/priority/aging ordering of the pending queue, deadline
+        # shedding, and suspension of low-priority live streams under
+        # memory pressure (requires the host tier below to park KV).
+        self.qos = qos
+        # Host-RAM KV tier (HBM -> host): trie evictions demote their
+        # blocks here instead of freeing outright, trie misses probe it
+        # before cold prefill, and suspended streams pin their exported
+        # KV here until resume. 0 disables.
+        if host_kv_bytes and kv_layout != "paged":
+            raise ValueError("host_kv_bytes requires kv_layout='paged'")
+        self._host_tier = (HostKvTier(int(host_kv_bytes))
+                           if host_kv_bytes else None)
+        # Host-global bytes one tiered token costs (the tier holds the
+        # gathered, unsharded payload even under tp).
+        self._host_bytes_per_token = (
+            kv_bytes_per_token(cfg.n_layers, cfg.n_kv_heads, cfg.head_dim,
+                               jnp.dtype(cfg.dtype).itemsize, kv_dtype)
+            if self._alloc is not None else 0)
+        # Head-of-line bypass: how many memory-blocked candidates a
+        # round may skip past looking for a smaller request that fits,
+        # and how many blocked rounds age a head into an unskippable
+        # shield (so bypass can never starve the big request).
+        self.hol_bypass_limit = max(0, int(hol_bypass_limit))
+        self.hol_shield_rounds = max(1, int(hol_shield_rounds))
         # Serializes device access to self._state between the scheduler
         # thread and caller-thread prime_prefix (which, in paged mode,
         # writes primed blocks into the SHARED pool — the jitted calls
@@ -416,6 +490,16 @@ class ContinuousDecoder:
         self.kv_handoff_exports = 0   # prompts exported to a decode peer
         self.kv_handoff_imports = 0   # prompts imported from a prefill peer
         self.kv_handoff_tokens = 0    # prefix tokens that rode a handoff
+        # Tiered-KV / QoS counters (zero when the features are off).
+        self.kv_suspends = 0          # live streams parked to the host tier
+        self.kv_resumes = 0           # parked streams re-admitted
+        self.kv_host_hits = 0         # trie misses served by the host tier
+        self.qos_deadline_shed = 0    # requests shed past their deadline
+        self.hol_bypasses = 0         # admissions that jumped a blocked head
+        # Decode service per tenant (tokens emitted) — the weighted-fair
+        # ordering's used-share input. Guarded by _mlock with the other
+        # counters.
+        self._tenant_served: dict[str, float] = {}
         self.kv_blocks_peak = 0      # high-water blocks_in_use
         self.peak_in_flight = 0      # high-water concurrent requests
         # Counter mutations and metrics() reads go through this lock so
@@ -437,6 +521,13 @@ class ContinuousDecoder:
         self._h_queue_wait = self.registry.histogram(
             "serving_queue_wait_seconds",
             "Submit to slot admission (includes memory deferrals)")
+        # Per-tenant queue wait: tenant ids are hash-bucketed into a
+        # BOUNDED label set (qos.tenant_bucket) — raw ids are
+        # client-controlled and would explode exposition cardinality.
+        self._h_tenant_wait = self.registry.histogram(
+            "serving_tenant_queue_wait_seconds",
+            "Submit to slot admission, by hash-bucketed tenant",
+            labels=("tenant",))
         self._h_dispatch = self.registry.histogram(
             "serving_dispatch_seconds",
             "Device round-trip duration", labels=("kind",))
@@ -474,19 +565,40 @@ class ContinuousDecoder:
 
     def submit(self, tokens: list[int], max_new_tokens: int,
                temperature: float = 0.0, *,
-               request_id: str | None = None) -> StreamHandle:
+               request_id: str | None = None, tenant: str = "",
+               priority: int | None = None,
+               deadline_ms: float = 0.0) -> StreamHandle:
+        """``tenant``/``priority``/``deadline_ms`` are the QoS surface
+        (threaded from the gateway's X-Tenant/X-Priority/X-Deadline-Ms
+        headers). With a QoS policy configured, the tenant's token
+        bucket gates this call (raises
+        :class:`~kubeflow_tpu.serving.qos.QosRejected` -> HTTP 429 with
+        Retry-After), the pop loop orders by weighted fair share +
+        aged priority, and a request still queued past its deadline is
+        shed instead of served."""
+        if self.qos is not None:
+            # Raises QosRejected when the tenant's bucket is empty —
+            # BEFORE the request enters the queue, so overload degrades
+            # to fast 429s instead of queue collapse.
+            self.qos.admit(tenant, time.perf_counter())
         if len(tokens) > self.prefill_len:
             tokens = tokens[: self.prefill_len]
         req = _Request(tokens=list(tokens),
                        want=min(max_new_tokens, self.max_new_tokens),
                        temperature=float(temperature))
+        req.tenant = tenant or DEFAULT_TENANT
+        req.priority = (self.qos.base_priority(tenant, priority)
+                        if self.qos is not None else int(priority or 0))
+        if deadline_ms and deadline_ms > 0:
+            req.deadline_t = req.submit_t + float(deadline_ms) / 1e3
         # Lifecycle timeline, keyed by the propagated X-Request-ID (or a
         # fresh one): submit marks t=0, queued marks entry to the pending
         # deque — every later phase hangs off these two anchors.
         req.timeline = self.trace.start(request_id)
         req.request_id = req.timeline.request_id
         req.timeline.event("submit", prompt_tokens=len(req.tokens),
-                           want=req.want)
+                           want=req.want, tenant=req.tenant,
+                           priority=req.priority)
         with self._cv:
             if self._stopped:
                 req.timeline.close(error=RuntimeError("decoder is stopped"))
@@ -498,8 +610,9 @@ class ContinuousDecoder:
 
     def generate(self, tokens: list[int], max_new_tokens: int,
                  temperature: float = 0.0,
-                 timeout: float | None = None) -> dict:
-        return self.submit(tokens, max_new_tokens, temperature).result(timeout)
+                 timeout: float | None = None, **submit_kw) -> dict:
+        return self.submit(tokens, max_new_tokens, temperature,
+                           **submit_kw).result(timeout)
 
     def stop(self) -> None:
         with self._cv:
@@ -524,6 +637,13 @@ class ContinuousDecoder:
         # first finisher wins, later calls are no-ops.
         if req.done.is_set():
             return
+        # A suspended request dying (deadline shed, stop, loop death)
+        # must drain its pinned host-tier payload — pinned bytes are
+        # exempt from LRU pressure, so nothing else ever reclaims them.
+        if req.host_key is not None and self._host_tier is not None:
+            with self._prefix_lock:
+                self._host_tier.discard(req.host_key)
+            req.host_key = None
         req.error = error
         req.finish_reason = reason if error is None else "error"
         if req.timeline is not None:
@@ -537,11 +657,40 @@ class ContinuousDecoder:
     # -- paged-KV bookkeeping (no-ops in the dense layout) -------------
 
     def _drop_entry_blocks(self, entry) -> None:
-        """Prefix-trie eviction hook: release the entry's refcounted
-        blocks. Called by PrefixCache.remove() with the prefix lock
-        held — must not re-acquire it."""
+        """Prefix-trie eviction hook: DEMOTE the entry's blocks to the
+        host tier (HBM -> host, verbatim bytes), then release the
+        refcounted blocks. Called by PrefixCache.remove() with the
+        prefix lock held — must not re-acquire it."""
+        if self._host_tier is not None and entry.blocks:
+            self._demote_entry(entry)
         for b in (entry.blocks or ()):
             self._alloc.free(b)
+
+    def _demote_entry(self, entry) -> None:
+        """Export an evicted entry's blocks into the host tier so a
+        later miss gets a second chance instead of a cold prefill.
+        Runs under the prefix lock (the eviction path itself); the
+        export's device fetch MUST complete before the blocks return
+        to the free list below us, so this is the one spot the
+        eviction path pays a device round-trip — the price of
+        demoting instead of destroying."""
+        plen = min(len(entry.key), len(entry.blocks) * self.kv_block_size)
+        key = tuple(entry.key[:plen])
+        if plen < 1 or self._host_tier.has(key):
+            return
+        est = (self._alloc.blocks_for(plen) * self.kv_block_size
+               * self._host_bytes_per_token)
+        if not self._host_tier.can_fit(est):
+            return  # pinned suspensions own the budget; skip the copy
+        ids = list(entry.blocks[: self._alloc.blocks_for(plen)])
+        try:
+            payload = self._export_ids(ids)
+        except Exception:
+            # A dead/poisoned device state must not wedge the eviction
+            # path (the crash drain evicts the whole trie): losing the
+            # second-chance copy is fine, losing the free() is a leak.
+            return
+        self._host_tier.put(key, payload, plen)
 
     def _set_table_row(self, slot: int, blocks: list[int]) -> None:
         """Point ``slot``'s host block-table row at ``blocks`` (sentinel
@@ -610,7 +759,7 @@ class ContinuousDecoder:
             lengths[i] = max(len(req.tokens), 1)
             slots[i] = slot
             temps[i] = req.temperature
-            wants[i] = req.want
+            wants[i] = req.want_left
         # ONE admission executable per (batch, length) bucket: always the
         # fused variant (the extra decode step is ~free on device, and a
         # second plain-admit executable would surprise-compile
@@ -692,6 +841,14 @@ class ContinuousDecoder:
         or None (miss; any pin released)."""
         with self._prefix_lock:
             m = self.prefix_cache.match(req.tokens)
+        if m is None and self._host_tier is not None \
+                and self._alloc is not None:
+            # Second chance: a demoted (or suspended) prefix in the
+            # host tier re-imports onto device and the admission
+            # proceeds as an ordinary prefix hit.
+            if self._promote_host_prefix(req.tokens, req.timeline):
+                with self._prefix_lock:
+                    m = self.prefix_cache.match(req.tokens)
         if m is None:
             return None
         entry, plen = m
@@ -743,7 +900,8 @@ class ContinuousDecoder:
                 self._state, last, tok, emit = paged_admit_prefix_and_step(
                     self._state, self.params, self.cfg, jnp.int32(slot),
                     jnp.int32(prefix_len), jnp.asarray(toks),
-                    jnp.int32(len(req.tokens)), jnp.int32(req.want),
+                    jnp.int32(len(req.tokens)),
+                    jnp.int32(req.want_left),
                     jnp.float32(req.temperature), self.top_k, self.eos_id,
                     self.kv_fused, self._kmesh)
             with self._mlock:
@@ -758,7 +916,8 @@ class ContinuousDecoder:
                     self._state, self.params, self.cfg, jnp.int32(slot),
                     pool, jnp.int32(entry.slot), jnp.int32(prefix_len),
                     jnp.asarray(toks), jnp.int32(len(req.tokens)),
-                    jnp.int32(req.want), jnp.float32(req.temperature),
+                    jnp.int32(req.want_left),
+                    jnp.float32(req.temperature),
                     self.top_k, self.eos_id)
         req.pinned_prefix = entry
         with self._mlock:
@@ -1071,7 +1230,26 @@ class ContinuousDecoder:
             raise ValueError(
                 f"handoff payload carries {self._payload_nblk(payload)} "
                 f"blocks; prefix_len {plen} needs {nblk}")
-        key = tuple(toks[:plen])
+        imported = self._install_prefix_payload(tuple(toks[:plen]),
+                                                payload)
+        if imported:
+            with self._mlock:
+                self.kv_handoff_imports += 1
+                self.kv_handoff_tokens += plen
+        return imported
+
+    def _install_prefix_payload(self, key: tuple, payload: dict) -> bool:
+        """Allocate local blocks, scatter ``payload`` in VERBATIM, and
+        register ``key`` in the trie — the re-import core shared by the
+        peer handoff (:meth:`import_prompt`) and host-tier promotion
+        (:meth:`_promote_host_prefix`). Returns False when it cannot
+        land (no free blocks, every trie slot pinned)."""
+        cache = self.prefix_cache
+        nblk = self._alloc.blocks_for(len(key))
+        if self._payload_nblk(payload) != nblk:
+            raise ValueError(
+                f"payload carries {self._payload_nblk(payload)} blocks; "
+                f"prefix_len {len(key)} needs {nblk}")
         with self._prefix_lock:
             if cache.has(key):
                 cache.touch(key)
@@ -1127,24 +1305,213 @@ class ContinuousDecoder:
                 with self._mlock:
                     self.prefix_inserts += 1
                 imported = True
-        if imported:
-            with self._mlock:
-                self.kv_handoff_imports += 1
-                self.kv_handoff_tokens += plen
         return imported
+
+    def _promote_host_prefix(self, tokens: list[int],
+                             timeline=None) -> bool:
+        """Second-chance lookup: a trie miss probes the host tier for
+        the longest demoted prefix of ``tokens`` and re-imports it
+        through :meth:`_install_prefix_payload` — the admission then
+        rides the ordinary prefix-hit path instead of a cold prefill.
+        The payload stays in the tier (unpinned LRU): a later eviction
+        of the promoted entry skips the re-export."""
+        with self._prefix_lock:
+            m = self._host_tier.match(tokens)
+        if m is None:
+            return False
+        entry, depth = m
+        if (self.prefix_cache is None
+                or depth < self.prefix_cache.min_len):
+            return False
+        nblk = self._alloc.blocks_for(depth)
+
+        def _slice(node):
+            if isinstance(node, dict):
+                return {k: _slice(v) for k, v in node.items()}
+            return node[:, :nblk]
+
+        # Causality: the payload's leading blocks back ANY depth <= its
+        # own, so an interior match imports just the covering slice.
+        payload = {s: _slice(entry.payload[s]) for s in ("k", "v")}
+        if not self._install_prefix_payload(tuple(entry.key[:depth]),
+                                            payload):
+            return False
+        with self._prefix_lock:
+            self._host_tier.note_promotion()
+        with self._mlock:
+            self.kv_host_hits += 1
+        if timeline is not None:
+            timeline.event("promote", prefix_len=depth)
+        return True
+
+    # -- QoS: ordering, deadline shedding, stream suspension -----------
+
+    def _order_pending_locked(self, now: float) -> None:
+        """Re-order the pending deque by QoS policy (called under the
+        cv): weighted fair share across tenants (tokens served over
+        weight, lowest first), then priority with starvation aging,
+        then FIFO — the scheduler queue's ordering applied to
+        inference admission. The sort is stable, so equal keys keep
+        their arrival order."""
+        qos = self.qos
+        with self._mlock:
+            served = dict(self._tenant_served)
+        self._pending = deque(sorted(
+            self._pending,
+            key=lambda r: order_key(
+                served=served.get(r.tenant, 0.0),
+                weight=qos.spec(r.tenant).weight,
+                priority=r.priority,
+                waited_seconds=now - r.submit_t,
+                aging_seconds=qos.aging_seconds,
+                submit_t=r.submit_t)))
+
+    def _shed_expired_locked(self, now: float) -> None:
+        """Shed queued requests whose deadline already passed (under
+        the cv): decode compute spent on an answer nobody is waiting
+        for only starves the requests that still have time."""
+        expired = [r for r in self._pending
+                   if r.deadline_t is not None and now > r.deadline_t]
+        if not expired:
+            return
+        dead = {id(r) for r in expired}
+        self._pending = deque(r for r in self._pending
+                              if id(r) not in dead)
+        with self._mlock:
+            self.qos_deadline_shed += len(expired)
+        for r in expired:
+            if r.timeline is not None:
+                r.timeline.event("deadline_shed",
+                                 waited_ms=round(1e3 * (now - r.submit_t),
+                                                 3))
+            self._finish(r, error=DeadlineExceeded(
+                f"deadline passed after {now - r.submit_t:.3f}s in queue"))
+
+    def _pick_suspend_victim_locked(self, cand: _Request,
+                                    need: int) -> int:
+        """Choose a live stream to SUSPEND so the memory-blocked
+        ``cand`` can admit: the lowest-base-priority stream STRICTLY
+        below the candidate's base priority, whose exported KV fits
+        the host tier and whose blocks actually clear the candidate's
+        watermark. Base priorities on both sides deliberately: aging
+        orders the QUEUE (a starved request eventually pops first) but
+        must never drive preemption — an aged equal-priority candidate
+        suspending a peer would ping-pong streams of one tenant
+        through the host tier forever. Called with the cv AND prefix
+        lock held (it reads allocator and tier state). Returns -1 when
+        nothing qualifies — the round then defers exactly as before
+        QoS existed."""
+        if self.qos is None or self._host_tier is None:
+            return -1
+        victim, victim_p = -1, None
+        for slot in range(self.slots):
+            r = self._slot_req[slot]
+            if r is None or r.want_left <= 0:
+                continue
+            if len(r.tokens) + len(r.out) - r.folded < 2:
+                continue  # a 1-token sequence has no exportable prefix
+            if r.priority >= cand.priority:
+                continue
+            if victim_p is None or r.priority < victim_p:
+                victim, victim_p = slot, r.priority
+        if victim < 0:
+            return -1
+        r = self._slot_req[victim]
+        plen = len(r.tokens) + len(r.out) - r.folded - 1
+        est = (self._alloc.blocks_for(plen) * self.kv_block_size
+               * self._host_bytes_per_token)
+        if not self._host_tier.can_fit(est):
+            return -1  # suspension must never strand an unresumable stream
+        freed = len(self._slot_blocks[victim])
+        if self._alloc.free_blocks + freed - need < self.kv_low_watermark:
+            return -1  # even suspending wouldn't admit the candidate
+        return victim
+
+    def _suspend_stream(self, slot: int) -> None:
+        """Park the live stream in ``slot``: retire its device row,
+        export the KV backing its sequence-so-far into the host tier
+        (PINNED — resume byte-identity depends on those exact bytes),
+        free the slot and its blocks, and requeue the request. Resume
+        is the ordinary pop-loop admission: the parked request's
+        tokens now include everything it emitted, so it prefix-hits
+        the promoted payload and continues exactly where it stopped —
+        inference preemption as data-exact as the training
+        scheduler's. Runs on the scheduler thread with no locks held.
+        """
+        req = self._slot_req[slot]
+        if req is None:
+            return
+        seq = req.tokens + req.out[req.folded:]
+        plen = len(seq) - 1
+        ids = self._slot_blocks[slot][: self._alloc.blocks_for(plen)]
+        # Retire the row FIRST: its blocks return to the pool below,
+        # and a still-active row would scatter the next step's K/V
+        # through freed (possibly re-allocated) blocks — the PR-8
+        # stale-row hazard, parked the same way device-side EOS is.
+        with self._state_lock:
+            self._state = retire_row(self._state, slot)
+        payload = self._export_ids(ids)
+        key = tuple(seq[:plen])
+        with self._prefix_lock:
+            parked = self._host_tier.put(key, payload, plen, pinned=True)
+        self._slot_req[slot] = None
+        self._active_count -= 1
+        self._release_pin(req)
+        self._free_slot_blocks(slot)
+        if parked:
+            req.host_key = key
+        elif len(seq) > self.prefill_len:
+            # No host copy AND too long to re-prefill cold: the stream
+            # cannot resume. Unreachable while the victim pick checks
+            # can_fit, but never park an unresumable request.
+            self._finish(req, error=MemoryError(
+                "suspended stream lost its KV payload"))
+            return
+        req.tokens = seq
+        req.folded = len(req.out)
+        req.admit_plan = None
+        req.submit_t = time.perf_counter()  # queue wait re-anchors at park
+        if req.timeline is not None:
+            req.timeline.event("suspend", emitted=len(req.out),
+                               prefix_len=plen)
+        with self._mlock:
+            self.kv_suspends += 1
+        with self._cv:
+            if self._stopped:
+                self._finish(req, error=RuntimeError("decoder stopped"))
+                return
+            self._pending.append(req)
+            self._cv.notify()
 
     def _mark_admitted(self, req: _Request, slot: int) -> None:
         """Record the pop→slot transition: queue-wait histogram + the
         timeline's admitted event (deferral rounds stretch this wait —
-        exactly the signal the admission instrumentation must carry)."""
+        exactly the signal the admission instrumentation must carry).
+        A resumed (previously suspended) request re-anchors its wait at
+        park time, so the histograms measure the park, not the whole
+        stream lifetime."""
         wait = time.perf_counter() - req.submit_t
         self._h_queue_wait.observe(wait)
+        self._h_tenant_wait.labels(tenant_bucket(req.tenant)).observe(wait)
+        if req.out:
+            # Tokens already emitted == this is a suspended stream
+            # coming back; once admitted, its pinned host-tier payload
+            # becomes ordinary second-chance cache.
+            if req.host_key is not None and self._host_tier is not None:
+                with self._prefix_lock:
+                    self._host_tier.unpin(req.host_key)
+                req.host_key = None
+            with self._mlock:
+                self.kv_resumes += 1
+            if req.timeline is not None:
+                req.timeline.event("resume", emitted=len(req.out),
+                                   want_left=req.want_left)
         if req.timeline is not None:
             req.timeline.event("admitted", slot=slot,
                                wait_ms=round(1e3 * wait, 3))
 
     def _post_admit(self, req: _Request, slot: int) -> None:
-        if req.want == 0:
+        if req.want_left == 0:
             # Pure prefill (caller wants last-position logits only): the row
             # was inserted inactive; publish its prefix, then hand the
             # result back immediately.
@@ -1168,12 +1535,14 @@ class ContinuousDecoder:
         the host only finishes the request and frees the slot."""
         now = time.perf_counter()
         emitted_n, ttft_sum, ttft_n = 0, 0.0, 0
+        tenant_tok: dict[str, int] = {}
         for slot in range(self.slots):
             req = self._slot_req[slot]
             if req is None or not emitted[slot]:
                 continue
             tok = int(toks[slot])
             req.out.append(tok)
+            tenant_tok[req.tenant] = tenant_tok.get(req.tenant, 0) + 1
             if req.ttft_s is None:
                 req.ttft_s = now - req.submit_t
                 ttft_sum += req.ttft_s
@@ -1203,6 +1572,8 @@ class ContinuousDecoder:
             self.tokens_emitted += emitted_n
             self.ttft_sum += ttft_sum
             self.ttft_count += ttft_n
+            for t, n in tenant_tok.items():
+                self._tenant_served[t] = self._tenant_served.get(t, 0.0) + n
 
     def _dispatch_block(self, toks: np.ndarray, emitted: np.ndarray) -> None:
         """Route one verify step's tokens ([slots, K+1], ``emitted`` a
@@ -1211,6 +1582,7 @@ class ContinuousDecoder:
         budget and truncated at EOS, so the mask is trusted verbatim."""
         now = time.perf_counter()
         emitted_n, ttft_sum, ttft_n = 0, 0.0, 0
+        tenant_tok: dict[str, int] = {}
         for slot in range(self.slots):
             req = self._slot_req[slot]
             if req is None or not emitted[slot, 0]:
@@ -1234,6 +1606,8 @@ class ContinuousDecoder:
                 emitted_n += 1
                 row_emitted += 1
             if row_emitted:
+                tenant_tok[req.tenant] = (tenant_tok.get(req.tenant, 0)
+                                          + row_emitted)
                 if req.last_emit_t is not None:
                     self._h_itl.observe(now - req.last_emit_t)
                 req.last_emit_t = now
@@ -1251,6 +1625,8 @@ class ContinuousDecoder:
             self.tokens_emitted += emitted_n
             self.ttft_sum += ttft_sum
             self.ttft_count += ttft_n
+            for t, n in tenant_tok.items():
+                self._tenant_served[t] = self._tenant_served.get(t, 0.0) + n
 
     def _tune_slot(self, slot: int, accepted: int, drafted: int) -> None:
         """Shrink a slot's draft length while verification keeps throwing
@@ -1383,18 +1759,22 @@ class ContinuousDecoder:
                     self._cv.wait(timeout=0.5)
                 if self._stopped:
                     return
+                now = time.perf_counter()
+                self._shed_expired_locked(now)
+                if self.qos is not None and len(self._pending) > 1:
+                    self._order_pending_locked(now)
                 pending = []
                 deferred = False
-                for slot in range(self.slots):
-                    if not self._pending or deferred:
-                        break
-                    if self._slot_req[slot] is not None:
-                        continue
-                    if self._alloc is None:
+                suspend_slot = -1
+                free_slots = [s for s in range(self.slots)
+                              if self._slot_req[s] is None]
+                if self._alloc is None:
+                    while free_slots and self._pending:
                         req = self._pending.popleft()
+                        slot = free_slots.pop(0)
                         self._mark_admitted(req, slot)
                         pending.append((req, slot))
-                        continue
+                else:
                     # Memory-aware admission: a request enters only when
                     # its WORST-CASE block count fits the pool (so the
                     # stream can never OOM mid-decode), reserving the
@@ -1404,13 +1784,22 @@ class ContinuousDecoder:
                     # shrinks the reservation to the non-shared blocks.
                     # The low-watermark defers admission while other
                     # work is in flight instead of draining the pool to
-                    # zero headroom.
-                    while self._pending:
-                        req = self._pending[0]
+                    # zero headroom. Three QoS/fairness extensions ride
+                    # on top: candidates arrive in fair-share/priority
+                    # order; a memory-blocked head may be BYPASSED by
+                    # up to hol_bypass_limit later candidates that fit
+                    # (defer_rounds aging shields it from starving);
+                    # and when the blocked candidate outranks a live
+                    # stream, that stream is SUSPENDED to the host tier
+                    # instead of the whole queue deferring.
+                    idx = 0
+                    bypassed = 0
+                    while free_slots and idx < len(self._pending):
+                        req = self._pending[idx]
                         worst = self._alloc.blocks_for(
-                            max(len(req.tokens), 1) + req.want)
+                            max(len(req.tokens), 1) + req.want_left)
                         if worst > self._alloc.num_blocks:
-                            self._pending.popleft()
+                            del self._pending[idx]
                             self._finish(req, error=ValueError(
                                 f"request needs {worst} KV blocks but "
                                 f"the pool holds "
@@ -1421,45 +1810,76 @@ class ContinuousDecoder:
                         n_shared = (plan[1] // self.kv_block_size
                                     if plan is not None else 0)
                         need = worst - n_shared
+                        fits = True
+                        # A parked stream longer than the compiled
+                        # prompt shape can only resume through its
+                        # exported prefix — without a plan it waits for
+                        # the promote to find memory, never cold-
+                        # prefills a truncated sequence.
+                        resumable = (plan is not None
+                                     or len(req.tokens) <= self.prefill_len)
                         with self._prefix_lock:
                             self._reclaim_blocks(need, req.timeline)
                             headroom = self._alloc.free_blocks - need
                             busy = self._active_count > 0 or pending
-                            if headroom < (self.kv_low_watermark
-                                           if busy else 0):
+                            if (not resumable
+                                    or headroom < (self.kv_low_watermark
+                                                   if busy else 0)):
+                                fits = False
                                 if plan is not None:
                                     self.prefix_cache.release(plan[0])
-                                if req.timeline is not None:
-                                    req.timeline.event(
-                                        "deferred", need=need,
-                                        free=self._alloc.free_blocks)
-                                deferred = True
-                                break
-                            own = self._alloc.alloc(need)
-                            shared = (list(plan[0].blocks[:n_shared])
-                                      if plan is not None else [])
-                            for b in shared:
-                                self._alloc.share(b)
-                            self.kv_blocks_peak = max(
-                                self.kv_blocks_peak,
-                                self._alloc.blocks_in_use)
-                        req.admit_plan = plan
-                        blocks = shared + own
-                        self._slot_blocks[slot] = blocks
-                        # The TABLE row stays sentinel until this
-                        # request's own admission dispatch uploads it
-                        # (_admit_prefix/_admit_batch). Pointing it at
-                        # the blocks now would arm a stale-row write:
-                        # an earlier admission's fused decode step in
-                        # the SAME round still sees this slot's old
-                        # device length, and its unconditional K/V
-                        # scatter would land junk inside these blocks —
-                        # including refcount-SHARED prefix blocks other
-                        # streams read.
-                        self._pending.popleft()
-                        self._mark_admitted(req, slot)
-                        pending.append((req, slot))
-                        break
+                                if not deferred:
+                                    deferred = True
+                                    suspend_slot = \
+                                        self._pick_suspend_victim_locked(
+                                            req, need)
+                            else:
+                                own = self._alloc.alloc(need)
+                                shared = (list(plan[0].blocks[:n_shared])
+                                          if plan is not None else [])
+                                for b in shared:
+                                    self._alloc.share(b)
+                                self.kv_blocks_peak = max(
+                                    self.kv_blocks_peak,
+                                    self._alloc.blocks_in_use)
+                        if fits:
+                            req.admit_plan = plan
+                            req.defer_rounds = 0
+                            slot = free_slots.pop(0)
+                            self._slot_blocks[slot] = shared + own
+                            # The TABLE row stays sentinel until this
+                            # request's own admission dispatch uploads
+                            # it (_admit_prefix/_admit_batch). Pointing
+                            # it at the blocks now would arm a
+                            # stale-row write: an earlier admission's
+                            # fused decode step in the SAME round still
+                            # sees this slot's old device length, and
+                            # its unconditional K/V scatter would land
+                            # junk inside these blocks — including
+                            # refcount-SHARED prefix blocks other
+                            # streams read.
+                            del self._pending[idx]
+                            if bypassed:
+                                with self._mlock:
+                                    self.hol_bypasses += 1
+                            self._mark_admitted(req, slot)
+                            pending.append((req, slot))
+                            continue
+                        # Blocked: note the deferral, but keep scanning
+                        # for a smaller candidate that fits — unless
+                        # this head has aged past the bypass shield
+                        # (then nothing younger may jump it again).
+                        req.defer_rounds += 1
+                        if req.timeline is not None:
+                            req.timeline.event(
+                                "deferred", need=need,
+                                free=self._alloc.free_blocks)
+                        if req.defer_rounds >= self.hol_shield_rounds:
+                            break
+                        bypassed += 1
+                        if bypassed > self.hol_bypass_limit:
+                            break
+                        idx += 1
                 if deferred:
                     with self._mlock:
                         self.kv_defer_admissions += 1
@@ -1471,6 +1891,13 @@ class ContinuousDecoder:
                 # (one site under the cv made the guard inconsistent).
                 self._ramp_streak = 0
             try:
+                if suspend_slot >= 0:
+                    # Preempt-to-host: the victim was chosen under the
+                    # cv, but the export is a device round-trip submits
+                    # must not wait on — executed here, outside the cv.
+                    # Its freed blocks admit the blocked candidate on
+                    # the next round.
+                    self._suspend_stream(suspend_slot)
                 if pending:
                     # Admission fuses prefill + insert + one decode step
                     # into a single dispatch, so a new request's first
@@ -1509,7 +1936,7 @@ class ContinuousDecoder:
                             self._admit_prefix(req, slot, entry, plen, s)
                     if misses:
                         self._admit_batch(misses)
-                    ramp = (any(req.want for req, _ in pending)
+                    ramp = (any(req.want_left for req, _ in pending)
                             and (self.chunk_size == 1
                                  or self._ramp_streak < 1))
                     if ramp:
@@ -1614,6 +2041,13 @@ class ContinuousDecoder:
                 "kv_handoff_exports": self.kv_handoff_exports,
                 "kv_handoff_imports": self.kv_handoff_imports,
                 "kv_handoff_tokens": self.kv_handoff_tokens,
+                "kv_suspends": self.kv_suspends,
+                "kv_resumes": self.kv_resumes,
+                "kv_host_hits": self.kv_host_hits,
+                "qos_deadline_shed": self.qos_deadline_shed,
+                "hol_bypasses": self.hol_bypasses,
+                "qos_enabled": self.qos is not None,
+                "tenant_served": dict(self._tenant_served),
                 "role": self.role,
                 "tp_shards": self.tp_shards,
             }
@@ -1641,6 +2075,19 @@ class ContinuousDecoder:
                                        if self._alloc else 0)
             snap["kv_bytes_total"] = (self._alloc.bytes_total
                                       if self._alloc else 0)
+            # Host-tier (HBM -> host) occupancy: the second-chance
+            # cache plus pinned suspended-stream payloads. Pinned bytes
+            # draining to zero is the suspension leak invariant.
+            tier = self._host_tier
+            snap["kv_host_tier_bytes"] = tier.bytes_in_use if tier else 0
+            snap["kv_host_tier_bytes_total"] = (tier.capacity_bytes
+                                                if tier else 0)
+            snap["kv_host_tier_pinned_bytes"] = (tier.pinned_bytes
+                                                 if tier else 0)
+            snap["kv_host_tier_entries"] = len(tier) if tier else 0
+            snap["kv_host_demotions"] = tier.demotions if tier else 0
+            snap["kv_host_evictions"] = tier.evictions if tier else 0
+            snap["kv_host_promotions"] = tier.promotions if tier else 0
         # Histogram-backed latency quantiles (ttft_avg_s above stays for
         # backward compatibility — bench_serving.py and dashboards read
         # it — but the distribution is what autoscaling policies need).

@@ -138,6 +138,24 @@ class EngineConfig:
     # needs tp chips. The `serving_kv_bytes_*` gauges then price the
     # pool PER CHIP.
     tp_shards: int = 1
+    # Host-RAM KV tier budget in bytes (paged layout; 0 disables).
+    # Prefix-trie evictions DEMOTE their blocks here instead of freeing
+    # outright, trie misses probe it before cold prefill (second-chance
+    # cache — effective pool size rises past HBM at equal device
+    # bytes), and QoS suspensions park live streams' KV here until
+    # resume.
+    host_kv_bytes: int = 0
+    # Multi-tenant QoS tenants: "name=weight[:rate[:burst[:priority]]]"
+    # comma-separated (serving/qos.py:parse_tenants). Empty disables
+    # QoS entirely — FIFO admission, one implicit tenant, exactly the
+    # pre-QoS decoder. With tenants set, submits carry
+    # tenant/priority/deadline (gateway X-Tenant/X-Priority/
+    # X-Deadline-Ms headers), token buckets 429 over-rate tenants, and
+    # the pop loop orders by weighted fair share + aged priority.
+    qos_tenants: str = ""
+    # Seconds of queue wait worth one priority point (starvation
+    # aging); <= 0 disables aging.
+    qos_aging_s: float = 30.0
     # Compute dtype override ("bfloat16"/"float32"); empty keeps the
     # model preset's dtype. The tpu-serving manifest's --dtype arg.
     dtype: str = ""

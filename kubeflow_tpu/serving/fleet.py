@@ -180,11 +180,16 @@ class DecoderFleet:
         """The decoder's crash path (_fail_all) propagates WHATEVER
         killed the scheduler loop into every live stream — RuntimeError
         for a graceful stop, the loop's own exception otherwise — and a
-        TimeoutError means the replica stopped responding. The only
-        error that is the REQUEST's fault is ValueError (admission
-        validation, e.g. an over-budget prompt): that must surface to
-        the caller, not kill the replica."""
-        return not isinstance(err, (ValueError, ReplicaUnavailableError))
+        TimeoutError means the replica stopped responding. The errors
+        that are the REQUEST's fault — ValueError (admission
+        validation, e.g. an over-budget prompt) and QosRejected (the
+        tenant is over rate; DeadlineExceeded is a TimeoutError but
+        carries its own type) — must surface to the caller, not kill
+        the replica."""
+        from kubeflow_tpu.serving.qos import DeadlineExceeded, QosRejected
+
+        return not isinstance(err, (ValueError, ReplicaUnavailableError,
+                                    QosRejected, DeadlineExceeded))
 
     # -- placement -----------------------------------------------------
 
@@ -303,13 +308,28 @@ class DecoderFleet:
 
     def submit(self, tokens, max_new_tokens: int,
                temperature: float = 0.0, *,
-               request_id: str | None = None) -> FleetHandle:
+               request_id: str | None = None, tenant: str = "",
+               priority: int | None = None,
+               deadline_ms: float = 0.0) -> FleetHandle:
         """Route and submit, re-routing (and marking dead) when the
         chosen replica's scheduler is already gone — a submit never
         fails just because one replica died. Disaggregated fleets run
         the two-hop relay first: prefill-pool export, decode-pool
         import, then the decode submit below (which prefix-hits the
-        imported blocks)."""
+        imported blocks). ``tenant``/``priority``/``deadline_ms``
+        thread through to the replica's QoS admission (a QosRejected
+        bubbles to the caller — an over-rate tenant is not a replica
+        death)."""
+        # QoS kwargs forwarded only when set, so duck-typed replicas
+        # (test stubs, wrappers) without the QoS surface keep working
+        # for tenant-less traffic.
+        qos_kw = {}
+        if tenant:
+            qos_kw["tenant"] = tenant
+        if priority is not None:
+            qos_kw["priority"] = priority
+        if deadline_ms:
+            qos_kw["deadline_ms"] = deadline_ms
         handoff = None
         if self.disaggregated:
             if self._handoff_viable(tokens):
@@ -330,7 +350,7 @@ class DecoderFleet:
                             self.handoff_fallbacks += 1
                 handle = self._replicas[name].submit(
                     tokens, max_new_tokens, temperature,
-                    request_id=request_id)
+                    request_id=request_id, **qos_kw)
             except Exception as e:  # noqa: BLE001 — death check below
                 if not self._is_replica_death(e):
                     raise

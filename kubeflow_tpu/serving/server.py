@@ -30,6 +30,7 @@ from kubeflow_tpu.observability.tracing import (
 )
 from kubeflow_tpu.serving.batcher import DynamicBatcher
 from kubeflow_tpu.serving.engine import EngineConfig, InferenceEngine
+from kubeflow_tpu.serving.qos import QosRejected
 
 
 class _Metrics:
@@ -92,7 +93,11 @@ class ModelServer:
         with self._decoder_lock:
             if self._decoder is None:
                 from kubeflow_tpu.serving.continuous import ContinuousDecoder
+                from kubeflow_tpu.serving.qos import QosPolicy
 
+                qos = (QosPolicy(self.engine.cfg.qos_tenants,
+                                 aging_seconds=self.engine.cfg.qos_aging_s)
+                       if self.engine.cfg.qos_tenants else None)
                 self._decoder = ContinuousDecoder(
                     self.engine.params, self.engine.model.config,
                     slots=self.engine.cfg.batch_size,
@@ -115,13 +120,16 @@ class ModelServer:
                     stream_timeout_s=self.engine.cfg.stream_timeout_s,
                     role=self.engine.cfg.serving_role,
                     tp_shards=self.engine.cfg.tp_shards,
+                    qos=qos,
+                    host_kv_bytes=self.engine.cfg.host_kv_bytes,
                 )
             return self._decoder
 
     # ------------------------------------------------------------------
 
     def handle_predict(self, name: str, body: dict,
-                       request_id: str | None = None) -> dict:
+                       request_id: str | None = None,
+                       qos: dict | None = None) -> dict:
         if name != self.engine.cfg.model:
             raise KeyError(f"model {name!r} not served")
         instances = body.get("instances")
@@ -129,6 +137,7 @@ class ModelServer:
             raise ValueError("body must contain non-empty 'instances'")
         for inst in instances:
             self.engine.validate_instance(inst)
+        qos = qos or {}
         # Generation requests go to the continuous decoder (per-request
         # lengths are decoupled — a short request returns as soon as ITS
         # tokens are done); plain predicts coalesce in the dynamic batcher.
@@ -142,7 +151,7 @@ class ModelServer:
                 handles.append(("gen", inst, self.decoder.submit(
                     inst["tokens"], inst["max_new_tokens"],
                     float(inst.get("temperature", 0.0)),
-                    request_id=rid,
+                    request_id=rid, **qos,
                 )))
             else:
                 handles.append(("batch", inst,
@@ -175,7 +184,8 @@ class ModelServer:
         return pred
 
     def handle_predict_stream(self, name: str, body: dict,
-                              request_id: str | None = None):
+                              request_id: str | None = None,
+                              qos: dict | None = None):
         """Streaming generation: yields JSON-line dicts, one per token, then
         a terminal ``{"done": true, ...}`` record. Exactly one instance per
         stream (the chunked-HTTP / gRPC-stream unit is a single sequence)."""
@@ -193,7 +203,7 @@ class ModelServer:
         handle = self.decoder.submit(
             inst["tokens"], inst["max_new_tokens"],
             float(inst.get("temperature", 0.0)),
-            request_id=request_id,
+            request_id=request_id, **(qos or {}),
         )
 
         # Validation above runs eagerly (before the HTTP 200 goes out); only
@@ -389,6 +399,32 @@ class ModelServer:
                                 d["kv_handoff_imports"],
                             "serving_kv_handoff_tokens_total":
                                 d["kv_handoff_tokens"],
+                            # Tiered KV (HBM -> host) + QoS: tier
+                            # occupancy gauges (pinned = suspended
+                            # streams' parked payloads) and the
+                            # suspend/resume/shed counters.
+                            "serving_kv_host_tier_bytes":
+                                d["kv_host_tier_bytes"],
+                            "serving_kv_host_tier_bytes_total":
+                                d["kv_host_tier_bytes_total"],
+                            "serving_kv_host_tier_pinned_bytes":
+                                d["kv_host_tier_pinned_bytes"],
+                            "serving_kv_host_tier_entries":
+                                d["kv_host_tier_entries"],
+                            "serving_kv_host_demotions_total":
+                                d["kv_host_demotions"],
+                            "serving_kv_host_promotions_total":
+                                d["kv_host_promotions"],
+                            "serving_kv_host_evictions_total":
+                                d["kv_host_evictions"],
+                            "serving_suspends_total": d["kv_suspends"],
+                            "serving_resumes_total": d["kv_resumes"],
+                            "serving_deadline_shed_total":
+                                d["qos_deadline_shed"],
+                            "serving_hol_bypasses_total":
+                                d["hol_bypasses"],
+                            "serving_qos_enabled":
+                                int(d["qos_enabled"]),
                             "serving_in_flight": d["in_flight"],
                             "serving_queued": d["queued"],
                             # serving_tp_shards rides the decoder
@@ -451,6 +487,32 @@ class ModelServer:
                     self.wfile.write(b"0\r\n\r\n")
                     self.wfile.flush()
 
+            def _qos_headers(self) -> dict:
+                """The QoS surface threaded from the gateway: tenant
+                identity, request priority, and a shed deadline. Bad
+                numeric values are a client error (400 via the
+                ValueError path), not a silent default."""
+                qos = {}
+                tenant = self.headers.get("X-Tenant")
+                if tenant:
+                    qos["tenant"] = tenant
+                prio = self.headers.get("X-Priority")
+                if prio:
+                    try:
+                        qos["priority"] = int(prio)
+                    except ValueError:
+                        raise ValueError(
+                            f"malformed X-Priority {prio!r}") from None
+                deadline = self.headers.get("X-Deadline-Ms")
+                if deadline:
+                    try:
+                        qos["deadline_ms"] = float(deadline)
+                    except ValueError:
+                        raise ValueError(
+                            f"malformed X-Deadline-Ms {deadline!r}"
+                        ) from None
+                return qos
+
             def do_POST(self):
                 t0 = time.perf_counter()
                 error = False
@@ -465,16 +527,18 @@ class ModelServer:
                     if self.path.startswith("/v1/models/") and \
                             self.path.endswith(":predict"):
                         name = self.path[len("/v1/models/"):-len(":predict")]
+                        qos = self._qos_headers()
                         if body.get("stream"):
                             self._send_stream(
                                 server.handle_predict_stream(
                                     name, body,
-                                    request_id=self._request_id)
+                                    request_id=self._request_id,
+                                    qos=qos)
                             )
                         else:
                             self._send(200, server.handle_predict(
                                 name, body,
-                                request_id=self._request_id))
+                                request_id=self._request_id, qos=qos))
                     elif self.path.startswith("/v1/models/") and \
                             self.path.endswith(":prefill"):
                         name = self.path[len("/v1/models/"):-len(":prefill")]
@@ -490,9 +554,28 @@ class ModelServer:
                 except KeyError as e:
                     error = True
                     self._send(404, {"error": str(e)})
+                except QosRejected as e:
+                    # Token-bucket overload: shed with backpressure the
+                    # client can act on instead of queuing into
+                    # collapse.
+                    error = True
+                    self.send_response(429)
+                    rid = getattr(self, "_request_id", None)
+                    if rid:
+                        self.send_header(REQUEST_ID_HEADER, rid)
+                    payload = json.dumps({"error": str(e)}).encode()
+                    self.send_header("Retry-After",
+                                     str(max(1, int(e.retry_after_s
+                                                    + 0.999))))
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
                 except TimeoutError as e:
                     # An overloaded/stalled decoder is a server-side
-                    # failure, not a bad request.
+                    # failure, not a bad request (deadline sheds — a
+                    # DeadlineExceeded is a TimeoutError — land here
+                    # too: the answer's window has passed).
                     error = True
                     self._send(503, {"error": str(e) or "generation "
                                      "timed out"})

@@ -1,0 +1,769 @@
+"""Multi-tenant QoS + tiered HBM→host KV cache tests.
+
+The Gavel fair-share/priority policies applied to inference admission
+(serving/qos.py), the host-RAM second-chance tier (serving/kv_tier.py),
+and the decoder's suspend→resume preemption: fair-share convergence,
+deadline shedding, byte-identity of suspended-and-resumed streams
+(greedy fp/int8/tp>1, plus a replayed SAMPLED stream — the shared
+state RNG makes naive sampled comparison meaningless, so the test
+replays the exact split sequence), host-tier LRU/pin bookkeeping, leak
+freedom on the crash paths, head-of-line bypass, and the gateway's
+429 + Retry-After shedding.
+"""
+
+import json
+import socket
+import threading
+import time
+
+import jax
+import pytest
+
+from kubeflow_tpu.models.registry import get_model
+from kubeflow_tpu.serving.continuous import ContinuousDecoder
+from kubeflow_tpu.serving.fleet import DecoderFleet
+from kubeflow_tpu.serving.kv_tier import HostKvTier, payload_nbytes
+from kubeflow_tpu.serving.qos import (
+    DeadlineExceeded,
+    QosPolicy,
+    QosRejected,
+    TenantSpec,
+    TokenBucket,
+    order_key,
+    parse_tenants,
+    render_tenants,
+    tenant_bucket,
+)
+
+
+@pytest.fixture(scope="module")
+def model():
+    spec = get_model("lm-test-tiny")
+    params = spec.init(jax.random.PRNGKey(0), spec.config)
+    return spec, params
+
+
+def _decoder(model, *, slots=4, prefill_len=32, max_new=32, pool=10,
+             block=8, pfx_slots=4, min_len=8, watermark=0, seed=0, **kw):
+    spec, params = model
+    return ContinuousDecoder(
+        params, spec.config, slots=slots, prefill_len=prefill_len,
+        max_new_tokens=max_new, kv_layout="paged", kv_block_size=block,
+        kv_pool_blocks=pool, prefix_cache_slots=pfx_slots,
+        prefix_cache_min_len=min_len, kv_low_watermark=watermark,
+        stream_timeout_s=120.0, seed=seed, **kw)
+
+
+def _two_tier_qos():
+    return QosPolicy({"gold": TenantSpec("gold", weight=8, priority=10),
+                      "free": TenantSpec("free", weight=1, priority=0)},
+                     aging_seconds=30.0)
+
+
+def _force_suspension(d, victim_prompt, victim_want, *,
+                      victim_kw=None, min_emitted=1):
+    """Submit a low-priority victim, wait until it has emitted at least
+    ``min_emitted`` tokens, then submit high-priority golds that cannot
+    fit alongside it — the pop loop suspends the victim. Returns
+    (victim_handle, gold_handles)."""
+    h = d.submit(victim_prompt, victim_want, tenant="free",
+                 **(victim_kw or {}))
+    deadline = time.perf_counter() + 30
+    while (len(h._req.out) < min_emitted
+           and time.perf_counter() < deadline):
+        time.sleep(0.002)
+    assert len(h._req.out) >= min_emitted, "victim never started"
+    golds = [d.submit([9] * 20 + [i], 4, tenant="gold")
+             for i in range(3)]
+    return h, golds
+
+
+# ---------------------------------------------------------------------------
+# qos.py primitives
+# ---------------------------------------------------------------------------
+
+
+def test_token_bucket_refill_and_retry_after():
+    b = TokenBucket(rate=2.0, burst=2.0, now=0.0)
+    assert b.try_take(0.0) == (True, 0.0)
+    assert b.try_take(0.0) == (True, 0.0)
+    ok, retry = b.try_take(0.0)
+    assert not ok and retry == pytest.approx(0.5)
+    # Half a token refilled after 0.25s; a whole one after 0.5s.
+    ok, retry = b.try_take(0.25)
+    assert not ok and retry == pytest.approx(0.25)
+    assert b.try_take(0.5) == (True, 0.0)
+    # rate 0 = unlimited.
+    free = TokenBucket(rate=0.0, burst=0.0)
+    assert all(free.try_take(0.0)[0] for _ in range(100))
+
+
+def test_parse_and_render_tenants_round_trip():
+    spec = "free=1,gold=8:100:200:10,mid=2:5"
+    tenants = parse_tenants(spec)
+    assert tenants["gold"] == TenantSpec("gold", 8, 100, 200, 10)
+    assert tenants["mid"].rate == 5 and tenants["mid"].priority == 0
+    assert tenants["free"].weight == 1
+    rendered = render_tenants({
+        n: {"weight": t.weight, "rate": t.rate, "burst": t.burst,
+            "priority": t.priority} for n, t in tenants.items()})
+    assert parse_tenants(rendered) == tenants
+    for bad in ("noequals", "x=1:2:3:4:5", "y=abc"):
+        with pytest.raises(ValueError):
+            parse_tenants(bad)
+    with pytest.raises(ValueError):
+        TenantSpec("z", weight=0)
+
+
+def test_tenant_bucket_is_stable_and_bounded():
+    values = {tenant_bucket(f"tenant-{i}") for i in range(500)}
+    assert values <= {f"t{i:02d}" for i in range(16)}
+    assert tenant_bucket("alice") == tenant_bucket("alice")
+    assert tenant_bucket("") == tenant_bucket("default")
+
+
+def test_qos_policy_admission_and_priority_defaults():
+    qos = QosPolicy("gold=8:2:2:10,free=1", aging_seconds=30)
+    assert qos.base_priority("gold", None) == 10
+    assert qos.base_priority("gold", 3) == 3
+    assert qos.base_priority("unknown", None) == 0
+    qos.admit("gold", 0.0)
+    qos.admit("gold", 0.0)
+    with pytest.raises(QosRejected) as err:
+        qos.admit("gold", 0.0)
+    assert err.value.retry_after_s > 0
+    # free has no rate: unlimited.
+    for _ in range(50):
+        qos.admit("free", 0.0)
+
+
+def test_fair_share_converges_to_weights():
+    """Property: under full backlog, serving whoever has the lowest
+    order_key converges each tenant's service share to its weight."""
+    import random
+
+    rng = random.Random(7)
+    for _trial in range(5):
+        weights = {f"t{i}": rng.choice([1, 2, 4, 8])
+                   for i in range(rng.randint(2, 4))}
+        served = {t: 0.0 for t in weights}
+        for step in range(4000):
+            pick = min(weights, key=lambda t: order_key(
+                served=served[t], weight=weights[t], priority=0,
+                waited_seconds=0.0, aging_seconds=0.0,
+                submit_t=float(step)))
+            served[pick] += 1.0
+        total_w = sum(weights.values())
+        for t, w in weights.items():
+            share = served[t] / 4000
+            assert share == pytest.approx(w / total_w, abs=0.02), \
+                (weights, served)
+
+
+def test_aging_eventually_outranks_priority():
+    """A starved low-priority request overtakes a fresh high-priority
+    one once its wait crosses the aging window times the gap."""
+    def key(prio, waited):
+        return order_key(served=0.0, weight=1.0, priority=prio,
+                         waited_seconds=waited, aging_seconds=10.0,
+                         submit_t=0.0)
+
+    assert key(10, 0.0) < key(0, 50.0)    # gap 10 needs > 100s of wait
+    assert key(0, 150.0) < key(10, 0.0)   # starved past the gap: first
+
+
+# ---------------------------------------------------------------------------
+# HostKvTier bookkeeping (pure host)
+# ---------------------------------------------------------------------------
+
+
+def _payload(tokens_worth, bytes_per_token=8):
+    import numpy as np
+
+    arr = np.zeros((1, 1, tokens_worth, bytes_per_token // 2),
+                   dtype=np.float16)
+    return {"k": arr, "v": arr.copy()}
+
+
+def test_host_tier_lru_bound_and_pins():
+    p = _payload(8)
+    per = payload_nbytes(p)
+    tier = HostKvTier(capacity_bytes=3 * per)
+    assert tier.put((1,), _payload(8), 1)
+    assert tier.put((2,), _payload(8), 1)
+    assert tier.put((3,), _payload(8), 1)
+    tier.get((1,))  # refresh: (2,) is now LRU
+    assert tier.put((4,), _payload(8), 1)
+    assert tier.bytes_in_use <= tier.capacity_bytes
+    assert not tier.has((2,)) and tier.has((1,))
+    assert tier.evictions == 1
+    # Pinned entries are exempt from LRU and gate can_fit.
+    tier2 = HostKvTier(capacity_bytes=2 * per)
+    assert tier2.put((1,), _payload(8), 1, pinned=True)
+    assert tier2.put((2,), _payload(8), 1, pinned=True)
+    assert tier2.pinned_bytes == 2 * per
+    assert not tier2.put((3,), _payload(8), 1)  # nothing evictable
+    assert not tier2.can_fit(per)
+    tier2.unpin((1,))
+    assert tier2.put((3,), _payload(8), 1)      # (1,) evicted
+    assert not tier2.has((1,))
+    tier2.discard((2,))
+    assert tier2.pinned_bytes == 0
+    # Oversized payload refused outright.
+    assert not HostKvTier(per - 1).put((9,), _payload(8), 1)
+
+
+def test_host_tier_interior_prefix_match():
+    tier = HostKvTier(1 << 20)
+    tier.put((1, 2, 3, 4, 5), _payload(8), 5)
+    # Exact re-arrival matches at depth len-1 (one suffix token rule).
+    entry, depth = tier.match([1, 2, 3, 4, 5])
+    assert entry.key == (1, 2, 3, 4, 5) and depth == 4
+    # Extension matches at full stored depth.
+    assert tier.match([1, 2, 3, 4, 5, 6, 7])[1] == 5
+    # Divergent tail matches the common run.
+    assert tier.match([1, 2, 3, 9, 9])[1] == 3
+    assert tier.match([8, 8]) is None
+
+
+# ---------------------------------------------------------------------------
+# Decoder QoS: ordering, deadlines, rejection
+# ---------------------------------------------------------------------------
+
+
+def test_submit_rejects_over_rate_tenant(model):
+    qos = QosPolicy({"capped": TenantSpec("capped", rate=0.01,
+                                          burst=1)})
+    d = _decoder(model, qos=qos)
+    try:
+        d.generate([1, 2, 3], 2, tenant="capped")
+        with pytest.raises(QosRejected):
+            d.submit([1, 2, 3], 2, tenant="capped")
+    finally:
+        d.stop()
+
+
+def test_deadline_shedding(model):
+    """A request whose deadline passes while queued is finished with
+    DeadlineExceeded, never served."""
+    d = _decoder(model, slots=1, qos=QosPolicy({}))
+    try:
+        blocker = d.submit([5, 6, 7], 32)
+        next(blocker.tokens(timeout=60))  # occupies the only slot
+        doomed = d.submit([1, 2, 3], 4, deadline_ms=1.0)
+        with pytest.raises(DeadlineExceeded):
+            doomed.result(timeout=60)
+        assert doomed._req.out == []
+        assert blocker.result(timeout=60)["tokens"]  # undisturbed
+        assert d.metrics()["qos_deadline_shed"] == 1
+    finally:
+        d.stop()
+
+
+def test_priority_orders_the_queue(model):
+    """With one slot, a high-priority late arrival is served before
+    queued low-priority requests."""
+    d = _decoder(model, slots=1, qos=_two_tier_qos())
+    try:
+        first = d.submit([5, 6, 7], 8, tenant="free")
+        lows = [d.submit([5, 6, 7, i], 4, tenant="free")
+                for i in range(3)]
+        gold = d.submit([9, 9, 9], 2, tenant="gold")
+        gold.result(timeout=120)
+        assert any(not h._req.done.is_set() for h in lows), \
+            "gold should finish before the queued free backlog drains"
+        for h in [first] + lows:
+            h.result(timeout=120)
+    finally:
+        d.stop()
+
+
+def test_tenant_served_accounting_and_labels(model):
+    d = _decoder(model, qos=_two_tier_qos())
+    try:
+        d.generate([1, 2, 3], 4, tenant="gold")
+        d.generate([4, 5, 6], 2, tenant="free")
+        served = d.metrics()["tenant_served"]
+        assert served["gold"] == 4 and served["free"] == 2
+        text = d.registry.render()
+        assert 'serving_tenant_queue_wait_seconds_count{tenant="' in text
+    finally:
+        d.stop()
+
+
+# ---------------------------------------------------------------------------
+# Suspend -> resume byte-identity
+# ---------------------------------------------------------------------------
+
+
+def _suspend_resume_run(model, make, prompt, want):
+    """Run the suspension scenario under ``make()`` decoders and return
+    (undisturbed_tokens, resumed_tokens, metrics)."""
+    ref = make()
+    try:
+        want_ref = ref.generate(prompt, want, timeout=120)["tokens"]
+    finally:
+        ref.stop()
+    d = make()
+    try:
+        h, golds = _force_suspension(d, prompt, want)
+        for g in golds:
+            g.result(timeout=120)
+        out = h.result(timeout=120)["tokens"]
+        m = d.metrics()
+        assert m["kv_suspends"] >= 1, "scenario failed to suspend"
+        assert m["kv_resumes"] >= 1
+        assert m["kv_host_tier_pinned_bytes"] == 0
+    finally:
+        d.stop()
+    return want_ref, out, m
+
+
+def test_suspend_resume_greedy_byte_identity(model):
+    def make():
+        return _decoder(model, qos=_two_tier_qos(),
+                        host_kv_bytes=1 << 20, watermark=2)
+
+    want_ref, out, _m = _suspend_resume_run(
+        model, make, [5, 6, 7, 8, 9, 10, 11, 12], 32)
+    assert out == want_ref
+
+
+def test_suspend_resume_int8_byte_identity(model):
+    def make():
+        return _decoder(model, qos=_two_tier_qos(),
+                        host_kv_bytes=1 << 20, watermark=2,
+                        kv_dtype="int8")
+
+    want_ref, out, _m = _suspend_resume_run(
+        model, make, [5, 6, 7, 8, 9, 10, 11, 12], 32)
+    assert out == want_ref
+
+
+def test_suspend_resume_tp2_byte_identity(model):
+    def make():
+        return _decoder(model, qos=_two_tier_qos(),
+                        host_kv_bytes=1 << 20, watermark=2,
+                        tp_shards=2)
+
+    want_ref, out, _m = _suspend_resume_run(
+        model, make, [5, 6, 7, 8, 9, 10, 11, 12], 32)
+    assert out == want_ref
+
+
+def test_suspend_resume_sampled_tier_round_trip_identity(model):
+    """Sampled byte-identity, done honestly: the sampling key is ONE
+    state-wide stream split once per decode round, so a resumed
+    stream's continuation lawfully draws different keys than an
+    undisturbed run whenever OTHER streams consumed rounds in between
+    — naive end-to-end comparison is meaningless for temperature > 0.
+    What suspension actually relies on is that the KV a parked stream
+    resumes from is byte-exact through the export -> host tier ->
+    re-import round trip. Pin exactly that, with identical split
+    schedules: decoder A continues a sampled stream from its
+    device-resident published prefix; same-seed decoder B runs the
+    identical schedule but has its trie force-evicted first, so the
+    continuation must PROMOTE the demoted payload from the host tier.
+    Any corruption in the tier round trip diverges the sampled
+    tokens."""
+    prompt, cut, rest = [5, 6, 7, 8, 9, 10, 11, 12], 6, 18
+
+    def run(through_tier):
+        d = _decoder(model, host_kv_bytes=1 << 20, seed=3)
+        try:
+            head = d.generate(prompt, cut, temperature=1.0,
+                              timeout=120)["tokens"]
+            if through_tier:
+                with d._prefix_lock:
+                    while d.prefix_cache.evict_lru():
+                        pass
+            tail = d.generate(prompt + head, rest, temperature=1.0,
+                              timeout=120)["tokens"]
+            m = d.metrics()
+        finally:
+            d.stop()
+        return head, tail, m
+
+    head_a, tail_a, m_a = run(through_tier=False)
+    head_b, tail_b, m_b = run(through_tier=True)
+    assert head_a == head_b           # same seed, same schedule
+    assert m_b["kv_host_hits"] >= 1   # B resumed THROUGH the tier
+    assert m_a["kv_host_hits"] == 0
+    assert tail_b == tail_a, \
+        "host-tier round trip corrupted a sampled stream's KV"
+
+
+# ---------------------------------------------------------------------------
+# Second chance + crash/_fail_all leak freedom
+# ---------------------------------------------------------------------------
+
+
+def test_demote_then_second_chance_promotion(model):
+    """An evicted prefix re-imports from the host tier: hit-after-evict
+    > 0 and the re-arrival pays suffix-only prefill."""
+    d = _decoder(model, host_kv_bytes=1 << 20)
+    try:
+        pfx = list(range(1, 17))
+        out1 = d.generate(pfx + [99], 4, timeout=120)["tokens"]
+        with d._prefix_lock:
+            while d.prefix_cache.evict_lru():
+                pass
+        before = d.metrics()
+        out2 = d.generate(pfx + [99], 4, timeout=120)["tokens"]
+        m = d.metrics()
+        assert out2 == out1
+        assert m["kv_host_hits"] >= 1
+        assert m["kv_host_promotions"] >= 1
+        assert m["kv_host_demotions"] >= 1
+        # Suffix-only: far fewer than the 17 cold tokens.
+        assert m["prefill_tokens"] - before["prefill_tokens"] < 17
+    finally:
+        d.stop()
+
+
+def test_no_tier_eviction_still_frees(model):
+    """host_kv_bytes=0: eviction frees outright, exactly the old
+    behavior (no tier objects, no counters)."""
+    d = _decoder(model)
+    try:
+        d.generate(list(range(1, 17)), 4, timeout=120)
+        with d._prefix_lock:
+            while d.prefix_cache.evict_lru():
+                pass
+        m = d.metrics()
+        assert m["kv_host_demotions"] == 0
+        assert m["kv_blocks_in_use"] == 0
+        assert m["kv_host_tier_bytes_total"] == 0
+    finally:
+        d.stop()
+
+
+def test_fail_all_drains_parked_streams_and_both_tiers(model):
+    """Crash with a SUSPENDED stream parked: the parked request fails
+    fast (it is invisible to the slots — the queued sweep must catch
+    it), its pinned payload drains, and the device pool returns to
+    zero after a full trie evict."""
+    d = _decoder(model, qos=_two_tier_qos(), host_kv_bytes=1 << 20,
+                 watermark=2)
+    try:
+        # Victim suspended by a LONG gold stream that keeps the pool
+        # full, so the victim stays parked.
+        h = d.submit([5, 6, 7, 8, 9, 10, 11, 12], 32, tenant="free")
+        while len(h._req.out) < 2:
+            time.sleep(0.002)
+        gold = d.submit([9] * 20, 32, tenant="gold")
+        deadline = time.perf_counter() + 30
+        while (d.metrics()["kv_suspends"] < 1
+               and time.perf_counter() < deadline):
+            time.sleep(0.002)
+        assert d.metrics()["kv_suspends"] >= 1
+        assert h._req.host_key is not None  # parked, payload pinned
+        # Poison the device state under the lock: the next dispatch
+        # raises and _fail_all sweeps live AND parked work.
+        with d._state_lock:
+            d._state = None
+        t0 = time.perf_counter()
+        with pytest.raises(Exception):
+            h.result(timeout=60)
+        with pytest.raises(Exception):
+            gold.result(timeout=60)
+        assert time.perf_counter() - t0 < 10, "parked stream hung"
+        with d._prefix_lock:
+            while d.prefix_cache.evict_lru():
+                pass
+        m = d.metrics()
+        assert m["kv_host_tier_pinned_bytes"] == 0
+        assert m["kv_blocks_in_use"] == 0
+    finally:
+        d.stop()
+
+
+def test_chaos_replica_kill_with_parked_streams(model):
+    """Fleet flavor of the crash: a replica dies holding a suspended
+    stream; the parked stream fails fast with the 502-coded error, the
+    survivor is untouched, and the victim's tiers drain to zero."""
+    qos = _two_tier_qos()
+    reps = {
+        "r0": _decoder(model, qos=qos, host_kv_bytes=1 << 20,
+                       watermark=2),
+        "r1": _decoder(model, qos=qos, host_kv_bytes=1 << 20,
+                       watermark=2),
+    }
+    fleet = DecoderFleet(reps, affinity_tokens=8)
+    try:
+        # Place a victim stream on r0 directly (routing is irrelevant
+        # to the invariant being pinned).
+        victim = reps["r0"]
+        h, golds = _force_suspension(victim,
+                                     [5, 6, 7, 8, 9, 10, 11, 12], 32)
+        # Keep the pool full so the free stream stays parked.
+        keeper = victim.submit([8] * 20, 32, tenant="gold")
+        deadline = time.perf_counter() + 30
+        while (victim.metrics()["kv_suspends"] < 1
+               and time.perf_counter() < deadline):
+            time.sleep(0.002)
+        assert victim.metrics()["kv_suspends"] >= 1
+        # A survivor-homed stream (QoS kwargs thread through the
+        # fleet submit).
+        toks, probe = [1, 2, 3, 4], 0
+        while fleet.route(toks) != "r1" and probe < 200:
+            probe += 1
+            toks = [1, 2, 3, 4 + probe]
+        assert fleet.route(toks) == "r1"
+        survivor_h = fleet.submit(toks, 8, tenant="free",
+                                  priority=None, deadline_ms=0.0)
+        with victim._state_lock:
+            victim._state = None
+        t0 = time.perf_counter()
+        for handle in [h, keeper] + golds:
+            with pytest.raises(Exception):
+                handle.result(timeout=60)
+        assert time.perf_counter() - t0 < 10, "parked stream hung"
+        assert survivor_h.result(timeout=120)["tokens"]
+        with victim._prefix_lock:
+            while victim.prefix_cache.evict_lru():
+                pass
+        m = victim.metrics()
+        assert m["kv_host_tier_pinned_bytes"] == 0
+        assert m["kv_blocks_in_use"] == 0
+    finally:
+        fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# Head-of-line bypass (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_hol_bypass_small_jumps_deferred_giant(model):
+    """A memory-deferred giant no longer stalls the round: a smaller
+    request behind it that fits is admitted, and the giant still
+    completes once the pool drains (aging shields it)."""
+    # Pool 6 blocks: filler holds 4; giant needs 6 (deferred); small 1.
+    d = _decoder(model, slots=3, pool=6, max_new=16, pfx_slots=0)
+    try:
+        filler = d.submit([1, 2] * 8, 16)     # 16+16 tok = 4 blocks
+        next(filler.tokens(timeout=60))
+        giant = d.submit([3, 4] * 16, 16)     # 32+16 tok = 6 blocks
+        small = d.submit([5], 4)              # 1+4 tok = 1 block
+        res = small.result(timeout=120)
+        assert len(res["tokens"]) == 4
+        assert not giant._req.done.is_set(), \
+            "small should complete while the giant is still deferred"
+        assert len(giant.result(timeout=120)["tokens"]) == 16
+        m = d.metrics()
+        assert m["hol_bypasses"] >= 1
+        assert m["kv_defer_admissions"] >= 1
+        assert m["kv_blocks_in_use"] == 0
+        filler.result(timeout=120)
+    finally:
+        d.stop()
+
+
+# ---------------------------------------------------------------------------
+# HTTP surfaces: server headers + 429, gateway shedding (satellite)
+# ---------------------------------------------------------------------------
+
+
+def _post(port, path, payload, headers=None):
+    conn = socket.create_connection(("127.0.0.1", port), timeout=30)
+    try:
+        body = json.dumps(payload).encode()
+        head = (f"POST {path} HTTP/1.1\r\nHost: x\r\n"
+                f"Content-Type: application/json\r\n"
+                f"Content-Length: {len(body)}\r\n")
+        for k, v in (headers or {}).items():
+            head += f"{k}: {v}\r\n"
+        conn.sendall(head.encode() + b"\r\n" + body)
+        conn.settimeout(30)
+        data = b""
+        while b"\r\n\r\n" not in data:
+            data += conn.recv(65536)
+        header_blob, _, rest = data.partition(b"\r\n\r\n")
+        status = int(header_blob.split(b" ")[1])
+        headers_out = {}
+        for line in header_blob.split(b"\r\n")[1:]:
+            k, _, v = line.decode().partition(":")
+            headers_out[k.strip().lower()] = v.strip()
+        length = int(headers_out.get("content-length", 0))
+        while len(rest) < length:
+            rest += conn.recv(65536)
+        return status, headers_out, rest[:length]
+    finally:
+        conn.close()
+
+
+@pytest.fixture()
+def qos_server():
+    from kubeflow_tpu.serving.engine import EngineConfig
+    from kubeflow_tpu.serving.server import ModelServer
+
+    server = ModelServer(
+        EngineConfig(model="lm-test-tiny", batch_size=4, max_seq_len=32,
+                     max_new_tokens=8, kv_layout="paged",
+                     kv_block_size=8, host_kv_bytes=1 << 20,
+                     qos_tenants="gold=8:0:0:10,capped=1:1:1"),
+        port=0, grpc_port=None, batch_timeout_ms=2)
+    server.start()
+    yield server
+    server.stop()
+
+
+def test_server_threads_qos_headers(qos_server):
+    port = qos_server.port
+    status, _h, body = _post(
+        port, "/v1/models/lm-test-tiny:predict",
+        {"instances": [{"tokens": [1, 2, 3], "max_new_tokens": 4}]},
+        headers={"X-Tenant": "gold", "X-Priority": "5",
+                 "X-Deadline-Ms": "60000"})
+    assert status == 200, body
+    served = qos_server.decoder.metrics()["tenant_served"]
+    assert served.get("gold") == 4
+
+
+def test_server_429_with_retry_after(qos_server):
+    port = qos_server.port
+    payload = {"instances": [{"tokens": [1, 2, 3],
+                              "max_new_tokens": 2}]}
+    status, _h, _b = _post(port, "/v1/models/lm-test-tiny:predict",
+                           payload, headers={"X-Tenant": "capped"})
+    assert status == 200
+    status, headers, body = _post(port,
+                                  "/v1/models/lm-test-tiny:predict",
+                                  payload,
+                                  headers={"X-Tenant": "capped"})
+    assert status == 429, body
+    assert int(headers["retry-after"]) >= 1
+    # Malformed QoS headers are a 400, not a silent default.
+    status, _h, _b = _post(port, "/v1/models/lm-test-tiny:predict",
+                           payload, headers={"X-Priority": "high"})
+    assert status == 400
+
+
+def test_gateway_sheds_429_with_retry_after():
+    """Raw-socket regression: the gateway answers an over-rate tenant
+    (and a saturated pool) with 429 + Retry-After BEFORE any upstream
+    work — previously it had no 429 path at all."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from kubeflow_tpu.gateway import Gateway, Route, RouteTable
+
+    hits = []
+
+    class Backend(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            hits.append(self.path)
+            n = int(self.headers.get("Content-Length", 0))
+            self.rfile.read(n)
+            self.send_response(200)
+            self.send_header("Content-Length", "2")
+            self.end_headers()
+            self.wfile.write(b"{}")
+
+    backend = ThreadingHTTPServer(("127.0.0.1", 0), Backend)
+    threading.Thread(target=backend.serve_forever, daemon=True).start()
+    addr = f"127.0.0.1:{backend.server_address[1]}"
+    table = RouteTable()
+    table.set_routes([Route(
+        name="m", prefix="/models/m/", service=addr,
+        backends=((addr, 1.0),),
+        qos_tenants=(("capped", 1.0, 1.0),))])
+    gw = Gateway(table, port=0, admin_port=0, probe_interval=0)
+    gw.start()
+    try:
+        status, _h, _b = _post(gw.port, "/models/m/x", {"a": 1},
+                               headers={"X-Tenant": "capped"})
+        assert status == 200
+        status, headers, body = _post(gw.port, "/models/m/x", {"a": 1},
+                                      headers={"X-Tenant": "capped"})
+        assert status == 429, body
+        assert int(headers["retry-after"]) >= 1
+        assert b"over admission rate" in body
+        # Unlimited tenants pass.
+        status, _h, _b = _post(gw.port, "/models/m/x", {"a": 1},
+                               headers={"X-Tenant": "other"})
+        assert status == 200
+        assert gw.qos_shed_total == 1
+        assert len(hits) == 2  # the shed request never reached upstream
+
+        # Saturated pool: every healthy backend at the pressure bound.
+        table.set_routes([Route(
+            name="m", prefix="/models/m/", service=addr,
+            backends=((addr, 1.0),), pressure=1,
+            qos_default_rate=1000.0, qos_default_burst=1000.0)])
+        gw.load.acquire(addr)  # one in-flight = at the bound
+        try:
+            status, headers, body = _post(gw.port, "/models/m/x",
+                                          {"a": 1})
+            assert status == 429 and b"saturated" in body
+            assert headers["retry-after"] == "1"
+        finally:
+            gw.load.release(addr)
+        status, _h, _b = _post(gw.port, "/models/m/x", {"a": 1})
+        assert status == 200
+    finally:
+        gw.stop()
+        backend.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# CRD / manifest plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_tpu_serving_prototype_renders_qos_args():
+    from kubeflow_tpu.manifests.core import generate
+
+    objs = generate("tpu-serving", {
+        "name": "lm", "namespace": "kubeflow", "kv_layout": "paged",
+        "host_kv_bytes": 1 << 28,
+        "qos_tenants": "gold=8:100:200:10,free=1", "qos_aging_s": 20.0})
+    args = objs[0]["spec"]["template"]["spec"]["containers"][0]["args"]
+    assert f"--host-kv-bytes={1 << 28}" in args
+    assert "--qos-tenants=gold=8:100:200:10,free=1" in args
+    assert "--qos-aging-s=20.0" in args
+    # Defaults render no QoS args at all (goldens unchanged).
+    objs = generate("tpu-serving", {"name": "lm",
+                                    "namespace": "kubeflow"})
+    args = objs[0]["spec"]["template"]["spec"]["containers"][0]["args"]
+    assert not any(a.startswith(("--qos", "--host-kv")) for a in args)
+
+
+def test_inference_operator_threads_qos_to_replicas_and_route(api):
+    import yaml
+
+    from kubeflow_tpu.apis.inference import (
+        inference_service,
+        inference_service_crd,
+    )
+    from kubeflow_tpu.manifests.core import GATEWAY_ROUTE_ANNOTATION
+    from kubeflow_tpu.operators.inference import (
+        InferenceServiceController,
+    )
+
+    api.apply(inference_service_crd())
+    svc = inference_service(
+        "svc", "kubeflow", "lm-test-tiny", replicas=2,
+        engine={"kv_layout": "paged", "hostKvBytes": 4096},
+        qos={"agingSeconds": 15,
+             "tenants": {"gold": {"weight": 8, "rate": 100,
+                                  "burst": 200, "priority": 10},
+                         "free": {"weight": 1}}})
+    api.apply(svc)
+    ctrl = InferenceServiceController(api, fetch_metrics=lambda a: None)
+    ctrl.reconcile(api.get("kubeflow-tpu.org/v1", "InferenceService",
+                           "svc", "kubeflow"))
+    dep = api.get("apps/v1", "Deployment", "svc-r0", "kubeflow")
+    args = dep["spec"]["template"]["spec"]["containers"][0]["args"]
+    assert "--host-kv-bytes=4096" in args
+    assert "--qos-aging-s=15.0" in args
+    assert any(a.startswith("--qos-tenants=")
+               and "gold=8:100:200:10" in a for a in args)
+    router = api.get("v1", "Service", "svc", "kubeflow")
+    route = yaml.safe_load(
+        router["metadata"]["annotations"][GATEWAY_ROUTE_ANNOTATION])
+    assert route["qos"]["tenants"]["gold"] == {"rate": 100.0,
+                                               "burst": 200.0}
