@@ -143,6 +143,177 @@ def run_input_pipeline(model_name: str, batch_size: int, seq_len: int,
     return result
 
 
+def run_elastic(model_name: str = "lm-test-tiny", batch_size: int = 8,
+                seq_len: int = 32, steps: int = 12,
+                opt_name: str = "adamw") -> dict:
+    """Elastic-training bench: grow half→all and shrink all→half of the
+    visible devices mid-run through the REAL loop's reshard point.
+
+    Measures per-direction remap time (``elastic_reshard_*_ms``) and full
+    step-time lost to the resize (``elastic_downtime_*_ms``), and prices
+    the alternative the shrink path replaces: a preempt→requeue→resume
+    round (synchronous checkpoint save + restore into the target mesh +
+    step rebuild, measured with the same primitives — the compute-only
+    floor of the kill path, which on a real cluster also pays requeue
+    backoff and pod restart). Sets the ``regression`` marker when any
+    post-reshard loss differs from the undisturbed restore-into-target
+    reference at the same global batch (live reshard must equal the
+    rescale path it replaces, byte-for-byte), or when shrink fails to
+    beat the kill-path floor for the same capacity release."""
+    import re
+    import shutil
+    import tempfile
+
+    from kubeflow_tpu.train import checkpoint as ckpt_lib
+    from kubeflow_tpu.train.loop import RunConfig, run
+    from kubeflow_tpu.train.optimizers import OptimizerConfig
+
+    n = len(jax.devices())
+    small = max(n // 2, 1)
+    flip = steps // 2
+    opt = OptimizerConfig(name=opt_name, warmup_steps=2,
+                          total_steps=steps + 2)
+
+    def losses_of(lines):
+        out = {}
+        for line in lines:
+            m = re.match(r"step=(\d+) loss=(\S+)", line)
+            if m:
+                out[int(m.group(1))] = m.group(2)
+        return out
+
+    def drive(ck_dir, mesh_source):
+        lines = []
+        cfg = RunConfig(
+            model=model_name, batch_size=batch_size, seq_len=seq_len,
+            steps=steps, log_every=1, optimizer=opt, prefetch=2,
+            graceful_shutdown=False, checkpoint_dir=ck_dir,
+            checkpoint_every=10 ** 9,
+        )
+        result = run(cfg, log=lambda *a: lines.append(" ".join(
+            str(x) for x in a)), mesh_source=mesh_source)
+        return result, losses_of(lines)
+
+    out: dict = {"metric": "elastic_reshard_ms", "unit": "ms",
+                 "devices": n}
+    worst_ms = 0.0
+    root = tempfile.mkdtemp(prefix="bench_elastic_")
+    try:
+        for direction, start, target in (("grow", small, n),
+                                         ("shrink", n, small)):
+            ck = os.path.join(root, direction)
+            fired = []
+
+            def source(direction=direction, start=start, target=target,
+                       fired=fired):
+                # Flip once the loop reaches the mid-run step: the poll
+                # runs before step `flip` executes, so the grant changes
+                # exactly at that step boundary.
+                return target if fired else start
+
+            lines = []
+            cfg = RunConfig(
+                model=model_name, batch_size=batch_size, seq_len=seq_len,
+                steps=steps, log_every=1, optimizer=opt, prefetch=2,
+                graceful_shutdown=False, checkpoint_dir=ck,
+                checkpoint_every=10 ** 9,
+            )
+
+            def log_hook(msg, lines=lines, fired=fired):
+                msg = str(msg)
+                lines.append(msg)
+                m = re.match(r"step=(\d+) ", msg)
+                if m and int(m.group(1)) >= flip:
+                    fired.append(True)
+
+            result = run(cfg, log=log_hook, mesh_source=source)
+            losses = losses_of(lines)
+            if result["reshard_count"] != 1:
+                out["regression"] = (
+                    f"{direction}: expected exactly one reshard, got "
+                    f"{result['reshards']}")
+                return out
+            event = result["reshards"][0]
+            out[f"elastic_reshard_{direction}_ms"] = round(
+                1e3 * event["seconds"], 1)
+            out[f"elastic_downtime_{direction}_ms"] = round(
+                1e3 * event["downtime_seconds"], 1)
+            worst_ms = max(worst_ms, 1e3 * event["downtime_seconds"])
+
+            # Undisturbed reference: restore the reshard-point checkpoint
+            # into the target mesh and run the tail through the same
+            # loop. Prune later checkpoint steps from a copy so
+            # restore_latest lands on the reshard step.
+            ref_ck = os.path.join(root, f"{direction}-ref")
+            shutil.copytree(ck, ref_ck)
+            reshard_step = event["step"]
+            for entry in os.listdir(ref_ck):
+                if entry.isdigit() and int(entry) > reshard_step:
+                    shutil.rmtree(os.path.join(ref_ck, entry))
+            assert ckpt_lib.latest_step(ref_ck) == reshard_step
+            ref_result, ref_losses = drive(ref_ck, lambda: target)
+            mismatch = [
+                s for s in range(reshard_step + 1, steps + 1)
+                if losses.get(s) != ref_losses.get(s)]
+            if mismatch or result["loss"] != ref_result["loss"]:
+                out["regression"] = (
+                    f"{direction}: post-reshard losses diverge from the "
+                    f"restore-path reference at steps {mismatch[:4]}: "
+                    f"live={[losses.get(s) for s in mismatch[:4]]} "
+                    f"ref={[ref_losses.get(s) for s in mismatch[:4]]} "
+                    f"final live={result['loss']} ref={ref_result['loss']}")
+                return out
+
+        # The kill path's compute-only floor for the same capacity
+        # release (shrink leg): synchronous save, restore into the
+        # target mesh, rebuild + recompile the step. The real path adds
+        # requeue backoff and pod restart on top.
+        from kubeflow_tpu.models.registry import get_model
+        from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+        from kubeflow_tpu.train.data import place_batch, synthetic_batch
+        from kubeflow_tpu.train.trainer import (
+            build_train_step,
+            init_state,
+            state_shardings,
+        )
+
+        model = get_model(model_name)
+        big = build_mesh(MeshConfig(data=n))
+        state = init_state(jax.random.PRNGKey(0), model, opt, big)
+        kill_ck = os.path.join(root, "kill")
+        t0 = time.perf_counter()
+        ckpt_lib.save(kill_ck, 1, state, force=True)
+        target_mesh = build_mesh(MeshConfig(data=small),
+                                 devices=jax.devices()[:small])
+        abstract = jax.eval_shape(lambda: state)
+        abstract = jax.tree.map(
+            lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                              sharding=s),
+            abstract, state_shardings(abstract, target_mesh, model))
+        restored, _ = ckpt_lib.restore_latest(kill_ck, abstract)
+        step_fn = build_train_step(model, opt, target_mesh)
+        batch = place_batch(synthetic_batch(model, batch_size, seq_len),
+                            target_mesh, model)
+        restored, metrics = step_fn(restored, batch)
+        jax.block_until_ready(metrics["loss"])
+        kill_ms = 1e3 * (time.perf_counter() - t0)
+        out["elastic_kill_resume_ms"] = round(kill_ms, 1)
+        shrink_ms = out["elastic_downtime_shrink_ms"]
+        out["elastic_shrink_vs_kill_speedup"] = round(
+            kill_ms / max(shrink_ms, 1e-9), 2)
+        if shrink_ms >= kill_ms:
+            out["regression"] = (
+                f"shrink downtime {shrink_ms}ms not better than the "
+                f"kill-resume floor {kill_ms}ms")
+        out["value"] = round(worst_ms, 1)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+        import gc
+        gc.collect()
+        jax.clear_caches()
+    return out
+
+
 def run_training_isolated(*args, _fn: str = "run_training",
                           **kwargs) -> dict:
     """A bench function (default ``run_training``) in a FRESH subprocess.
@@ -220,9 +391,26 @@ def main() -> int:
     parser.add_argument("--skip-pipeline", action="store_true",
                         help="skip the input-pipeline stall comparison")
     parser.add_argument("--serving-requests", type=int, default=40)
+    parser.add_argument("--elastic", action="store_true",
+                        help="elastic-training scenario only: grow/shrink "
+                             "reshard latency + byte-equality + kill-path "
+                             "comparison (one JSON line)")
     parser.add_argument("--trace-dir", default=None,
                         help="capture a jax.profiler trace of the timed steps")
     args = parser.parse_args()
+
+    if args.elastic:
+        # The scenario needs a multi-chip mesh; on the CPU backend carve
+        # 8 virtual devices (set BEFORE any jax call initializes the
+        # backend — the flag only affects the host platform, so it is
+        # inert on TPU).
+        if "xla_force_host_platform_device_count" not in os.environ.get(
+                "XLA_FLAGS", ""):
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=8").strip()
+        print(json.dumps(run_elastic(steps=max(args.steps, 12))))
+        return 0
 
     on_tpu = jax.default_backend() == "tpu"
     if args.quick or not on_tpu:

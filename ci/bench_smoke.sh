@@ -91,3 +91,24 @@ for key in ("train_input_stall_pct", "train_input_stall_off_pct",
         sys.exit(f"bench output missing {key}: {rec}")
 '
 echo "train pipeline smoke ok"
+# Elastic training: grow 4->8 and shrink 8->4 mid-run through the real
+# loop's reshard point. The marker fires when any post-reshard loss
+# differs from the undisturbed restore-into-target reference at the
+# same global batch (live reshard must equal the rescale path it
+# replaces, byte-for-byte), or when the shrink downtime fails to beat
+# the kill-path floor (sync save + restore + step rebuild) for the
+# same capacity release.
+out="$(JAX_PLATFORMS=cpu python bench.py --elastic --steps 12)"
+check_json "$out"
+printf '%s\n' "$out" | python -c '
+import json, sys
+rec = json.loads([ln for ln in sys.stdin.read().splitlines()
+                  if ln.strip()][-1])
+for key in ("elastic_reshard_grow_ms", "elastic_reshard_shrink_ms",
+            "elastic_kill_resume_ms", "elastic_shrink_vs_kill_speedup"):
+    if key not in rec:
+        sys.exit(f"bench output missing {key}: {rec}")
+if rec["elastic_shrink_vs_kill_speedup"] <= 1.0:
+    sys.exit(f"shrink not strictly better than kill-requeue-resume: {rec}")
+'
+echo "elastic reshard smoke ok"

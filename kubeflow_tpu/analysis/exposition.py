@@ -42,6 +42,9 @@ LABEL_VOCAB = frozenset({
     # (serving/qos.py:tenant_bucket — a bounded t00..tNN set), never
     # raw client-supplied tenant strings.
     "tenant",
+    # Elastic training: values are exactly {"grow", "shrink"}
+    # (parallel/reshard.ReshardStats.direction).
+    "direction",
 })
 
 _NAME_RE = re.compile(r"^[a-z][a-z0-9]*(_[a-z0-9]+)+$")

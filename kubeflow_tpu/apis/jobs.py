@@ -220,6 +220,25 @@ def job_schema(kind: str, *, api_version: str | None = None) -> dict:
                     "queue": {"type": "string"},
                     "profile": {"type": "string"},
                     "preemptible": {"type": "boolean"},
+                    # Elastic host range (scheduler-managed jobs): the
+                    # grant may move inside [minReplicas, maxReplicas]
+                    # while the job runs — the scheduler shrinks it to
+                    # seat a queued gang (instead of evicting) and grows
+                    # it into idle capacity; workers reshard live at the
+                    # next step boundary (train/elastic.py). minReplicas
+                    # must cover the gang's pod count: processes are
+                    # fixed for the job's life, only the accelerator
+                    # grant above them is elastic.
+                    "elastic": {
+                        "type": "object",
+                        "required": ["minReplicas", "maxReplicas"],
+                        "properties": {
+                            "minReplicas": {"type": "integer",
+                                            "minimum": 1},
+                            "maxReplicas": {"type": "integer",
+                                            "minimum": 1},
+                        },
+                    },
                 },
                 "x-kubernetes-preserve-unknown-fields": True,
             },
@@ -401,3 +420,25 @@ def validate_job(job: Mapping) -> None:
     queue = spec.get("queue")
     if queue is not None and not isinstance(queue, str):
         raise JobValidationError(f"{kind}: queue must be a string")
+    elastic = spec.get("elastic")
+    if elastic is not None:
+        if not isinstance(elastic, Mapping):
+            raise JobValidationError(f"{kind}: elastic must be an object")
+        try:
+            lo = int(elastic["minReplicas"])
+            hi = int(elastic["maxReplicas"])
+        except (KeyError, TypeError, ValueError):
+            raise JobValidationError(
+                f"{kind}: elastic needs integer minReplicas/maxReplicas")
+        if lo < 1 or hi < lo:
+            raise JobValidationError(
+                f"{kind}: elastic range [{lo}, {hi}] invalid "
+                "(1 <= min <= max)")
+        pods = sum(rs.get("replicas", 1) for rs in replica_specs.values())
+        if lo < pods:
+            # The grant can never drop below the process count — worker
+            # processes are fixed; only chips above them are elastic.
+            raise JobValidationError(
+                f"{kind}: elastic minReplicas {lo} below the gang's "
+                f"{pods} pod(s); the host grant cannot drop under the "
+                "process count")

@@ -90,6 +90,26 @@ def is_preemptible(job: Mapping) -> bool:
     return bool(job.get("spec", {}).get("preemptible", True))
 
 
+def elastic_spec(job: Mapping) -> dict | None:
+    """The job's elastic range — ``{"min": minReplicas, "max":
+    maxReplicas}`` in hosts — or None for a fixed-size gang. Declaring
+    the range is the job's consent to live resizing: the scheduler may
+    grant anywhere inside it and move the grant while the job runs (the
+    train loop reshards at the next step boundary). Malformed blocks
+    read as non-elastic so the scheduler never resizes on garbage."""
+    raw = job.get("spec", {}).get("elastic")
+    if not isinstance(raw, Mapping):
+        return None
+    try:
+        lo = int(raw.get("minReplicas", 1))
+        hi = int(raw.get("maxReplicas", lo))
+    except (TypeError, ValueError):
+        return None
+    if lo < 1 or hi < lo:
+        return None
+    return {"min": lo, "max": hi}
+
+
 def placement(job: Mapping) -> dict | None:
     """Parse the job's placement annotation; None when unplaced (or the
     annotation is malformed — treated as unplaced so the scheduler
@@ -107,11 +127,41 @@ def placement(job: Mapping) -> dict | None:
 
 
 def encode_placement(pool: str, topology: str, slice_id: str,
-                     nodes: list[str], decided_at: str) -> str:
-    return json.dumps({
+                     nodes: list[str], decided_at: str,
+                     elastic: Mapping | None = None) -> str:
+    """``elastic`` (written for elastic jobs only) carries
+    ``{"granted": n, "min": m, "max": M}`` so the training loop can map
+    its host grant onto a device count without a second API read: target
+    devices = visible devices × granted / max (the pod is provisioned
+    for the max grant; parallel/reshard.scaled_mesh_config does the
+    axis math)."""
+    decided = {
         "pool": pool, "topology": topology, "slice": slice_id,
         "nodes": list(nodes), "decidedAt": decided_at,
-    }, sort_keys=True)
+    }
+    if elastic is not None:
+        decided["elastic"] = dict(elastic)
+    return json.dumps(decided, sort_keys=True)
+
+
+def placement_grant(job: Mapping) -> tuple[int, int] | None:
+    """(granted, max) hosts from an elastic placement; None when the job
+    is unplaced or not elastic. The ratio is the elastic train loop's
+    resize signal (train/elastic.py)."""
+    decided = placement(job)
+    if decided is None:
+        return None
+    elastic = decided.get("elastic")
+    if not isinstance(elastic, Mapping):
+        return None
+    try:
+        granted = int(elastic.get("granted", len(decided["nodes"])))
+        cap = int(elastic["max"])
+    except (KeyError, TypeError, ValueError):
+        return None
+    if granted < 1 or cap < granted:
+        return None
+    return granted, cap
 
 
 # ---------------------------------------------------------------------------
@@ -143,6 +193,23 @@ def scheduling_policy_schema() -> dict:
                             "requeueBackoffSeconds": {
                                 "type": "number", "minimum": 0},
                             "gracePeriodSeconds": {
+                                "type": "number", "minimum": 0},
+                        },
+                    },
+                    "elastic": {
+                        # Live-resize policy for jobs declaring
+                        # spec.elastic: shrink a running elastic victim
+                        # (placement rewrite → step-boundary reshard)
+                        # before falling back to preemption-by-kill, and
+                        # opportunistically grow elastic jobs into idle
+                        # capacity left after the queue pass.
+                        "type": "object",
+                        "properties": {
+                            "shrinkBeforePreempt": {"type": "boolean"},
+                            "growEnabled": {"type": "boolean"},
+                            "growDelaySeconds": {
+                                # Quiet period after a shrink before the
+                                # same job may grow back (anti-thrash).
                                 "type": "number", "minimum": 0},
                         },
                     },
@@ -211,6 +278,7 @@ def policy_knobs(policy: Mapping) -> dict:
     """Resolve a policy spec into a flat knob dict with defaults."""
     spec = policy.get("spec", {}) if policy else {}
     preemption = spec.get("preemption", {}) or {}
+    elastic = spec.get("elastic", {}) or {}
     weights = {DEFAULT_QUEUE: DEFAULT_QUEUE_WEIGHT}
     for q in spec.get("queues", []) or []:
         if isinstance(q, Mapping) and q.get("name"):
@@ -226,6 +294,9 @@ def policy_knobs(policy: Mapping) -> dict:
         "requeue_backoff": float(preemption.get(
             "requeueBackoffSeconds", DEFAULT_REQUEUE_BACKOFF_SECONDS)),
         "grace_seconds": float(preemption.get("gracePeriodSeconds", 30.0)),
+        "shrink_enabled": bool(elastic.get("shrinkBeforePreempt", True)),
+        "grow_enabled": bool(elastic.get("growEnabled", True)),
+        "grow_delay": float(elastic.get("growDelaySeconds", 0.0)),
         "queue_weights": weights,
         "profiles": dict(spec.get("profiles", {}) or {}),
     }

@@ -62,9 +62,10 @@ def _loopback(value: str) -> str:
 
 
 # Tail of a pod's output kept in status.log (the kubectl-logs analogue).
-# Sized so a few-hundred-step training log survives whole — the
-# preemption-resume E2E reads per-step losses out of it.
-_LOG_TAIL = 16384
+# Matches the 64KB spool window so a few-hundred-step per-step training
+# log survives whole — the preemption-resume AND elastic-shrink E2Es
+# read every per-step loss (and the reshard event line) out of it.
+_LOG_TAIL = 65536
 
 
 @dataclass
@@ -236,11 +237,18 @@ class FakeKubelet:
         """Drain the pod's spooled output (last 64KB) and close the file."""
         if run.out_file is None:
             return ""
-        size = run.out_file.seek(0, 2)
-        run.out_file.seek(max(0, size - 65536))
-        out = run.out_file.read().decode("utf-8", "replace")
+        out = FakeKubelet._peek_tail(run)
         run.out_file.close()
         return out
+
+    @staticmethod
+    def _peek_tail(run: "_Running") -> str:
+        """The pod's spooled output so far (last 64KB) WITHOUT closing —
+        live-log streaming for still-running pods (the `kubectl logs`
+        view tests use to observe a training loop mid-run)."""
+        size = run.out_file.seek(0, 2)
+        run.out_file.seek(max(0, size - 65536))
+        return run.out_file.read().decode("utf-8", "replace")
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -262,6 +270,18 @@ class FakeKubelet:
                     run.proc.wait()  # reap; also flushes remaining output
                     rc = -9
                 else:
+                    # Live log streaming: publish the output tail while
+                    # the pod runs, so observers (tests, the dashboard)
+                    # can follow a long-running workload without waiting
+                    # for exit.
+                    out = self._peek_tail(run)
+                    if out:
+                        pod = self.client.get_or_none(
+                            POD_API, "Pod", key[1], key[0])
+                        if (pod is not None
+                                and (pod.get("status", {}).get("log")
+                                     or "") != out[-_LOG_TAIL:]):
+                            self._set_phase(pod, "Running", log=out)
                     continue
             # Only the tail survives into status.log — don't materialize
             # a long-running pod's full output.

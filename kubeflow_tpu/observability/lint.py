@@ -305,6 +305,10 @@ def _self_check() -> int:
                     {"name": "main", "image": "i"}]}}}}},
         })
         SchedulerController(fake).reconcile_all()
+        # The elastic-training reshard families live in the same shared
+        # registry (train/elastic.py registers them at import) — pull
+        # them in before the scrape so their TYPE lines are asserted.
+        import kubeflow_tpu.train.elastic  # noqa: F401
 
         health = HealthServer(
             0, lambda: {"kubeflow_tpu_controllers_running": 1},
@@ -327,6 +331,10 @@ def _self_check() -> int:
                 ("scheduler_admissions_total", "counter"),
                 ("scheduler_preemptions_total", "counter"),
                 ("scheduler_requeues_total", "counter"),
+                ("scheduler_shrinks_total", "counter"),
+                ("scheduler_grows_total", "counter"),
+                ("train_reshards_total", "counter"),
+                ("train_reshard_seconds", "histogram"),
                 ("scheduler_unschedulable_jobs", "gauge")):
             if type_line(family, kind) not in operator_body:
                 failures.append(

@@ -186,9 +186,19 @@ class JobController(Controller):
         # fully creatable or fully parked (all-or-nothing admission).
         managed = sched_api.is_managed(job)
         decided = sched_api.placement(job) if managed else None
-        if (managed and decided is not None
-                and len(decided.get("nodes", [])) != len(desired)):
-            decided = None  # stale reservation (gang size changed): park
+        if managed and decided is not None:
+            nodes = decided.get("nodes", [])
+            if sched_api.elastic_spec(job) is not None:
+                # Elastic grant: pods sit on the PREFIX of the granted
+                # hosts; the grant may exceed the pod count (the extra
+                # hosts are accelerator width the training loop meshes
+                # over). Only a grant too small to seat every process
+                # parks the gang — a shrink/grow rewrite above the pod
+                # count must NOT churn pods, that is the whole point.
+                if len(nodes) < len(desired):
+                    decided = None
+            elif len(nodes) != len(desired):
+                decided = None  # stale reservation (gang size changed)
 
         pods = []
         for idx, (rt, i, rspec) in enumerate(desired):

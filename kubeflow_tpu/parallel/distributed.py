@@ -141,6 +141,38 @@ def global_any(flag: bool, *, timeout_ms: int = 60_000) -> bool:
     return any(vote == "1" for _, vote in votes)
 
 
+_min_seq = itertools.count()
+
+
+def global_min_int(value: int, *, timeout_ms: int = 60_000) -> int:
+    """All-reduce an integer over the gang's coordination service,
+    returning the MINIMUM everywhere. The elastic reshard agreement
+    (train/elastic.py): each process reports the resize target it has
+    observed (or a +inf sentinel), and the gang acts on the reduced
+    value — identical on every process, so a placement rewrite that
+    lands between different steps on different processes still produces
+    one common reshard step (the earliest observer's value wins for the
+    whole gang). Same KV+barrier transport as :func:`global_any`: every
+    process must call this at the same loop point and the same number
+    of times. Single-process returns the local value."""
+    if jax.process_count() <= 1:
+        return int(value)
+    from jax._src import distributed as _distributed
+
+    client = _distributed.global_state.client
+    seq = next(_min_seq)
+    prefix = f"ktpu/min/{seq}/"
+    client.key_value_set(f"{prefix}{jax.process_index()}", str(int(value)))
+    client.wait_at_barrier(f"ktpu/min-barrier/{seq}", timeout_ms)
+    votes = client.key_value_dir_get(prefix)
+    if seq > 0:
+        try:  # best-effort GC of the previous round's keys
+            client.key_value_delete(f"ktpu/min/{seq - 1}/")
+        except Exception:
+            pass
+    return min(int(v) for _, v in votes)
+
+
 def barrier(name: str = "barrier") -> None:
     """Block until every process reaches this point (checkpoint/teardown
     ordering — the role the openmpi sidecar's file signals play at

@@ -1,0 +1,142 @@
+"""Live state resharding between mesh shapes (the Tenplex-style remap).
+
+Rescaling a running job used to mean checkpoint-restore by hand; this
+module turns it into a state transformation: given a live pytree (params,
+optimizer slots, RNG, step — any leaves) and the target mesh's
+``NamedSharding`` tree, :func:`reshard_pytree` moves every leaf onto the
+target placement **bit-for-bit**. Two paths:
+
+- **device-to-device** when the source and target device sets overlap
+  (the common grow/shrink case — the surviving chips keep their bytes and
+  only the delta moves): one ``jax.device_put`` against the target
+  shardings, XLA's resharding transfers shard deltas directly;
+- **host-gather fallback** when the sets are disjoint (a job migrated to
+  a different slice): leaves are fetched to host memory and re-placed,
+  which works across any two device sets a single process can see.
+
+Both paths are pure data movement — no arithmetic touches the values, so
+the remapped state is bitwise identical to the source (pinned in tests).
+The compute that follows it on a different mesh degree is
+f32-equivalent-but-not-bitwise to the old degree (psum partial grouping
+changes with the shard count — the same caveat class as the serving tp
+meshes), which is why the elastic byte-equality contract compares against
+the restore-into-target-mesh path, not a fixed-mesh run
+(docs/training.md "Elastic training").
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import jax
+
+from kubeflow_tpu.parallel.mesh import AXIS_DATA, MESH_AXES, MeshConfig
+
+
+def tree_devices(tree) -> set:
+    """The set of devices currently holding any leaf of ``tree``."""
+    out: set = set()
+    for leaf in jax.tree.leaves(tree):
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None:
+            out |= set(sharding.device_set)
+    return out
+
+
+def shardings_devices(shardings) -> set:
+    out: set = set()
+    for sh in jax.tree.leaves(
+            shardings, is_leaf=lambda x: hasattr(x, "device_set")):
+        out |= set(getattr(sh, "device_set", ()))
+    return out
+
+
+@dataclass
+class ReshardStats:
+    """What one remap did (the Timeline-style record the train result
+    carries)."""
+
+    from_devices: int = 0
+    to_devices: int = 0
+    method: str = "device"  # "device" | "host"
+    leaves: int = 0
+    bytes: int = 0
+    seconds: float = 0.0
+
+    @property
+    def direction(self) -> str:
+        return "grow" if self.to_devices >= self.from_devices else "shrink"
+
+    def to_dict(self) -> dict:
+        return {
+            "from_devices": self.from_devices,
+            "to_devices": self.to_devices,
+            "direction": self.direction,
+            "method": self.method,
+            "leaves": self.leaves,
+            "bytes": self.bytes,
+            "seconds": round(self.seconds, 6),
+        }
+
+
+@dataclass
+class ReshardResult:
+    tree: object
+    stats: ReshardStats = field(default_factory=ReshardStats)
+
+
+def reshard_pytree(tree, shardings) -> ReshardResult:
+    """Remap ``tree`` onto ``shardings`` (a matching pytree of
+    ``NamedSharding``), bit-for-bit. Chooses device-to-device transfer
+    when the device sets overlap, host-gather otherwise. Blocks until
+    the remapped leaves are resident, so the caller's timing (and the
+    source buffers' release) is real, not dispatch latency."""
+    import time
+
+    src = tree_devices(tree)
+    dst = shardings_devices(shardings)
+    stats = ReshardStats(
+        from_devices=len(src), to_devices=len(dst),
+        leaves=len(jax.tree.leaves(tree)),
+        bytes=sum(getattr(x, "nbytes", 0) for x in jax.tree.leaves(tree)),
+    )
+    t0 = time.perf_counter()
+    if src and dst and not (src & dst):
+        # Disjoint sets: XLA cannot be assumed to route between device
+        # sets that share no member (cross-slice moves) — stage through
+        # host memory, then place with the target shardings.
+        stats.method = "host"
+        host = jax.device_get(tree)
+        out = jax.device_put(host, shardings)
+    else:
+        stats.method = "device"
+        out = jax.device_put(tree, shardings)
+    jax.block_until_ready(jax.tree.leaves(out))
+    stats.seconds = time.perf_counter() - t0
+    return ReshardResult(tree=out, stats=stats)
+
+
+def scaled_mesh_config(base: MeshConfig, n_devices: int) -> MeshConfig:
+    """The target mesh shape for an elastic resize: the **data** axis
+    absorbs the change (the only axis whose degree is free of the model's
+    geometry — fsdp/tensor/… splits are dimension-bound), every other
+    axis keeps its degree. Raises when ``n_devices`` is not divisible by
+    the fixed axes' product (the scheduler grants whole multiples of the
+    per-host chip count, so a clean spec never hits this)."""
+    if n_devices < 1:
+        raise ValueError(f"n_devices must be >= 1, got {n_devices}")
+    degrees = base.degrees()
+    fixed = math.prod(d for a, d in degrees.items()
+                      if a != AXIS_DATA and d != -1)
+    if any(d == -1 for a, d in degrees.items() if a != AXIS_DATA):
+        raise ValueError(
+            "elastic resize needs every non-data axis degree explicit; "
+            f"got {degrees}")
+    if n_devices % fixed:
+        raise ValueError(
+            f"{n_devices} devices not divisible by the fixed axes' "
+            f"product {fixed} — cannot scale the data axis")
+    kwargs = {a: degrees[a] for a in MESH_AXES}
+    kwargs[AXIS_DATA] = n_devices // fixed
+    return MeshConfig(**kwargs, ici_axes=base.ici_axes)

@@ -123,6 +123,12 @@ class ClusterCapacity:
     def release(self, holder: str) -> None:
         self._busy = {n: h for n, h in self._busy.items() if h != holder}
 
+    def vacate(self, nodes: Iterable[str]) -> None:
+        """Free specific hosts (an elastic shrink returns the tail of a
+        grant while the holder keeps the rest)."""
+        for node in nodes:
+            self._busy.pop(node, None)
+
     def feasible(self, n_hosts: int,
                  accelerator: str | None = None) -> list[Slice]:
         """Slices with >= n_hosts free right now (accelerator-filtered)."""

@@ -70,6 +70,12 @@ M_REQUEUES = OPERATOR_METRICS.counter(
 M_UNSCHEDULABLE = OPERATOR_METRICS.gauge(
     "scheduler_unschedulable_jobs",
     "Jobs whose request can never fit the current pools")
+M_SHRINKS = OPERATOR_METRICS.counter(
+    "scheduler_shrinks_total",
+    "Elastic jobs shrunk (placement rewritten) to seat a queued gang")
+M_GROWS = OPERATOR_METRICS.counter(
+    "scheduler_grows_total",
+    "Elastic jobs grown into idle capacity")
 
 
 def _now_dt() -> datetime.datetime:
@@ -174,6 +180,9 @@ class SchedulerController(Controller):
 
         depth: dict[str, int] = {}
         unschedulable = 0
+        waiting = 0         # fits-someday gangs still queued this round
+        resized: set[str] = set()  # jobs shrunk this round: never ALSO
+        #                            evicted, and never regrown, in it
         for entry in order_queue(queue, now,
                                  aging_seconds=knobs["aging_seconds"],
                                  queue_weights=knobs["queue_weights"],
@@ -190,12 +199,30 @@ class SchedulerController(Controller):
                 M_PLACEMENT.observe(time.perf_counter() - t0)
                 depth[entry.queue] -= 1
                 continue
-            if (knobs["preemption_enabled"]
-                    and not (entry.eligible_at and entry.eligible_at > now)
-                    and _key_str(entry.key) not in pending_preemptors):
+            in_backoff = bool(entry.eligible_at and entry.eligible_at > now)
+            is_pending = _key_str(entry.key) in pending_preemptors
+            # The cheaper move first: reclaim grant above an elastic
+            # victim's floor (a placement rewrite the victim absorbs at a
+            # step boundary) before any SIGTERM flies.
+            if (knobs["shrink_enabled"] and not in_backoff
+                    and not is_pending
+                    and self._try_shrink(entry, placed, capacity, book,
+                                         knobs, now, resized)):
+                M_PLACEMENT.observe(time.perf_counter() - t0)
+                depth[entry.queue] -= 1
+                continue
+            waiting += 1
+            if (knobs["preemption_enabled"] and not in_backoff
+                    and not is_pending):
                 if self._try_preempt(entry, placed, capacity,
-                                     pods_by_job, knobs, now):
+                                     pods_by_job, knobs, now,
+                                     exclude=resized):
                     pending_preemptors.add(_key_str(entry.key))
+
+        if knobs["grow_enabled"] and not waiting:
+            # Only genuinely idle capacity: a queued gang that could fit
+            # this pool someday has first claim on freed hosts.
+            self._grow_pass(placed, capacity, knobs, now, resized)
 
         for q in set(depth) | set(knobs["queue_weights"]):
             M_QUEUE_DEPTH.labels(q).set(depth.get(q, 0))
@@ -293,16 +320,29 @@ class SchedulerController(Controller):
             except ValueError:
                 pass
         tpu = job.get("spec", {}).get("tpu", {}) or {}
+        pods = _gang_hosts(job)
+        elastic = api.elastic_spec(job)
+        if elastic and elastic["max"] < pods:
+            # A range that cannot seat every process is malformed
+            # (admission webhook validation rejects it; a scheduler must
+            # not act on garbage): treat as a fixed-size gang.
+            elastic = None
+        # Elastic gangs admit at their floor (every process seated, at
+        # least minReplicas hosts) and extend toward maxReplicas from
+        # whatever the slice has free — degraded admission now beats
+        # queued-at-full-size later; the grow pass recovers the rest.
+        hosts = max(pods, elastic["min"]) if elastic else pods
         return QueueEntry(
             key=_job_key(job),
             priority=api.job_priority(job),
             queue=api.job_queue(job),
-            hosts=_gang_hosts(job),
+            hosts=hosts,
             queued_at=queued_at,
             eligible_at=eligible_at,
             accelerator=tpu.get("accelerator") or None,
             profile=job.get("spec", {}).get("profile"),
             preemptible=api.is_preemptible(job),
+            elastic=elastic,
             job=job,
         )
 
@@ -323,10 +363,23 @@ class SchedulerController(Controller):
                     sl.slice_id)
 
         sl = min(feasible, key=rank)
-        nodes = capacity.reserve(sl, entry.hosts, _key_str(entry.key))
+        want = entry.hosts
+        if entry.elastic:
+            # Opportunistic grow at admission: take whatever the chosen
+            # slice has free up to maxReplicas — idle contiguous hosts
+            # convert straight into data-parallel width.
+            free = len(capacity.free_hosts(sl))
+            want = min(max(entry.hosts, free), entry.elastic["max"])
+        nodes = capacity.reserve(sl, want, _key_str(entry.key))
         kind, ns, name = entry.key
+        elastic_grant = None
+        if entry.elastic:
+            elastic_grant = {"granted": len(nodes),
+                             "min": entry.elastic["min"],
+                             "max": entry.elastic["max"]}
         placement = api.encode_placement(sl.pool, sl.topology, sl.slice_id,
-                                         nodes, _iso(now))
+                                         nodes, _iso(now),
+                                         elastic=elastic_grant)
         self.client.patch(
             jobs_api.JOBS_API_VERSION, kind, name,
             {"metadata": {"annotations": {
@@ -341,6 +394,7 @@ class SchedulerController(Controller):
             "state": api.STATE_ADMITTED,
             "pool": sl.pool, "slice": sl.slice_id,
             "nodes": nodes, "admittedAt": _iso(now),
+            "granted": len(nodes) if entry.elastic else None,
             "requeueAfter": None, "preemptedBy": None,
         }, condition=(api.COND_QUEUED, "False", "Admitted",
                       f"placed on {sl.pool}/{sl.slice_id}"))
@@ -350,11 +404,153 @@ class SchedulerController(Controller):
         log.info("admitted %s -> %s/%s %s", _key_str(entry.key),
                  sl.pool, sl.slice_id, nodes)
 
+    def _floor(self, job: Mapping) -> int:
+        """An elastic job's smallest legal grant: every pod seated and at
+        least minReplicas hosts."""
+        elastic = api.elastic_spec(job)
+        if elastic is None:
+            return _gang_hosts(job)
+        return max(_gang_hosts(job), elastic["min"])
+
+    def _try_shrink(self, entry: QueueEntry, placed, capacity, book,
+                    knobs, now, resized: set[str]) -> bool:
+        """Seat ``entry`` by shrinking elastic jobs toward their floors —
+        a placement rewrite the victims absorb live (step-boundary
+        reshard), no eviction, no lost step. Applies only when shrinking
+        fully seats the entry on one slice; chooses the slice needing the
+        fewest shrunk jobs. Declaring ``spec.elastic`` is consent to run
+        anywhere inside the range whenever the cluster is contended, so
+        (unlike eviction) no priority gap gates the reclaim — grant above
+        the floor is borrowed capacity."""
+        candidates = []
+        for sl in capacity.slices:
+            if entry.accelerator not in (None, sl.pool):
+                continue
+            if sl.size < entry.hosts:
+                continue
+            free = len(capacity.free_hosts(sl))
+            shrinkable = []
+            for job in placed:
+                decided = api.placement(job)
+                if not decided or decided.get("slice") != sl.slice_id:
+                    continue
+                if api.elastic_spec(job) is None:
+                    continue
+                reclaim = len(decided["nodes"]) - self._floor(job)
+                if reclaim > 0:
+                    shrinkable.append((job, reclaim))
+            # Lowest priority loses width first; bigger reclaim breaks
+            # ties (fewer jobs disturbed for the same freed capacity).
+            shrinkable.sort(key=lambda jr: (api.job_priority(jr[0]),
+                                            -jr[1]))
+            chosen, freed = [], free
+            for job, reclaim in shrinkable:
+                if freed >= entry.hosts:
+                    break
+                take = min(reclaim, entry.hosts - freed)
+                chosen.append((job, take))
+                freed += take
+            if freed >= entry.hosts and chosen:
+                candidates.append((len(chosen), sl, chosen))
+        if not candidates:
+            return False
+        _, sl, chosen = min(candidates,
+                            key=lambda c: (c[0], c[1].slice_id))
+        for job, take in chosen:
+            self._shrink(job, take, capacity, now)
+            resized.add(_key_str(_job_key(job)))
+        self._admit(entry, [sl], capacity, book, now)
+        return True
+
+    def _shrink(self, job: dict, hosts: int, capacity, now) -> None:
+        """Return the tail ``hosts`` of an elastic grant. Pods sit on the
+        grant's PREFIX (operators/jobs.py maps pod i to nodes[i]), so a
+        tail drop never unseats a process — the job's training loop sees
+        the smaller grant at its next placement poll and reshards."""
+        decided = api.placement(job)
+        keep = decided["nodes"][:len(decided["nodes"]) - hosts]
+        dropped = decided["nodes"][len(keep):]
+        self._rewrite_grant(job, decided, keep, now)
+        capacity.vacate(dropped)
+        M_SHRINKS.inc()
+        log.info("shrunk %s to %d host(s), released %s",
+                 _key_str(_job_key(job)), len(keep), dropped)
+
+    def _grow_pass(self, placed, capacity, knobs, now,
+                   resized: set[str]) -> None:
+        """Extend under-max elastic grants into hosts left free after the
+        queue pass (idle → data-parallel width). A job resized this round
+        never regrows in it, and ``growDelaySeconds`` keeps a quiet
+        period after any resize (anti-thrash)."""
+        for job in placed:
+            key = _key_str(_job_key(job))
+            if key in resized:
+                continue
+            elastic = api.elastic_spec(job)
+            decided = api.placement(job)
+            if elastic is None or decided is None:
+                continue
+            granted = len(decided["nodes"])
+            if granted >= elastic["max"]:
+                continue
+            sched = job.get("status", {}).get("scheduling", {}) or {}
+            if knobs["grow_delay"] > 0 and sched.get("resizedAt"):
+                try:
+                    since = (now - parse_time(
+                        sched["resizedAt"])).total_seconds()
+                    if since < knobs["grow_delay"]:
+                        continue
+                except ValueError:
+                    pass
+            sl = next((s for s in capacity.slices
+                       if s.pool == decided.get("pool")
+                       and s.slice_id == decided.get("slice")), None)
+            if sl is None:
+                continue
+            extra = min(len(capacity.free_hosts(sl)),
+                        elastic["max"] - granted)
+            if extra <= 0:
+                continue
+            nodes = decided["nodes"] + capacity.reserve(sl, extra, key)
+            self._rewrite_grant(job, decided, nodes, now)
+            M_GROWS.inc()
+            log.info("grew %s to %d host(s) on %s/%s", key, len(nodes),
+                     sl.pool, sl.slice_id)
+
+    def _rewrite_grant(self, job: dict, decided: Mapping,
+                       nodes: list[str], now) -> None:
+        """Publish a resized grant: the SAME all-or-nothing placement
+        annotation with a new node set, granted count updated, state
+        still Admitted. Also updates the in-memory job dict so later
+        passes in this round see the new grant, not the snapshot's."""
+        elastic = api.elastic_spec(job) or {}
+        kind, ns, name = _job_key(job)
+        placement = api.encode_placement(
+            decided.get("pool", ""), decided.get("topology", ""),
+            decided.get("slice", ""), nodes, _iso(now),
+            elastic={"granted": len(nodes),
+                     "min": elastic.get("min", 1),
+                     "max": elastic.get("max", len(nodes))})
+        self.client.patch(
+            jobs_api.JOBS_API_VERSION, kind, name,
+            {"metadata": {"annotations": {api.ANN_PLACEMENT: placement}}},
+            ns,
+        )
+        job.setdefault("metadata", {}).setdefault(
+            "annotations", {})[api.ANN_PLACEMENT] = placement
+        self._write_scheduling(job, {
+            "nodes": list(nodes), "granted": len(nodes),
+            "resizedAt": _iso(now),
+        })
+
     def _try_preempt(self, entry: QueueEntry, placed, capacity,
-                     pods_by_job, knobs, now) -> bool:
+                     pods_by_job, knobs, now,
+                     exclude: set[str] = frozenset()) -> bool:
         """Free one slice for ``entry`` by evicting strictly lower-priority
         gangs. Chooses the slice needing the fewest victims; victims are
-        the lowest-priority, most-recently-admitted gangs there."""
+        the lowest-priority, most-recently-admitted gangs there. Jobs in
+        ``exclude`` (shrunk this round) are never also evicted — one
+        round disturbs a victim at most once."""
         candidates = []
         for sl in capacity.slices:
             if entry.accelerator not in (None, sl.pool):
@@ -366,6 +562,8 @@ class SchedulerController(Controller):
             for job in placed:
                 decided = api.placement(job)
                 if not decided or decided.get("slice") != sl.slice_id:
+                    continue
+                if _key_str(_job_key(job)) in exclude:
                     continue
                 if not api.is_preemptible(job):
                     continue

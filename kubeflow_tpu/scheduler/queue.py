@@ -54,12 +54,16 @@ class QueueEntry:
     key: tuple[str, str, str]  # (kind, namespace, name)
     priority: int
     queue: str
-    hosts: int                 # gang size in hosts (= pods)
+    hosts: int                 # hosts needed to admit (elastic: the floor)
     queued_at: datetime.datetime
     eligible_at: datetime.datetime | None = None  # preemption backoff
     accelerator: str | None = None
     profile: str | None = None
     preemptible: bool = True
+    # Elastic range {"min", "max"} in hosts (apis/scheduling.elastic_spec):
+    # admission reserves `hosts` (the floor) and opportunistically extends
+    # toward max in the same round; None = fixed-size gang.
+    elastic: dict | None = None
     job: dict = field(default_factory=dict, repr=False)
 
     def effective_priority(self, now: datetime.datetime,

@@ -1,0 +1,158 @@
+"""Elastic training: scheduler-driven live resharding (Tenplex-style).
+
+The glue between the cluster scheduler's placement annotation and the
+training loop's step boundary. The scheduler resizes an elastic job by
+rewriting ``kubeflow-tpu.org/placement`` (grant inside
+``spec.elastic.{minReplicas,maxReplicas}``); the loop polls that
+annotation between steps and, on a changed target, drains the input
+pipeline, remaps the live TrainState onto the new mesh
+(:mod:`kubeflow_tpu.parallel.reshard` — bit-for-bit, device-to-device
+with a host-gather fallback), rebuilds the jitted step, re-anchors the
+data stream (stateless in ``(seed, step)``) and continues — no process
+restart, no lost step.
+
+Byte-equality contract: the resharded continuation is bitwise identical
+to stopping at the reshard step and restoring the checkpoint into the
+target mesh (the rescale path this replaces). Compute across different
+mesh degrees is f32-equivalent but NOT bitwise to a fixed-mesh run (psum
+partial grouping follows the shard count — the serving tp caveat class),
+so that restore-path run IS the "undisturbed reference at the same
+global batch" the tests and the chaos soak pin against.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Callable
+
+import jax
+
+from kubeflow_tpu.observability.metrics import MetricRegistry
+from kubeflow_tpu.operators.base import OPERATOR_METRICS
+from kubeflow_tpu.parallel.reshard import (
+    ReshardStats,
+    reshard_pytree,
+    scaled_mesh_config,
+)
+
+log = logging.getLogger(__name__)
+
+# Reshard observability rides the shared operator registry: in-process
+# runs (tests, the manager's embedded workers) surface on the operator
+# /metrics scrape; subprocess workers report the same numbers through
+# the result dict's `reshards` timeline.
+M_RESHARDS = OPERATOR_METRICS.counter(
+    "train_reshards_total",
+    "Live train-state remaps between mesh shapes, by direction",
+    labels=("direction",))
+M_RESHARD_SECONDS = OPERATOR_METRICS.histogram(
+    "train_reshard_seconds",
+    "Wall time of one live reshard (drain to new jitted step ready)")
+
+ENV_APISERVER = "KUBEFLOW_TPU_APISERVER"
+
+# Sentinel for "no target visible here" in the gang all-reduce: above
+# any real device count, so min() over the gang only surfaces a target
+# every process has seen.
+_NO_TARGET = 2**31 - 1
+
+
+def placement_device_source(*, environ=None, client=None,
+                            total_devices: int | None = None
+                            ) -> Callable[[], int | None] | None:
+    """A poll callable mapping the job's live placement annotation to a
+    target device count, or None when the pod has no job identity (not
+    operator-launched). Target devices = visible devices × granted/max —
+    the pod is provisioned for the max grant, the mesh uses the granted
+    fraction. Transient apiserver faults read as "no change": placement
+    polling must never kill training."""
+    from kubeflow_tpu.apis import jobs as jobs_api
+    from kubeflow_tpu.apis import scheduling as sched_api
+
+    env = os.environ if environ is None else environ
+    name = env.get(jobs_api.ENV_JOB_NAME)
+    if not name:
+        return None
+    ns = env.get(jobs_api.ENV_JOB_NAMESPACE, "default")
+    kind = env.get(jobs_api.ENV_JOB_KIND, "JaxJob")
+    if client is None:
+        from kubeflow_tpu.k8s.client import (
+            ClusterConfig,
+            HttpK8sClient,
+            KindRegistry,
+        )
+
+        # The default registry only maps builtins — teach it this job
+        # kind's REST plural so the GET path resolves.
+        registry = KindRegistry()
+        registry.register_crd(jobs_api.job_crd(kind))
+        host = env.get(ENV_APISERVER)
+        client = HttpK8sClient(
+            ClusterConfig(host=host) if host else None, registry)
+
+    def poll() -> int | None:
+        try:
+            job = client.get(jobs_api.JOBS_API_VERSION, kind, name, ns)
+        except Exception:
+            return None
+        grant = sched_api.placement_grant(job)
+        if grant is None:
+            return None
+        granted, cap = grant
+        n = total_devices if total_devices else len(jax.devices())
+        return max(1, (n * granted) // cap)
+
+    return poll
+
+
+def agreed_target(local: int | None, num_processes: int) -> int | None:
+    """Gang-consistent resize target: every process must act on the SAME
+    target at the SAME step, but each polls the annotation independently
+    and may see a rewrite at different steps. All-reduce the locally
+    observed target (min over the gang, absent = +inf): the reduced
+    value is identical on every process, so the EARLIEST observer's
+    target drives the whole gang in lockstep (the same
+    earliest-signal-wins shape as the SIGTERM agreement; two rewrites
+    racing resolve to the smaller — safer — grant until the next poll
+    converges). Rides the coordination-service KV like global_any (no
+    XLA dispatch); single-process is a local no-op."""
+    if num_processes <= 1:
+        return local
+    from kubeflow_tpu.parallel.distributed import global_min_int
+
+    agreed = global_min_int(local if local is not None else _NO_TARGET)
+    return None if agreed >= _NO_TARGET else agreed
+
+
+def reshard_train_state(state, model, opt_cfg, base_mesh_config,
+                        target_devices: int, *, accum_steps: int = 1,
+                        registry: MetricRegistry | None = None):
+    """Remap a live TrainState onto ``target_devices`` and rebuild the
+    jitted step against the new mesh. Returns ``(mesh, state, step_fn,
+    stats)``. The data axis absorbs the resize
+    (:func:`~kubeflow_tpu.parallel.reshard.scaled_mesh_config`); the
+    remap itself is pure data movement, bitwise lossless."""
+    from kubeflow_tpu.parallel.mesh import build_mesh
+    from kubeflow_tpu.train.trainer import build_train_step, state_shardings
+
+    import time
+
+    devices = jax.devices()
+    if target_devices > len(devices):
+        raise ValueError(
+            f"target {target_devices} devices but only {len(devices)} "
+            "are visible to this process")
+    t0 = time.perf_counter()
+    mesh = build_mesh(scaled_mesh_config(base_mesh_config, target_devices),
+                      devices=devices[:target_devices])
+    abstract = jax.eval_shape(lambda: state)
+    shardings = state_shardings(abstract, mesh, model)
+    result = reshard_pytree(state, shardings)
+    step_fn = build_train_step(model, opt_cfg, mesh,
+                               accum_steps=accum_steps)
+    stats: ReshardStats = result.stats
+    stats.seconds = time.perf_counter() - t0
+    M_RESHARDS.labels(stats.direction).inc()
+    M_RESHARD_SECONDS.observe(stats.seconds)
+    return mesh, result.tree, step_fn, stats

@@ -55,6 +55,13 @@ class RunConfig:
     # effective batch batch_size×accum_steps at fixed HBM. The data
     # stream advances accum_steps microbatches per step.
     accum_steps: int = 1
+    # Elastic resharding (train.elastic): poll the scheduler's placement
+    # annotation every `elastic_poll_steps` steps; on a changed device
+    # grant, drain the prefetcher, remap the live state onto the new
+    # mesh (bit-for-bit), rebuild the jitted step and continue at the
+    # same step — the data axis absorbs the resize, the global batch is
+    # unchanged. 0 = fixed mesh.
+    elastic_poll_steps: int = 0
     # KTPU token-corpus file (train.tokenstore); empty = synthetic data.
     data_path: str | None = None
     checkpoint_dir: str | None = None
@@ -76,16 +83,44 @@ class RunConfig:
     profile_steps: int = 5
 
 
-def run(cfg: RunConfig, *, log=print) -> dict:
-    """Train; returns final metrics {step, loss, samples_per_sec, ...}."""
+def run(cfg: RunConfig, *, log=print, mesh_source=None) -> dict:
+    """Train; returns final metrics {step, loss, samples_per_sec, ...}.
+
+    ``mesh_source`` (tests/bench inject it; ``elastic_poll_steps`` builds
+    the placement-annotation poller for operator-launched pods) is a
+    zero-arg callable returning the current target device count, or None
+    for "no signal" — the loop reshards at the next poll boundary when
+    the gang-agreed target differs from the running mesh."""
+    from kubeflow_tpu.train import elastic as elastic_lib
+
     info = initialize_from_env()
     model = get_model(cfg.model, **cfg.model_overrides)
+    if mesh_source is None and cfg.elastic_poll_steps > 0:
+        mesh_source = elastic_lib.placement_device_source()
+    if mesh_source is not None and info.is_multislice:
+        log("elastic resharding is single-slice only; ignoring the "
+            "placement poller on this multislice gang")
+        mesh_source = None
     # A multislice gang (MEGASCALE env) must get the hybrid DCN placement —
     # slices span the data axis; ICI-hungry axes stay within slices.
-    mesh = build_mesh(
-        cfg.mesh,
-        num_slices=info.num_slices if info.is_multislice else None,
-    )
+    if mesh_source is not None:
+        # Elastic: the scheduler may have granted less than the max at
+        # admission — the FIRST mesh already honors the grant.
+        target = elastic_lib.agreed_target(mesh_source(),
+                                           info.num_processes)
+        n = min(target or len(jax.devices()), len(jax.devices()))
+        try:
+            mesh = build_mesh(
+                elastic_lib.scaled_mesh_config(cfg.mesh, n),
+                devices=jax.devices()[:n])
+        except ValueError as e:
+            log(f"ignoring initial elastic grant of {n} device(s): {e}")
+            mesh = build_mesh(cfg.mesh)
+    else:
+        mesh = build_mesh(
+            cfg.mesh,
+            num_slices=info.num_slices if info.is_multislice else None,
+        )
     opt_cfg = cfg.optimizer
 
     state = init_state(jax.random.PRNGKey(cfg.seed), model, opt_cfg, mesh)
@@ -126,7 +161,7 @@ def run(cfg: RunConfig, *, log=print) -> dict:
 
     try:
         return _train(cfg, info, model, mesh, opt_cfg, state, start_step,
-                      ckpt, stop_requested, log)
+                      ckpt, stop_requested, log, mesh_source=mesh_source)
     finally:
         if prev_handler is not None:
             import signal
@@ -134,22 +169,13 @@ def run(cfg: RunConfig, *, log=print) -> dict:
             signal.signal(signal.SIGTERM, prev_handler)
 
 
-def _train(cfg, info, model, mesh, opt_cfg, state, start_step, ckpt,
-           stop_requested, log):
-
-    step_fn = build_train_step(model, opt_cfg, mesh,
-                               accum_steps=cfg.accum_steps)
-    # The stream position counts MICROBATCHES: an accumulating run
-    # resumed at optimizer step N replays from microbatch N×accum_steps —
-    # data-exact resume stays stateless in (seed, step).
-    stream_step = start_step * cfg.accum_steps
-    store = None
-    if cfg.data_path:
-        from kubeflow_tpu.train.tokenstore import TokenStore
-
-        # Stateless in (seed, step): restarting at start_step replays the
-        # exact stream position — checkpoint resume is data-exact.
-        store = TokenStore(cfg.data_path)
+def _make_batches(cfg, info, model, mesh, stream_step, store):
+    """(batches, prefetcher) for one mesh + stream position. The stream
+    is stateless in (seed, microbatch-step), so an elastic reshard
+    re-anchors it here at the current position — the prefetched lookahead
+    the drain discarded is re-synthesized against the NEW mesh, byte-
+    identical batch order either way."""
+    if store is not None:
         stream = store.stream(
             cfg.batch_size, cfg.seq_len, seed=cfg.seed,
             start_step=stream_step, shard=info.process_id,
@@ -173,15 +199,35 @@ def _train(cfg, info, model, mesh, opt_cfg, state, start_step, ckpt,
         return place_batch(b, mesh, model,
                            microbatched=cfg.accum_steps > 1)
 
-    prefetcher = None
     if cfg.prefetch > 0:
         # Each process prefetches only its own shard (the stream above is
         # already per-process); placement is collective-free, so the
         # producer thread is multi-host safe.
         prefetcher = Prefetcher(stream, place, depth=cfg.prefetch)
-        batches = prefetcher
-    else:
-        batches = (place(b) for b in stream)
+        return prefetcher, prefetcher
+    return (place(b) for b in stream), None
+
+
+def _train(cfg, info, model, mesh, opt_cfg, state, start_step, ckpt,
+           stop_requested, log, mesh_source=None):
+    from kubeflow_tpu.train import elastic as elastic_lib
+
+    step_fn = build_train_step(model, opt_cfg, mesh,
+                               accum_steps=cfg.accum_steps)
+    # The stream position counts MICROBATCHES: an accumulating run
+    # resumed at optimizer step N replays from microbatch N×accum_steps —
+    # data-exact resume stays stateless in (seed, step).
+    store = None
+    if cfg.data_path:
+        from kubeflow_tpu.train.tokenstore import TokenStore
+
+        # Stateless in (seed, step): restarting at start_step replays the
+        # exact stream position — checkpoint resume is data-exact.
+        store = TokenStore(cfg.data_path)
+    batches, prefetcher = _make_batches(
+        cfg, info, model, mesh, start_step * cfg.accum_steps, store)
+    poll_steps = (cfg.elastic_poll_steps
+                  or (1 if mesh_source is not None else 0))
 
     # SIGTERM lands per pod at different steps, but checkpoint save is a
     # collective — under a gang the local flag is all-reduced each step
@@ -204,8 +250,59 @@ def _train(cfg, info, model, mesh, opt_cfg, state, start_step, ckpt,
     steps_done = 0
     profiling = False
     preempted_at = None
+    reshards = []
+    rejected_target = None
     try:
         for step in range(start_step, cfg.steps):
+            if (mesh_source is not None and poll_steps
+                    and (step - start_step) % poll_steps == 0):
+                # Reshard point: the gang-agreed grant decides; the poll
+                # cadence is deterministic in step, so every process
+                # enters the agreement the same number of times.
+                target = elastic_lib.agreed_target(mesh_source(),
+                                                   info.num_processes)
+                if (target and target != mesh.devices.size
+                        and target != rejected_target):
+                    t_rs = time.perf_counter()
+                    try:
+                        elastic_lib.scaled_mesh_config(cfg.mesh, target)
+                        if target > len(jax.devices()):
+                            raise ValueError(
+                                f"only {len(jax.devices())} device(s) "
+                                "visible to this process")
+                    except ValueError as e:
+                        rejected_target = target
+                        log(f"ignoring reshard target {target}: {e}")
+                    else:
+                        rejected_target = None
+                        # Drain in-flight prefetch BEFORE touching the
+                        # state: the lookahead was placed for the old
+                        # mesh; the stream re-anchors at this step.
+                        if prefetcher is not None:
+                            prefetcher.close()
+                        if ckpt is not None:
+                            # Reshard-point checkpoint: crash safety
+                            # across the remap, and the restore-into-
+                            # target replay the byte-equality pin
+                            # compares against.
+                            ckpt.save(step, state, force=True)
+                            ckpt.wait()
+                        mesh, state, step_fn, stats = (
+                            elastic_lib.reshard_train_state(
+                                state, model, opt_cfg, cfg.mesh, target,
+                                accum_steps=cfg.accum_steps))
+                        batches, prefetcher = _make_batches(
+                            cfg, info, model, mesh,
+                            step * cfg.accum_steps, store)
+                        event = stats.to_dict()
+                        event["step"] = step
+                        event["downtime_seconds"] = round(
+                            time.perf_counter() - t_rs, 6)
+                        reshards.append(event)
+                        log(f"resharded {stats.direction} "
+                            f"{stats.from_devices}->{stats.to_devices} "
+                            f"devices at step {step} in "
+                            f"{stats.seconds * 1e3:.0f}ms ({stats.method})")
             t_step = time.perf_counter()
             if cfg.profile_dir and info.process_id == 0:
                 if step - start_step == cfg.profile_start_step:
@@ -302,6 +399,13 @@ def _train(cfg, info, model, mesh, opt_cfg, state, start_step, ckpt,
         "step_time_p99_ms": round(1e3 * step_hist.quantile(0.99), 3),
         "prefetch_depth": cfg.prefetch,
         "accum_steps": cfg.accum_steps,
+        # Elastic reshard timeline: one event per live remap (direction,
+        # devices, remap seconds, full downtime incl. drain + stream
+        # re-anchor) — the Timeline-style record dashboards and the
+        # run_elastic bench read.
+        "devices": int(mesh.devices.size),
+        "reshard_count": len(reshards),
+        "reshards": reshards,
     }
     if info.process_id == 0 and preempted_at is None:
         publish_metrics(result, log=log)

@@ -1,0 +1,39 @@
+"""Minimized elastic-reshard drain hazard: the host-gather fallback and
+the prefetcher join running UNDER a held placement lock.
+
+The reshard point's most exposed class: a poller thread shares
+``_placement_lock`` with the drain; holding it across
+``jax.device_get`` (the disjoint-device-set fallback gathers the whole
+TrainState to host) and across the producer join parks every placement
+poll — and with it the scheduler's view of the job — for the entire
+remap. The lock-discipline checker must flag both blocking calls
+(``lock-blocking-call``).
+"""
+
+import threading
+
+import jax
+
+
+class BadElasticDrain:
+    """Drains and reshards with the placement lock held throughout."""
+
+    def __init__(self, state, produce):
+        self._placement_lock = threading.Lock()
+        self._state = state
+        self._producer = threading.Thread(target=produce, daemon=True)
+        self._producer.start()
+        self._target = None
+
+    def poll(self):
+        with self._placement_lock:
+            return self._target
+
+    def reshard(self, shardings):
+        with self._placement_lock:
+            # BUG: the whole drain + host gather runs under the lock the
+            # poller contends on — every placement poll stalls for the
+            # full remap.
+            self._producer.join(10.0)
+            host = jax.device_get(self._state)
+            self._state = jax.device_put(host, shardings)

@@ -1,0 +1,31 @@
+"""Elastic-reshard drain GOOD twin: the blocking work (producer join,
+host gather, re-placement) runs with no lock held; the placement lock
+guards only the pointer swaps, so the poller never waits out a remap."""
+
+import threading
+
+import jax
+
+
+class GoodElasticDrain:
+    """Drain and remap outside the lock; swap under it."""
+
+    def __init__(self, state, produce):
+        self._placement_lock = threading.Lock()
+        self._state = state
+        self._producer = threading.Thread(target=produce, daemon=True)
+        self._producer.start()
+        self._target = None
+
+    def poll(self):
+        with self._placement_lock:
+            return self._target
+
+    def reshard(self, shardings):
+        with self._placement_lock:
+            state = self._state
+        self._producer.join(10.0)
+        host = jax.device_get(state)
+        moved = jax.device_put(host, shardings)
+        with self._placement_lock:
+            self._state = moved

@@ -68,6 +68,21 @@ def test_lock_order_cycle_detected():
         FIXTURES / "lock_order_cycle_bad.py")
 
 
+def test_elastic_drain_blocking_under_lock_detected():
+    """The elastic reshard hot path's most exposed class: the drain's
+    producer join AND the host-gather fallback's device_get held under
+    the placement lock a poller thread contends on — both blocking
+    calls must be flagged."""
+    found = _findings(FIXTURES / "lock_elastic_drain_bad.py")
+    hits = [f for f in found if f.rule == "lock-blocking-call"]
+    assert len(hits) >= 2, found
+    messages = " ".join(h.message for h in hits)
+    assert "_placement_lock" in messages
+    assert "device_get" in messages
+    assert "join" in messages
+    assert all(h.symbol == "BadElasticDrain.reshard" for h in hits)
+
+
 def test_pr4_torn_metrics_detected():
     found = _findings(FIXTURES / "lock_torn_metrics_bad.py")
     hits = [f for f in found if f.rule == "lock-inconsistent-guard"]
@@ -122,7 +137,8 @@ def test_metrics_exposition_detected():
 
 
 def test_good_fixtures_are_clean():
-    for name in ("lock_good.py", "thread_lifecycle_good.py",
+    for name in ("lock_good.py", "lock_elastic_drain_good.py",
+                 "thread_lifecycle_good.py",
                  "resource_good.py", "jax_hygiene_good.py",
                  "jax_hygiene_shard_map_good.py",
                  "metrics_exposition_good.py"):

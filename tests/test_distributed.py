@@ -498,6 +498,46 @@ def test_preemption_resume_e2e_continues_loss_trajectory(api, tmp_path):
     assert resumed.get(250) == pytest.approx(control[250], abs=2e-4)
 
 
+def test_global_min_int_agrees_across_staggered_gang():
+    """The elastic reshard agreement primitive, isolated: two real
+    processes run the same global_min_int sequence; one observes the
+    resize target (4) at round 2, the other at round 5. The all-reduced
+    value is identical everywhere, so BOTH act on the target at round 2
+    — the earliest observer wins for the whole gang (same earliest-
+    signal-wins shape as the SIGTERM agreement), which is what lets the
+    gang reshard in lockstep however the placement poll staggers."""
+    sentinel = 2**31 - 1
+    port = free_port()
+    prog = (
+        "import os\n"
+        "from kubeflow_tpu.parallel.distributed import ("
+        "global_min_int, initialize_from_env, shutdown)\n"
+        "initialize_from_env()\n"
+        "see_at = int(os.environ['SEE_AT'])\n"
+        "first = -1\n"
+        "for round_id in range(8):\n"
+        f"    local = 4 if round_id >= see_at else {sentinel}\n"
+        "    agreed = global_min_int(local)\n"
+        f"    if agreed < {sentinel} and first < 0:\n"
+        "        first = round_id\n"
+        "print('FIRST_AGREED=' + str(first))\n"
+        "shutdown()\n"
+    )
+    procs = []
+    for pid, see_at in ((0, 2), (1, 5)):
+        env = worker_env(port, 2, pid, devices=1)
+        env["SEE_AT"] = str(see_at)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", prog], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            cwd=REPO,
+        ))
+    outs = [p.communicate(timeout=120)[0] for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out
+        assert "FIRST_AGREED=2" in out, out
+
+
 def test_global_any_agrees_across_staggered_gang():
     """The stop-flag agreement primitive (ADVICE r5 #2), isolated: two
     real processes join the rendezvous and run the same global_any

@@ -1,0 +1,410 @@
+"""Elastic-training tests: bitwise state remap between mesh shapes, the
+loop's reshard point (grow 4→8 / shrink 8→4 byte-equal to the undisturbed
+restore-into-target reference at the same global batch, for dp, dp×fsdp
+and dp×tp meshes), cross-mesh checkpoint restore (8-way→4-way→8-way),
+placement polling, and the reshard metric families."""
+
+import re
+import shutil
+
+import jax
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models.registry import get_model
+from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+from kubeflow_tpu.parallel.reshard import (
+    reshard_pytree,
+    scaled_mesh_config,
+)
+from kubeflow_tpu.train import checkpoint as ckpt_lib
+from kubeflow_tpu.train.data import place_batch, synthetic_batch
+from kubeflow_tpu.train.loop import RunConfig, run
+from kubeflow_tpu.train.optimizers import OptimizerConfig
+from kubeflow_tpu.train.trainer import (
+    build_train_step,
+    init_state,
+    state_shardings,
+)
+
+OPT = OptimizerConfig(learning_rate=1e-2, warmup_steps=2, total_steps=50)
+
+
+def _state_on(mesh, model, steps=2, batch_size=8, seq_len=16):
+    state = init_state(jax.random.PRNGKey(0), model, OPT, mesh)
+    step_fn = build_train_step(model, OPT, mesh)
+    for s in range(steps):
+        batch = place_batch(synthetic_batch(model, batch_size, seq_len,
+                                            seed=s), mesh, model)
+        state, _ = step_fn(state, batch)
+    return state
+
+
+def _bits_equal(a, b) -> bool:
+    return all(jax.tree.leaves(jax.tree.map(
+        lambda x, y: bool(np.array_equal(np.asarray(x), np.asarray(y))),
+        a, b)))
+
+
+# ---------------------------------------------------------------------------
+# reshard layer
+# ---------------------------------------------------------------------------
+
+
+def test_reshard_pytree_bitwise_roundtrip():
+    """8-way → 4-way → 8-way: the remap is pure data movement — every
+    leaf bit-identical after each hop, device path chosen for
+    overlapping sets."""
+    model = get_model("lm-test-tiny")
+    devs = jax.devices()
+    m8 = build_mesh(MeshConfig(data=8))
+    m4 = build_mesh(MeshConfig(data=4), devices=devs[:4])
+    state = _state_on(m8, model)
+    before = jax.device_get(state)
+
+    sh4 = state_shardings(jax.eval_shape(lambda: state), m4, model)
+    down = reshard_pytree(state, sh4)
+    assert down.stats.direction == "shrink"
+    assert down.stats.method == "device"
+    assert down.stats.from_devices == 8 and down.stats.to_devices == 4
+    assert _bits_equal(before, jax.device_get(down.tree))
+
+    sh8 = state_shardings(jax.eval_shape(lambda: down.tree), m8, model)
+    up = reshard_pytree(down.tree, sh8)
+    assert up.stats.direction == "grow"
+    assert _bits_equal(before, jax.device_get(up.tree))
+    # Leaves really live on the target mesh now.
+    wq = up.tree.params["layers"]["attn"]["wq"]
+    assert set(wq.sharding.device_set) == set(devs)
+
+
+def test_reshard_disjoint_device_sets_host_fallback():
+    """Source and target sharing no device (a cross-slice migration):
+    the host-gather fallback path, still bit-for-bit."""
+    model = get_model("lm-test-tiny")
+    devs = jax.devices()
+    m_lo = build_mesh(MeshConfig(data=4), devices=devs[:4])
+    m_hi = build_mesh(MeshConfig(data=4), devices=devs[4:])
+    state = _state_on(m_lo, model)
+    before = jax.device_get(state)
+    sh = state_shardings(jax.eval_shape(lambda: state), m_hi, model)
+    moved = reshard_pytree(state, sh)
+    assert moved.stats.method == "host"
+    assert _bits_equal(before, jax.device_get(moved.tree))
+    assert set(moved.tree.params["final_norm"].sharding.device_set) \
+        <= set(devs[4:])
+
+
+def test_scaled_mesh_config_data_axis_absorbs_resize():
+    assert scaled_mesh_config(MeshConfig(), 8).data == 8
+    cfg = scaled_mesh_config(MeshConfig(data=-1, fsdp=2), 8)
+    assert cfg.data == 4 and cfg.fsdp == 2
+    cfg = scaled_mesh_config(MeshConfig(data=2, tensor=2), 4)
+    assert cfg.data == 2 and cfg.tensor == 2
+    with pytest.raises(ValueError, match="not divisible"):
+        scaled_mesh_config(MeshConfig(fsdp=2), 5)
+    with pytest.raises(ValueError, match="explicit"):
+        scaled_mesh_config(MeshConfig(data=2, fsdp=-1), 8)
+    with pytest.raises(ValueError):
+        scaled_mesh_config(MeshConfig(), 0)
+
+
+# ---------------------------------------------------------------------------
+# loop reshard point: byte-equality vs the restore-into-target reference
+# ---------------------------------------------------------------------------
+
+
+def _losses_of(lines):
+    out = {}
+    for line in lines:
+        m = re.match(r"step=(\d+) loss=(\S+)", line)
+        if m:
+            out[int(m.group(1))] = m.group(2)
+    return out
+
+
+def _drive(cfg, mesh_source):
+    lines = []
+    result = run(cfg, log=lambda *a: lines.append(
+        " ".join(str(x) for x in a)), mesh_source=mesh_source)
+    return result, _losses_of(lines), lines
+
+
+def _elastic_cfg(ck_dir, mesh, steps=6, accum=1):
+    return RunConfig(
+        model="lm-test-tiny", mesh=mesh, optimizer=OPT,
+        # Smallest shape that still exercises every mesh axis (tp needs
+        # n_heads/n_kv_heads divisible): compile time dominates these
+        # tests, not step count.
+        model_overrides={"n_layers": 1, "d_model": 32, "d_ff": 64,
+                         "n_heads": 2, "n_kv_heads": 2},
+        batch_size=8, seq_len=16, steps=steps, log_every=1,
+        prefetch=2, accum_steps=accum, graceful_shutdown=False,
+        checkpoint_dir=ck_dir, checkpoint_every=10 ** 9,
+    )
+
+
+def _prune_after(ck_dir, step):
+    import os
+
+    for entry in os.listdir(ck_dir):
+        if entry.isdigit() and int(entry) > step:
+            shutil.rmtree(f"{ck_dir}/{entry}")
+    assert ckpt_lib.latest_step(ck_dir) == step
+
+
+MESHES = {
+    "dp": MeshConfig(),
+    "dp_fsdp": MeshConfig(data=-1, fsdp=2),
+    "dp_tp": MeshConfig(data=-1, tensor=2),
+}
+
+
+@pytest.mark.parametrize("mesh_kind", list(MESHES))
+@pytest.mark.parametrize("direction", ["grow", "shrink"])
+def test_reshard_point_byte_equal_to_restore_reference(
+        tmp_path, mesh_kind, direction):
+    """The acceptance pin: grow 4→8 and shrink 8→4 mid-run, loss
+    trajectory after the reshard byte-equal to an undisturbed run at the
+    same global batch continuing from the reshard-point state on the
+    target mesh (the checkpoint-restore rescale path live resharding
+    replaces — compute across mesh degrees is f32-equivalent, not
+    bitwise, so THAT is the undisturbed reference; docs/training.md)."""
+    steps, flip = 6, 3
+    start, target = (4, 8) if direction == "grow" else (8, 4)
+    mesh = MESHES[mesh_kind]
+    fired = []
+
+    def source():
+        return target if fired else start
+
+    lines = []
+    cfg = _elastic_cfg(str(tmp_path / "live"), mesh, steps=steps)
+
+    def log_hook(msg):
+        msg = str(msg)
+        lines.append(msg)
+        if re.match(rf"step={flip} ", msg):
+            fired.append(True)
+
+    result = run(cfg, log=log_hook, mesh_source=source)
+    losses = _losses_of(lines)
+    assert result["reshard_count"] == 1, result["reshards"]
+    event = result["reshards"][0]
+    assert event["direction"] == direction
+    assert event["step"] == flip
+    assert result["devices"] == target
+    assert result["step"] == steps
+
+    # Undisturbed reference: restore the reshard-point checkpoint into
+    # the target mesh, run the tail with no resize.
+    ref_ck = str(tmp_path / "ref")
+    shutil.copytree(cfg.checkpoint_dir, ref_ck)
+    _prune_after(ref_ck, flip)
+    ref_result, ref_losses, _ = _drive(
+        _elastic_cfg(ref_ck, mesh, steps=steps), lambda: target)
+    assert ref_result["reshard_count"] == 0
+    for s in range(flip + 1, steps + 1):
+        assert losses[s] == ref_losses[s], (
+            f"{mesh_kind} {direction}: step {s} loss {losses[s]} != "
+            f"reference {ref_losses[s]}")
+    assert result["loss"] == ref_result["loss"]
+
+
+def test_reshard_point_with_accum_microbatching(tmp_path):
+    """Gradient accumulation across a shrink: the stream re-anchors in
+    MICROBATCH units (step × accum), so the post-reshard trajectory still
+    matches the restore reference byte-for-byte at the same global
+    batch."""
+    steps, flip, accum = 6, 3, 2
+    fired = []
+    lines = []
+    cfg = _elastic_cfg(str(tmp_path / "live"), MeshConfig(), steps=steps,
+                       accum=accum)
+
+    def log_hook(msg):
+        msg = str(msg)
+        lines.append(msg)
+        if re.match(rf"step={flip} ", msg):
+            fired.append(True)
+
+    result = run(cfg, log=log_hook, mesh_source=lambda: 4 if fired else 8)
+    losses = _losses_of(lines)
+    assert result["reshard_count"] == 1
+    ref_ck = str(tmp_path / "ref")
+    shutil.copytree(cfg.checkpoint_dir, ref_ck)
+    _prune_after(ref_ck, flip)
+    ref_result, ref_losses, _ = _drive(
+        _elastic_cfg(ref_ck, MeshConfig(), steps=steps, accum=accum),
+        lambda: 4)
+    for s in range(flip + 1, steps + 1):
+        assert losses[s] == ref_losses[s]
+    assert result["loss"] == ref_result["loss"]
+
+
+def test_infeasible_target_ignored_and_logged_once(tmp_path):
+    """A grant that cannot map onto the fixed axes (5 devices with
+    fsdp=2) is skipped — the loop keeps training on the old mesh and
+    logs the rejection once, not every step."""
+    lines = []
+    cfg = _elastic_cfg(str(tmp_path / "ck"), MeshConfig(data=-1, fsdp=2),
+                       steps=4)
+    result = run(cfg, log=lambda *a: lines.append(" ".join(
+        str(x) for x in a)), mesh_source=lambda: 5)
+    assert result["reshard_count"] == 0
+    assert result["step"] == 4
+    rejects = [ln for ln in lines if "ignoring reshard target 5" in ln]
+    assert len(rejects) == 1, lines
+
+
+def test_target_beyond_visible_devices_rejected(tmp_path):
+    lines = []
+    cfg = _elastic_cfg(str(tmp_path / "ck"), MeshConfig(), steps=3)
+    result = run(cfg, log=lambda *a: lines.append(" ".join(
+        str(x) for x in a)), mesh_source=lambda: 16)
+    assert result["reshard_count"] == 0
+    assert any("ignoring reshard target 16" in ln for ln in lines)
+
+
+def test_initial_grant_shapes_first_mesh(tmp_path):
+    """A job admitted below its max grant starts on the granted fraction
+    — the first mesh honors the annotation, no reshard event."""
+    cfg = _elastic_cfg(str(tmp_path / "ck"), MeshConfig(), steps=3)
+    result, _, _ = _drive(cfg, lambda: 4)
+    assert result["devices"] == 4
+    assert result["reshard_count"] == 0
+
+
+# ---------------------------------------------------------------------------
+# checkpoint: restore into a different mesh shape (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_restore_into_different_mesh_roundtrip(tmp_path):
+    """8-way save → 4-way restore → 4-way save → 8-way restore:
+    restore_latest places into the TARGET abstract state's shardings
+    whatever mesh wrote the checkpoint; bits survive the full round
+    trip."""
+    model = get_model("lm-test-tiny")
+    devs = jax.devices()
+    m8 = build_mesh(MeshConfig(data=4, fsdp=2))
+    m4 = build_mesh(MeshConfig(data=2, fsdp=2), devices=devs[:4])
+    state = _state_on(m8, model)
+    before = jax.device_get(state)
+
+    ck8 = str(tmp_path / "ck8")
+    ckpt_lib.save(ck8, 2, state)
+
+    def abstract_on(mesh):
+        a = jax.eval_shape(lambda: state)
+        return jax.tree.map(
+            lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                              sharding=s),
+            a, state_shardings(a, mesh, model))
+
+    on4, step = ckpt_lib.restore_latest(ck8, abstract_on(m4))
+    assert step == 2
+    assert _bits_equal(before, jax.device_get(on4))
+    wq = on4.params["layers"]["attn"]["wq"]
+    assert set(wq.sharding.device_set) <= set(devs[:4])
+    # The restored state trains on the smaller mesh.
+    fn4 = build_train_step(model, OPT, m4)
+    batch = place_batch(synthetic_batch(model, 8, 16, seed=9), m4, model)
+    on4b, metrics = fn4(on4, batch)
+    assert np.isfinite(float(metrics["loss"]))
+
+    ck4 = str(tmp_path / "ck4")
+    ckpt_lib.save(ck4, 3, on4b)
+    back, step = ckpt_lib.restore_latest(ck4, abstract_on(m8))
+    assert step == 3
+    assert _bits_equal(jax.device_get(on4b), jax.device_get(back))
+
+
+# ---------------------------------------------------------------------------
+# placement polling + metrics
+# ---------------------------------------------------------------------------
+
+
+def _job_with_grant(granted, cap, nodes=None):
+    from kubeflow_tpu.apis import scheduling as sched_api
+
+    nodes = nodes if nodes is not None else [f"h{i}" for i in
+                                             range(granted)]
+    return {
+        "apiVersion": "kubeflow-tpu.org/v1", "kind": "JaxJob",
+        "metadata": {"name": "ej", "namespace": "ns", "annotations": {
+            sched_api.ANN_PLACEMENT: sched_api.encode_placement(
+                "v5e", "2x4", "v5e-0", nodes, "t0",
+                elastic={"granted": granted, "min": 1, "max": cap}),
+        }},
+        "spec": {"priority": 1, "elastic": {"minReplicas": 1,
+                                            "maxReplicas": cap}},
+    }
+
+
+class _StubClient:
+    def __init__(self, job=None, error=None):
+        self.job = job
+        self.error = error
+
+    def get(self, api_version, kind, name, ns):
+        if self.error is not None:
+            raise self.error
+        return self.job
+
+
+def test_placement_device_source_scales_visible_devices():
+    from kubeflow_tpu.apis.jobs import (
+        ENV_JOB_KIND,
+        ENV_JOB_NAME,
+        ENV_JOB_NAMESPACE,
+    )
+    from kubeflow_tpu.train.elastic import placement_device_source
+
+    env = {ENV_JOB_NAME: "ej", ENV_JOB_NAMESPACE: "ns",
+           ENV_JOB_KIND: "JaxJob"}
+    poll = placement_device_source(
+        environ=env, client=_StubClient(_job_with_grant(1, 2)),
+        total_devices=8)
+    assert poll() == 4  # half the grant -> half the devices
+    poll = placement_device_source(
+        environ=env, client=_StubClient(_job_with_grant(2, 2)),
+        total_devices=8)
+    assert poll() == 8
+    # Transient apiserver fault reads as "no signal", never an exception.
+    poll = placement_device_source(
+        environ=env, client=_StubClient(error=ConnectionError("down")),
+        total_devices=8)
+    assert poll() is None
+    # Unplaced / non-elastic placement: no signal.
+    bare = _job_with_grant(2, 2)
+    del bare["metadata"]["annotations"]
+    poll = placement_device_source(
+        environ=env, client=_StubClient(bare), total_devices=8)
+    assert poll() is None
+    # No job identity (not operator-launched): no source at all.
+    assert placement_device_source(environ={}, client=_StubClient()) \
+        is None
+
+
+def test_reshard_metric_families_rendered(tmp_path):
+    """train_reshards_total{direction} + train_reshard_seconds land in
+    the shared operator registry after a live reshard."""
+    from kubeflow_tpu.observability.metrics import type_line
+    from kubeflow_tpu.operators.base import OPERATOR_METRICS
+
+    fired = []
+    cfg = _elastic_cfg(str(tmp_path / "ck"), MeshConfig(), steps=4)
+
+    def log_hook(msg):
+        if re.match(r"step=2 ", str(msg)):
+            fired.append(True)
+
+    result = run(cfg, log=log_hook,
+                 mesh_source=lambda: 4 if fired else 8)
+    assert result["reshard_count"] == 1
+    body = OPERATOR_METRICS.render()
+    assert type_line("train_reshards_total", "counter") in body
+    assert 'train_reshards_total{direction="shrink"}' in body
+    assert type_line("train_reshard_seconds", "histogram") in body
+    assert "train_reshard_seconds_count" in body

@@ -19,6 +19,7 @@ import datetime
 import json
 import os
 import random
+import re
 import time
 
 import pytest
@@ -527,6 +528,391 @@ def test_node_loss_revokes_placement_and_reschedules(cluster):
 
 
 # ---------------------------------------------------------------------------
+# elastic: shrink-before-preempt, grow into idle capacity
+# ---------------------------------------------------------------------------
+
+
+def _elastic_job(name, replicas=1, min_r=1, max_r=2, **kw):
+    job = _job(name, replicas=replicas, **kw)
+    job["spec"]["elastic"] = {"minReplicas": min_r, "maxReplicas": max_r}
+    return job
+
+
+def _granted(api, name):
+    decided = sched_api.placement(_get_job(api, name))
+    return len(decided["nodes"]) if decided else None
+
+
+def test_elastic_admission_extends_grant_to_max(cluster):
+    api, sched, jc = cluster
+    _add_slice(api, "v5e", "v5e-0", 3)
+    api.create(_elastic_job("stretchy", min_r=1, max_r=3, priority=1))
+    sched.reconcile_all()
+    jc.reconcile_all()
+    job = _get_job(api, "stretchy")
+    decided = sched_api.placement(job)
+    assert decided["nodes"] == ["v5e-0-h0", "v5e-0-h1", "v5e-0-h2"]
+    assert decided["elastic"] == {"granted": 3, "min": 1, "max": 3}
+    assert job["status"]["scheduling"]["granted"] == 3
+    # One pod (the process count), seated on the grant's first host.
+    pods = _pods_of(api, "stretchy")
+    assert len(pods) == 1
+    assert pods[0]["spec"]["nodeName"] == "v5e-0-h0"
+
+
+def test_elastic_degraded_admission_at_partial_capacity(cluster):
+    """Only 1 of 2 hosts free: the elastic gang admits at its floor now
+    instead of queueing for the max."""
+    api, sched, jc = cluster
+    _add_slice(api, "v5e", "v5e-0", 2)
+    _run_gang(api, sched, jc, "occupant", replicas=1, priority=1)
+    api.create(_elastic_job("flex", min_r=1, max_r=2, priority=1))
+    sched.reconcile_all()
+    assert _sched_state(api, "flex") == sched_api.STATE_ADMITTED
+    assert _granted(api, "flex") == 1
+
+
+def test_shrink_before_preempt_seats_vip_without_killing(cluster):
+    """The PR's core scheduler behavior: a queued gang that cannot fit
+    SHRINKS an elastic victim (placement rewrite, pods untouched, job
+    still Admitted/Running) instead of evicting it — and the preemptor
+    admits in the SAME round."""
+    api, sched, jc = cluster
+    _add_slice(api, "v5e", "v5e-0", 2)
+    api.create(_elastic_job("victim", min_r=1, max_r=2, priority=0))
+    sched.reconcile_all()
+    jc.reconcile_all()
+    assert _granted(api, "victim") == 2
+    for pod in _pods_of(api, "victim"):
+        _set_pod_phase(api, pod["metadata"]["name"], "Running")
+    jc.reconcile_all()
+    pod_names = {p["metadata"]["name"] for p in _pods_of(api, "victim")}
+
+    api.create(_job("vip", replicas=1, priority=10))
+    sched.reconcile_all()
+
+    victim = _get_job(api, "victim")
+    decided = sched_api.placement(victim)
+    assert decided is not None, "victim must stay placed"
+    assert decided["nodes"] == ["v5e-0-h0"]
+    assert decided["elastic"]["granted"] == 1
+    assert victim["status"]["scheduling"]["state"] == \
+        sched_api.STATE_ADMITTED
+    assert victim["status"]["scheduling"]["granted"] == 1
+    assert victim["status"]["scheduling"].get("resizedAt")
+    # No eviction artifacts anywhere.
+    assert victim["metadata"]["annotations"].get(
+        sched_api.ANN_PREEMPTED_BY) is None
+    assert victim["status"].get("preemptionCount") is None
+    # VIP seated on the released host in the same round.
+    assert _sched_state(api, "vip") == sched_api.STATE_ADMITTED
+    assert sched_api.placement(_get_job(api, "vip"))["nodes"] == \
+        ["v5e-0-h1"]
+
+    jc.reconcile_all()
+    # The victim's pod set is untouched — a shrink must never churn pods.
+    after = {p["metadata"]["name"] for p in _pods_of(api, "victim")}
+    assert after == pod_names
+    assert all(p["status"]["phase"] == "Running"
+               for p in _pods_of(api, "victim"))
+    assert len(_pods_of(api, "vip")) == 1
+
+    body = OPERATOR_METRICS_RENDER()
+    assert re.search(r"scheduler_shrinks_total \d", body)
+
+
+def OPERATOR_METRICS_RENDER():
+    from kubeflow_tpu.operators.base import OPERATOR_METRICS
+
+    return OPERATOR_METRICS.render()
+
+
+def test_shrink_at_floor_falls_back_to_pr10_preemption(cluster):
+    """An elastic job already at its floor has nothing to reclaim: the
+    scheduler preempts exactly as PR 10 — lowest-priority preemptible
+    victim evicted with the full mark-then-evict sequence."""
+    api, sched, jc = cluster
+    _add_slice(api, "v5e", "v5e-0", 2)
+    api.create(_elastic_job("atfloor", min_r=2, max_r=2, priority=5))
+    sched.reconcile_all()
+    jc.reconcile_all()
+    assert _granted(api, "atfloor") == 2
+
+    api.create(_job("vip", replicas=2, priority=10))
+    sched.reconcile_all()
+    victim = _get_job(api, "atfloor")
+    assert sched_api.placement(victim) is None
+    assert victim["metadata"]["annotations"][
+        sched_api.ANN_PREEMPTED_BY] == "JaxJob/kubeflow/vip"
+    assert victim["status"]["scheduling"]["state"] == \
+        sched_api.STATE_PREEMPTED
+
+
+def test_shrink_reclaims_only_down_to_floor(cluster):
+    """minReplicas bounds the reclaim: a 3-host grant with min 2 gives
+    up exactly one host; a 2-host preemptor cannot be seated by shrink
+    alone and falls back to eviction of OTHER victims (never the one
+    just shrunk — one round disturbs a victim at most once)."""
+    api, sched, jc = cluster
+    _add_slice(api, "v5e", "v5e-0", 3)
+    api.create(_elastic_job("bounded", min_r=2, max_r=3, priority=0))
+    sched.reconcile_all()
+    assert _granted(api, "bounded") == 3
+
+    api.create(_job("one", replicas=1, priority=10))
+    sched.reconcile_all()
+    assert _granted(api, "bounded") == 2  # shrink freed exactly 1
+    assert _sched_state(api, "one") == sched_api.STATE_ADMITTED
+
+    # Next arrival needs 2: bounded is at floor, only eviction remains —
+    # and it evicts bounded (the only preemptible victim), never having
+    # shrunk it in the same round.
+    api.create(_job("two", replicas=2, priority=20))
+    sched.reconcile_all()
+    bounded = _get_job(api, "bounded")
+    assert bounded["status"]["scheduling"]["state"] == \
+        sched_api.STATE_PREEMPTED
+
+
+def test_grow_into_idle_capacity_after_completion(cluster):
+    """A completed neighbor frees hosts and nothing is queued: the
+    elastic job grows back toward max (placement rewrite, granted up)."""
+    api, sched, jc = cluster
+    _add_slice(api, "v5e", "v5e-0", 2)
+    _run_gang(api, sched, jc, "neighbor", replicas=1, priority=1)
+    api.create(_elastic_job("flex", min_r=1, max_r=2, priority=1))
+    sched.reconcile_all()
+    assert _granted(api, "flex") == 1
+
+    for pod in _pods_of(api, "neighbor"):
+        _set_pod_phase(api, pod["metadata"]["name"], "Succeeded")
+    jc.reconcile_all()
+    sched.reconcile_all()
+    job = _get_job(api, "flex")
+    decided = sched_api.placement(job)
+    assert len(decided["nodes"]) == 2
+    assert decided["elastic"]["granted"] == 2
+    assert job["status"]["scheduling"]["granted"] == 2
+    body = OPERATOR_METRICS_RENDER()
+    assert re.search(r"scheduler_grows_total \d", body)
+
+
+def test_grow_yields_to_queued_gang(cluster):
+    """Freed capacity goes to the queued gang, not to growing a running
+    elastic job past it — grow takes only genuinely idle hosts."""
+    api, sched, jc = cluster
+    _add_slice(api, "v5e", "v5e-0", 2)
+    _run_gang(api, sched, jc, "neighbor", replicas=1, priority=1)
+    api.create(_elastic_job("flex", min_r=1, max_r=2, priority=1))
+    sched.reconcile_all()
+    assert _granted(api, "flex") == 1
+    api.create(_job("queued", replicas=1, priority=1))
+    sched.reconcile_all()
+    assert _sched_state(api, "queued") == sched_api.STATE_QUEUED
+
+    for pod in _pods_of(api, "neighbor"):
+        _set_pod_phase(api, pod["metadata"]["name"], "Succeeded")
+    jc.reconcile_all()
+    sched.reconcile_all()
+    # The queued gang got the host; flex stays at 1.
+    assert _sched_state(api, "queued") == sched_api.STATE_ADMITTED
+    assert _granted(api, "flex") == 1
+
+
+def test_grow_delay_quiet_period(cluster):
+    """growDelaySeconds: a just-shrunk job does not bounce straight
+    back when the preemptor finishes quickly."""
+    api, sched, jc = cluster
+    pol = api.get(sched_api.SCHEDULING_API_VERSION,
+                  sched_api.SCHEDULING_POLICY_KIND, "default", NS)
+    pol["spec"]["elastic"] = {"growDelaySeconds": 3600}
+    api.update(pol)
+    _add_slice(api, "v5e", "v5e-0", 2)
+    api.create(_elastic_job("calm", min_r=1, max_r=2, priority=0))
+    sched.reconcile_all()
+    jc.reconcile_all()
+    api.create(_job("vip", replicas=1, priority=10))
+    sched.reconcile_all()
+    assert _granted(api, "calm") == 1
+    for pod in _pods_of(api, "vip"):
+        _set_pod_phase(api, pod["metadata"]["name"], "Succeeded")
+    jc.reconcile_all()
+    sched.reconcile_all()
+    sched.reconcile_all()
+    assert _granted(api, "calm") == 1  # inside the quiet period
+
+
+def test_shrink_disabled_by_policy_falls_back_to_preempt(cluster):
+    api, sched, jc = cluster
+    pol = api.get(sched_api.SCHEDULING_API_VERSION,
+                  sched_api.SCHEDULING_POLICY_KIND, "default", NS)
+    pol["spec"]["elastic"] = {"shrinkBeforePreempt": False}
+    api.update(pol)
+    _add_slice(api, "v5e", "v5e-0", 2)
+    api.create(_elastic_job("victim", min_r=1, max_r=2, priority=0))
+    sched.reconcile_all()
+    api.create(_job("vip", replicas=1, priority=10))
+    sched.reconcile_all()
+    victim = _get_job(api, "victim")
+    assert victim["status"]["scheduling"]["state"] == \
+        sched_api.STATE_PREEMPTED
+
+
+def test_elastic_spec_validation():
+    from kubeflow_tpu.apis.jobs import JobValidationError, validate_job
+
+    ok = _elastic_job("ok", replicas=1, min_r=1, max_r=4, priority=1)
+    validate_job(ok)
+    bad_range = _elastic_job("bad", min_r=3, max_r=2, priority=1)
+    with pytest.raises(JobValidationError, match="invalid"):
+        validate_job(bad_range)
+    below_pods = _elastic_job("bad2", replicas=2, min_r=1, max_r=4,
+                              priority=1)
+    with pytest.raises(JobValidationError, match="below the gang"):
+        validate_job(below_pods)
+    garbage = _job("bad3", priority=1)
+    garbage["spec"]["elastic"] = {"minReplicas": "many"}
+    with pytest.raises(JobValidationError):
+        validate_job(garbage)
+    # Malformed elastic blocks read as non-elastic for the scheduler.
+    assert sched_api.elastic_spec(garbage) is None
+    assert sched_api.elastic_spec(ok) == {"min": 1, "max": 4}
+
+
+class _PatchRecorder:
+    """Transparent client proxy logging annotation patches — the shrink
+    vs evict property must be checked at patch granularity (an eviction
+    in the same round would overwrite the shrink in any before/after
+    snapshot)."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.patches: list[tuple[str, dict]] = []
+
+    def patch(self, api_version, kind, name, body, namespace=None):
+        self.patches.append((name, body))
+        return self._inner.patch(api_version, kind, name, body, namespace)
+
+    def __getattr__(self, attr):
+        return getattr(self._inner, attr)
+
+
+def test_property_shrink_grow_rounds_keep_invariants():
+    """Randomized elastic/fixed job mixes over randomized rounds: a
+    round never both resizes and evicts the same victim, grants stay
+    inside [floor, max], pods always sit on the grant's prefix, hosts
+    are never double-booked, and non-elastic gangs keep the PR-10
+    all-or-nothing contract."""
+    for seed in (0, 1, 2):
+        rng = random.Random(seed)
+        api = FakeApiServer()
+        api.ensure_namespace(NS)
+        for crd in jobs_api.all_job_crds():
+            api.apply(crd)
+        api.apply(sched_api.scheduling_policy_crd())
+        api.create(sched_api.scheduling_policy(
+            namespace=NS, preemption={"requeueBackoffSeconds": 0}))
+        slices = {"v5e-0": _add_slice(api, "v5e", "v5e-0", 4),
+                  "v5e-1": _add_slice(api, "v5e", "v5e-1", 3)}
+        recorder = _PatchRecorder(api)
+        sched = SchedulerController(recorder)
+        jc = JobController(api, "JaxJob")
+
+        jobs = {}
+        for i in range(7):
+            name = f"j{i}"
+            if rng.random() < 0.5:
+                max_r = rng.randint(2, 4)
+                jobs[name] = {"pods": 1, "elastic": (1, max_r)}
+                api.create(_elastic_job(name, replicas=1, min_r=1,
+                                        max_r=max_r,
+                                        priority=rng.randint(0, 10)))
+            else:
+                pods = rng.randint(1, 3)
+                jobs[name] = {"pods": pods, "elastic": None}
+                api.create(_job(name, replicas=pods,
+                                priority=rng.randint(0, 10)))
+
+        def check_round_patches():
+            """A resize rewrite ({placement: str} alone) and an evict
+            ({placement: None, preempted-by: str}) must never target the
+            same job inside one round."""
+            resized_jobs, evicted_jobs = set(), set()
+            for name, body in recorder.patches:
+                ann = body.get("metadata", {}).get("annotations")
+                if not ann or sched_api.ANN_PLACEMENT not in ann:
+                    continue
+                if (ann[sched_api.ANN_PLACEMENT] is None
+                        and ann.get(sched_api.ANN_PREEMPTED_BY)):
+                    evicted_jobs.add(name)
+                elif (ann[sched_api.ANN_PLACEMENT] is not None
+                      and sched_api.ANN_PREEMPTED_BY not in ann):
+                    resized_jobs.add(name)
+            both = resized_jobs & evicted_jobs
+            assert not both, (
+                f"seed={seed}: jobs resized AND evicted in one round: "
+                f"{both}")
+
+        def check_state():
+            assignments = {}
+            for name, info in jobs.items():
+                job = _get_job(api, name)
+                state = job.get("status", {}).get("state")
+                decided = sched_api.placement(job)
+                pods = _pods_of(api, name)
+                if info["elastic"]:
+                    lo, hi = info["elastic"]
+                    floor = max(lo, info["pods"])
+                    if decided is not None:
+                        granted = len(decided["nodes"])
+                        assert floor <= granted <= hi, (
+                            f"seed={seed}: {name} grant {granted} "
+                            f"outside [{floor}, {hi}]")
+                        for pod in pods:
+                            if pod.get("status", {}).get("phase") in (
+                                    "Succeeded", "Failed"):
+                                continue
+                            assert pod["spec"]["nodeName"] in \
+                                decided["nodes"][:info["pods"]], (
+                                f"seed={seed}: {name} pod off the "
+                                "grant prefix")
+                else:
+                    assert len(pods) in (0, info["pods"]), (
+                        f"seed={seed}: {name} partially placed")
+                if decided is None:
+                    continue
+                assert set(decided["nodes"]) <= set(
+                    slices[decided["slice"]])
+                if state in ("Succeeded", "Failed"):
+                    continue
+                for node in decided["nodes"]:
+                    assert node not in assignments, (
+                        f"seed={seed}: {node} double-booked by "
+                        f"{assignments[node]} and {name}")
+                    assignments[node] = name
+
+        for _ in range(40):
+            op = rng.random()
+            if op < 0.4:
+                recorder.patches.clear()
+                sched.reconcile_all()
+                check_round_patches()
+            elif op < 0.7:
+                jc.reconcile_all()
+            else:
+                placed = [n for n in jobs
+                          if sched_api.placement(_get_job(api, n))
+                          and _get_job(api, n).get("status", {}).get(
+                              "state") not in ("Succeeded", "Failed")]
+                if placed:
+                    done = rng.choice(placed)
+                    for pod in _pods_of(api, done):
+                        _set_pod_phase(api, pod["metadata"]["name"],
+                                       "Succeeded")
+            check_state()
+
+
+# ---------------------------------------------------------------------------
 # all-or-nothing: property-style over randomized mixes + interleavings
 # ---------------------------------------------------------------------------
 
@@ -786,6 +1172,178 @@ def _train_job(name, ck_dir, steps, *, priority=None, grace=60):
     return _job(name, replicas=1, priority=priority, grace=grace,
                 command=["python", "-m", "kubeflow_tpu.train.loop",
                          json.dumps(cfg)])
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", CHAOS_SEEDS[:1])
+def test_chaos_elastic_shrink_soak_byte_equal(seed, tmp_path):
+    """The elastic acceptance E2E: a VIP gang arrives while an elastic
+    victim trains across the whole slice; the scheduler SHRINKS the
+    victim (placement rewrite through a hostile apiserver) instead of
+    killing it — the victim's live loop reshards 8→4 devices at a step
+    boundary and keeps training, the VIP seats on the released host,
+    BOTH jobs Succeed, the victim's pod is never restarted, and the
+    victim's post-reshard losses are byte-equal to an undisturbed
+    same-global-batch reference (the reshard-point checkpoint restored
+    into the target mesh and replayed with no scheduler in the loop)."""
+    import shutil
+
+    from kubeflow_tpu.k8s.httpfake import serve
+    from kubeflow_tpu.train import checkpoint as ckpt_lib
+    from kubeflow_tpu.train.loop import RunConfig, run
+
+    # Sized so the victim is still mid-run through admission → VIP
+    # arrival → shrink → live reshard (a few seconds of remaining
+    # runtime at ~15ms/step) while the WHOLE per-step loss log still
+    # fits the kubelet's 64KB status.log tail — the byte-equality
+    # comparison below reads every post-reshard line from it.
+    steps = 400
+    fake = FakeApiServer()
+    fake.ensure_namespace(NS)
+    for crd in jobs_api.all_job_crds():
+        fake.apply(crd)
+    fake.apply(sched_api.scheduling_policy_crd())
+    fake.create(sched_api.scheduling_policy(
+        namespace=NS,
+        preemption={"requeueBackoffSeconds": 0.5,
+                    "gracePeriodSeconds": 60},
+        # Grow stays off so the victim reshards exactly once — the
+        # byte-equality replay below anchors at that single reshard
+        # point (live grow is pinned by the fast elastic tests).
+        elastic={"growEnabled": False},
+    ))
+    _add_slice(fake, "v5e", "v5e-0", 2)
+
+    # The victim's in-pod placement poller reads through the real HTTP
+    # frontend; controllers go through the hostile chaos wrapper.
+    httpd, port = serve(fake)
+    chaos = ChaosApiServer(fake, seed=seed, error_rate=0.05,
+                           conflict_rate=0.15,
+                           error_after_create_rate=0.05,
+                           latency_seconds=0.001)
+    kubelet = FakeKubelet(
+        fake, cpu_devices_per_pod=8, timeout=600,
+        extra_env={
+            "KUBEFLOW_TPU_APISERVER": f"http://127.0.0.1:{port}"})
+    sched = SchedulerController(
+        chaos,
+        evict=lambda pod, grace: kubelet.evict(
+            pod["metadata"]["name"], pod["metadata"]["namespace"],
+            grace_seconds=grace))
+    jc = JobController(chaos, "JaxJob")
+
+    def tolerant(fn):
+        from kubeflow_tpu.k8s.client import ApiError
+
+        try:
+            fn()
+        except ApiError as e:
+            if not e.transient and e.code != 409:
+                raise
+
+    def spin(predicate, deadline=300.0, message="condition"):
+        end = time.monotonic() + deadline
+        while time.monotonic() < end:
+            kubelet.step()
+            tolerant(jc.reconcile_all)
+            tolerant(sched.reconcile_all)
+            if predicate():
+                return
+            time.sleep(0.05)
+        raise AssertionError(f"elastic soak timed out waiting for "
+                             f"{message} (seed={seed})")
+
+    ck = str(tmp_path / "victim")
+    cfg = {"model": "lm-test-tiny",
+           "model_overrides": {"n_layers": 2, "d_model": 64,
+                               "d_ff": 128},
+           "steps": steps, "log_every": 1, "batch_size": 8,
+           "seq_len": 32, "checkpoint_every": 10 ** 9, "seed": 5,
+           "checkpoint_dir": ck, "elastic_poll_steps": 1,
+           "prefetch": 2}
+    victim = _job("victim", replicas=1, priority=0, grace=60,
+                  command=["python", "-m", "kubeflow_tpu.train.loop",
+                           json.dumps(cfg)])
+    victim["spec"]["elastic"] = {"minReplicas": 1, "maxReplicas": 2}
+
+    def grant_is(n):
+        def check():
+            decided = sched_api.placement(_get_job(fake, "victim"))
+            return bool(decided) and len(decided["nodes"]) == n
+        return check
+
+    def victim_log():
+        pod = fake.get_or_none("v1", "Pod", "victim-worker-0", NS)
+        return (pod or {}).get("status", {}).get("log") or ""
+
+    try:
+        fake.create(victim)
+        spin(grant_is(2), message="victim admitted at full grant")
+        # Provably mid-training (first steps logged) before the VIP
+        # arrives — early, so plenty of run remains for the live shrink.
+        spin(lambda: "step=5 " in victim_log(),
+             message="victim mid-training")
+
+        fake.create(_job("vip", replicas=1, priority=10, grace=5,
+                         command=["python", "-c",
+                                  "print('vip work done')"]))
+        spin(grant_is(1), deadline=60,
+             message="victim shrunk to 1 host")
+        # The victim's loop must absorb the shrink LIVE, well before its
+        # run ends.
+        spin(lambda: "resharded shrink" in victim_log(), deadline=60,
+             message="victim live reshard")
+        spin(lambda: _get_job(fake, "vip").get("status", {}).get(
+            "state") == "Succeeded", message="vip completion")
+        spin(lambda: _get_job(fake, "victim").get("status", {}).get(
+            "state") == "Succeeded", message="victim completion")
+
+        victim_job = _get_job(fake, "victim")
+        # Shrunk, never killed: no preemption artifacts, no restarts,
+        # the one pod lived through the whole run.
+        assert victim_job["status"].get("preemptionCount") is None
+        assert victim_job["status"].get("restartCount", 0) == 0
+        log = fake.get("v1", "Pod", "victim-worker-0",
+                       NS)["status"]["log"]
+        assert "resumed from checkpoint" not in log
+        m = re.search(r"resharded shrink 8->4 devices at step (\d+)",
+                      log)
+        assert m, f"no live shrink in victim log (seed={seed}):\n" \
+                  f"{log[-2000:]}"
+        reshard_step = int(m.group(1))
+        victim_losses = _losses_from_log(log)
+        assert victim_losses.get(steps), "victim never finished"
+
+        # Undisturbed same-global-batch reference: the reshard-point
+        # checkpoint restored into the 4-device target mesh, replayed
+        # in-process with no scheduler, no chaos, no SIGTERM.
+        ref_ck = str(tmp_path / "ref")
+        shutil.copytree(ck, ref_ck)
+        for entry in os.listdir(ref_ck):
+            if entry.isdigit() and int(entry) > reshard_step:
+                shutil.rmtree(os.path.join(ref_ck, entry))
+        assert ckpt_lib.latest_step(ref_ck) == reshard_step
+        lines = []
+        ref = run(RunConfig(
+            model="lm-test-tiny",
+            model_overrides={"n_layers": 2, "d_model": 64, "d_ff": 128},
+            steps=steps, log_every=1, batch_size=8, seq_len=32,
+            checkpoint_every=10 ** 9, seed=5, checkpoint_dir=ref_ck,
+            prefetch=2, graceful_shutdown=False),
+            log=lambda *a: lines.append(" ".join(str(x) for x in a)),
+            mesh_source=lambda: 4)
+        assert ref["step"] == steps
+        ref_losses = _losses_from_log("\n".join(lines))
+        for step in range(reshard_step + 1, steps + 1):
+            assert victim_losses[step] == ref_losses[step], (
+                f"seed={seed}: step {step}: victim "
+                f"{victim_losses[step]} != reference {ref_losses[step]}")
+        # The soak really ran against a hostile apiserver.
+        assert len(chaos.faults()) >= 5
+    finally:
+        kubelet.shutdown()
+        httpd.shutdown()
 
 
 @pytest.mark.chaos
