@@ -79,9 +79,18 @@ when per-chip tokens/s falls under 0.8x single-chip on TPU (aggregate
 retention under 0.6x on the shared-core CPU emulation), or on leaked
 blocks.
 
+``--weight-push-sweep`` benchmarks live weight streaming: a weight
+push into a decoder serving live streams (zero-drain swap — the stall
+is the state-lock wait, gated at one decode-dispatch gap p99; zero
+dropped streams; post-swap greedy tokens byte-identical to a cold
+start on the pushed weights for fp, int8 and tp=2 pools) plus the RL
+learner loop at per-step push cadence against the restart-per-update
+baseline (>=5x rollout throughput required — the reason RLJob exists).
+
 Usage: python bench_serving.py [--quick] [--requests N] [--generate]
        [--prefix-reuse] [--speculative] [--concurrency-sweep]
        [--kv-dtype-sweep] [--fleet-sweep] [--disagg-sweep] [--tp-sweep]
+       [--weight-push-sweep]
 """
 
 from __future__ import annotations
@@ -1522,6 +1531,155 @@ def _bench_qos_sweep(args, model) -> dict:
     }
 
 
+def _bench_weight_push_sweep(args, model) -> dict:
+    """Live weight streaming vs restart-per-update.
+
+    Three legs:
+
+    1. **Zero-drain swap under load** — live greedy streams mid-decode
+       while ``update_weights`` installs new params. Gates: zero
+       dropped or errored streams (every stream emits its full budget),
+       and the swap stall (state-lock wait + pointer swap, the stall
+       decode actually pays) at most one decode-dispatch gap at p99
+       (2x slack for CPU timer noise).
+    2. **Post-swap byte identity** — fresh greedy prompts after the
+       push must match a decoder cold-started on the pushed weights,
+       for fp, int8 and tp=2 pools (the int8 leg pins that codes and
+       scales are recomputed under the new weights, never reused; the
+       tp leg pins that the host-gathered push reshards onto the mesh
+       exactly). Zero leaked blocks after trie drain.
+    3. **RL loop throughput** — the minimal learner loop
+       (train/rl.py) at per-step push cadence, live pushes vs the
+       restart-per-update baseline (actors torn down, compiled
+       executables dropped, rebuilt on the new params — what a real
+       kill-restart pays). Gate: rollout throughput >= 5x the restart
+       baseline at equal hardware.
+    """
+    import threading
+
+    from kubeflow_tpu.models.registry import get_model
+    from kubeflow_tpu.serving.continuous import ContinuousDecoder
+
+    spec = get_model(model)
+    p1 = spec.init(jax.random.PRNGKey(0), spec.config)
+    p2 = spec.init(jax.random.PRNGKey(1), spec.config)
+    prefill_len, gen = 32, 24
+    slots, block = 8, 8
+
+    def mk(params, **kw):
+        return ContinuousDecoder(
+            params, spec.config, slots=slots, prefill_len=prefill_len,
+            max_new_tokens=gen, prefix_cache_slots=8,
+            prefix_cache_min_len=8, kv_layout="paged",
+            kv_block_size=block, stream_timeout_s=600.0, **kw)
+
+    def prompt(i):
+        return [3 + (j % 29) for j in range(12)] + [5 + (i % 80)] * 4
+
+    def swap_leg(label, **kw):
+        """One pool flavor: streams straddle a swap; post-swap fresh
+        prompts must match a cold decoder on the new weights."""
+        d = mk(p1, **kw)
+        # Untimed warmup: absorb the admit/decode executables so the
+        # measured stall and dispatch gap are steady-state numbers,
+        # not compilation (a production swap lands on a warm server).
+        for i in range(2):
+            d.generate(prompt(60 + i), gen, timeout=600)
+        n_stream = 6
+        results: dict[int, list] = {}
+
+        def one(i):
+            out = []
+            for tok in d.submit(prompt(i), gen).tokens(timeout=600):
+                out.append(tok)
+            results[i] = out
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(n_stream)]
+        for th in threads:
+            th.start()
+        deadline = time.perf_counter() + 10
+        while (d.metrics()["in_flight"] < 2
+               and time.perf_counter() < deadline):
+            time.sleep(0.005)
+        t_push = time.perf_counter()
+        d.update_weights(p2)
+        push_s = time.perf_counter() - t_push
+        for th in threads:
+            th.join(timeout=600)
+        m = d.metrics()
+        stall_s = m["weight_swap_seconds_last"]
+        p99_gap_s = max(d._h_dispatch.labels("decode").quantile(0.99),
+                        d._h_dispatch.labels("admit").quantile(0.99))
+        complete = (len(results) == n_stream
+                    and all(len(v) == gen for v in results.values()))
+        post = {i: d.generate(prompt(100 + i), gen,
+                              timeout=600)["tokens"] for i in range(3)}
+        with d._prefix_lock:
+            while d.prefix_cache.evict_lru():
+                pass
+        leaked = d.metrics()["kv_blocks_in_use"]
+        d.stop()
+        cold = mk(p2, **kw)
+        want = {i: cold.generate(prompt(100 + i), gen,
+                                 timeout=600)["tokens"]
+                for i in range(3)}
+        cold.stop()
+        return {
+            "label": label,
+            "push_ms": round(1e3 * push_s, 3),
+            "swap_stall_ms": round(1e3 * stall_s, 3),
+            "dispatch_p99_ms": round(1e3 * p99_gap_s, 3),
+            "streams_complete": complete,
+            "post_swap_identical": post == want,
+            "stall_within_gap": stall_s <= max(2 * p99_gap_s, 1e-3),
+            "leaked_blocks": int(leaked),
+        }
+
+    legs = [swap_leg("fp"), swap_leg("int8", kv_dtype="int8")]
+    if jax.device_count() >= 2:
+        legs.append(swap_leg("tp2", tp_shards=2))
+
+    # --- RL loop: live push vs restart-per-update ---------------------
+    from kubeflow_tpu.train.rl import RLConfig, run_rl
+
+    steps = 5 if args.quick else 8
+    rl_kw = dict(model=model, steps=steps, batch_size=1,
+                 push_every_steps=1, actors=2, prompt_len=8,
+                 max_new_tokens=4, prefetch=0, actor_slots=4)
+    # Untimed warmup absorbs every executable the LIVE run touches, so
+    # the live measurement is steady-state. The restart baseline's
+    # whole point is that it pays compilation again on every update —
+    # its recompiles are the measurement, not noise.
+    run_rl(RLConfig(**rl_kw))
+    live = run_rl(RLConfig(**rl_kw))
+    restart = run_rl(RLConfig(**rl_kw, restart_per_update=True))
+    ratio = (live["rollout_tokens_per_sec"]
+             / max(restart["rollout_tokens_per_sec"], 1e-9))
+
+    swap_ok = all(leg["streams_complete"] and leg["post_swap_identical"]
+                  and leg["stall_within_gap"] for leg in legs)
+    leaked = sum(leg["leaked_blocks"] for leg in legs)
+    return {
+        "benchmark": "serving_weight_push_sweep",
+        "model": model,
+        "legs": legs,
+        "rl_live_rollout_tokens_per_sec": round(
+            live["rollout_tokens_per_sec"], 2),
+        "rl_restart_rollout_tokens_per_sec": round(
+            restart["rollout_tokens_per_sec"], 2),
+        "rl_throughput_ratio": round(ratio, 2),
+        "rl_pushes": live["pushes"],
+        "rl_push_ms_avg": live["push_ms_avg"],
+        "rl_restart_ms_avg": restart["restart_ms_avg"],
+        "kv_blocks_in_use_after_drain": leaked,
+        "regression": (not swap_ok or leaked != 0 or ratio < 5.0),
+        "config": f"{model} streams6x{gen} prefill{prefill_len} "
+                  f"block{block} slots{slots} rl_steps{steps} "
+                  f"push_every1",
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
@@ -1578,6 +1736,15 @@ def main() -> int:
                          "suspended streams, zero leaked blocks in "
                          "device pool and host tier, host-tier "
                          "second-chance hits)")
+    ap.add_argument("--weight-push-sweep", action="store_true",
+                    help="benchmark live weight streaming: zero-drain "
+                         "swap under live streams (stall <= one "
+                         "dispatch gap, zero dropped streams, "
+                         "post-swap greedy byte-identical to a cold "
+                         "start on the pushed weights for fp/int8/tp2) "
+                         "plus the RL loop at per-step push cadence "
+                         "(>=5x rollout throughput vs "
+                         "restart-per-update)")
     ap.add_argument("--tp-sweep", action="store_true",
                     help="benchmark model-parallel serving: tp=1/2/4 "
                          "mesh shapes at equal total pool bytes "
@@ -1586,7 +1753,8 @@ def main() -> int:
                          "tokens/s gate, zero leaked blocks)")
     args = ap.parse_args()
 
-    if args.tp_sweep and "xla_force_host_platform_device_count" not in \
+    if (args.tp_sweep or args.weight_push_sweep) and \
+            "xla_force_host_platform_device_count" not in \
             os.environ.get("XLA_FLAGS", ""):
         # The tp ladder needs a multi-device mesh. On the CPU CI host
         # the backend is virtualized to 8 devices — this must land
@@ -1596,7 +1764,10 @@ def main() -> int:
             os.environ.get("XLA_FLAGS", "")
             + " --xla_force_host_platform_device_count=8").strip()
     on_tpu = jax.default_backend() == "tpu"
-    if args.qos_sweep:
+    if args.weight_push_sweep:
+        model = "llama-1b" if on_tpu and not args.quick else "lm-test-tiny"
+        result = _bench_weight_push_sweep(args, model)
+    elif args.qos_sweep:
         model = "llama-1b" if on_tpu and not args.quick else "lm-test-tiny"
         result = _bench_qos_sweep(args, model)
     elif args.tp_sweep:

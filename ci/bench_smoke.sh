@@ -75,6 +75,15 @@ check_json "$out"
 # real chips), or on leaked blocks.
 out="$(JAX_PLATFORMS=cpu python bench_serving.py --quick --tp-sweep)"
 check_json "$out"
+# Live weight streaming: the marker fires when a live swap drops or
+# errors any in-flight stream, when the swap stall exceeds one
+# decode-dispatch gap at p99, when post-swap greedy tokens differ from
+# a decoder cold-started on the pushed weights (fp, int8, tp=2), when
+# the RL loop's rollout throughput under per-step live pushes falls
+# under 5x the restart-per-update baseline at equal hardware, or on
+# leaked blocks.
+out="$(JAX_PLATFORMS=cpu python bench_serving.py --quick --weight-push-sweep)"
+check_json "$out"
 echo "bench smoke ok"
 # Training input pipeline: prefetch-on must match prefetch-off final
 # loss byte-for-byte (bench.py sets the regression marker otherwise)

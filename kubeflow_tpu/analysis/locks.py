@@ -7,9 +7,11 @@ entry-guard propagation through private ``self._m()`` calls):
 - ``lock-blocking-call`` — a call that can block the host (queue
   ``get``/``put`` that can wait, socket/HTTP, thread ``join``,
   ``time.sleep``, untimed ``Event.wait``, ``jax.device_get`` /
-  ``block_until_ready`` device syncs) while any lock is held. This is
-  the PR-9 stall as a rule: an import held the prefix lock across the
-  state-lock device wait and froze the scheduler's pop path.
+  ``block_until_ready`` device syncs, ``jax.device_put`` host→device
+  transfers — the weight-swap buffer install class) while any lock is
+  held. This is the PR-9 stall as a rule: an import held the prefix
+  lock across the state-lock device wait and froze the scheduler's
+  pop path.
   ``Condition.wait`` on the *held* condition is exempt — waiting
   releases it (the false-positive fixture the checker must pass).
 
@@ -473,6 +475,13 @@ class _LockChecker:
             return "time.sleep()"
         if dotted in ("jax.device_get", "jax.block_until_ready"):
             return f"device sync {dotted}()"
+        if dotted == "jax.device_put":
+            # The host→device transfer behind a weight-swap buffer
+            # install: issuing it under a held lock serializes every
+            # contending thread behind the whole copy. The zero-drain
+            # pattern stages buffers OUTSIDE the lock and swaps the
+            # pointer under it.
+            return "host-to-device transfer jax.device_put()"
         if leaf == "block_until_ready":
             return "device sync .block_until_ready()"
         if leaf in ("urlopen", "create_connection"):

@@ -1,0 +1,95 @@
+"""RL package: the RLJob CRD + an example train↔serve RL workload.
+
+The RLJob operator itself rides the training-operator manager (its
+Deployment and RBAC live in the ``training-operator`` prototype); this
+package ships the CRD and a ready-to-edit CR declaring the full loop —
+a learner gang, an elastic preemptible actor pool, the rollout shape,
+and the weight-push policy (docs/rl.md).
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.apis import rl as rl_api
+from kubeflow_tpu.manifests import images
+from kubeflow_tpu.manifests.core import ParamSpec, prototype
+from kubeflow_tpu.version import DEFAULT_NAMESPACE
+
+
+@prototype(
+    "rl-job",
+    "RLJob CRD + an example Podracer-style RL workload: learner gang at "
+    "high priority pushing live weights into an elastic preemptible "
+    "actor pool every K steps",
+    params=[
+        ParamSpec("name", "rl-smoke"),
+        ParamSpec("namespace", DEFAULT_NAMESPACE),
+        ParamSpec("image", images.PLATFORM),
+        ParamSpec("model", "lm-test-tiny", "registry model (the policy)"),
+        ParamSpec("learner_replicas", 1, "learner gang size"),
+        ParamSpec("learner_priority", rl_api.DEFAULT_LEARNER_PRIORITY,
+                  "scheduler priority of the learner gang"),
+        ParamSpec("learner_steps", 100, "optimizer steps to run"),
+        ParamSpec("actor_replicas", 2, "rollout actors at start"),
+        ParamSpec("actor_min_replicas", 1,
+                  "elastic floor the scheduler may shrink the pool to"),
+        ParamSpec("actor_max_replicas", 4,
+                  "elastic ceiling for opportunistic grow"),
+        ParamSpec("actor_priority", rl_api.DEFAULT_ACTOR_PRIORITY,
+                  "scheduler priority of the actor pool (preemptible)"),
+        ParamSpec("push_every_steps", rl_api.DEFAULT_PUSH_EVERY_STEPS,
+                  "optimizer steps between live weight pushes"),
+        ParamSpec("weights_max_lag", rl_api.DEFAULT_WEIGHTS_MAX_LAG,
+                  "max weight-epoch skew before an actor leaves "
+                  "rollout routing"),
+        ParamSpec("prompt_len", 8, "rollout prompt length"),
+        ParamSpec("max_new_tokens", 16, "rollout generation length"),
+        ParamSpec("chips_per_replica", 0,
+                  "google.com/tpu chips per learner/actor pod (0 = CPU)"),
+    ],
+)
+def rl_job(
+    name: str,
+    namespace: str,
+    image: str,
+    model: str,
+    learner_replicas: int,
+    learner_priority: int,
+    learner_steps: int,
+    actor_replicas: int,
+    actor_min_replicas: int,
+    actor_max_replicas: int,
+    actor_priority: int,
+    push_every_steps: int,
+    weights_max_lag: int,
+    prompt_len: int,
+    max_new_tokens: int,
+    chips_per_replica: int,
+) -> list[dict]:
+    cr = rl_api.rl_job(
+        name,
+        namespace,
+        model,
+        image=image,
+        learner={
+            "replicas": learner_replicas,
+            "priority": learner_priority,
+            "steps": learner_steps,
+            "pushEverySteps": push_every_steps,
+            "tpuChipsPerReplica": chips_per_replica,
+        },
+        actors={
+            "replicas": actor_replicas,
+            "minReplicas": actor_min_replicas,
+            "maxReplicas": actor_max_replicas,
+            "priority": actor_priority,
+            "tpuChipsPerReplica": chips_per_replica,
+            # The live weight-push path swaps under the paged pool's
+            # continuous decoder; the operator pins these defaults too.
+            "engine": {"kv_layout": "paged"},
+        },
+        rollout={"promptLen": prompt_len,
+                 "maxNewTokens": max_new_tokens},
+        weights={"maxLag": weights_max_lag},
+    )
+    rl_api.validate_rl_job(cr)
+    return [rl_api.rl_job_crd(), cr]

@@ -72,11 +72,17 @@ def training_operator(
     )
 
     objs.append(k8s.service_account(name, namespace, labels))
+    # The manager also runs the RLJob controller (operators/rl.py),
+    # which reconciles RLJobs into learner/actor JaxJob children — so
+    # the operator needs the rljobs surface next to the job kinds.
+    from kubeflow_tpu.apis import rl as rl_api
+
     rules = [
         k8s.policy_rule(
             [API_GROUP],
             [p for p in jobs_api.PLURALS.values()]
-            + [f"{p}/status" for p in jobs_api.PLURALS.values()],
+            + [f"{p}/status" for p in jobs_api.PLURALS.values()]
+            + [rl_api.RL_PLURAL, f"{rl_api.RL_PLURAL}/status"],
             ["*"],
         ),
         k8s.policy_rule([""], ["pods", "services", "events", "configmaps"], ["*"]),

@@ -61,6 +61,12 @@ SNAPSHOT_CASES: dict[str, tuple[str, dict]] = {
          "num_tpu_chips": 4, "prefill_replicas": 2,
          "prefill_max_replicas": 4, "kv_pressure": 0.85},
     ),
+    "rl-job": (
+        "rl-job",
+        {"name": "podracer", "model": "lm-test-tiny",
+         "actor_replicas": 2, "actor_max_replicas": 4,
+         "push_every_steps": 2},
+    ),
     "nfs-volume": ("nfs-volume", {"server": "10.0.0.2"}),
     "serving-route": (
         "serving-route",

@@ -28,6 +28,7 @@ def make_all_controllers(client):
         WorkflowController,
     )
     from kubeflow_tpu.operators.profiles import ProfileController
+    from kubeflow_tpu.operators.rl import RLJobController
     from kubeflow_tpu.scheduler.controller import SchedulerController
     from kubeflow_tpu.tuning.controller import StudyJobController
 
@@ -35,6 +36,7 @@ def make_all_controllers(client):
         *make_job_controllers(client),
         SchedulerController(client),
         InferenceServiceController(client),
+        RLJobController(client),
         NotebookController(client),
         ProfileController(client),
         StudyJobController(client),

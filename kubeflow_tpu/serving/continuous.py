@@ -157,6 +157,13 @@ class _Request:
     # suspension — a later suspension must append only out[folded:],
     # never double-count the first park's fold.
     folded: int = 0
+    # Weights epoch this request's PREFILL ran under (stamped inside
+    # the admission dispatch's state-lock scope). A finishing stream
+    # only publishes its prompt K/V into the prefix trie when this
+    # still matches the decoder's live version — a stream that
+    # straddled a weight swap computed its prompt K/V under weights
+    # the decoder no longer serves.
+    weights_version: int = 0
 
     @property
     def want_left(self) -> int:
@@ -550,6 +557,23 @@ class ContinuousDecoder:
             "serving_tp_shards",
             "Tensor-parallel mesh width of this replica (1 = "
             "single-chip)").set(self.tp_shards)
+        # Live weight streaming (update_weights): monotonically
+        # increasing weights epoch, push counter, and the end-to-end
+        # push duration (device placement + atomic swap + stale flush).
+        self.weights_version = 0
+        self.weight_pushes = 0
+        self.weight_stale_refused = 0  # stale trie/tier hits refused
+        self.last_swap_seconds = 0.0   # last push's in-lock swap stall
+        self._g_weights_version = self.registry.gauge(
+            "serving_weights_version",
+            "Weights epoch installed by live pushes (0 = boot weights)")
+        self._c_weight_pushes = self.registry.counter(
+            "serving_weight_pushes_total",
+            "Live weight swaps installed by update_weights")
+        self._h_weight_push = self.registry.histogram(
+            "serving_weight_push_seconds",
+            "update_weights duration: device placement, atomic swap, "
+            "stale-KV flush")
         # Per-stream lifecycle timelines, bounded ring, served at the
         # model server's /debug/requests (JSON + chrome-trace export).
         self.trace = TraceStore()
@@ -674,6 +698,9 @@ class ContinuousDecoder:
         to the free list below us, so this is the one spot the
         eviction path pays a device round-trip — the price of
         demoting instead of destroying."""
+        # tpu-lint: disable=lock-inconsistent-guard -- epoch fence; swap flush re-sweeps
+        if entry.version != self.weights_version:
+            return  # stale epoch: destroying beats a poisoned second chance
         plen = min(len(entry.key), len(entry.blocks) * self.kv_block_size)
         key = tuple(entry.key[:plen])
         if plen < 1 or self._host_tier.has(key):
@@ -690,7 +717,7 @@ class ContinuousDecoder:
             # path (the crash drain evicts the whole trie): losing the
             # second-chance copy is fine, losing the free() is a leak.
             return
-        self._host_tier.put(key, payload, plen)
+        self._host_tier.put(key, payload, plen, version=entry.version)
 
     def _set_table_row(self, slot: int, blocks: list[int]) -> None:
         """Point ``slot``'s host block-table row at ``blocks`` (sentinel
@@ -767,6 +794,11 @@ class ContinuousDecoder:
         # (allocated at pop time) instead of scattering into dense rows.
         t_disp = time.perf_counter()
         with self._state_lock:
+            # The weights epoch this admission's prefill runs under —
+            # read inside the same lock scope that passes self.params
+            # to the dispatch, so it can never stamp the wrong epoch.
+            for req, _slot in pending:
+                req.weights_version = self.weights_version
             if self._alloc is not None:
                 # Table rows go live only now, under THIS dispatch —
                 # the rows' device length/active are set by the same
@@ -839,14 +871,34 @@ class ContinuousDecoder:
         shortened to ``prompt_len - bucket`` — less reuse, never a wrong
         write. Returns (entry, prefix_len, bucket) with the entry pinned,
         or None (miss; any pin released)."""
+        # A resumed (previously suspended) stream may consume K/V from
+        # the epoch it was parked under — the payload IS its state and
+        # the stream straddles the swap by design. Fresh requests must
+        # only ever hit the live epoch.
+        allow_stale = bool(req.out or req.folded)
         with self._prefix_lock:
             m = self.prefix_cache.match(req.tokens)
+            # tpu-lint: disable=lock-inconsistent-guard -- epoch fence; publish guard catches
+            live_epoch = self.weights_version
+            if (m is not None and not allow_stale
+                    and m[0].version != live_epoch):
+                # Stale hit: refuse, and remove the entry so it stops
+                # shadowing deeper fresh entries (pinned peers keep it
+                # alive until their release; it stays refused).
+                entry = m[0]
+                self.prefix_cache.release(entry)
+                if entry.refs == 0:
+                    self.prefix_cache.remove(entry)
+                with self._mlock:
+                    self.weight_stale_refused += 1
+                m = None
         if m is None and self._host_tier is not None \
                 and self._alloc is not None:
             # Second chance: a demoted (or suspended) prefix in the
             # host tier re-imports onto device and the admission
             # proceeds as an ordinary prefix hit.
-            if self._promote_host_prefix(req.tokens, req.timeline):
+            if self._promote_host_prefix(req.tokens, req.timeline,
+                                         allow_stale=allow_stale):
                 with self._prefix_lock:
                     m = self.prefix_cache.match(req.tokens)
         if m is None:
@@ -885,6 +937,7 @@ class ContinuousDecoder:
             bs = self.kv_block_size
             n_full = prefix_len // bs
             with self._state_lock:
+                req.weights_version = self.weights_version
                 if prefix_len % bs:
                     # First owned block (table index n_full) receives
                     # the donor's partially-shared tail content.
@@ -912,6 +965,7 @@ class ContinuousDecoder:
             with self._prefix_lock:
                 pool = self._prefix_pool
             with self._state_lock:
+                req.weights_version = self.weights_version
                 self._state, last, tok, emit = admit_prefix_and_step(
                     self._state, self.params, self.cfg, jnp.int32(slot),
                     pool, jnp.int32(entry.slot), jnp.int32(prefix_len),
@@ -947,6 +1001,22 @@ class ContinuousDecoder:
         cache = self.prefix_cache
         if cache is None or req.error is not None:
             return
+        # tpu-lint: disable=lock-inconsistent-guard -- epoch fence; swap flush removes it
+        if req.weights_version != self.weights_version:
+            # The stream straddled a live weight swap: its prompt K/V
+            # was computed under weights the decoder no longer serves —
+            # pooling it would hand stale bytes to post-swap admissions.
+            return
+        ent = req.pinned_prefix
+        if ent is not None and getattr(ent, "version", 0) != \
+                req.weights_version:
+            # Plan/admit race across a swap: the prefix plan pinned a
+            # then-current entry, the swap landed before the admission
+            # dispatch, and the row's leading K/V is old-epoch while
+            # its suffix is new. The stream itself is a legal straddler
+            # (one version boundary), but its blocks must never enter
+            # the trie stamped as the new epoch.
+            return
         key = tuple(req.tokens)
         if len(key) < cache.min_len:
             return
@@ -957,6 +1027,7 @@ class ContinuousDecoder:
             entry = cache.reserve(key)
             if entry is None:  # every pool slot pinned by peers in flight
                 return
+            entry.version = req.weights_version
             if self._alloc is not None:
                 # Paged publish is pure bookkeeping: the prompt's K/V
                 # already lives in the slot's pool blocks, so the entry
@@ -994,6 +1065,11 @@ class ContinuousDecoder:
         if len(toks) < self.prefix_cache.min_len:
             return False
         key = tuple(toks)
+        # One consistent (params, epoch) pair: a concurrent live weight
+        # swap flips both under the state lock, and the primed entry's
+        # version stamp must match the weights that computed its bytes.
+        with self._state_lock:
+            params, wver = self.params, self.weights_version
         with self._prefix_lock:
             if self.prefix_cache.has(key):
                 self.prefix_cache.touch(key)
@@ -1019,7 +1095,7 @@ class ContinuousDecoder:
                     arr = np.zeros((1, w), np.int32)
                     arr[0, : len(toks)] = toks
                     cache, _last = prefill(
-                        self.params, jnp.asarray(arr),
+                        params, jnp.asarray(arr),
                         jnp.asarray([len(toks)], np.int32), self.cfg,
                         total_len=w)
                     with self._state_lock:
@@ -1038,7 +1114,7 @@ class ContinuousDecoder:
                     arr = np.zeros((1, t), np.int32)
                     arr[0, : len(toks)] = toks
                     cache, _last = prefill(
-                        self.params, jnp.asarray(arr),
+                        params, jnp.asarray(arr),
                         jnp.asarray([len(toks)], np.int32), self.cfg,
                         total_len=self.prefill_len)
                     self._prefix_pool = store_prefix_cache(
@@ -1046,6 +1122,7 @@ class ContinuousDecoder:
                 except Exception:
                     self.prefix_cache.remove(entry)
                     raise
+            entry.version = wver
             with self._mlock:
                 self.prefix_inserts += 1
                 self.prefill_tokens += len(toks)  # priming IS a prefill
@@ -1104,8 +1181,10 @@ class ContinuousDecoder:
             w = nblk * self.kv_block_size
             arr = np.zeros((1, w), np.int32)
             arr[0, : len(prefix_toks)] = prefix_toks
+            with self._state_lock:
+                params = self.params  # consistent with any live swap
             cache, _last = prefill(
-                self.params, jnp.asarray(arr),
+                params, jnp.asarray(arr),
                 jnp.asarray([len(prefix_toks)], np.int32), self.cfg,
                 total_len=w)
             with self._state_lock:
@@ -1238,12 +1317,16 @@ class ContinuousDecoder:
                 self.kv_handoff_tokens += plen
         return imported
 
-    def _install_prefix_payload(self, key: tuple, payload: dict) -> bool:
+    def _install_prefix_payload(self, key: tuple, payload: dict, *,
+                                version: int | None = None) -> bool:
         """Allocate local blocks, scatter ``payload`` in VERBATIM, and
         register ``key`` in the trie — the re-import core shared by the
         peer handoff (:meth:`import_prompt`) and host-tier promotion
         (:meth:`_promote_host_prefix`). Returns False when it cannot
-        land (no free blocks, every trie slot pinned)."""
+        land (no free blocks, every trie slot pinned). ``version``
+        stamps the installed entry's weights epoch (None = the live
+        one: peer handoffs in a weight-streaming fleet are assumed
+        version-aligned — the broadcast's ``max_lag`` bounds the skew)."""
         cache = self.prefix_cache
         nblk = self._alloc.blocks_for(len(key))
         if self._payload_nblk(payload) != nblk:
@@ -1302,21 +1385,30 @@ class ContinuousDecoder:
                 imported = cache.has(key)
             else:
                 entry.blocks = tuple(blocks)
+                # tpu-lint: disable=lock-inconsistent-guard -- epoch fence; swap flush re-sweeps
+                entry.version = (self.weights_version
+                                 if version is None else int(version))
                 with self._mlock:
                     self.prefix_inserts += 1
                 imported = True
         return imported
 
     def _promote_host_prefix(self, tokens: list[int],
-                             timeline=None) -> bool:
+                             timeline=None, *,
+                             allow_stale: bool = False) -> bool:
         """Second-chance lookup: a trie miss probes the host tier for
         the longest demoted prefix of ``tokens`` and re-imports it
         through :meth:`_install_prefix_payload` — the admission then
         rides the ordinary prefix-hit path instead of a cold prefill.
         The payload stays in the tier (unpinned LRU): a later eviction
-        of the promoted entry skips the re-export."""
+        of the promoted entry skips the re-export. ``allow_stale``
+        (resumed suspended streams only) accepts payloads from an
+        older weights epoch; fresh requests only match the live one."""
         with self._prefix_lock:
-            m = self._host_tier.match(tokens)
+            # tpu-lint: disable=lock-inconsistent-guard -- epoch fence; stale entry refused
+            live_epoch = self.weights_version
+            m = self._host_tier.match(
+                tokens, None if allow_stale else live_epoch)
         if m is None:
             return False
         entry, depth = m
@@ -1334,7 +1426,8 @@ class ContinuousDecoder:
         # own, so an interior match imports just the covering slice.
         payload = {s: _slice(entry.payload[s]) for s in ("k", "v")}
         if not self._install_prefix_payload(tuple(entry.key[:depth]),
-                                            payload):
+                                            payload,
+                                            version=entry.version):
             return False
         with self._prefix_lock:
             self._host_tier.note_promotion()
@@ -1343,6 +1436,134 @@ class ContinuousDecoder:
         if timeline is not None:
             timeline.event("promote", prefix_len=depth)
         return True
+
+    # -- live weight streaming -----------------------------------------
+
+    def update_weights(self, params, *, version: int | None = None,
+                       draft_params=None) -> int:
+        """Zero-drain in-place weight swap: install a new param pytree
+        between dispatches without dropping a single live stream.
+
+        Double-buffered by construction: the new tree is placed onto
+        the EXISTING shardings (tp>1 reuses shard_pytree + the model's
+        partition rules, so a host-gathered push from any learner mesh
+        lands correctly — the placement IS the reshard, the same trick
+        as the handoff envelope) with NO lock held, while decode keeps
+        dispatching against the old buffers; the install itself is a
+        pointer swap under the state lock — the dispatch boundary — so
+        no decode step can ever see torn weights. Live streams keep
+        their slots and KV and continue across the boundary (their
+        token sequences are consistent with exactly one version
+        switch, never an interleave); prompt K/V cached under the old
+        weights is flushed/refused so post-swap admissions are
+        byte-identical to a decoder cold-started on the new weights.
+
+        ``version`` stamps the push (monotonic; a stale or duplicate
+        version is a no-op returning the installed epoch — stragglers
+        in a fleet broadcast converge on the next push); None
+        auto-increments. ``draft_params`` swaps a paired
+        DraftModelProposer's weights in the SAME state-lock epoch —
+        target and draft can never serve different versions, which
+        would silently collapse speculative acceptance.
+
+        Returns the installed weights epoch."""
+        t0 = time.perf_counter()
+        # One consistent (params, epoch) snapshot to validate against.
+        with self._state_lock:
+            cur_params, cur_version = self.params, self.weights_version
+        if version is not None and int(version) <= cur_version:
+            return cur_version
+        # Shape/dtype contract against the serving tree (tree.map
+        # raises on a structure mismatch); dtype casts on host so a
+        # f32 learner can push into a bf16 server.
+        def _fit(n, o):
+            if tuple(getattr(n, "shape", ())) != tuple(o.shape):
+                raise ValueError(
+                    f"pushed leaf shape {getattr(n, 'shape', None)} "
+                    f"!= serving shape {o.shape}")
+            n = np.asarray(n) if not hasattr(n, "dtype") else n
+            return n.astype(o.dtype) if n.dtype != o.dtype else n
+
+        params = jax.tree.map(_fit, params, cur_params)
+        # Double buffer: place outside every lock. The old buffers
+        # keep serving dispatches while the host→device copy streams.
+        if self.mesh is not None:
+            from kubeflow_tpu.models.transformer import partition_rules
+            from kubeflow_tpu.parallel.sharding import shard_pytree
+
+            new_params = shard_pytree(params, self.mesh,
+                                      partition_rules(self.cfg))
+        else:
+            new_params = jax.device_put(params)
+        jax.block_until_ready(new_params)
+        spec = self._spec
+        draft_new = None
+        if draft_params is not None:
+            if spec is None or not hasattr(spec, "params"):
+                raise ValueError(
+                    "draft_params given but no draft-model proposer is "
+                    "configured (draft_mode='model:<name>')")
+            draft_new = jax.device_put(
+                jax.tree.map(_fit, draft_params, spec.params))
+            jax.block_until_ready(draft_new)
+        t_swap = time.perf_counter()
+        with self._state_lock:
+            # Re-check under the lock: a concurrent higher-versioned
+            # push may have won while our buffers streamed in.
+            if version is not None and int(version) <= \
+                    self.weights_version:
+                return self.weights_version
+            self.params = new_params
+            if draft_new is not None:
+                spec.install_weights(draft_new)
+            self.weights_version = (int(version) if version is not None
+                                    else self.weights_version + 1)
+            new_version = self.weights_version
+        swap_s = time.perf_counter() - t_swap
+        trie_flushed, tier_flushed = self._flush_stale_kv(new_version)
+        total_s = time.perf_counter() - t0
+        self._g_weights_version.set(new_version)
+        self._c_weight_pushes.inc()
+        self._h_weight_push.observe(total_s)
+        with self._mlock:
+            self.weight_pushes += 1
+            # The stall decode actually pays: waiting out the in-flight
+            # dispatch for the lock plus the pointer swap — the number
+            # the bench gates at <= one dispatch gap.
+            self.last_swap_seconds = swap_s
+        tl = self.trace.start(f"weights-v{new_version}")
+        tl.event("push", version=new_version,
+                 place_ms=round(1e3 * (t_swap - t0), 3),
+                 draft=draft_new is not None)
+        tl.event("swap", swap_ms=round(1e3 * swap_s, 3))
+        if trie_flushed or tier_flushed:
+            tl.event("flush", trie_entries=trie_flushed,
+                     tier_entries=tier_flushed)
+        tl.close()
+        return new_version
+
+    def _flush_stale_kv(self, version: int) -> tuple[int, int]:
+        """Drop cached K/V computed under a pre-swap weights epoch:
+        unpinned stale trie entries are removed outright (their blocks
+        free; demotion is skipped — see :meth:`_demote_entry`) and
+        unpinned stale host-tier payloads discarded. Entries pinned by
+        in-flight admissions survive the sweep but are refused and
+        removed at their next match (:meth:`_plan_prefix`); PINNED
+        tier payloads are suspended streams' state and straddle the
+        swap by design."""
+        trie_flushed = tier_flushed = 0
+        with self._prefix_lock:
+            if self.prefix_cache is not None:
+                for entry in self.prefix_cache.entries():
+                    if entry.version != version and entry.refs == 0:
+                        self.prefix_cache.remove(entry)
+                        trie_flushed += 1
+            if self._host_tier is not None:
+                for e in self._host_tier.entries():
+                    if e.version != version and not e.pinned:
+                        self._host_tier.discard(e.key)
+                        tier_flushed += 1
+        return trie_flushed, tier_flushed
 
     # -- QoS: ordering, deadline shedding, stream suspension -----------
 
@@ -1453,7 +1674,8 @@ class ContinuousDecoder:
         payload = self._export_ids(ids)
         key = tuple(seq[:plen])
         with self._prefix_lock:
-            parked = self._host_tier.put(key, payload, plen, pinned=True)
+            parked = self._host_tier.put(key, payload, plen, pinned=True,
+                                         version=req.weights_version)
         self._slot_req[slot] = None
         self._active_count -= 1
         self._release_pin(req)
@@ -2050,7 +2272,15 @@ class ContinuousDecoder:
                 "tenant_served": dict(self._tenant_served),
                 "role": self.role,
                 "tp_shards": self.tp_shards,
+                "weight_pushes": self.weight_pushes,
+                "weights_stale_refused": self.weight_stale_refused,
+                "weight_swap_seconds_last": self.last_swap_seconds,
             }
+        # The weights epoch swaps under the state lock; its own scope
+        # (never nested with the other snapshot locks) keeps the read
+        # consistent without coupling the lock hierarchies.
+        with self._state_lock:
+            snap["weights_version"] = self.weights_version
         # Allocator / trie stats live under the prefix lock — taken in a
         # SEPARATE scope (never nested with the metrics lock) so the two
         # subsystems can't deadlock against each other.

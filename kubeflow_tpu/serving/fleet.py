@@ -120,7 +120,8 @@ class DecoderFleet:
     def __init__(self, replicas: dict, *,
                  affinity_tokens: int = DEFAULT_AFFINITY_TOKENS,
                  pressure: int = 0, kv_pressure: float = 0.0,
-                 router: str = "affine", seed: int = 0):
+                 router: str = "affine", seed: int = 0,
+                 weights_max_lag: int = 0):
         if not replicas:
             raise ValueError("DecoderFleet needs at least one replica")
         if router not in ("affine", "random"):
@@ -147,6 +148,17 @@ class DecoderFleet:
         self.handoffs = 0           # prefill→decode KV relays completed
         self.handoff_fallbacks = 0  # degraded to a plain decode submit
         self.handoff_skipped = 0    # prompts too short to register
+        # Live weight streaming: highest weights epoch any replica has
+        # installed, per-replica installed epochs, and the skew bound.
+        # A replica lagging the fleet by more than ``weights_max_lag``
+        # pushes (0 = unbounded) is excluded from ROUTING until a later
+        # push lands on it — stragglers converge on the next push, and
+        # no request is ever served by weights older than the bound.
+        self.weights_max_lag = int(weights_max_lag)
+        self._weights_latest = 0
+        self._weights_installed: dict[str, int] = {}
+        self.weight_pushes = 0          # broadcast_weights calls
+        self.weight_push_failures = 0   # per-replica push failures
 
     # -- membership ----------------------------------------------------
 
@@ -169,6 +181,23 @@ class DecoderFleet:
         prefill replica (colocated replicas can take decode legs)."""
         return [m for m in self.live_members()
                 if (self._roles[m] == "prefill") == prefill]
+
+    def _fresh(self, live: list[str]) -> list[str]:
+        """Drop replicas lagging the fleet's weights epoch by more than
+        ``weights_max_lag`` pushes. At least one live replica always
+        carries the latest epoch (it defined it), so the fallback to
+        the raw list only fires when every fresh replica has since
+        died — availability then beats freshness."""
+        if self.weights_max_lag <= 0:
+            return live
+        with self._lock:
+            latest = self._weights_latest
+            if latest <= 0:
+                return live
+            fresh = [m for m in live
+                     if latest - self._weights_installed.get(m, 0)
+                     <= self.weights_max_lag]
+        return fresh or live
 
     def mark_dead(self, name: str, cause: Exception | None = None) -> None:
         with self._lock:
@@ -218,6 +247,7 @@ class DecoderFleet:
                     and self._kv_fill(name) >= self.kv_pressure)
 
     def _route_among(self, tokens, live: list[str]) -> str:
+        live = self._fresh(live)
         if not live:
             raise ReplicaUnavailableError("<none>")
         with self._lock:
@@ -259,7 +289,7 @@ class DecoderFleet:
         """The decode leg's placement: least-KV-loaded live decode
         replica (real-byte fill is what binds a decode pool), depth then
         name breaking ties deterministically."""
-        live = self._live_pool(prefill=False)
+        live = self._fresh(self._live_pool(prefill=False))
         if not live:
             raise ReplicaUnavailableError("<none>")
         return min(live, key=lambda m: (self._kv_fill(m),
@@ -368,6 +398,84 @@ class DecoderFleet:
         return self.submit(tokens, max_new_tokens, temperature).result(
             timeout)
 
+    # -- live weight streaming ----------------------------------------
+
+    def broadcast_weights(self, params, *, version: int | None = None,
+                          draft_params=None) -> dict:
+        """Fan a weight push out to every live replica CONCURRENTLY
+        (each replica's ``update_weights`` double-buffers and swaps
+        independently; one slow host→device copy must not serialize
+        the fleet behind it). A replica dying mid-push is marked dead
+        and excluded — the broadcast completes on the survivors, and a
+        straggler that comes back converges on the NEXT push (per-
+        replica installed epochs + ``weights_max_lag`` keep it out of
+        routing meanwhile). A push failure that is the PUSH's fault
+        (shape mismatch) is reported per replica, never kills one.
+
+        Returns ``{"version", "installed": {replica: epoch},
+        "failed": {replica: error}, "lagging": [replica, ...]}``."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        with self._lock:
+            target = (int(version) if version is not None
+                      else self._weights_latest + 1)
+        # Attempt EVERY member, dead included: a replica that died (or
+        # was preempted) and came back converges on the next push — a
+        # landed install on a replica whose scheduler is alive revives
+        # it into routing.
+        names = self.members()
+
+        def push(name):
+            try:
+                return name, self._replicas[name].update_weights(
+                    params, version=target,
+                    draft_params=draft_params), None
+            except Exception as e:  # noqa: BLE001 — death check below
+                return name, None, e
+
+        installed: dict[str, int] = {}
+        failed: dict[str, str] = {}
+        if names:
+            with ThreadPoolExecutor(max_workers=len(names)) as pool:
+                outcomes = list(pool.map(push, names))
+            for name, ver, err in outcomes:
+                if err is None:
+                    installed[name] = ver
+                elif self._is_replica_death(err):
+                    self.mark_dead(name, cause=err)
+                    failed[name] = str(err)
+                else:
+                    failed[name] = str(err)
+        with self._lock:
+            self.weight_pushes += 1
+            self.weight_push_failures += len(failed)
+            for name, ver in installed.items():
+                self._weights_installed[name] = max(
+                    ver, self._weights_installed.get(name, 0))
+                # Revive a previously-dead replica the push landed on —
+                # unless its scheduler loop is known-stopped (a stopped
+                # decoder still swaps params fine; routing to it would
+                # just re-kill it).
+                if not getattr(self._replicas[name], "_stopped", False):
+                    self._dead.discard(name)
+            if installed:
+                self._weights_latest = max(self._weights_latest,
+                                           max(installed.values()))
+            latest = self._weights_latest
+            lagging = sorted(
+                m for m in set(self._replicas) - self._dead
+                if latest - self._weights_installed.get(m, 0) > 0)
+        return {"version": target, "installed": installed,
+                "failed": failed, "lagging": lagging}
+
+    def weights_versions(self) -> dict:
+        """Per-replica installed weights epoch plus the fleet's latest
+        (dashboards and the RL learner's skew check read this)."""
+        with self._lock:
+            return {"latest": self._weights_latest,
+                    "installed": dict(self._weights_installed),
+                    "max_lag": self.weights_max_lag}
+
     def metrics(self) -> dict:
         """Per-replica decoder metrics plus fleet aggregates (the bench
         and the autoscaler read the same names the single-decoder
@@ -383,6 +491,10 @@ class DecoderFleet:
                 "remapped": self.remapped, "handoffs": self.handoffs,
                 "handoff_fallbacks": self.handoff_fallbacks,
                 "handoff_skipped": self.handoff_skipped,
+                "weight_pushes": self.weight_pushes,
+                "weight_push_failures": self.weight_push_failures,
+                "weights_latest": self._weights_latest,
+                "weights_installed": dict(self._weights_installed),
             }
         per: dict[str, dict] = {}
         for name in self.members():
@@ -396,7 +508,11 @@ class DecoderFleet:
         agg.update(replicas=per, live=sorted(per),
                    dead=dead, routed=counters["routed"],
                    spilled=counters["spilled"],
-                   remapped=counters["remapped"])
+                   remapped=counters["remapped"],
+                   weight_pushes=counters["weight_pushes"],
+                   weight_push_failures=counters["weight_push_failures"],
+                   weights_latest=counters["weights_latest"],
+                   weights_installed=counters["weights_installed"])
         if self.disaggregated:
             agg.update(
                 roles=dict(self._roles),

@@ -53,6 +53,12 @@ class TierEntry:
     nbytes: int
     pinned: bool = False
     last_used: int = field(default=0)
+    # Weights epoch the payload's K/V was computed under (same contract
+    # as PrefixEntry.version): a demoted payload from before a live
+    # weight swap must never feed a fresh request's promotion. Pinned
+    # suspended-stream payloads are exempt — they ARE the stream's
+    # state, and the stream straddles the swap by design.
+    version: int = 0
 
 
 class HostKvTier:
@@ -94,10 +100,11 @@ class HostKvTier:
     # -- insert / evict ------------------------------------------------
 
     def put(self, key, payload: dict, prefix_len: int, *,
-            pinned: bool = False) -> bool:
+            pinned: bool = False, version: int = 0) -> bool:
         """Store ``payload`` under ``key`` (evicting LRU unpinned
         entries to fit). Returns False when it cannot fit. Re-putting
-        an existing key refreshes it (and may pin it)."""
+        an existing key refreshes it (and may pin it). ``version``
+        stamps the weights epoch the bytes were computed under."""
         key = tuple(key)
         nbytes = payload_nbytes(payload)
         old = self._by_key.get(key)
@@ -110,7 +117,7 @@ class HostKvTier:
                 return False
         entry = TierEntry(key=key, payload=payload,
                           prefix_len=int(prefix_len), nbytes=nbytes,
-                          pinned=pinned)
+                          pinned=pinned, version=int(version))
         self._tick(entry)
         self._by_key[key] = entry
         self.bytes_in_use += nbytes
@@ -144,6 +151,11 @@ class HostKvTier:
         if entry is not None:
             self._drop(entry)
 
+    def entries(self) -> list[TierEntry]:
+        """Snapshot of every stored entry (the weight-swap stale flush
+        iterates it; callers serialize as with every other method)."""
+        return list(self._by_key.values())
+
     def unpin(self, key) -> None:
         """Make a suspended stream's payload ordinary LRU cache again
         (resume installed it on device; the copy here is now just a
@@ -161,10 +173,15 @@ class HostKvTier:
             self._tick(entry)
         return entry
 
-    def match(self, tokens) -> tuple[TierEntry, int] | None:
+    def match(self, tokens,
+              version: int | None = None) -> tuple[TierEntry, int] | None:
         """Deepest stored payload serving a prefix of ``tokens``:
         returns ``(entry, depth)`` — the first ``depth`` positions of
         ``entry.payload`` back ``tokens[:depth]`` — or None.
+        ``version`` (when given) skips entries stamped with a different
+        weights epoch: a fresh request must never promote KV computed
+        under weights the decoder no longer serves; a resuming
+        suspended stream passes None (its payload IS its state).
 
         Causality makes any SHORTER depth of a stored payload valid
         too (position ``i`` depends only on tokens ``0..i``), so an
@@ -177,6 +194,8 @@ class HostKvTier:
         cap = len(tokens) - 1
         best: tuple[TierEntry, int] | None = None
         for entry in self._by_key.values():
+            if version is not None and entry.version != version:
+                continue
             lim = min(entry.prefix_len, cap)
             if best is not None and lim <= best[1]:
                 continue

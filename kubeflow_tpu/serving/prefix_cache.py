@@ -35,6 +35,12 @@ class PrefixEntry:
     refs: int = 0       # in-flight admissions reading this slot
     last_used: int = 0  # LRU clock tick
     blocks: tuple[int, ...] | None = None  # paged: KV blocks held
+    # Weights epoch the cached K/V was computed under: a live weight
+    # push (ContinuousDecoder.update_weights) bumps the decoder's
+    # version, and entries stamped with an older one are stale — their
+    # bytes answer a model that no longer serves. The decoder refuses
+    # and removes stale matches; the cache itself stays version-blind.
+    version: int = 0
 
     def __len__(self) -> int:
         return len(self.key)
@@ -161,6 +167,11 @@ class PrefixCache:
         if not candidates:
             return None
         return min(candidates, key=lambda e: e.last_used)
+
+    def entries(self) -> list[PrefixEntry]:
+        """Snapshot of every live entry (the weight-swap stale flush
+        iterates it; callers hold the same lock as every other call)."""
+        return list(self._by_key.values())
 
     def evict_lru(self) -> bool:
         """Evict the least-recently-used UNPINNED entry (memory-pressure

@@ -1,0 +1,183 @@
+"""HTTP actor fleet for the RL learner — the cross-pod twin of
+:class:`~kubeflow_tpu.serving.fleet.DecoderFleet`.
+
+Inside one RLJob, learner and actors are separate gangs: the learner
+pod reaches each actor's model server over HTTP (pod DNS injected by
+the RLJob operator). This module is the minimal client surface the
+learner loop (:mod:`kubeflow_tpu.train.rl`) needs from a fleet:
+
+- ``generate`` — one rollout over ``:predict`` (round-robin with dead-
+  target exclusion; a dead actor costs throughput, never the run);
+- ``broadcast_weights`` — the chunked weight push
+  (:func:`kubeflow_tpu.serving.weights.push_weights`) fanned out
+  CONCURRENTLY at each actor's ``:weights`` endpoint, straggler-
+  tolerant with the same ``max_lag`` routing exclusion as the
+  in-process fleet;
+- ``metrics``/``stop`` — enough bookkeeping for the result dict.
+
+Weight bytes travel learner→actor directly, never through the gateway.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.client import HTTPConnection
+
+from kubeflow_tpu.serving.weights import DEFAULT_CHUNK_BYTES, push_weights
+
+
+class RemoteActorFleet:
+    """Round-robin rollout client + weight broadcaster over HTTP
+    model-server targets (``host:port`` each)."""
+
+    def __init__(self, targets: list[str], model: str, *,
+                 weights_max_lag: int = 0,
+                 chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+                 timeout: float = 600.0):
+        if not targets:
+            raise ValueError("RemoteActorFleet needs at least one target")
+        self.targets = list(targets)
+        self.model = model
+        self.weights_max_lag = int(weights_max_lag)
+        self.chunk_bytes = int(chunk_bytes)
+        self.timeout = float(timeout)
+        self._lock = threading.Lock()
+        self._rr = 0
+        self._dead: set[str] = set()
+        self._weights_latest = 0
+        self._weights_installed: dict[str, int] = {}
+        self.weight_pushes = 0
+        self.weight_push_failures = 0
+        self.rollouts = 0
+
+    # -- routing -------------------------------------------------------
+
+    def _live(self) -> list[str]:
+        with self._lock:
+            live = [t for t in self.targets if t not in self._dead]
+            latest = self._weights_latest
+            if self.weights_max_lag > 0 and latest > 0:
+                fresh = [t for t in live
+                         if latest - self._weights_installed.get(t, 0)
+                         <= self.weights_max_lag]
+                live = fresh or live
+        return live
+
+    def _pick(self) -> str:
+        live = self._live()
+        if not live:
+            raise RuntimeError("every actor target is dead")
+        with self._lock:
+            self._rr += 1
+            return live[self._rr % len(live)]
+
+    # -- rollouts ------------------------------------------------------
+
+    def generate(self, tokens, max_new_tokens: int,
+                 temperature: float = 0.0,
+                 timeout: float | None = None) -> dict:
+        body = json.dumps({"instances": [{
+            "tokens": [int(t) for t in tokens],
+            "max_new_tokens": int(max_new_tokens),
+            "temperature": float(temperature),
+        }]}).encode()
+        last_err: Exception | None = None
+        for _ in range(len(self.targets)):
+            target = self._pick()
+            host, _, port_s = target.partition(":")
+            try:
+                conn = HTTPConnection(host, int(port_s or 80),
+                                      timeout=timeout or self.timeout)
+                try:
+                    conn.request(
+                        "POST", f"/v1/models/{self.model}:predict",
+                        body=body,
+                        headers={"Content-Type": "application/json"})
+                    resp = conn.getresponse()
+                    payload = json.loads(resp.read() or b"{}")
+                finally:
+                    conn.close()
+                if resp.status != 200:
+                    raise OSError(
+                        f"{target} answered {resp.status}: "
+                        f"{payload.get('error', '')}")
+                pred = payload["predictions"][0]
+                with self._lock:
+                    self.rollouts += 1
+                return {"tokens": pred.get("tokens", []),
+                        "finish_reason": pred.get("finish_reason", "")}
+            except (OSError, ValueError, KeyError, IndexError) as e:
+                last_err = e
+                with self._lock:
+                    self._dead.add(target)
+        raise RuntimeError(
+            f"every actor target failed; last error: {last_err}")
+
+    # -- weight streaming ---------------------------------------------
+
+    def broadcast_weights(self, params, *, version: int | None = None,
+                          draft_params=None) -> dict:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with self._lock:
+            target_v = (int(version) if version is not None
+                        else self._weights_latest + 1)
+        # Attempt every target, dead included: an actor pod that
+        # restarted behind the same DNS converges on the next push.
+        live = list(self.targets)
+
+        def push(addr):
+            try:
+                out = push_weights(addr, self.model, params, target_v,
+                                   draft_params=draft_params,
+                                   chunk_bytes=self.chunk_bytes,
+                                   timeout=self.timeout)
+                return addr, int(out.get("weights_version", target_v)), \
+                    None
+            except Exception as e:  # noqa: BLE001 — recorded per target
+                return addr, None, e
+
+        installed: dict[str, int] = {}
+        failed: dict[str, str] = {}
+        if live:
+            with ThreadPoolExecutor(max_workers=len(live)) as pool:
+                for addr, ver, err in pool.map(push, live):
+                    if err is None:
+                        installed[addr] = ver
+                    else:
+                        failed[addr] = str(err)
+        with self._lock:
+            self.weight_pushes += 1
+            self.weight_push_failures += len(failed)
+            for addr, ver in installed.items():
+                self._weights_installed[addr] = max(
+                    ver, self._weights_installed.get(addr, 0))
+                self._dead.discard(addr)  # a landed push revives it
+            if installed:
+                self._weights_latest = max(self._weights_latest,
+                                           max(installed.values()))
+            latest = self._weights_latest
+            lagging = sorted(
+                t for t in self.targets if t not in self._dead
+                and latest - self._weights_installed.get(t, 0) > 0)
+        return {"version": target_v, "installed": installed,
+                "failed": failed, "lagging": lagging}
+
+    # -- bookkeeping ---------------------------------------------------
+
+    def metrics(self) -> dict:
+        with self._lock:
+            return {
+                "targets": list(self.targets),
+                "dead": sorted(self._dead),
+                "rollouts": self.rollouts,
+                "weight_pushes": self.weight_pushes,
+                "weight_push_failures": self.weight_push_failures,
+                "weights_latest": self._weights_latest,
+                "weights_installed": dict(self._weights_installed),
+            }
+
+    def stop(self) -> None:
+        """Remote actors have their own lifecycle (the RLJob operator
+        tears the pool down); nothing to stop client-side."""

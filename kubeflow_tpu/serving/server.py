@@ -83,6 +83,10 @@ class ModelServer:
         # dynamic batcher. Lazily built: non-LM servers never pay for it.
         self._decoder = None
         self._decoder_lock = threading.Lock()
+        # Live weight pushes (:weights endpoint): chunk assembly state,
+        # serialized so concurrent learner chunks interleave safely.
+        self._weights_assembler = None
+        self._weights_lock = threading.Lock()
 
     @property
     def decoder(self):
@@ -291,6 +295,49 @@ class ModelServer:
         h = handoff_mod.unpack(body)  # ValueError on garbage -> 400
         return {"imported": bool(self.decoder.import_prompt(h))}
 
+    # -- live weight streaming -----------------------------------------
+    #
+    # The HTTP face of ContinuousDecoder.update_weights: a learner
+    # POSTs chunked weight envelopes (serving/weights.py) directly at
+    # each replica's ``:weights`` — server-to-server, the gateway never
+    # relays weight bytes. Chunks assemble per weights epoch; the swap
+    # installs atomically only when the last chunk lands, so a torn or
+    # abandoned push can never reach the decoder.
+
+    def handle_weights(self, name: str, body: dict) -> dict:
+        from kubeflow_tpu.serving import weights as weights_mod
+
+        if name != self.engine.cfg.model:
+            raise KeyError(f"model {name!r} not served")
+        decoder = self.decoder
+        if decoder is None:
+            raise ValueError("model does not support generation")
+        chunk = weights_mod.unpack_chunk(body)  # ValueError -> 400
+        with self._weights_lock:
+            if self._weights_assembler is None:
+                self._weights_assembler = weights_mod.WeightChunkAssembler()
+            done = self._weights_assembler.add(chunk)
+            pending = self._weights_assembler.pending
+        if done is None:
+            return {"installed": False, "pending": pending,
+                    "weights_version": chunk["weights_version"]}
+        leaves, has_draft = done
+        model_leaves, draft_leaves = weights_mod.split_namespaces(leaves)
+        params = weights_mod.unflatten_params(model_leaves,
+                                              decoder.params)
+        draft = None
+        if has_draft:
+            spec = getattr(decoder, "_spec", None)
+            if spec is None or not hasattr(spec, "params"):
+                raise ValueError(
+                    "push carries draft weights but no draft-model "
+                    "proposer is configured")
+            draft = weights_mod.unflatten_params(draft_leaves,
+                                                 spec.params)
+        installed = decoder.update_weights(
+            params, version=chunk["weights_version"], draft_params=draft)
+        return {"installed": True, "weights_version": installed}
+
     def handle_metadata(self, name: str) -> dict:
         if name != self.engine.cfg.model:
             raise KeyError(f"model {name!r} not served")
@@ -425,6 +472,12 @@ class ModelServer:
                                 d["hol_bypasses"],
                             "serving_qos_enabled":
                                 int(d["qos_enabled"]),
+                            # Live weight streaming: the version gauge,
+                            # push counter and push-seconds histogram
+                            # ride the decoder registry above; the
+                            # stale-hit refusals land here.
+                            "serving_weights_stale_refused_total":
+                                d["weights_stale_refused"],
                             "serving_in_flight": d["in_flight"],
                             "serving_queued": d["queued"],
                             # serving_tp_shards rides the decoder
@@ -548,6 +601,11 @@ class ModelServer:
                             self.path.endswith(":import"):
                         name = self.path[len("/v1/models/"):-len(":import")]
                         self._send(200, server.handle_import(name, body))
+                    elif self.path.startswith("/v1/models/") and \
+                            self.path.endswith(":weights"):
+                        name = self.path[len("/v1/models/"):
+                                         -len(":weights")]
+                        self._send(200, server.handle_weights(name, body))
                     else:
                         error = True
                         self._send(404, {"error": f"no route {self.path}"})

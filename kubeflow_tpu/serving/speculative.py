@@ -52,6 +52,11 @@ class NgramProposer:
     def reset(self, slot: int) -> None:  # per-slot state: none
         pass
 
+    def install_weights(self, params) -> None:
+        """N-gram drafting has no weights — a live weight push is a
+        no-op here (the proposer reads committed tokens, which are
+        already the new model's outputs after the swap)."""
+
     def _lookup(self, context: list[int], n: int) -> list[int]:
         if n <= 0:
             return []
@@ -112,6 +117,18 @@ class DraftModelProposer:
         """A new request took ``slot``: its whole prompt is pending feed
         (the stale cache content is overwritten as the feed advances)."""
         self._fed[slot] = 0
+
+    def install_weights(self, params) -> None:
+        """Swap in new draft weights (already device-placed by the
+        caller). The decoder installs this INSIDE the same state-lock
+        epoch as the target's swap — a draft proposing from old weights
+        against a new-weights verifier doesn't break correctness
+        (verification accepts only what the target would emit) but
+        silently collapses acceptance, which is the entire throughput
+        win. The draft KV cache is NOT invalidated: positions fed
+        before the swap were committed target tokens either way, and
+        the proposer's output is a hint the verify pass re-scores."""
+        self.params = params
 
     def propose(self, requests: list[tuple[int, list[int], int]],
                 ) -> dict[int, list[int]]:

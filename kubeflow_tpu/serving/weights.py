@@ -1,0 +1,266 @@
+"""Live weight-push envelopes for the serving fleet.
+
+A learner pushes fresh params into running decoders
+(:meth:`~kubeflow_tpu.serving.continuous.ContinuousDecoder.update_weights`);
+this module is the host-side wire format around that push — the weights
+sibling of :mod:`kubeflow_tpu.serving.handoff`:
+
+- in process (``DecoderFleet.broadcast_weights``) the pytree travels as
+  plain arrays — zero copies beyond the device fetch the learner already
+  paid;
+- across the HTTP fleet, :func:`pack_weights` splits the flattened tree
+  into size-bounded CHUNKS of base64-encoded leaves (a model is orders
+  of magnitude bigger than a KV handoff — one monolithic JSON body would
+  stall the server's accept loop and double peak host memory), each
+  chunk a self-describing versioned envelope POSTed at the model
+  server's ``:weights`` endpoint. The server assembles chunks per
+  weights epoch (:class:`WeightChunkAssembler`) and installs the tree
+  atomically only when the LAST chunk lands — a half-received push can
+  never install. Weight bytes travel server-to-server (learner → each
+  replica), never through the gateway.
+
+Leaves are keyed by their pytree path (``parallel.sharding.path_str``
+spelling), and the receiver rebuilds the tree against its OWN serving
+params' structure — paths it doesn't recognize, or a push that doesn't
+cover every serving leaf, fail loudly instead of installing a torn tree.
+
+Pure host logic — numpy only, no jax — importable by learners and tests
+without the serving stack's device deps.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from http.client import HTTPConnection
+
+import numpy as np
+
+# Envelope schema version: receivers reject anything newer rather than
+# guess at a layout (a mis-parsed push would install garbage weights).
+WEIGHTS_ENVELOPE_VERSION = 1
+
+# Default chunk payload bound. Small enough that a chunk never stalls a
+# model server's HTTP thread for long; large enough that tiny models
+# ship in one POST.
+DEFAULT_CHUNK_BYTES = 8 << 20
+
+
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # jax dependency, always present here
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _pack_array(arr) -> dict:
+    a = np.asarray(arr)
+    return {
+        "dtype": a.dtype.name,
+        "shape": list(a.shape),
+        "data": base64.b64encode(np.ascontiguousarray(a).tobytes())
+        .decode("ascii"),
+    }
+
+
+def _unpack_array(d: dict) -> np.ndarray:
+    if not isinstance(d, dict) or "data" not in d:
+        raise ValueError("malformed weights array")
+    raw = base64.b64decode(d["data"])
+    arr = np.frombuffer(raw, dtype=_np_dtype(d["dtype"]))
+    return arr.reshape([int(s) for s in d["shape"]])
+
+
+def flatten_params(params) -> dict[str, np.ndarray]:
+    """``path -> host array`` for a param pytree (the path spelling of
+    ``parallel.sharding.path_str``, so envelopes and receivers agree).
+    Device leaves are fetched to host; paths are unique by construction
+    (pytree paths are)."""
+    import jax
+
+    from kubeflow_tpu.parallel.sharding import path_str
+
+    leaves = {}
+    for kp, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        leaves[path_str(kp)] = np.asarray(jax.device_get(leaf))
+    return leaves
+
+
+def unflatten_params(leaves: dict[str, np.ndarray], reference):
+    """Rebuild a pytree shaped like ``reference`` from a ``path ->
+    array`` map. Raises ``ValueError`` when the push does not cover the
+    reference's leaves exactly — a partial tree must never install."""
+    import jax
+
+    from kubeflow_tpu.parallel.sharding import path_str
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(reference)
+    want = [path_str(kp) for kp, _ in flat]
+    missing = [p for p in want if p not in leaves]
+    extra = sorted(set(leaves) - set(want))
+    if missing or extra:
+        raise ValueError(
+            f"weights push does not match the serving tree: "
+            f"missing={missing[:3]}{'...' if len(missing) > 3 else ''} "
+            f"extra={extra[:3]}{'...' if len(extra) > 3 else ''}")
+    return jax.tree_util.tree_unflatten(
+        treedef, [leaves[p] for p in want])
+
+
+def pack_weights(params, weights_version: int, *,
+                 chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+                 draft_params=None) -> list[dict]:
+    """Split ``params`` into one or more JSON-safe chunk envelopes.
+
+    Chunks split on leaf boundaries by cumulative payload size; every
+    chunk carries ``(weights_version, seq, chunks)`` so the receiver
+    can assemble exactly one epoch at a time and discard a superseded
+    partial push. ``draft_params`` (a paired draft model's tree) rides
+    the same envelopes under a separate namespace, so target and draft
+    install in the same epoch."""
+    items = [("m/" + p, a) for p, a in flatten_params(params).items()]
+    if draft_params is not None:
+        items += [("d/" + p, a)
+                  for p, a in flatten_params(draft_params).items()]
+    groups: list[list[tuple[str, np.ndarray]]] = [[]]
+    size = 0
+    for path, arr in items:
+        nbytes = int(arr.nbytes)
+        if groups[-1] and size + nbytes > max(1, int(chunk_bytes)):
+            groups.append([])
+            size = 0
+        groups[-1].append((path, arr))
+        size += nbytes
+    chunks = []
+    for seq, group in enumerate(groups):
+        chunks.append({
+            "version": WEIGHTS_ENVELOPE_VERSION,
+            "weights_version": int(weights_version),
+            "seq": seq,
+            "chunks": len(groups),
+            "has_draft": draft_params is not None,
+            "leaves": {p: _pack_array(a) for p, a in group},
+        })
+    return chunks
+
+
+def unpack_chunk(env: dict) -> dict:
+    """Decode one chunk envelope. Raises ``ValueError`` on a malformed
+    or version-mismatched envelope — the server answers 400 instead of
+    assembling garbage."""
+    if not isinstance(env, dict) or \
+            env.get("version") != WEIGHTS_ENVELOPE_VERSION:
+        raise ValueError(
+            f"unsupported weights envelope version="
+            f"{env.get('version') if isinstance(env, dict) else env!r}")
+    try:
+        wv = int(env["weights_version"])
+        seq = int(env["seq"])
+        total = int(env["chunks"])
+    except (KeyError, TypeError, ValueError):
+        raise ValueError("weights envelope missing version/seq/chunks"
+                         ) from None
+    if not 0 <= seq < total:
+        raise ValueError(f"weights chunk seq {seq} outside 0..{total - 1}")
+    leaves = env.get("leaves")
+    if not isinstance(leaves, dict):
+        raise ValueError("weights envelope carries no leaves")
+    return {
+        "weights_version": wv, "seq": seq, "chunks": total,
+        "has_draft": bool(env.get("has_draft")),
+        "leaves": {str(p): _unpack_array(a) for p, a in leaves.items()},
+    }
+
+
+class WeightChunkAssembler:
+    """Per-epoch chunk assembly on the receiving server.
+
+    Chunks of ONE weights epoch accumulate until all arrive; then
+    :meth:`add` returns the complete ``(leaves, has_draft)`` and resets.
+    A chunk for a NEWER epoch discards any partial older one (the
+    straggler learner lost the race; it converges on the next push); a
+    chunk for an older epoch than the assembling one is rejected as
+    stale. Callers serialize access (the model server wraps calls in
+    its own lock)."""
+
+    def __init__(self) -> None:
+        self._version: int | None = None
+        self._chunks: int = 0
+        self._seen: set[int] = set()
+        self._leaves: dict[str, np.ndarray] = {}
+        self._has_draft = False
+
+    @property
+    def pending(self) -> int:
+        """Chunks still missing for the epoch being assembled."""
+        return self._chunks - len(self._seen) if self._seen else 0
+
+    def add(self, chunk: dict) -> tuple[dict, bool] | None:
+        wv = chunk["weights_version"]
+        if self._version is not None and wv < self._version:
+            raise ValueError(
+                f"stale weights chunk for epoch {wv}; assembling "
+                f"{self._version}")
+        if self._version != wv:
+            self._version = wv
+            self._chunks = chunk["chunks"]
+            self._seen = set()
+            self._leaves = {}
+            self._has_draft = chunk["has_draft"]
+        if chunk["chunks"] != self._chunks:
+            raise ValueError(
+                f"weights chunk count changed mid-push "
+                f"({chunk['chunks']} != {self._chunks})")
+        if chunk["seq"] in self._seen:
+            return None  # duplicate delivery: idempotent
+        self._seen.add(chunk["seq"])
+        self._leaves.update(chunk["leaves"])
+        if len(self._seen) < self._chunks:
+            return None
+        leaves, has_draft = self._leaves, self._has_draft
+        self._version, self._chunks = None, 0
+        self._seen, self._leaves = set(), {}
+        return leaves, has_draft
+
+
+def split_namespaces(leaves: dict) -> tuple[dict, dict]:
+    """Split assembled leaves into (model, draft) path maps (the
+    ``m/``/``d/`` namespaces :func:`pack_weights` writes)."""
+    model = {p[2:]: a for p, a in leaves.items() if p.startswith("m/")}
+    draft = {p[2:]: a for p, a in leaves.items() if p.startswith("d/")}
+    return model, draft
+
+
+def push_weights(target: str, model: str, params, weights_version: int,
+                 *, draft_params=None,
+                 chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+                 timeout: float = 60.0) -> dict:
+    """POST a param pytree at ``target``'s ``:weights`` endpoint
+    chunk-by-chunk (``target`` = ``host:port`` of a model server —
+    learner-to-server direct, never through the gateway). Returns the
+    final chunk's response dict ({"installed": bool, "weights_version":
+    int}). Raises ``OSError``/``ValueError`` on transport or protocol
+    failure — the caller (broadcast, operator) owns retry policy."""
+    host, _, port_s = target.partition(":")
+    out: dict = {}
+    for env in pack_weights(params, weights_version,
+                            chunk_bytes=chunk_bytes,
+                            draft_params=draft_params):
+        data = json.dumps(env).encode()
+        conn = HTTPConnection(host, int(port_s or 80), timeout=timeout)
+        try:
+            conn.request("POST", f"/v1/models/{model}:weights",
+                         body=data,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            body = resp.read()
+            if resp.status != 200:
+                raise ValueError(
+                    f"weights push chunk {env['seq']} refused: "
+                    f"HTTP {resp.status} {body[:200]!r}")
+            out = json.loads(body or b"{}")
+        finally:
+            conn.close()
+    return out

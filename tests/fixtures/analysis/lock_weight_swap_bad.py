@@ -1,0 +1,37 @@
+"""Minimized live-weight-swap hazard: the new param buffers installed
+with ``jax.device_put`` UNDER the held state lock.
+
+The zero-drain contract says the state lock is only the dispatch
+boundary — a pointer swap. Issuing the host→device transfer inside it
+parks the scheduler thread (and every decode dispatch contending for
+the lock) behind the entire weight copy: the swap "stall" becomes the
+whole model's transfer time instead of one dispatch gap. The
+lock-discipline checker must flag the transfer
+(``lock-blocking-call``).
+"""
+
+import threading
+
+import jax
+
+
+class BadWeightSwap:
+    """Installs pushed weights with the state lock held throughout."""
+
+    def __init__(self, params):
+        self._state_lock = threading.Lock()
+        self._params = params
+        self._version = 0
+
+    def decode_step(self, step_fn, state):
+        with self._state_lock:
+            return step_fn(state, self._params)
+
+    def update_weights(self, host_params):
+        with self._state_lock:
+            # BUG: the whole host→device copy runs under the lock every
+            # decode dispatch needs — the fleet's token cadence stalls
+            # for the full transfer, not one dispatch gap.
+            self._params = jax.device_put(host_params)
+            self._version += 1
+            return self._version

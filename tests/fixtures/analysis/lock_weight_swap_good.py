@@ -1,0 +1,29 @@
+"""Live-weight-swap GOOD twin: double-buffered install. The
+host→device transfer runs with no lock held (decode keeps dispatching
+against the old buffers while the copy streams in); the state lock
+guards only the pointer swap, so the stall is one dispatch gap."""
+
+import threading
+
+import jax
+
+
+class GoodWeightSwap:
+    """Stage buffers outside the lock; swap the pointer under it."""
+
+    def __init__(self, params):
+        self._state_lock = threading.Lock()
+        self._params = params
+        self._version = 0
+
+    def decode_step(self, step_fn, state):
+        with self._state_lock:
+            return step_fn(state, self._params)
+
+    def update_weights(self, host_params):
+        staged = jax.device_put(host_params)
+        jax.block_until_ready(staged)
+        with self._state_lock:
+            self._params = staged
+            self._version += 1
+            return self._version

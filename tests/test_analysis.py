@@ -83,6 +83,20 @@ def test_elastic_drain_blocking_under_lock_detected():
     assert all(h.symbol == "BadElasticDrain.reshard" for h in hits)
 
 
+def test_weight_swap_device_put_under_lock_detected():
+    """The live weight-push hot path's exposed class: the new param
+    buffers installed with jax.device_put while the state lock —
+    the dispatch boundary every decode contends on — is held. The
+    transfer must be flagged as a blocking call."""
+    found = _findings(FIXTURES / "lock_weight_swap_bad.py")
+    hits = [f for f in found if f.rule == "lock-blocking-call"]
+    assert hits, found
+    messages = " ".join(h.message for h in hits)
+    assert "_state_lock" in messages
+    assert "device_put" in messages
+    assert all(h.symbol == "BadWeightSwap.update_weights" for h in hits)
+
+
 def test_pr4_torn_metrics_detected():
     found = _findings(FIXTURES / "lock_torn_metrics_bad.py")
     hits = [f for f in found if f.rule == "lock-inconsistent-guard"]
@@ -138,6 +152,7 @@ def test_metrics_exposition_detected():
 
 def test_good_fixtures_are_clean():
     for name in ("lock_good.py", "lock_elastic_drain_good.py",
+                 "lock_weight_swap_good.py",
                  "thread_lifecycle_good.py",
                  "resource_good.py", "jax_hygiene_good.py",
                  "jax_hygiene_shard_map_good.py",

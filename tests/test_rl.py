@@ -1,0 +1,274 @@
+"""RLJob tests: CRD validation, the operator's lowering into a
+high-priority learner gang + an elastic preemptible actor pool, status
+aggregation, and the minimal learner loop (train/rl.py) driving live
+weight pushes end-to-end (including actor death mid-run)."""
+
+from __future__ import annotations
+
+import pytest
+
+from kubeflow_tpu.apis.rl import (
+    RL_API_VERSION,
+    RL_KIND,
+    RLJobValidationError,
+    rl_job,
+    rl_job_crd,
+    validate_rl_job,
+)
+from kubeflow_tpu.operators.rl import ENV_RL_ACTORS, RLJobController
+
+NS = "kubeflow"
+
+
+@pytest.fixture()
+def api(api):
+    from kubeflow_tpu.apis.jobs import JAX_JOB_KIND, job_crd
+
+    api.apply(rl_job_crd())
+    api.apply(job_crd(JAX_JOB_KIND))
+    return api
+
+
+def _cr(name="podracer", **kw):
+    kw.setdefault("learner", {"steps": 10, "pushEverySteps": 2})
+    kw.setdefault("actors", {"replicas": 2, "minReplicas": 1,
+                             "maxReplicas": 4})
+    kw.setdefault("rollout", {"promptLen": 8, "maxNewTokens": 16})
+    return rl_job(name, NS, "lm-test-tiny", **kw)
+
+
+# ---------------------------------------------------------------------------
+# API / validation
+# ---------------------------------------------------------------------------
+
+
+def test_crd_schema_and_defaults():
+    crd = rl_job_crd()
+    assert crd["spec"]["names"]["kind"] == RL_KIND
+    props = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"][
+        "properties"]["spec"]["properties"]
+    assert {"model", "learner", "actors", "rollout",
+            "weights"} <= set(props)
+    validate_rl_job(_cr())  # defaults are valid
+
+
+def test_validation_rejects_inverted_priorities():
+    cr = _cr(learner={"priority": 0}, actors={"priority": 10})
+    with pytest.raises(RLJobValidationError):
+        validate_rl_job(cr)
+    # Equal priorities are just as wrong: nothing marks the actors as
+    # the capacity to reclaim first.
+    cr = _cr(learner={"priority": 5}, actors={"priority": 5})
+    with pytest.raises(RLJobValidationError):
+        validate_rl_job(cr)
+
+
+def test_validation_rejects_bad_elastic_range():
+    with pytest.raises(RLJobValidationError):
+        validate_rl_job(_cr(actors={"replicas": 2, "minReplicas": 3,
+                                    "maxReplicas": 2}))
+    with pytest.raises(RLJobValidationError):
+        validate_rl_job(_cr(actors={"replicas": 9, "minReplicas": 1,
+                                    "maxReplicas": 4}))
+    with pytest.raises(RLJobValidationError):
+        validate_rl_job(_cr(learner={"pushEverySteps": 0}))
+    with pytest.raises(RLJobValidationError):
+        validate_rl_job({"metadata": {"name": "x"}, "spec": {}})
+
+
+# ---------------------------------------------------------------------------
+# Operator lowering
+# ---------------------------------------------------------------------------
+
+
+def test_reconcile_lowers_into_two_scheduler_managed_gangs(api):
+    ctrl = RLJobController(api)
+    api.create(_cr())
+    assert ctrl.reconcile_all() == 1
+
+    learner = api.get("kubeflow-tpu.org/v1", "JaxJob",
+                      "podracer-learner", NS)
+    actors = api.get("kubeflow-tpu.org/v1", "JaxJob",
+                     "podracer-actors", NS)
+    # Scheduler-managed at a real priority gap; the learner is the job.
+    assert learner["spec"]["priority"] == 100
+    assert learner["spec"]["preemptible"] is False
+    assert actors["spec"]["priority"] == 0
+    assert actors["spec"]["preemptible"] is True
+    # Actors are elastic: the PR-14 scheduler may shrink them live.
+    assert actors["spec"]["elastic"] == {"minReplicas": 1,
+                                         "maxReplicas": 4}
+    assert actors["spec"]["replicaSpecs"]["Worker"]["replicas"] == 2
+    # Both children owned by the RLJob (cascade delete).
+    for child in (learner, actors):
+        ref = child["metadata"]["ownerReferences"][0]
+        assert ref["kind"] == RL_KIND and ref["name"] == "podracer"
+    # The learner knows its actor pool: pod-DNS model-server addresses.
+    env = {e["name"]: e.get("value", "") for e in
+           learner["spec"]["replicaSpecs"]["Worker"]["template"]["spec"]
+           ["containers"][0]["env"]}
+    assert env[ENV_RL_ACTORS].split(",") == [
+        "podracer-actors-worker-0.podracer-actors.kubeflow:8500",
+        "podracer-actors-worker-1.podracer-actors.kubeflow:8500",
+    ]
+    # Actor pods run continuous-decode model servers on the paged pool
+    # (the layout the live weight swap and rollout admission ride).
+    args = actors["spec"]["replicaSpecs"]["Worker"]["template"]["spec"][
+        "containers"][0]["args"]
+    assert "--decode-mode=continuous" in args
+    assert "--kv-layout=paged" in args
+
+    status = api.get(RL_API_VERSION, RL_KIND, "podracer",
+                     NS).get("status", {})
+    assert status["phase"] == "Pending"
+    assert status["learner"]["job"] == "podracer-learner"
+    assert status["actors"]["replicas"] == 2
+
+
+def test_status_aggregates_children(api):
+    ctrl = RLJobController(api)
+    api.create(_cr())
+    ctrl.reconcile_all()
+    learner = api.get("kubeflow-tpu.org/v1", "JaxJob",
+                      "podracer-learner", NS)
+    learner.setdefault("status", {})["state"] = "Running"
+    learner["status"]["metrics"] = {"weights_version": 7}
+    api.update_status(learner)
+    ctrl.reconcile_all()
+    status = api.get(RL_API_VERSION, RL_KIND, "podracer",
+                     NS).get("status", {})
+    assert status["phase"] == "Running"
+    assert status["weightsVersion"] == 7
+    # Learner done => the RLJob is done (actors serve until teardown).
+    learner = api.get("kubeflow-tpu.org/v1", "JaxJob",
+                      "podracer-learner", NS)
+    learner["status"]["state"] = "Succeeded"
+    api.update_status(learner)
+    ctrl.reconcile_all()
+    status = api.get(RL_API_VERSION, RL_KIND, "podracer",
+                     NS).get("status", {})
+    assert status["phase"] == "Succeeded"
+
+
+def test_invalid_cr_fails_without_children(api):
+    ctrl = RLJobController(api)
+    api.create(_cr(name="bad", learner={"priority": 0},
+                   actors={"priority": 5}))
+    ctrl.reconcile_all()
+    status = api.get(RL_API_VERSION, RL_KIND, "bad",
+                     NS).get("status", {})
+    assert status["phase"] == "Failed"
+    assert "priority" in status["reason"]
+    assert api.get_or_none("kubeflow-tpu.org/v1", "JaxJob",
+                           "bad-learner", NS) is None
+
+
+def test_spec_change_updates_children(api):
+    ctrl = RLJobController(api)
+    api.create(_cr())
+    ctrl.reconcile_all()
+    cr = api.get(RL_API_VERSION, RL_KIND, "podracer", NS)
+    cr["spec"]["actors"]["replicas"] = 3
+    cr["spec"]["actors"]["maxReplicas"] = 6
+    api.update(cr)
+    ctrl.reconcile_all()
+    actors = api.get("kubeflow-tpu.org/v1", "JaxJob",
+                     "podracer-actors", NS)
+    assert actors["spec"]["replicaSpecs"]["Worker"]["replicas"] == 3
+    assert actors["spec"]["elastic"]["maxReplicas"] == 6
+
+
+# ---------------------------------------------------------------------------
+# The learner loop
+# ---------------------------------------------------------------------------
+
+
+def test_run_rl_pushes_and_converges():
+    from kubeflow_tpu.train.rl import RLConfig, run_rl
+
+    cfg = RLConfig(steps=4, batch_size=1, push_every_steps=2,
+                   actors=2, prompt_len=8, max_new_tokens=4,
+                   prefetch=2)
+    res = run_rl(cfg)
+    assert res["step"] == 4
+    assert res["pushes"] == 1 and res["weights_version"] == 1
+    # >= because the prefetcher's producer runs ahead of the consumed
+    # steps (that overlap is the point of riding the PR-5 pipeline).
+    assert res["rollouts"] >= 4
+    assert res["rollout_tokens"] == 4 * res["rollouts"]
+    assert set(res["weights_installed"].values()) == {1}
+    assert res["loss"] is not None
+
+
+def test_run_rl_survives_actor_death():
+    """Kill one actor mid-run: rollouts remap to the survivor, the
+    push converges the fleet that remains, the loop completes."""
+    from kubeflow_tpu.train.rl import RLConfig, build_actor_fleet, run_rl
+
+    cfg = RLConfig(steps=4, batch_size=1, push_every_steps=2,
+                   actors=2, prompt_len=8, max_new_tokens=4,
+                   prefetch=0)
+    import jax
+
+    from kubeflow_tpu.models.registry import get_model
+
+    spec = get_model(cfg.model)
+    params = spec.init(jax.random.PRNGKey(cfg.seed), spec.config)
+    fleet = build_actor_fleet(params, cfg, spec)
+    try:
+        # Poison one replica's scheduler loop: the next routed rollout
+        # fails over and the replica is excluded.
+        victim = fleet._replicas["actor0"]
+        victim.stop()
+        res = run_rl(cfg, fleet=fleet)
+        assert res["step"] == 4 and res["pushes"] == 1
+        assert res["rollouts"] == 4
+        # The dead actor took no push; the survivor is converged.
+        assert res["weights_installed"].get("actor1") == 1
+    finally:
+        fleet.stop()
+
+
+def test_remote_actor_fleet_over_http():
+    """The learner's cross-pod face: rollouts over :predict, weight
+    broadcast over :weights, dead-target failover."""
+    import jax
+
+    from kubeflow_tpu.models.registry import get_model
+    from kubeflow_tpu.serving.engine import EngineConfig
+    from kubeflow_tpu.serving.remote_fleet import RemoteActorFleet
+    from kubeflow_tpu.serving.server import ModelServer
+
+    spec = get_model("lm-test-tiny")
+    p2 = spec.init(jax.random.PRNGKey(1), spec.config)
+    server = ModelServer(
+        EngineConfig(model="lm-test-tiny", batch_size=4, max_seq_len=32,
+                     max_new_tokens=8, kv_layout="paged",
+                     kv_block_size=4),
+        port=0, grpc_port=None, batch_timeout_ms=2)
+    server.start()
+    try:
+        live = f"127.0.0.1:{server.port}"
+        dead = "127.0.0.1:1"  # nothing listens: dies on first use
+        fleet = RemoteActorFleet([dead, live], "lm-test-tiny",
+                                 weights_max_lag=1, timeout=30.0,
+                                 chunk_bytes=1024)
+        out = fleet.generate([3, 4, 5, 6, 7, 8], 8)
+        assert len(out["tokens"]) == 8
+        res = fleet.broadcast_weights(p2)
+        assert res["installed"].get(live) == 1
+        assert dead in res["failed"]
+        assert server.decoder.metrics()["weights_version"] == 1
+        m = fleet.metrics()
+        assert m["weights_latest"] == 1 and m["rollouts"] == 1
+    finally:
+        server.stop()
+
+
+def test_rl_prototype_golden_membership():
+    from kubeflow_tpu.manifests.core import generate
+
+    objs = generate("rl-job", {"name": "x", "model": "lm-test-tiny"})
+    kinds = [o["kind"] for o in objs]
+    assert kinds == ["CustomResourceDefinition", RL_KIND]
+    validate_rl_job(objs[1])
