@@ -1680,6 +1680,137 @@ def _bench_weight_push_sweep(args, model) -> dict:
     }
 
 
+def _bench_rollout_sweep(args, model) -> dict:
+    """Progressive delivery end to end, against REAL decoders.
+
+    Two legs drive the RolloutController + a DecoderFleet of
+    ContinuousDecoders through a full canary walk on synthetic scrape
+    signals and a fake clock:
+
+    1. **Good push** — a healthy candidate walks 1% → 100% and
+       promotes; every live replica converges on the candidate epoch
+       and fleet greedy decodes are byte-identical to a decoder
+       cold-started on the candidate weights.
+    2. **Bad push** — the canary cohort reports regressed TTFT the
+       moment it holds the candidate epoch; the controller rolls back
+       from Shadow (before any real traffic shifted), records the
+       breach evidence in status, and post-rollback fleet greedy
+       decodes are byte-identical to the incumbent cold decoder — the
+       zero-drain rollback push restored the exact weights, not
+       approximately.
+    """
+    from kubeflow_tpu.apis.inference import (
+        inference_service,
+        inference_service_crd,
+    )
+    from kubeflow_tpu.k8s.fake import FakeApiServer
+    from kubeflow_tpu.models.registry import get_model
+    from kubeflow_tpu.operators.rollout import RolloutController
+    from kubeflow_tpu.serving.continuous import ContinuousDecoder
+    from kubeflow_tpu.serving.fleet import DecoderFleet
+
+    spec = get_model(model)
+    p_inc = spec.init(jax.random.PRNGKey(0), spec.config)
+    p_cand = spec.init(jax.random.PRNGKey(1), spec.config)
+    gen, n_rep = 16, 3
+    calm = {"queue_wait_p99_s": 0.05, "ttft_p99_s": 0.1,
+            "inter_token_p99_s": 0.02, "kv_utilization": 0.2,
+            "queued": 0.0, "error_rate": 0.0}
+
+    def mk(params):
+        return ContinuousDecoder(
+            params, spec.config, slots=4, prefill_len=32,
+            max_new_tokens=gen, stream_timeout_s=600.0)
+
+    def prompt(i):
+        return [3 + (j % 29) for j in range(10)] + [5 + (i % 80)] * 4
+
+    def leg(label, regress_canary):
+        api = FakeApiServer()
+        api.ensure_namespace("kubeflow")
+        api.apply(inference_service_crd())
+        fleet = DecoderFleet(
+            {f"llm-r{i}": mk(p_inc) for i in range(n_rep)})
+        cr = inference_service(
+            "llm", "kubeflow", model, replicas=n_rep,
+            max_replicas=n_rep,
+            versions=[
+                {"name": "inc", "weightsRef": "ref/inc", "traffic": 0},
+                {"name": "cand", "weightsRef": "ref/cand",
+                 "traffic": 100}],
+            rollout={"stepSeconds": 1.0, "shadowSeconds": 1.0},
+            autoscale={"scrapePeriodSeconds": 5,
+                       "signalStalenessSeconds": 20})
+        api.create(cr)
+        clock = {"t": 0.0}
+
+        def fetch(addr):
+            sig = dict(calm)
+            ro = (api.get("kubeflow-tpu.org/v1", "InferenceService",
+                          "llm", "kubeflow").get("status") or {}) \
+                .get("rollout") or {}
+            canaries = {f"{m}.kubeflow:8500"
+                        for m in ro.get("canaryMembers", [])}
+            if regress_canary and addr in canaries:
+                sig["ttft_p99_s"] = 5.0  # >> incumbent p99 * gateRatio
+            return sig
+
+        rc = RolloutController(
+            api, fleet_for=lambda ns, n: fleet,
+            weights_for={"ref/inc": p_inc, "ref/cand": p_cand}.get,
+            fetch_metrics=fetch, clock=lambda: clock["t"])
+        rounds = 0
+        for rounds in range(1, 13):
+            rc.reconcile_all()
+            ro = (api.get("kubeflow-tpu.org/v1", "InferenceService",
+                          "llm", "kubeflow").get("status") or {}) \
+                .get("rollout") or {}
+            if ro.get("phase") in ("Promoted", "RolledBack"):
+                rc.reconcile_all()  # terminal convergence pass
+                break
+            clock["t"] += 2.0
+        wv = fleet.weights_versions()
+        epochs = sorted({wv["installed"].get(m, 0)
+                         for m in fleet.live_members()})
+        got = [fleet.generate(prompt(i), gen, timeout=600)["tokens"]
+               for i in range(4)]
+        fleet.stop()
+        winner = p_inc if regress_canary else p_cand
+        cold = mk(winner)
+        want = [cold.generate(prompt(i), gen, timeout=600)["tokens"]
+                for i in range(4)]
+        cold.stop()
+        return {
+            "label": label,
+            "phase": ro.get("phase", ""),
+            "rounds": rounds,
+            "fleet_epochs": epochs,
+            "breach_reason": (ro.get("evidence") or {}).get("reason",
+                                                            ""),
+            "breach_signal": (ro.get("evidence") or {}).get("signal",
+                                                            ""),
+            "serves_winner_weights": got == want,
+        }
+
+    good = leg("good-push", regress_canary=False)
+    bad = leg("bad-push", regress_canary=True)
+    ok = (good["phase"] == "Promoted"
+          and len(good["fleet_epochs"]) == 1
+          and good["serves_winner_weights"]
+          and bad["phase"] == "RolledBack"
+          and bad["breach_reason"] == "gate-breach"
+          and len(bad["fleet_epochs"]) == 1
+          and bad["serves_winner_weights"])
+    return {
+        "benchmark": "serving_rollout_sweep",
+        "model": model,
+        "legs": [good, bad],
+        "regression": not ok,
+        "config": f"{model} replicas{n_rep} gen{gen} "
+                  f"steps[1,10,50,100] gate1.5x",
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
@@ -1745,6 +1876,11 @@ def main() -> int:
                          "plus the RL loop at per-step push cadence "
                          "(>=5x rollout throughput vs "
                          "restart-per-update)")
+    ap.add_argument("--rollout-sweep", action="store_true",
+                    help="benchmark progressive delivery: SLO-gated "
+                         "canary walk over real decoders (good push "
+                         "promotes, regressed push auto-rolls-back "
+                         "with byte-identical post-rollback streams)")
     ap.add_argument("--tp-sweep", action="store_true",
                     help="benchmark model-parallel serving: tp=1/2/4 "
                          "mesh shapes at equal total pool bytes "
@@ -1764,7 +1900,10 @@ def main() -> int:
             os.environ.get("XLA_FLAGS", "")
             + " --xla_force_host_platform_device_count=8").strip()
     on_tpu = jax.default_backend() == "tpu"
-    if args.weight_push_sweep:
+    if args.rollout_sweep:
+        model = "llama-1b" if on_tpu and not args.quick else "lm-test-tiny"
+        result = _bench_rollout_sweep(args, model)
+    elif args.weight_push_sweep:
         model = "llama-1b" if on_tpu and not args.quick else "lm-test-tiny"
         result = _bench_weight_push_sweep(args, model)
     elif args.qos_sweep:

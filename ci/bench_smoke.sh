@@ -84,6 +84,14 @@ check_json "$out"
 # leaked blocks.
 out="$(JAX_PLATFORMS=cpu python bench_serving.py --quick --weight-push-sweep)"
 check_json "$out"
+# Progressive delivery: the marker fires when a healthy candidate
+# fails to walk 1%->100% and promote (fleet left on mixed epochs or
+# serving weights that differ from a cold start on the candidate), or
+# when a TTFT-regressed candidate fails to auto-roll-back from Shadow
+# with gate-breach evidence and byte-identical post-rollback streams
+# vs the incumbent cold decoder.
+out="$(JAX_PLATFORMS=cpu python bench_serving.py --quick --rollout-sweep)"
+check_json "$out"
 echo "bench smoke ok"
 # Training input pipeline: prefetch-on must match prefetch-off final
 # loss byte-for-byte (bench.py sets the regression marker otherwise)

@@ -45,6 +45,10 @@ LABEL_VOCAB = frozenset({
     # Elastic training: values are exactly {"grow", "shrink"}
     # (parallel/reshard.ReshardStats.direction).
     "direction",
+    # Progressive delivery: values are spec.versions[].name — at most
+    # two per service (incumbent + candidate, validate_versions), plus
+    # the literal "shadow" fallback for an unnamed mirror target.
+    "version",
 })
 
 _NAME_RE = re.compile(r"^[a-z][a-z0-9]*(_[a-z0-9]+)+$")

@@ -33,10 +33,66 @@ DEFAULT_AUTOSCALE = {
     "scaleDownRatio": 0.5,
     "cooldownSeconds": 60.0,
     "scrapePeriodSeconds": 10.0,
+    # How long a replica's last-good scrape may stand in for a failed
+    # one. Within the window the operator HOLDS (no scale, no rollout
+    # gate verdict on substituted data); past it the replica counts as
+    # unobservable.
+    "signalStalenessSeconds": 30.0,
 }
 
 # Roles a disaggregated InferenceService splits its replicas into.
 INFERENCE_ROLES = ("prefill", "decode")
+
+# Rollout policy defaults: the canary walk schedule (percent of traffic
+# at each step), the dwell per step, and the SLO gates. ``gateRatio``
+# bounds the candidate's TTFT/inter-token p99 at a multiple of the
+# incumbent's; ``errorRateRatio`` does the same for the error rate (with
+# an absolute floor so a 0-error incumbent doesn't make any candidate
+# error infinite); ``quorum`` is the fraction of canary replicas that
+# must stay scrapeable — losing it is a rollback, not a wait.
+DEFAULT_ROLLOUT = {
+    "steps": [1, 10, 50, 100],
+    "stepSeconds": 60.0,
+    "gateRatio": 1.5,
+    "errorRateRatio": 2.0,
+    "errorRateFloor": 0.01,
+    "shadowFraction": 0.1,
+    "shadowSeconds": 30.0,
+    "quorum": 0.5,
+}
+
+
+def validate_versions(versions: list[dict]) -> list[dict]:
+    """Validate a ``spec.versions`` list: unique names, every entry a
+    ``{name, weightsRef, traffic}``, traffic weights summing to 100.
+    Returns a normalized copy (ints/floats coerced) or raises
+    ValueError — shared by the builder, the CRD tests, and the rollout
+    controller's admission path."""
+    if not versions:
+        raise ValueError("spec.versions must be a non-empty list")
+    seen: set[str] = set()
+    out: list[dict] = []
+    total = 0.0
+    for v in versions:
+        name = str(v.get("name", "")).strip()
+        if not name:
+            raise ValueError("spec.versions entry missing name")
+        if name in seen:
+            raise ValueError(f"duplicate version name {name!r}")
+        seen.add(name)
+        if not str(v.get("weightsRef", "")).strip():
+            raise ValueError(f"version {name!r} missing weightsRef")
+        traffic = float(v.get("traffic", 0))
+        if traffic < 0 or traffic > 100:
+            raise ValueError(
+                f"version {name!r} traffic {traffic} outside [0, 100]")
+        total += traffic
+        out.append({"name": name, "weightsRef": str(v["weightsRef"]),
+                    "traffic": traffic})
+    if abs(total - 100.0) > 1e-6:
+        raise ValueError(
+            f"spec.versions traffic weights sum to {total}, want 100")
+    return out
 
 
 def inference_service_crd() -> dict:
@@ -49,6 +105,7 @@ def inference_service_crd() -> dict:
         "scaleDownRatio": {"type": "number", "minimum": 0, "maximum": 1},
         "cooldownSeconds": {"type": "number", "minimum": 0},
         "scrapePeriodSeconds": {"type": "number", "minimum": 0},
+        "signalStalenessSeconds": {"type": "number", "minimum": 0},
     }
     # Engine knobs pass through to the model-server args verbatim, but
     # tpShards is declared explicitly: the operator reads it to size
@@ -138,6 +195,49 @@ def inference_service_crd() -> dict:
                     "qos": qos_schema,
                     "autoscale": {"type": "object",
                                   "properties": autoscale_props},
+                    # Progressive delivery: the declared model versions
+                    # (traffic is the steady-state split the rollout
+                    # walks toward) and the canary policy knobs.
+                    "versions": {
+                        "type": "array",
+                        "items": {
+                            "type": "object",
+                            "required": ["name", "weightsRef"],
+                            "properties": {
+                                "name": {"type": "string"},
+                                "weightsRef": {"type": "string"},
+                                "traffic": {"type": "number",
+                                            "minimum": 0,
+                                            "maximum": 100},
+                            },
+                        },
+                    },
+                    "rollout": {
+                        "type": "object",
+                        "properties": {
+                            "steps": {
+                                "type": "array",
+                                "items": {"type": "number",
+                                          "minimum": 0,
+                                          "maximum": 100},
+                            },
+                            "stepSeconds": {"type": "number",
+                                            "minimum": 0},
+                            "gateRatio": {"type": "number",
+                                          "minimum": 1},
+                            "errorRateRatio": {"type": "number",
+                                               "minimum": 1},
+                            "errorRateFloor": {"type": "number",
+                                               "minimum": 0},
+                            "shadowFraction": {"type": "number",
+                                               "minimum": 0,
+                                               "maximum": 1},
+                            "shadowSeconds": {"type": "number",
+                                              "minimum": 0},
+                            "quorum": {"type": "number",
+                                       "minimum": 0, "maximum": 1},
+                        },
+                    },
                 },
             },
             "status": {"type": "object",
@@ -188,6 +288,8 @@ def inference_service(
     roles: dict | None = None,
     qos: dict | None = None,
     autoscale: dict | None = None,
+    versions: list[dict] | None = None,
+    rollout: dict | None = None,
 ) -> dict:
     """Build an InferenceService CR. ``engine`` maps tpu-serving param
     names (batch_size, kv_layout, ...) to values; ``autoscale`` overrides
@@ -198,11 +300,31 @@ def inference_service(
     spill affine picks off a backend whose KV pool fill crosses it.
     ``qos`` ({tenants: {name: {weight, rate, burst, priority}},
     agingSeconds, default}) turns on multi-tenant fair-share admission
-    in every replica and 429 shedding at the gateway route."""
+    in every replica and 429 shedding at the gateway route.
+
+    ``versions`` ([{name, weightsRef, traffic}, ...], weights summing
+    to 100) declares the model versions the service serves; when more
+    than one is present the RolloutController canaries the newest in
+    via the walk declared by ``rollout`` (DEFAULT_ROLLOUT overridden
+    key-wise). Single-version specs (the default) are unchanged —
+    omitting ``versions`` produces the exact legacy manifest."""
     if roles:
         bad = set(roles) - set(INFERENCE_ROLES)
         if bad:
             raise ValueError(f"unknown inference roles {sorted(bad)}")
+    if versions is not None:
+        versions = validate_versions(versions)
+        if roles:
+            # Scope bound: a versioned rollout pushes one param tree
+            # into one homogeneous pool; disaggregated prefill/decode
+            # pools version independently is future work.
+            raise ValueError(
+                "spec.versions is not supported on a role-split "
+                "(disaggregated) service")
+    if rollout is not None:
+        bad = set(rollout) - set(DEFAULT_ROLLOUT)
+        if bad:
+            raise ValueError(f"unknown rollout keys {sorted(bad)}")
     router: dict = {"affinityTokens": int(affinity_tokens),
                     "pressure": int(pressure)}
     if kv_pressure:
@@ -227,6 +349,9 @@ def inference_service(
         spec["tpuChipsPerReplica"] = int(tpu_chips_per_replica)
     if engine:
         spec["engine"] = dict(engine)
+    if versions is not None:
+        spec["versions"] = versions
+        spec["rollout"] = {**DEFAULT_ROLLOUT, **(rollout or {})}
     return {
         "apiVersion": INFERENCE_API_VERSION,
         "kind": INFERENCE_KIND,

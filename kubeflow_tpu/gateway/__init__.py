@@ -164,6 +164,23 @@ class Gateway:
             "gateway_upstream_latency_seconds",
             "Upstream request latency (connect to response headers)",
             labels=("route",))
+        # Progressive-delivery families: request counts, shadow-mirror
+        # counts, and upstream-latency distributions labeled by model
+        # version — the per-version evidence a rollout gate compares
+        # (candidate p99 vs incumbent p99 on the SAME exposition).
+        self.version_requests = self.registry.counter(
+            "gateway_version_requests_total",
+            "Requests routed per model version on a split route",
+            labels=("route", "version"))
+        self.version_shadow_total = self.registry.counter(
+            "gateway_version_shadow_mirrors_total",
+            "Shadow requests mirrored per model version",
+            labels=("route", "version"))
+        self.version_upstream_latency = self.registry.histogram(
+            "gateway_version_upstream_latency_seconds",
+            "Upstream request latency per model version "
+            "(shadow mirrors included)",
+            labels=("route", "version"))
         # Per-request timelines (received → upstream → relayed), ring-
         # bounded, served at the admin /debug/requests. The request id
         # recorded here is the same X-Request-ID forwarded upstream, so

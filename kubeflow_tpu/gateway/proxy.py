@@ -197,12 +197,13 @@ def make_proxy_handler(gw):
                         ).encode(), {"Retry-After": "1"})
                         self.close_connection = True
                         return
-            # Prefix-affine routes hash the request BODY (the prompt's
-            # leading tokens), so it must be read before the pick — the
-            # other strategies keep the lazy read in _proxy_http.
+            # Prefix-affine and hash-split routes hash the request BODY
+            # (the prompt's leading tokens), so it must be read before
+            # the pick — the other strategies keep the lazy read in
+            # _proxy_http.
             body = None
             affinity_key = None
-            if (route.strategy == "prefix-affine"
+            if (route.strategy in ("prefix-affine", "hash-split")
                     and not self._is_upgrade()):
                 try:
                     length = int(self.headers.get("Content-Length", 0))
@@ -279,6 +280,31 @@ def make_proxy_handler(gw):
                         if sf is not None and sf >= fill:
                             spill = None  # no less-full pool to go to
                     if spill is not None:
+                        picked = spill
+                        gw.affine_spills += 1
+            elif route.strategy == "hash-split":
+                # Progressive delivery: the key's stable hash picks a
+                # VERSION group (so an affine prefix sees exactly one
+                # model version for the whole rollout), rendezvous
+                # picks the replica within the group. Pressure spill
+                # stays INSIDE the group — spilling across versions
+                # would serve a conversation two different models and
+                # corrupt the canary's latency comparison. A group
+                # whose members are all unhealthy falls back to the
+                # full healthy pool: serving the wrong version beats
+                # serving 502s.
+                split = route.pick_split((key or self.path).encode())
+                members = set(split[2]) if split else set()
+                group = [s for s in services if s in members] or services
+                order = rendezvous_order(key or self.path, group)
+                picked = order[0]
+                if (route.pressure > 0
+                        and gw.load.depth(picked) >= route.pressure
+                        and len(order) > 1):
+                    spill = gw.load.least_loaded(order[1:])
+                    if (spill is not None
+                            and gw.load.depth(spill)
+                            < gw.load.depth(picked)):
                         picked = spill
                         gw.affine_spills += 1
             elif route.strategy == "epsilon-greedy":
@@ -388,8 +414,19 @@ def make_proxy_handler(gw):
             if getattr(self, "_identity", None):
                 # The x-goog-authenticated-user-email analogue.
                 headers["X-Auth-Identity"] = self._identity
+            version = (route.version_of(service)
+                       if route.splits and service else "")
+            if version and not is_retry:
+                gw.version_requests.labels(route.name, version).inc()
             if route.shadow and not is_retry:
-                self._mirror(route, path, body, dict(headers))
+                # Shadow sampling is decided by the same stable key the
+                # split uses (different salt): a sampled-in prefix is
+                # mirrored on every turn, so the candidate sees whole
+                # conversations at shadow_fraction of the load.
+                mkey = affinity_key_for(body, self.path,
+                                        route.affinity_tokens)
+                if route.mirror_sample(mkey.encode()):
+                    self._mirror(route, path, body, dict(headers))
             tag_headers = {}
             if route.outlier_threshold > 0 and not is_retry:
                 value = OutlierStats.feature(body)
@@ -467,8 +504,13 @@ def make_proxy_handler(gw):
                     return
                 # Per-route upstream latency distribution (connect →
                 # response headers): the autoscaler-facing signal.
-                gw.upstream_latency.labels(route.name).observe(
-                    time.perf_counter() - t_up)
+                elapsed = time.perf_counter() - t_up
+                gw.upstream_latency.labels(route.name).observe(elapsed)
+                if version:
+                    # Per-version distribution: the rollout gate's
+                    # incumbent-vs-candidate comparison source.
+                    gw.version_upstream_latency.labels(
+                        route.name, version).observe(elapsed)
                 if tl is not None:
                     tl.event("upstream_response", status=resp.status,
                              upstream=f"{host}:{port}")
@@ -500,9 +542,14 @@ def make_proxy_handler(gw):
             host, _, port_s = addr.partition(":")
             method = self.command
             headers["X-Shadow"] = "true"
+            version = route.version_of(route.shadow) or "shadow"
+            route_name = route.name
 
             def send():
                 gw.shadow_total += 1
+                gw.version_shadow_total.labels(route_name,
+                                               version).inc()
+                t0 = time.perf_counter()
                 try:
                     conn = HTTPConnection(
                         host, int(port_s or 80),
@@ -512,6 +559,12 @@ def make_proxy_handler(gw):
                                  headers=headers)
                     conn.getresponse().read()
                     conn.close()
+                    # Response discarded; its LATENCY is the point —
+                    # the candidate's distribution under live load,
+                    # before it takes a single user-visible request.
+                    gw.version_upstream_latency.labels(
+                        route_name, version).observe(
+                        time.perf_counter() - t0)
                 except (OSError, ValueError):
                     pass
 

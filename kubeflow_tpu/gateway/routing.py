@@ -9,6 +9,7 @@ watches Services and keeps a longest-prefix route table.
 
 from __future__ import annotations
 
+import hashlib
 import logging
 import threading
 from dataclasses import dataclass
@@ -19,6 +20,15 @@ from kubeflow_tpu.k8s.client import K8sClient
 from kubeflow_tpu.manifests.core import GATEWAY_ROUTE_ANNOTATION
 
 log = logging.getLogger(__name__)
+
+
+def stable_hash01(key: bytes, salt: bytes = b"") -> float:
+    """Deterministic uniform [0, 1) from a routing key — the same key
+    maps to the same point on every gateway process forever (unlike
+    Python's seeded ``hash``), so a canary split holds its assignment
+    across gateway restarts and replicas."""
+    h = hashlib.blake2b(salt + key, digest_size=8).digest()
+    return int.from_bytes(h, "big") / 2.0**64
 
 
 @dataclass(frozen=True)
@@ -60,9 +70,22 @@ class Route:
     # to the chosen decode backend (one of ``backends``), then relays
     # the :predict to the decode backend as usual.
     prefill_backends: tuple = ()  # ((host:port, weight), ...)
+    # "hash-split": the progressive-delivery strategy — version groups
+    # (``splits``) each own a traffic weight, and a request is assigned
+    # to a group by STABLE hash of its affinity key, so every request
+    # sharing a prefix sees ONE model version (the per-request
+    # rng.choices draw would interleave versions within a conversation
+    # and poison both versions' prefix caches). Within the chosen
+    # group the pick is rendezvous-affine, same as prefix-affine.
+    splits: tuple = ()  # ((version, weight, (host:port, ...)), ...)
     # Shadow/mirror target: every request is also sent fire-and-forget to
     # this backend; its response is discarded and its failures invisible.
     shadow: str = ""
+    # Fraction of requests mirrored to ``shadow``, decided by stable
+    # hash of the affinity key (salted differently from the split hash
+    # so shadow sampling doesn't correlate with version assignment).
+    # 1.0 = mirror everything (the legacy behavior).
+    shadow_fraction: float = 1.0
     # Outlier detection (seldon outlier-detector-v1alpha2 surface): score
     # each prediction request's feature against a running window;
     # |z| > threshold tags the response and counts into the outlier rate.
@@ -102,6 +125,42 @@ class Route:
         services = [b[0] for b in self.backends]
         weights = [b[1] for b in self.backends]
         return rng.choices(services, weights=weights)[0]
+
+    def pick_split(self, key: bytes) -> tuple | None:
+        """Assign a routing key to one version group by stable hash:
+        the key's hash point falls into exactly one group's slice of
+        the cumulative weight space. Returns ``(version, weight,
+        backends)`` or None when the route has no splits."""
+        if not self.splits:
+            return None
+        total = sum(s[1] for s in self.splits)
+        if total <= 0:
+            return self.splits[0]
+        point = stable_hash01(key, b"split:") * total
+        acc = 0.0
+        for split in self.splits:
+            acc += split[1]
+            if point < acc:
+                return split
+        return self.splits[-1]
+
+    def mirror_sample(self, key: bytes) -> bool:
+        """Whether this request's key falls inside the shadow fraction
+        (deterministic per key: an affine prefix is either always or
+        never mirrored, so the candidate's prefix cache sees coherent
+        conversations instead of random single turns)."""
+        if self.shadow_fraction >= 1.0:
+            return True
+        if self.shadow_fraction <= 0.0:
+            return False
+        return stable_hash01(key, b"shadow:") < self.shadow_fraction
+
+    def version_of(self, service: str) -> str:
+        """The split version name owning ``service`` ("" if unsplit)."""
+        for version, _w, members in self.splits:
+            if service in members:
+                return version
+        return ""
 
     def target_for(self, path: str, service: str | None = None) -> str:
         """Rewrite `path` (which startswith prefix) onto the backend."""
@@ -144,8 +203,42 @@ def routes_from_service(svc: dict) -> list[Route]:
                 raise KeyError("service")
             strategy = spec.get("strategy", "weighted")
             if strategy not in ("weighted", "epsilon-greedy",
-                                "prefix-affine"):
+                                "prefix-affine", "hash-split"):
                 raise ValueError(f"unknown strategy {strategy!r}")
+            splits = []
+            seen_versions: set[str] = set()
+            for s in spec.get("splits", []) or []:
+                version = str(s.get("version", "")).strip()
+                if not version:
+                    raise ValueError("split missing version name")
+                if version in seen_versions:
+                    raise ValueError(
+                        f"duplicate split version {version!r}")
+                seen_versions.add(version)
+                weight = float(s.get("weight", 0))
+                if weight < 0:
+                    raise ValueError("negative split weight")
+                members = tuple(str(m) for m in s.get("backends", []))
+                if not members:
+                    raise ValueError(
+                        f"split {version!r} has no backends")
+                splits.append((version, weight, members))
+            splits = tuple(splits)
+            if splits and strategy != "hash-split":
+                raise ValueError("splits requires the hash-split "
+                                 "strategy")
+            if strategy == "hash-split":
+                if not splits:
+                    raise ValueError("hash-split needs a splits list")
+                if not any(w > 0 for _v, w, _m in splits):
+                    raise ValueError("all split weights zero")
+                if not spec.get("backends"):
+                    # backends stays the flattened union across splits
+                    # — health probing and the admin surface read it.
+                    raise ValueError("hash-split needs a backends pool")
+            shadow_fraction = float(spec.get("shadow_fraction", 1.0))
+            if not 0.0 <= shadow_fraction <= 1.0:
+                raise ValueError("shadow_fraction must be in [0, 1]")
             epsilon = float(spec.get("epsilon", 0.1))
             if not 0.0 <= epsilon <= 1.0:
                 raise ValueError("epsilon must be in [0, 1]")
@@ -205,7 +298,9 @@ def routes_from_service(svc: dict) -> list[Route]:
                 affinity_tokens=affinity_tokens, pressure=pressure,
                 kv_pressure=kv_pressure,
                 prefill_backends=prefill_backends,
+                splits=splits,
                 shadow=spec.get("shadow", ""),
+                shadow_fraction=shadow_fraction,
                 outlier_threshold=outlier_threshold,
                 outlier_window=outlier_window,
                 qos_tenants=qos_tenants,

@@ -155,7 +155,9 @@ def gateway_route(name: str, prefix: str, service: str, rewrite: str = "/",
                   pressure: int | None = None,
                   kv_pressure: float | None = None,
                   prefill_backends: list | None = None,
-                  qos: dict | None = None) -> dict:
+                  qos: dict | None = None,
+                  splits: list | None = None,
+                  shadow_fraction: float | None = None) -> dict:
     """Gateway route annotation for a Service — the platform-wide analogue of
     the `getambassador.io/config` annotations the reference attaches to every
     web-app Service (kubeflow/common/ambassador.libsonnet route pattern). The
@@ -204,6 +206,13 @@ def gateway_route(name: str, prefix: str, service: str, rewrite: str = "/",
         # over-rate requests answer 429 + Retry-After before any
         # upstream work.
         spec["qos"] = qos
+    if splits:
+        # Progressive delivery: [{version, weight, backends: [...]}]
+        # version groups for the hash-split strategy — a request is
+        # pinned to one group by stable hash of its affinity key.
+        spec["splits"] = splits
+    if shadow_fraction is not None:
+        spec["shadow_fraction"] = float(shadow_fraction)
     return {
         GATEWAY_ROUTE_ANNOTATION: yaml.safe_dump(spec, sort_keys=True)
     }

@@ -29,6 +29,7 @@ def make_all_controllers(client):
     )
     from kubeflow_tpu.operators.profiles import ProfileController
     from kubeflow_tpu.operators.rl import RLJobController
+    from kubeflow_tpu.operators.rollout import RolloutController
     from kubeflow_tpu.scheduler.controller import SchedulerController
     from kubeflow_tpu.tuning.controller import StudyJobController
 
@@ -36,6 +37,7 @@ def make_all_controllers(client):
         *make_job_controllers(client),
         SchedulerController(client),
         InferenceServiceController(client),
+        RolloutController(client),
         RLJobController(client),
         NotebookController(client),
         ProfileController(client),

@@ -167,6 +167,7 @@ def scrape_signals(text: str) -> dict:
     parsed = _parse_exposition(text)
     samples, buckets = parsed["samples"], parsed["buckets"]
     kv_total = samples.get("serving_kv_bytes_total", 0.0)
+    requests = samples.get("serving_requests_total", 0.0)
     return {
         "queue_wait_p99_s": _bucket_quantile(
             buckets.get("serving_queue_wait_seconds", []), 0.99),
@@ -177,6 +178,11 @@ def scrape_signals(text: str) -> dict:
         "kv_utilization": (samples.get("serving_kv_bytes_in_use", 0.0)
                            / kv_total if kv_total else 0.0),
         "queued": samples.get("serving_queued", 0.0),
+        # Lifetime error fraction — the rollout gate's third signal (a
+        # candidate that 500s at 2x the incumbent's rate fails the walk
+        # even if its latency looks fine).
+        "error_rate": (samples.get("serving_errors_total", 0.0)
+                       / requests if requests else 0.0),
     }
 
 
@@ -191,6 +197,40 @@ def _http_fetch_signals(addr: str, timeout: float = 2.0) -> dict | None:
             return scrape_signals(resp.read().decode("utf-8", "replace"))
     except (OSError, ValueError):
         return None
+
+
+class SignalCache:
+    """Failure-tolerant scrape front: one transient ``fetch`` timeout
+    must not manufacture an empty signal vector that a controller then
+    reads as a breach (or as calm, equally wrong). A failed scrape
+    returns the replica's LAST-GOOD sample while it is younger than the
+    staleness window — flagged stale, so callers can HOLD decisions
+    (never scale, never rollback, never promote on substituted data) —
+    and nothing once the window expires (the replica is then genuinely
+    unobservable and counts against scrape quorum)."""
+
+    def __init__(self, fetch, clock=time.monotonic):
+        self.fetch = fetch
+        self.clock = clock
+        self._last_good: dict[str, tuple[float, dict]] = {}
+
+    def scrape(self, addr: str, staleness_s: float) -> tuple[dict | None,
+                                                             bool]:
+        """(signals, fresh): fresh samples update the cache; a failure
+        inside the window yields (last_good, False); outside it,
+        (None, False)."""
+        sig = self.fetch(addr)
+        now = self.clock()
+        if sig is not None:
+            self._last_good[addr] = (now, sig)
+            return sig, True
+        held = self._last_good.get(addr)
+        if held is not None and (now - held[0]) <= float(staleness_s):
+            return held[1], False
+        return None, False
+
+    def forget(self, addr: str) -> None:
+        self._last_good.pop(addr, None)
 
 
 # ---------------------------------------------------------------------------
@@ -211,6 +251,10 @@ class InferenceServiceController(Controller):
         super().__init__(client)
         self.fetch_metrics = fetch_metrics or _http_fetch_signals
         self.clock = clock
+        # Late-bound fetch so tests (and wrappers) swapping
+        # ``fetch_metrics`` on a live controller take effect.
+        self.signal_cache = SignalCache(
+            lambda addr: self.fetch_metrics(addr), clock)
         # (ns, name) -> {"last_scale": monotonic | None}
         self._scale_state: dict[tuple[str, str], dict] = {}
 
@@ -297,13 +341,22 @@ class InferenceServiceController(Controller):
             current = min(max(current, lo), hi)
 
             signals = []
+            stale = False
             for i in range(current):
-                sig = self.fetch_metrics(
-                    self.replica_addr(name, ns, i, role))
+                sig, fresh = self.signal_cache.scrape(
+                    self.replica_addr(name, ns, i, role),
+                    float(cfg["signalStalenessSeconds"]))
                 if sig is not None:
                     signals.append(sig)
-            desired, reason = self._decide((ns, name, role), current,
-                                           lo, hi, signals, cfg, role)
+                    stale = stale or not fresh
+            if stale:
+                # A substituted (last-good) sample in the vector: HOLD.
+                # Scaling on held data acts on the past — a transient
+                # scrape timeout must never move the pool.
+                desired, reason = current, "hold: stale scrape signals"
+            else:
+                desired, reason = self._decide((ns, name, role), current,
+                                               lo, hi, signals, cfg, role)
             self._ensure_replicas(svc, desired, role, pool["engine"])
             self._prune_replicas(svc, desired, role)
             desired_by[role] = desired
@@ -489,15 +542,51 @@ class InferenceServiceController(Controller):
                 route_qos["default"] = {
                     "rate": float(d.get("rate", 0)),
                     "burst": float(d.get("burst", 0))}
+        # Progressive delivery: while a rollout is live (Shadow or
+        # Walking, per status.rollout — the RolloutController is the
+        # single writer of that block, this controller the single
+        # writer of the annotation) the route becomes a hash-split over
+        # two version groups. The canary subset is addressed by member
+        # NAME so the split survives scale events verbatim; members no
+        # longer in the pool simply drop out of the group.
+        strategy = "prefix-affine"
+        splits = None
+        shadow = ""
+        shadow_fraction = None
+        ro = (svc.get("status") or {}).get("rollout") or {}
+        if ro.get("phase") in ("Shadow", "Walking") and not decode_role:
+            all_addrs = [b["service"] for b in backends]
+            canary = [a for a in (
+                f"{m}.{ns}:{REST_PORT}" for m in ro.get(
+                    "canaryMembers", []))
+                if a in all_addrs]
+            stable = [a for a in all_addrs if a not in canary]
+            if canary and stable:
+                traffic = float(ro.get("trafficPercent", 0.0))
+                strategy = "hash-split"
+                splits = [
+                    {"version": ro.get("incumbent", {}).get(
+                        "name", "incumbent"),
+                     "weight": 100.0 - traffic, "backends": stable},
+                    {"version": ro.get("candidate", {}).get(
+                        "name", "candidate"),
+                     "weight": traffic, "backends": canary},
+                ]
+                if ro["phase"] == "Shadow":
+                    shadow = canary[0]
+                    shadow_fraction = float(ro.get("shadowFraction", 0.1))
         annotations = gateway_route(
             f"{name}-pool", f"/models/{name}/", backends[0]["service"],
-            backends=backends, strategy="prefix-affine",
+            backends=backends, strategy=strategy,
             affinity_tokens=int(router_cfg.get("affinityTokens", 32)),
             pressure=int(router_cfg.get("pressure", 8)),
             kv_pressure=(float(kv_pressure)
                          if kv_pressure is not None else None),
             prefill_backends=prefill_backends,
             qos=route_qos,
+            splits=splits,
+            shadow=shadow,
+            shadow_fraction=shadow_fraction,
         )
         router = k8s.service(
             name, ns, selector={},

@@ -400,8 +400,14 @@ class DecoderFleet:
 
     # -- live weight streaming ----------------------------------------
 
+    # Fan-out bound: a fleet-wide push at scale must not spawn a thread
+    # per replica — 16 concurrent host→device copies saturate the host
+    # NIC/PCIe long before 100 threads would help.
+    BROADCAST_MAX_WORKERS = 16
+
     def broadcast_weights(self, params, *, version: int | None = None,
-                          draft_params=None) -> dict:
+                          draft_params=None,
+                          members: list[str] | None = None) -> dict:
         """Fan a weight push out to every live replica CONCURRENTLY
         (each replica's ``update_weights`` double-buffers and swaps
         independently; one slow host→device copy must not serialize
@@ -412,18 +418,47 @@ class DecoderFleet:
         routing meanwhile). A push failure that is the PUSH's fault
         (shape mismatch) is reported per replica, never kills one.
 
+        ``members`` targets a named subset (the canary path: a rollout
+        pushes the candidate epoch into a few replicas while the rest
+        keep serving the incumbent); unknown names are reported in
+        ``failed`` rather than raising, so a rollout racing a replica
+        removal degrades to evidence instead of an exception. A subset
+        push does NOT advance the fleet's notion of "every live member
+        should hold latest": ``_weights_latest`` still tracks the max
+        installed epoch, and members outside the subset show up in
+        ``lagging`` — exactly what the rollout controller reads to
+        know the canary diverged on purpose.
+
         Returns ``{"version", "installed": {replica: epoch},
         "failed": {replica: error}, "lagging": [replica, ...]}``."""
         from concurrent.futures import ThreadPoolExecutor
 
         with self._lock:
-            target = (int(version) if version is not None
-                      else self._weights_latest + 1)
+            if version is not None:
+                target = int(version)
+            else:
+                # CLAIM the epoch under the lock, not just read it: two
+                # racing auto-increment broadcasts (a rollback push vs
+                # a learner's live push) that both computed latest+1
+                # would install the SAME epoch with different params —
+                # per-replica update_weights would then no-op whichever
+                # push arrived second, leaving the fleet epoch-uniform
+                # but weight-torn and undetectably so. Claiming makes
+                # the second racer pick a strictly higher epoch, so the
+                # race resolves by monotonicity like every other skew.
+                target = self._weights_latest + 1
+                self._weights_latest = target
         # Attempt EVERY member, dead included: a replica that died (or
         # was preempted) and came back converges on the next push — a
         # landed install on a replica whose scheduler is alive revives
         # it into routing.
         names = self.members()
+        unknown: dict[str, str] = {}
+        if members is not None:
+            known = set(names)
+            unknown = {m: "unknown fleet member" for m in members
+                       if m not in known}
+            names = [n for n in names if n in set(members)]
 
         def push(name):
             try:
@@ -434,9 +469,10 @@ class DecoderFleet:
                 return name, None, e
 
         installed: dict[str, int] = {}
-        failed: dict[str, str] = {}
+        failed: dict[str, str] = dict(unknown)
         if names:
-            with ThreadPoolExecutor(max_workers=len(names)) as pool:
+            workers = min(len(names), self.BROADCAST_MAX_WORKERS)
+            with ThreadPoolExecutor(max_workers=workers) as pool:
                 outcomes = list(pool.map(push, names))
             for name, ver, err in outcomes:
                 if err is None:

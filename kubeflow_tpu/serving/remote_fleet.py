@@ -117,15 +117,30 @@ class RemoteActorFleet:
     # -- weight streaming ---------------------------------------------
 
     def broadcast_weights(self, params, *, version: int | None = None,
-                          draft_params=None) -> dict:
+                          draft_params=None,
+                          members: list[str] | None = None) -> dict:
         from concurrent.futures import ThreadPoolExecutor
 
         with self._lock:
-            target_v = (int(version) if version is not None
-                        else self._weights_latest + 1)
+            if version is not None:
+                target_v = int(version)
+            else:
+                # Claim under the lock (see DecoderFleet.broadcast_
+                # weights): racing auto-increment pushes must pick
+                # distinct epochs or the loser tears the fleet.
+                target_v = self._weights_latest + 1
+                self._weights_latest = target_v
         # Attempt every target, dead included: an actor pod that
         # restarted behind the same DNS converges on the next push.
         live = list(self.targets)
+        unknown: dict[str, str] = {}
+        if members is not None:
+            # Targeted-subset push (the canary path) — same contract as
+            # DecoderFleet.broadcast_weights(members=...).
+            known = set(live)
+            unknown = {m: "unknown fleet target" for m in members
+                       if m not in known}
+            live = [t for t in live if t in set(members)]
 
         def push(addr):
             try:
@@ -139,9 +154,9 @@ class RemoteActorFleet:
                 return addr, None, e
 
         installed: dict[str, int] = {}
-        failed: dict[str, str] = {}
+        failed: dict[str, str] = dict(unknown)
         if live:
-            with ThreadPoolExecutor(max_workers=len(live)) as pool:
+            with ThreadPoolExecutor(max_workers=min(len(live), 16)) as pool:
                 for addr, ver, err in pool.map(push, live):
                     if err is None:
                         installed[addr] = ver

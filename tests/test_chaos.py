@@ -674,3 +674,230 @@ def test_namespaced_owner_does_not_cascade_across_namespaces(api):
     # ownerReferences never cross namespaces: the same-name/uid object in
     # another namespace survives.
     assert api.get_or_none("v1", "ConfigMap", "other-ns-child", "default")
+
+
+# ---------------------------------------------------------------------------
+# Progressive-delivery rollout state machine under chaos (fast)
+# ---------------------------------------------------------------------------
+#
+# The four failure modes a canary walk must survive (acceptance: each
+# converges to a single consistent fleet version with the outcome in
+# InferenceService status): a canary replica dying mid-rollout, an SLO
+# breach while still in shadow, the auto-rollback push racing a
+# concurrent fleet-wide broadcast_weights, and the operator restarting
+# mid-walk (state reconstructed from status + weights_versions()).
+
+
+def _rollout_env(api, n=4):
+    from test_rollout import CALM, StubFleet
+
+    from kubeflow_tpu.apis.inference import (
+        inference_service,
+        inference_service_crd,
+    )
+    from kubeflow_tpu.operators.inference import (
+        InferenceServiceController,
+    )
+    from kubeflow_tpu.operators.rollout import RolloutController
+
+    api.apply(inference_service_crd())
+    clock = {"t": 0.0}
+    fleet = StubFleet([f"llm-r{i}" for i in range(n)])
+    sig = {"default": dict(CALM), "by_addr": {}}
+
+    def fetch(addr):
+        v = sig["by_addr"].get(addr, sig["default"])
+        return dict(v) if v is not None else None
+
+    weights = {"ckpt/v1": "W-INCUMBENT", "ckpt/v2": "W-CANDIDATE"}
+
+    def make_rc():
+        return RolloutController(
+            api, fleet_for=lambda ns, n_: fleet,
+            weights_for=weights.get, fetch_metrics=fetch,
+            clock=lambda: clock["t"])
+
+    ic = InferenceServiceController(api, fetch_metrics=fetch,
+                                    clock=lambda: clock["t"])
+    cr = inference_service(
+        "llm", NS, "lm-test-tiny", replicas=n, max_replicas=n,
+        versions=[
+            {"name": "v1", "weightsRef": "ckpt/v1", "traffic": 0},
+            {"name": "v2", "weightsRef": "ckpt/v2", "traffic": 100}],
+        rollout={"stepSeconds": 1.0, "shadowSeconds": 1.0},
+        autoscale={"scrapePeriodSeconds": 5,
+                   "signalStalenessSeconds": 20})
+    api.create(cr)
+    return clock, fleet, sig, make_rc, ic
+
+
+def _ro(api):
+    return api.get("kubeflow-tpu.org/v1", "InferenceService", "llm",
+                   NS).get("status", {}).get("rollout", {})
+
+
+def _live_epochs(fleet):
+    wv = fleet.weights_versions()
+    return {wv["installed"].get(m, 0) for m in fleet.live_members()}
+
+
+def test_rollout_survives_canary_replica_death(api):
+    """One of two canary replicas dies mid-walk: its scrape goes dark
+    and its pushes fail, but quorum (1/2 scrapeable) holds — the walk
+    completes on the survivors and every LIVE replica converges on the
+    candidate epoch."""
+    clock, fleet, sig, make_rc, _ic = _rollout_env(api)
+    rc = make_rc()
+    rc.reconcile_all()
+    # Walk to 50%: two canary members.
+    for _ in range(3):
+        clock["t"] += 2.0
+        rc.reconcile_all()
+    ro = _ro(api)
+    assert ro["trafficPercent"] == 50.0
+    assert len(ro["canaryMembers"]) == 2
+    victim = ro["canaryMembers"][0]
+    fleet.dead.add(victim)
+    sig["by_addr"][f"{victim}.{NS}:8500"] = None
+    # The victim's held sample keeps the gate on HOLD inside the
+    # staleness window; past it the victim is unobservable but quorum
+    # (1 of 2 >= 0.5) still holds, so the walk resumes — it must NOT
+    # roll back on a survivable death.
+    for _ in range(6):
+        clock["t"] += 25.0
+        rc.reconcile_all()
+    ro = _ro(api)
+    assert ro["phase"] == "Promoted"
+    assert _live_epochs(fleet) == {2}
+    assert all(fleet.params_of[m] == "W-CANDIDATE"
+               for m in fleet.live_members())
+
+
+def test_breach_during_shadow_rolls_back_before_any_traffic(api):
+    """A latency breach while the candidate only sees mirrored traffic:
+    rollback fires before the candidate ever served a user request, the
+    route resets to plain prefix-affine, and the evidence lands in
+    status."""
+    from test_rollout import SLOW
+
+    import yaml as _yaml
+
+    from kubeflow_tpu.manifests.core import GATEWAY_ROUTE_ANNOTATION
+
+    clock, fleet, sig, make_rc, ic = _rollout_env(api)
+    rc = make_rc()
+    rc.reconcile_all()
+    ic.reconcile_all()
+    ro = _ro(api)
+    assert ro["phase"] == "Shadow"
+    assert ro["trafficPercent"] == 0.0
+    route = _yaml.safe_load(api.get("v1", "Service", "llm", NS)
+                            ["metadata"]["annotations"]
+                            [GATEWAY_ROUTE_ANNOTATION])
+    assert route["strategy"] == "hash-split"
+    canary = ro["canaryMembers"][0]
+    sig["by_addr"][f"{canary}.{NS}:8500"] = dict(SLOW)
+    clock["t"] += 2.0
+    rc.reconcile_all()
+    ic.reconcile_all()
+    ro = _ro(api)
+    assert ro["phase"] == "RolledBack"
+    assert ro["evidence"]["reason"] == "gate-breach"
+    assert ro["evidence"]["trafficPercent"] == 0.0  # never took traffic
+    assert _live_epochs(fleet) == {3}
+    assert all(p == "W-INCUMBENT" for p in fleet.params_of.values())
+    route = _yaml.safe_load(api.get("v1", "Service", "llm", NS)
+                            ["metadata"]["annotations"]
+                            [GATEWAY_ROUTE_ANNOTATION])
+    assert route["strategy"] == "prefix-affine"
+    assert "splits" not in route
+
+
+def test_rollback_racing_concurrent_broadcast_converges(api):
+    """The auto-rollback push races a concurrent fleet-wide
+    broadcast_weights (a learner's live push): epochs interleave across
+    members mid-flight, and the terminal-phase convergence loop must
+    re-push until weights_versions() reports ONE epoch — on the
+    incumbent's params, since RolledBack is the recorded outcome."""
+    from test_rollout import SLOW, StubFleet
+
+    clock, fleet, sig, make_rc, _ic = _rollout_env(api)
+
+    class RacingFleet(StubFleet):
+        def __init__(self, inner):
+            self.__dict__ = inner.__dict__
+            self.raced = {"done": False}
+
+        def broadcast_weights(self, params, **kw):
+            if (params == "W-INCUMBENT" and kw.get("members") is None
+                    and not self.raced["done"]):
+                # The race: while the rollback fans out, another actor
+                # lands a full push FIRST on half the members. Claimed
+                # epochs differ (rollback claimed its number already in
+                # the real fleet; here the racer claims the next), so
+                # the fleet is left on MIXED epochs, not torn params.
+                self.raced["done"] = True
+                StubFleet.broadcast_weights(
+                    self, "W-OTHER", members=["llm-r0", "llm-r1"])
+            return StubFleet.broadcast_weights(self, params, **kw)
+
+    racing = RacingFleet(fleet)
+    rc = make_rc()
+    rc.fleet_for = lambda ns, n: racing
+    rc.reconcile_all()
+    canary = _ro(api)["canaryMembers"][0]
+    sig["by_addr"][f"{canary}.{NS}:8500"] = dict(SLOW)
+    clock["t"] += 2.0
+    rc.reconcile_all()
+    ro = _ro(api)
+    assert ro["phase"] == "RolledBack"
+    # The race left survivors of both pushes in the fleet...
+    assert racing.raced["done"]
+    # ...and the convergence loop repairs it: re-reconciling in the
+    # terminal phase re-pushes the incumbent at a fresh epoch until the
+    # live fleet is uniform.
+    for _ in range(3):
+        clock["t"] += 2.0
+        rc.reconcile_all()
+    assert len(_live_epochs(fleet)) == 1
+    assert all(p == "W-INCUMBENT" for p in fleet.params_of.values())
+    assert _ro(api)["phase"] == "RolledBack"
+
+
+def test_operator_restart_mid_walk_resumes_from_status(api):
+    """Kill the controller mid-walk and bring up a FRESH one whose
+    monotonic clock restarted at zero: everything it needs — phase,
+    step, canary membership, epochs — must come back from status +
+    weights_versions(), and the walk must complete, not restart."""
+    clock, fleet, sig, make_rc, _ic = _rollout_env(api)
+    rc1 = make_rc()
+    rc1.reconcile_all()
+    for _ in range(2):
+        clock["t"] += 2.0
+        rc1.reconcile_all()
+    ro_before = _ro(api)
+    assert ro_before["phase"] == "Walking"
+    assert ro_before["trafficPercent"] == 10.0
+    pushes_before = len(fleet.pushes)
+
+    # Crash. The replacement starts with a reset monotonic clock (the
+    # phaseStartedAt in status is now in the "future") and no memory.
+    del rc1
+    clock["t"] = 0.0
+    rc2 = make_rc()
+    rc2.reconcile_all()
+    ro = _ro(api)
+    # Same walk, same canary subset, same epochs — not a restart.
+    assert ro["step"] == ro_before["step"]
+    assert ro["canaryMembers"] == ro_before["canaryMembers"]
+    assert ro["candidate"]["epoch"] == ro_before["candidate"]["epoch"]
+    for _ in range(4):
+        clock["t"] += 2.0
+        rc2.reconcile_all()
+    ro = _ro(api)
+    assert ro["phase"] == "Promoted"
+    assert _live_epochs(fleet) == {2}
+    # The resumed walk re-pushed idempotently (no-ops), never re-keyed
+    # the candidate to a new epoch.
+    assert all(v == 2 for v, _m, _p in fleet.pushes[pushes_before:]
+               if _p == "W-CANDIDATE")
