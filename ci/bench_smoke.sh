@@ -66,6 +66,15 @@ check_json "$out"
 # pool leaks blocks / the host tier leaks pinned bytes after drain.
 out="$(JAX_PLATFORMS=cpu python bench_serving.py --quick --qos-sweep)"
 check_json "$out"
+# Long-context serving: the marker fires when a prompt 4x the dense
+# prefill window fails to admit through bounded chunks byte-identically
+# (greedy AND sampled) to a monolithic wide-window reference, when one
+# token past max_prompt_len is not a clean PromptTooLong (413), when
+# decode streams fail to progress during a chunked admission or their
+# inter-token gap p99 exceeds 1.5x the no-prefill baseline, or on a
+# block leak after drain.
+out="$(JAX_PLATFORMS=cpu python bench_serving.py --quick --long-context-sweep)"
+check_json "$out"
 # Model-parallel serving: the marker fires when greedy tokens differ
 # across tp=1/2/4 mesh shapes at equal total pool bytes (including
 # shared-prefix block sharing + CoW and the int8 scale-carrying leg),

@@ -116,6 +116,15 @@ def inference_service_crd() -> dict:
         "type": "object",
         "properties": {
             "tpShards": {"type": "integer", "minimum": 1},
+            # Long-context knobs, declared explicitly: cpShards and
+            # ppStages multiply into the replica chip request
+            # (tp*cp*pp chips per pod), and role-level overrides let a
+            # disaggregated service run a wide-cp prefill pool feeding
+            # tp-only decode pools over the existing handoff.
+            "cpShards": {"type": "integer", "minimum": 1},
+            "ppStages": {"type": "integer", "minimum": 1},
+            "prefillChunkTokens": {"type": "integer", "minimum": 0},
+            "maxPromptLen": {"type": "integer", "minimum": 0},
             # Host-RAM KV tier budget (bytes): declared explicitly so
             # operators sizing pod memory see it in the schema — the
             # tier's bytes come out of the pod's RAM, not HBM.

@@ -73,6 +73,7 @@ class Gateway:
         redirect_target_port: int | None = None,
         challenge_lookup: Callable[[str], str | None] | None = None,
         upstream_timeout: float = 60.0,
+        max_body_bytes: int = 0,
         health: UpstreamHealth | None = None,
         probe_interval: float = 2.0,
         retry_budget: float = 0.2,
@@ -85,6 +86,11 @@ class Gateway:
         self.auth_url = auth_url
         self.resolve = resolve or (lambda addr: addr)
         self.upstream_timeout = upstream_timeout
+        # Declared-request-size ceiling (0 = unbounded): a long-context
+        # prompt larger than this answers 413 before any body byte is
+        # read, so one oversized client can't balloon gateway memory.
+        self.max_body_bytes = max_body_bytes
+        self.body_rejected_total = 0
         # TLS termination at the gateway (the iap-ingress/cert-manager
         # role, kubeflow/gcp/iap.libsonnet): cert+key mounted from a
         # Secret; empty = plain HTTP (in-mesh or behind an LB). The

@@ -57,6 +57,9 @@ def main(argv=None) -> int:
                         '[{"http_method":"GET","path_exact":"/healthz"}]')
     p.add_argument("--jwt-skew", type=float, default=60.0,
                    help="clock-skew allowance in seconds")
+    p.add_argument("--max-body-bytes", type=int, default=0,
+                   help="reject request bodies larger than this with "
+                        "413 before reading them (0 = unbounded)")
     args = p.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
@@ -107,7 +110,8 @@ def main(argv=None) -> int:
                  redirect_port=args.redirect_port,
                  redirect_target_port=args.redirect_target_port,
                  challenge_lookup=challenge_lookup,
-                 jwt_verifier=jwt_verifier)
+                 jwt_verifier=jwt_verifier,
+                 max_body_bytes=args.max_body_bytes)
     gw.start()
     log.info("gateway on :%d (admin :%d)", args.port, args.admin_port)
     try:

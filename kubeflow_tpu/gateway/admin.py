@@ -53,6 +53,8 @@ def make_admin_handler(gw):
                     "gateway_retries_total": gw.retries_total,
                     "gateway_affine_spills_total": gw.affine_spills,
                     "gateway_qos_shed_total": gw.qos_shed_total,
+                    "gateway_body_rejected_total":
+                        gw.body_rejected_total,
                     "gateway_handoffs_total": gw.handoffs_total,
                     "gateway_handoff_failures_total":
                         gw.handoff_failures,

@@ -56,6 +56,86 @@ def prompt_tokens_for(body: bytes | None) -> list | None:
     return None
 
 
+def _affinity_head_bound(width: int) -> int:
+    """How much of a long body the gateway buffers to compute an
+    affinity key: room for the JSON scaffolding plus the leading
+    ``width`` tokens the key actually hashes (~16 bytes per decimal
+    token id is generous). Everything past the head spills to the
+    backend unbuffered."""
+    return max(4096, 16 * int(width) + 1024)
+
+
+def leading_tokens_for(head: bytes, width: int) -> list | None:
+    """Leading prompt tokens out of a TRUNCATED predict-payload head.
+    ``json.loads`` rejects a cut-off body, but the affinity key only
+    hashes the first ``width`` tokens — scan the head for the first
+    ``"tokens"`` array and collect the integers that fit, so a long
+    prompt routes to the SAME affine replica a short one with the same
+    prefix does. Returns None (digest fallback) when no leading token
+    run can be recovered. Never raises."""
+    try:
+        text = head.decode("utf-8", "ignore")
+        idx = text.find('"tokens"')
+        if idx < 0:
+            return None
+        start = text.find("[", idx)
+        if start < 0:
+            return None
+        toks: list = []
+        num = ""
+        for ch in text[start + 1:]:
+            if ch in "-0123456789":
+                num += ch
+            elif ch in ", \t\r\n]":
+                if num:
+                    toks.append(int(num))
+                    num = ""
+                    if len(toks) >= max(int(width), 1):
+                        break
+                if ch == "]":
+                    break
+            else:
+                # Nested arrays / non-integer tokens: the strict parser
+                # wouldn't have produced a token list either — fall back
+                # to the digest key.
+                return None
+        return toks or None
+    except (ValueError, OverflowError):
+        return None
+
+
+class _SpilledBody:
+    """File-like request body for long payloads: the buffered head
+    replays first, then the remainder streams straight from the client
+    socket. ``http.client`` reads it in blocks, so the gateway never
+    holds more than the head in memory. The caller must forward an
+    explicit Content-Length of ``total_len`` (a file-like body without
+    one would be re-encoded chunked, which plain CL-only backends
+    don't speak)."""
+
+    def __init__(self, head: bytes, rfile, remaining: int):
+        self._head = head
+        self._rfile = rfile
+        self._remaining = max(int(remaining), 0)
+        self.total_len = len(head) + self._remaining
+
+    def read(self, n: int = -1) -> bytes:
+        if n is None or n < 0:
+            rest = (self._rfile.read(self._remaining)
+                    if self._remaining else b"")
+            out = self._head + rest
+            self._head, self._remaining = b"", 0
+            return out
+        if self._head:
+            out, self._head = self._head[:n], self._head[n:]
+            return out
+        if self._remaining <= 0:
+            return b""
+        data = self._rfile.read(min(n, self._remaining))
+        self._remaining = self._remaining - len(data) if data else 0
+        return data
+
+
 def affinity_key_for(body: bytes | None, path: str, width: int) -> str:
     """Routing key for a prefix-affine route: the prompt's leading
     tokens when the body is a predict payload (requests sharing a
@@ -91,6 +171,21 @@ def make_proxy_handler(gw):
             self.end_headers()
             if self.command != "HEAD":  # RFC 7231: HEAD has no body
                 self.wfile.write(body)
+
+        def _body_too_large(self, length: int) -> bool:
+            """413 on a declared body beyond ``gw.max_body_bytes`` —
+            BEFORE reading a byte of it, so an oversized long-context
+            prompt costs the gateway a header parse, not a buffer."""
+            if gw.max_body_bytes and length > gw.max_body_bytes:
+                gw.errors_total += 1
+                gw.body_rejected_total += 1
+                self._respond(413, json.dumps(
+                    {"error": f"request body {length} bytes exceeds "
+                              f"max_body_bytes {gw.max_body_bytes}"}
+                ).encode())
+                self.close_connection = True  # unread body desyncs
+                return True
+            return False
 
         def _handle(self):
             gw.requests_total += 1
@@ -213,11 +308,30 @@ def make_proxy_handler(gw):
                         {"error": "malformed Content-Length"}).encode())
                     self.close_connection = True
                     return
-                body = self.rfile.read(length) if length else b""
-                affinity_key = affinity_key_for(
-                    body, self.path, route.affinity_tokens)
+                if self._body_too_large(length):
+                    return
+                bound = _affinity_head_bound(route.affinity_tokens)
+                if length > bound:
+                    # Long-context payload: hash only a bounded head for
+                    # the backend pick and spill the remainder to the
+                    # relay unbuffered — a multi-megabyte prompt must
+                    # not be buffered (or JSON-parsed) at the gateway.
+                    head = self.rfile.read(bound)
+                    toks = leading_tokens_for(head, route.affinity_tokens)
+                    affinity_key = (
+                        prefix_affinity_key(toks, route.affinity_tokens)
+                        if toks is not None else
+                        hashlib.blake2b(head[:1024],
+                                        digest_size=8).hexdigest())
+                    body = _SpilledBody(head, self.rfile,
+                                        length - len(head))
+                else:
+                    body = self.rfile.read(length) if length else b""
+                    affinity_key = affinity_key_for(
+                        body, self.path, route.affinity_tokens)
             service = self._pick_backend(route, key=affinity_key)
             if (route.prefill_backends and affinity_key is not None
+                    and isinstance(body, bytes)
                     and self.path.endswith(":predict")):
                 # Disaggregated two-hop: have the affine prefill
                 # backend compute the prompt KV and push it to the
@@ -397,6 +511,8 @@ def make_proxy_handler(gw):
                         {"error": "malformed Content-Length"}).encode())
                     self.close_connection = True  # unread body desyncs
                     return
+                if self._body_too_large(length):
+                    return
                 body = self.rfile.read(length) if length else None
             # Forwarded prefix and authenticated identity are
             # gateway-asserted — client-supplied copies must never
@@ -411,6 +527,14 @@ def make_proxy_handler(gw):
             }
             headers["X-Forwarded-Prefix"] = route.prefix
             headers[REQUEST_ID_HEADER] = self._request_id
+            # A spilled long body streams from the client socket; the
+            # explicit Content-Length keeps http.client from falling
+            # back to chunked re-encoding (which CL-only backends don't
+            # speak). Body-inspection features below are skipped for it
+            # — only the head ever existed in gateway memory.
+            spilled = isinstance(body, _SpilledBody)
+            if spilled:
+                headers["Content-Length"] = str(body.total_len)
             if getattr(self, "_identity", None):
                 # The x-goog-authenticated-user-email analogue.
                 headers["X-Auth-Identity"] = self._identity
@@ -418,7 +542,7 @@ def make_proxy_handler(gw):
                        if route.splits and service else "")
             if version and not is_retry:
                 gw.version_requests.labels(route.name, version).inc()
-            if route.shadow and not is_retry:
+            if route.shadow and not is_retry and not spilled:
                 # Shadow sampling is decided by the same stable key the
                 # split uses (different salt): a sampled-in prefix is
                 # mirrored on every turn, so the candidate sees whole
@@ -428,7 +552,8 @@ def make_proxy_handler(gw):
                 if route.mirror_sample(mkey.encode()):
                     self._mirror(route, path, body, dict(headers))
             tag_headers = {}
-            if route.outlier_threshold > 0 and not is_retry:
+            if route.outlier_threshold > 0 and not is_retry \
+                    and not spilled:
                 value = OutlierStats.feature(body)
                 if value is not None:
                     z, is_out = gw.outliers.score(

@@ -74,6 +74,21 @@ REST_PORT = 8500
         ParamSpec("kv_fused_attention", False,
                   "fuse the paged decode read into the block-table "
                   "attention kernel (no dense KV gather per step)"),
+        ParamSpec("prefill_chunk_tokens", 0,
+                  "chunked prefill: split long admissions into bounded "
+                  "chunks interleaved with decode dispatches (0 "
+                  "disables; requires kv_layout=paged)"),
+        ParamSpec("max_prompt_len", 0,
+                  "longest admissible prompt (0 = the prefill window); "
+                  "beyond the prefill window requires chunked prefill"),
+        ParamSpec("cp_shards", 1,
+                  "context-parallel shards for chunk prefill attention "
+                  "(>1 rings the span attention across cp chips; "
+                  "chips per replica = tp*cp*pp)"),
+        ParamSpec("pp_stages", 1,
+                  "pipeline-parallel decoder stages (>1 shards stacked "
+                  "layers and the KV pool's layer dim across pp "
+                  "chips)"),
         ParamSpec("host_kv_bytes", 0,
                   "host-RAM KV tier budget in bytes (paged layout; 0 "
                   "disables): evictions demote blocks to host memory, "
@@ -113,6 +128,10 @@ def tpu_serving(
     serving_role: str,
     tp_shards: int,
     kv_fused_attention: bool,
+    prefill_chunk_tokens: int,
+    max_prompt_len: int,
+    cp_shards: int,
+    pp_stages: int,
     host_kv_bytes: int,
     qos_tenants: str,
     qos_aging_s: float,
@@ -145,6 +164,14 @@ def tpu_serving(
         args.insert(-1, f"--serving-role={serving_role}")
     if kv_fused_attention:
         args.insert(-1, "--kv-fused-attention")
+    if prefill_chunk_tokens:
+        args.insert(-1, f"--prefill-chunk-tokens={prefill_chunk_tokens}")
+    if max_prompt_len:
+        args.insert(-1, f"--max-prompt-len={max_prompt_len}")
+    if cp_shards > 1:
+        args.insert(-1, f"--cp-shards={cp_shards}")
+    if pp_stages > 1:
+        args.insert(-1, f"--pp-stages={pp_stages}")
     if host_kv_bytes:
         args.insert(-1, f"--host-kv-bytes={host_kv_bytes}")
     if qos_tenants:

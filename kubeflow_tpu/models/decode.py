@@ -25,6 +25,7 @@ from kubeflow_tpu.ops import rms_norm
 from kubeflow_tpu.ops.attention import (
     paged_decode_attention,
     paged_span_attention,
+    ring_span_attention,
 )
 from kubeflow_tpu.ops.rotary import rotary_frequencies
 from kubeflow_tpu.models.transformer import TransformerConfig, moe_ffn
@@ -752,7 +753,7 @@ def decode_chunk(state, params, cfg: TransformerConfig, steps: int,
 
 
 def _span_attention(x, layer, cfg, rope_bt, k_cache, v_cache, pos_b,
-                    table=None, fused=False, mesh=None):
+                    table=None, fused=False, mesh=None, ring=None):
     """Block attention where row ``b``'s ``S`` tokens occupy cache slots
     ``pos_b[b]..pos_b[b]+S-1`` — the S-wide sibling of
     :func:`_ragged_attention` (rows at heterogeneous positions). Block
@@ -765,7 +766,10 @@ def _span_attention(x, layer, cfg, rope_bt, k_cache, v_cache, pos_b,
     (ops/attention.py:paged_span_attention) so the dense
     ``[B, MB*Bs]`` view is never materialized — the same contract (and
     the same f32-equivalent-not-bitwise caveat) as the fused decode
-    read."""
+    read. ``ring`` (a serving mesh with a ``sequence`` axis) routes the
+    gathered span read through the context-parallel ring
+    (ops/attention.py:ring_span_attention) — chunked-prefill's long-
+    prompt path, same f32-equivalence caveat."""
     b, s, _d = x.shape
     hd = cfg.head_dim
     cos, sin = rope_bt
@@ -796,6 +800,13 @@ def _span_attention(x, layer, cfg, rope_bt, k_cache, v_cache, pos_b,
         k_read = _pool_gather(k_cache, table)
         v_read = _pool_gather(v_cache, table)
         total = table.shape[1] * _kv_arr(k_cache).shape[1]
+        if ring is not None:
+            out = ring_span_attention(
+                q, k_read, v_read, pos_b, n_kv_heads=cfg.n_kv_heads,
+                mesh=ring,
+            ).astype(cfg.dtype)
+            return (out.reshape(b, s, cfg.n_heads * hd)
+                    @ layer["wo"].astype(cfg.dtype), k_cache, v_cache)
     mask = jnp.arange(total)[None, None, :] <= cols[:, :, None]
     out = _gqa_attention(q, k_read, v_read, mask[:, None, None], cfg)
     return (out.astype(cfg.dtype) @ layer["wo"].astype(cfg.dtype),
@@ -804,7 +815,7 @@ def _span_attention(x, layer, cfg, rope_bt, k_cache, v_cache, pos_b,
 
 def _block_forward(params, cfg: TransformerConfig, k_cache0, v_cache0,
                    tokens, pos_b, token_valid, table=None, fused=False,
-                   mesh=None):
+                   mesh=None, ring=None):
     """[B, S] forward writing K/V at per-row start positions ``pos_b`` →
     (logits [B, S, V], k, v). The verify scoring pass, the paged
     suffix-only prefill, and the draft model's catch-up feed all ride
@@ -824,7 +835,7 @@ def _block_forward(params, cfg: TransformerConfig, k_cache0, v_cache0,
         h = rms_norm(x, layer["ln_attn"], eps=cfg.norm_eps)
         attn, k_cache, v_cache = _span_attention(
             h, layer["attn"], cfg, rope_bt, k_cache, v_cache, pos_b,
-            table=table, fused=fused, mesh=mesh,
+            table=table, fused=fused, mesh=mesh, ring=ring,
         )
         x = x + attn
         h = rms_norm(x, layer["ln_mlp"], eps=cfg.norm_eps)
@@ -1198,7 +1209,7 @@ def paged_admit_rows_and_step(state, params, cfg: TransformerConfig, slots,
 def _paged_admit_prefix_body(state, params, cfg: TransformerConfig, slot,
                              prefix_len, suffix_tokens, prompt_len,
                              remaining, temperature, fused=False,
-                             mesh=None):
+                             mesh=None, ring=None):
     """Suffix-only prefill through the slot's block table: the leading
     ``prefix_len`` positions are already backed by shared (and possibly
     one CoW'd) blocks, so the forward reads them in place — ZERO
@@ -1213,7 +1224,7 @@ def _paged_admit_prefix_body(state, params, cfg: TransformerConfig, slot,
         params, cfg, state["pool"]["k"], state["pool"]["v"], suffix_tokens,
         jnp.reshape(prefix_len, (1,)),
         token_valid=jnp.arange(s)[None, :] < suffix_len, table=table_row,
-        fused=fused, mesh=mesh,
+        fused=fused, mesh=mesh, ring=ring,
     )
     last = jnp.take_along_axis(
         logits, jnp.reshape(suffix_len - 1, (1, 1, 1)), axis=1
@@ -1231,25 +1242,73 @@ def _paged_admit_prefix_body(state, params, cfg: TransformerConfig, slot,
 
 @functools.partial(jax.jit,
                    static_argnames=("cfg", "top_k", "eos_id", "kv_fused",
-                                    "mesh"),
+                                    "mesh", "ring"),
                    donate_argnames=("state",))
 def paged_admit_prefix_and_step(state, params, cfg: TransformerConfig, slot,
                                 prefix_len, suffix_tokens, prompt_len,
                                 remaining, temperature, top_k: int = 0,
                                 eos_id: int | None = None,
-                                kv_fused: bool = False, mesh=None):
+                                kv_fused: bool = False, mesh=None,
+                                ring=None):
     """Paged twin of :func:`admit_prefix_and_step` — except the reused
     prefix is never gathered or copied: the host mapped the donor's full
     blocks into ``slot``'s table (refcount-shared) and CoW'd at most the
     one partially-filled tail block, so this dispatch only prefills the
-    suffix and takes the fused decode step."""
+    suffix and takes the fused decode step. ``ring`` routes the span
+    read through the context-parallel ring — the final chunk of a
+    chunked long admission rides this so its attention over the whole
+    already-scattered prompt is sequence-sharded too."""
     state, last = _paged_admit_prefix_body(state, params, cfg, slot,
                                            prefix_len, suffix_tokens,
                                            prompt_len, remaining,
-                                           temperature, kv_fused, mesh)
+                                           temperature, kv_fused, mesh,
+                                           ring)
     state, tok, emit = _decode_step_body(state, params, cfg, top_k, eos_id,
                                          kv_fused, mesh)
     return state, last, tok, emit
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "kv_fused", "mesh", "ring"),
+                   donate_argnames=("state",))
+def paged_prefill_chunk(state, params, cfg: TransformerConfig, slot, pos,
+                        chunk_tokens, chunk_len, kv_fused: bool = False,
+                        mesh=None, ring=None):
+    """One bounded chunk of a long admission: forward ``chunk_tokens``
+    ([1, S], right-padded to ``chunk_len`` real tokens) at virtual
+    positions ``pos..pos+chunk_len-1`` of ``slot``'s row, writing K/V
+    through the slot's block table. Each chunk's attention spans every
+    previously-scattered position (the span mask admits ``<= pos + s``),
+    so a chain of chunks reproduces the monolithic prefill's K/V
+    byte-for-byte — chunking changes the dispatch schedule, not the
+    math. The row is left PARKED (``length`` at the table horizon,
+    ``active`` False): interleaved decode dispatches between chunks see
+    an out-of-range position, so their unconditional scatters drop and
+    their masks never admit the half-built row (the same discipline as
+    :func:`retire_row`). The FINAL chunk must go through
+    :func:`paged_admit_prefix_and_step` with ``prefix_len`` = tokens
+    already chunked in — that activates the row, sets
+    length/remaining/last_logits, and takes the fused first decode step.
+    Consumes no RNG, so chunked sampling streams match monolithic ones.
+    Pad positions beyond ``chunk_len`` write junk K/V exactly like the
+    admit paths' padded suffixes — the next chunk (or decode) overwrites
+    them before any mask admits them. ``ring`` sequence-shards the span
+    read (context-parallel chunk prefill)."""
+    table_row = state["block_table"][slot][None]  # [1, mb]
+    _b, s = chunk_tokens.shape
+    _logits, pool_k, pool_v = _block_forward(
+        params, cfg, state["pool"]["k"], state["pool"]["v"], chunk_tokens,
+        jnp.reshape(pos, (1,)),
+        token_valid=jnp.arange(s)[None, :] < chunk_len, table=table_row,
+        fused=kv_fused, mesh=mesh, ring=ring,
+    )
+    total = state["block_table"].shape[1] * _kv_arr(pool_k).shape[2]
+    return {
+        **state,
+        "pool": {"k": pool_k, "v": pool_v},
+        "length": state["length"].at[slot].set(total),
+        "active": state["active"].at[slot].set(False),
+    }
 
 
 @functools.partial(jax.jit, donate_argnames=("pool",))
@@ -1346,26 +1405,34 @@ def copy_block(pool, dst, src):
 # from the weight shardings.
 
 
-def _kv_side_spec(side, axis: str):
+def _kv_side_spec(side, axis: str, pp_axis: str | None = None):
     """Spec for one side (k or v) of a KV store whose head dim is the
     second-to-last payload dim — covers the dense [L, slots, T, Hkv, hd]
     cache, the paged [L, N, Bs, Hkv, hd] pool, and the quantized
-    ``{"q", "scale"}`` pair (scales drop the trailing hd)."""
+    ``{"q", "scale"}`` pair (scales drop the trailing hd). ``pp_axis``
+    additionally shards the leading LAYER dim — the pipeline-parallel
+    serving layout, where each stage holds the KV for its own layer
+    range. Block ids index dims the split never touches, so the
+    allocator/trie/handoff host code is pp-blind exactly as it is
+    tp-blind."""
     from jax.sharding import PartitionSpec as P
 
     def _spec(arr):
-        return P(*([None] * (arr.ndim - 2)), axis, None)
+        return P(pp_axis, *([None] * (arr.ndim - 3)), axis, None)
 
     if isinstance(side, dict):
         return {"q": _spec(side["q"]),
-                "scale": P(*([None] * (side["scale"].ndim - 1)), axis)}
+                "scale": P(pp_axis, *([None] * (side["scale"].ndim - 2)),
+                           axis)}
     return _spec(side)
 
 
-def decode_state_specs(state, axis: str = "tensor"):
+def decode_state_specs(state, axis: str = "tensor",
+                       pp_axis: str | None = None):
     """PartitionSpec pytree for a decode state on a tensor-parallel
-    serving mesh: KV payload sharded over the KV-head axis, every other
-    leaf (tables, lengths, logits, RNG key) replicated."""
+    serving mesh: KV payload sharded over the KV-head axis (and, with
+    ``pp_axis``, over the layer dim), every other leaf (tables, lengths,
+    logits, RNG key) replicated."""
     from jax.sharding import PartitionSpec as P
 
     def _replicate(tree):
@@ -1374,13 +1441,15 @@ def decode_state_specs(state, axis: str = "tensor"):
     specs = {}
     for name, sub in state.items():
         if name in ("pool", "cache"):
-            specs[name] = {s: _kv_side_spec(sub[s], axis) for s in sub}
+            specs[name] = {s: _kv_side_spec(sub[s], axis, pp_axis)
+                           for s in sub}
         else:
             specs[name] = _replicate(sub)
     return specs
 
 
-def shard_decode_state(state, mesh, axis: str = "tensor"):
+def shard_decode_state(state, mesh, axis: str = "tensor",
+                       pp_axis: str | None = None):
     """Place a decode state (or a dense prefix pool — any {"k","v"}
     tree) onto ``mesh`` with the KV-head split of
     :func:`decode_state_specs`."""
@@ -1388,9 +1457,9 @@ def shard_decode_state(state, mesh, axis: str = "tensor"):
     from jax.sharding import PartitionSpec as P
 
     if set(state) == {"k", "v"}:
-        specs = {s: _kv_side_spec(state[s], axis) for s in state}
+        specs = {s: _kv_side_spec(state[s], axis, pp_axis) for s in state}
     else:
-        specs = decode_state_specs(state, axis)
+        specs = decode_state_specs(state, axis, pp_axis)
     shardings = jax.tree.map(
         lambda s: NamedSharding(mesh, s), specs,
         is_leaf=lambda x: isinstance(x, P))

@@ -68,6 +68,10 @@ ROLE_SIGNALS = {
 # CLI flag names (tp_shards). Normalized once at pool-spec time so the
 # role-override merge and the replica render both see one spelling.
 _ENGINE_KEY_ALIASES = {"tpShards": "tp_shards",
+                       "cpShards": "cp_shards",
+                       "ppStages": "pp_stages",
+                       "prefillChunkTokens": "prefill_chunk_tokens",
+                       "maxPromptLen": "max_prompt_len",
                        "hostKvBytes": "host_kv_bytes"}
 
 
@@ -436,10 +440,13 @@ class InferenceServiceController(Controller):
         spec = svc.get("spec", {})
         eng = (engine if engine is not None
                else _normalize_engine(spec.get("engine")))
-        # A tp-sharded replica is a tp-chip pod: tpShards sizes the chip
-        # request unless the spec pins it explicitly (0 = CPU stays 0).
+        # A model-parallel replica is a tp*cp*pp-chip pod: the mesh
+        # axes multiply into the chip request unless the spec pins it
+        # explicitly (0 = CPU stays 0).
         chips_spec = spec.get("tpuChipsPerReplica")
         chips = (max(1, int(eng.get("tp_shards", 1) or 1))
+                 * max(1, int(eng.get("cp_shards", 1) or 1))
+                 * max(1, int(eng.get("pp_stages", 1) or 1))
                  if chips_spec is None else int(chips_spec))
         params = {
             "name": self.replica_name(name, i, role),

@@ -697,6 +697,110 @@ def paged_span_attention(q, k_pool, v_pool, table, pos, *,
     return out.reshape(b, s_w, hq, hd)
 
 
+def ring_span_attention(q, k, v, pos, *, n_kv_heads: int,
+                        scale: float | None = None,
+                        mesh=None, axis: str = "sequence"):
+    """Context-parallel exact span attention — the chunked-prefill ring.
+
+    q: [B, S, Hq, hd] (one prefill chunk, already rotary-embedded; its
+    K/V already scattered into the pool); k, v: [B, T, Hkv, hd] — the
+    gathered, dequantized virtual rows (T = block_table width × block
+    size, junk beyond the written span is exact zeros); pos: [B] — span
+    token ``s`` of row ``b`` attends virtual positions ``<= pos[b] + s``
+    (its own just-written K/V included). Returns [B, S, Hq, hd] f32.
+
+    ``mesh`` with a ``sequence`` axis sized > 1 selects the ring twin:
+    the query chunk is sharded S/cp per device and the K/V view T/cp per
+    device; each device folds all cp K/V blocks with
+    ring_attention's collective-permute online-softmax core
+    (parallel/ring_attention.py:_block_attn), so per-device attention
+    memory is O(S/cp × T/cp) and one replica's max prompt scales with
+    cp. The span mask is computed from GLOBAL positions
+    (ring_attention.py:span_bias), so the result is the same math as the
+    dense read — f32-equivalent, not bitwise (online-softmax
+    accumulation order differs), the same caveat as the fused block-walk
+    kernels. GQA broadcasts K/V to query-head width before the ring
+    (chunk views are bounded, so the width cost is the q block's)."""
+    from kubeflow_tpu.parallel.ring_attention import (
+        _block_attn,
+        span_bias,
+    )
+
+    b, s_w, hq, hd = q.shape
+    t_w = k.shape[1]
+    if hq % n_kv_heads:
+        raise ValueError(
+            f"query heads {hq} not a multiple of kv heads {n_kv_heads}")
+    group = hq // n_kv_heads
+    sm_scale = (hd ** -0.5) if scale is None else scale
+    # [B, T, H, hd] -> f32 [B, Hq, T, hd] with K/V at query-head width.
+    qh = q.astype(jnp.float32).transpose(0, 2, 1, 3)
+    kh = jnp.repeat(k.astype(jnp.float32), group, axis=2).transpose(0, 2, 1, 3)
+    vh = jnp.repeat(v.astype(jnp.float32), group, axis=2).transpose(0, 2, 1, 3)
+
+    def _fold_all(qh_l, kh_l, vh_l, pos_l, q_start, k_start):
+        m0 = jnp.full((b, hq, qh_l.shape[2], 1), _NEG_INF, jnp.float32)
+        num0 = jnp.zeros(qh_l.shape, jnp.float32)
+        den0 = jnp.zeros((b, hq, qh_l.shape[2], 1), jnp.float32)
+        bias = span_bias(pos_l, q_start, k_start,
+                         qh_l.shape[2], kh_l.shape[2])[:, None]
+        return _block_attn(qh_l, kh_l, vh_l, bias, m0, num0, den0, sm_scale)
+
+    shards = int(mesh.shape.get(axis, 1)) if mesh is not None else 1
+    if shards <= 1:
+        m, num, den = _fold_all(qh, kh, vh, pos, 0, 0)
+        return (num / den).transpose(0, 2, 1, 3)
+
+    if s_w % shards or t_w % shards:
+        raise ValueError(
+            f"chunk width {s_w} and virtual width {t_w} must divide the "
+            f"{shards}-way {axis!r} axis")
+    from jax.sharding import PartitionSpec as P
+
+    from kubeflow_tpu.parallel.collectives import (
+        axis_size,
+        shard_map,
+    )
+
+    def _ring(qh_l, kh_l, vh_l, pos_l):
+        n = axis_size(axis)
+        idx = lax.axis_index(axis)
+        s_loc, t_loc = qh_l.shape[2], kh_l.shape[2]
+
+        def step(carry, i):
+            k_blk, v_blk, m, num, den = carry
+            # Block i arrived from device (idx + i) mod n — its global
+            # key offset; the query offset is this device's fixed chunk
+            # slice. Global coordinates keep the mask exact across the
+            # ring, fully-masked far blocks flush to exact zero when a
+            # real block folds (the finite -1e30 trick).
+            src = (idx + i) % n
+            bias = span_bias(pos_l, idx * s_loc, src * t_loc,
+                             s_loc, t_loc)[:, None]
+            m, num, den = _block_attn(qh_l, k_blk, v_blk, bias,
+                                      m, num, den, sm_scale)
+            perm = [(j, (j - 1) % n) for j in range(n)]
+            k_nxt = lax.ppermute(k_blk, axis_name=axis, perm=perm)
+            v_nxt = lax.ppermute(v_blk, axis_name=axis, perm=perm)
+            return (k_nxt, v_nxt, m, num, den), None
+
+        m0 = jnp.full((b, hq, s_loc, 1), _NEG_INF, jnp.float32)
+        num0 = jnp.zeros(qh_l.shape, jnp.float32)
+        den0 = jnp.zeros((b, hq, s_loc, 1), jnp.float32)
+        (_k, _v, m, num, den), _ = lax.scan(
+            step, (kh_l, vh_l, m0, num0, den0), jnp.arange(n))
+        return num / den
+
+    out = shard_map(
+        _ring, mesh=mesh,
+        in_specs=(P(None, None, axis, None), P(None, None, axis, None),
+                  P(None, None, axis, None), P()),
+        out_specs=P(None, None, axis, None),
+        axis_names=frozenset({axis}),
+    )(qh, kh, vh, pos)
+    return out.transpose(0, 2, 1, 3)
+
+
 def flash_attention(
     q,
     k,

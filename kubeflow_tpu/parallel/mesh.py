@@ -190,18 +190,30 @@ def single_device_mesh(device: jax.Device | None = None) -> Mesh:
     return build_mesh(MeshConfig(data=1), devices=[device])
 
 
-def serving_mesh(tp: int, *, devices: Sequence[jax.Device] | None = None
-                 ) -> Mesh:
-    """A pure tensor-parallel mesh over the first ``tp`` devices — the
-    model-parallel serving layout (one replica == one ``tp``-chip mesh;
-    every other axis is 1, so the tensor split lands on the innermost
-    ICI dimension). Serving replicates nothing across data/fsdp: the
-    fleet layer scales replicas, the mesh scales the model."""
+def serving_mesh(tp: int, *, cp: int = 1, pp: int = 1,
+                 devices: Sequence[jax.Device] | None = None) -> Mesh:
+    """The model-parallel serving layout: a ``pp×cp×tp`` mesh over the
+    first ``pp*cp*tp`` devices (one replica == one such mesh; every
+    other axis is 1, so the tensor split lands on the innermost ICI
+    dimension, the context ring just outside it, and the pipeline axis
+    outermost). ``cp`` sizes the ``sequence`` axis that chunked-prefill
+    ring attention shards long prompts over; ``pp`` sizes the
+    ``pipeline`` axis that the layer-stacked weights and the KV pool's
+    leading layer dim shard over. Serving replicates nothing across
+    data/fsdp: the fleet layer scales replicas, the mesh scales the
+    model."""
     if tp < 1:
         raise ValueError(f"tp must be >= 1, got {tp}")
+    if cp < 1:
+        raise ValueError(f"cp must be >= 1, got {cp}")
+    if pp < 1:
+        raise ValueError(f"pp must be >= 1, got {pp}")
+    need = tp * cp * pp
     devices = list(devices if devices is not None else jax.devices())
-    if len(devices) < tp:
+    if len(devices) < need:
         raise ValueError(
-            f"tp={tp} needs {tp} devices but only {len(devices)} are "
-            "visible")
-    return build_mesh(MeshConfig(data=1, tensor=tp), devices=devices[:tp])
+            f"tp={tp} cp={cp} pp={pp} needs {need} devices but only "
+            f"{len(devices)} are visible")
+    return build_mesh(MeshConfig(data=1, pipeline=pp, sequence=cp,
+                                 tensor=tp),
+                      devices=devices[:need])

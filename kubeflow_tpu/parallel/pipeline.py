@@ -31,6 +31,23 @@ from kubeflow_tpu.parallel.collectives import shard_map as _shard_map
 from kubeflow_tpu.parallel.mesh import AXIS_PIPELINE
 
 
+def stage_layer_ranges(n_layers: int, n_stages: int
+                       ) -> list[tuple[int, int]]:
+    """The contiguous ``[start, stop)`` layer range each pipeline stage
+    owns when the stacked L dim shards over the ``pipeline`` axis — the
+    single source of truth the serving layer uses to size per-stage KV
+    (stage ``s`` holds exactly its range's slice of the pool, so
+    per-chip KV bytes divide by ``n_stages``) and to validate the
+    ``pp_stages`` knob."""
+    if n_stages < 1:
+        raise ValueError(f"n_stages must be >= 1, got {n_stages}")
+    if n_layers % n_stages:
+        raise ValueError(
+            f"n_layers {n_layers} not divisible by pp_stages {n_stages}")
+    per = n_layers // n_stages
+    return [(s * per, (s + 1) * per) for s in range(n_stages)]
+
+
 def pipeline_apply(layer_fn, stage_params, x, mesh, *, n_micro: int):
     """Run ``x`` through the full layer stack with GPipe scheduling.
 

@@ -33,12 +33,14 @@ _NEG_INF = -1e30
 def _block_attn(q, k, v, bias, m_prev, num_prev, den_prev, scale):
     """Fold one K/V block into the running online-softmax state.
 
-    q: [B, H, Tq, D]; k,v: [B, H, Tk, D]; bias: [Tq, Tk] additive mask.
+    q: [B, H, Tq, D]; k,v: [B, H, Tk, D]; bias: additive mask
+    broadcastable to [B, H, Tq, Tk] (plain causal use passes [Tq, Tk];
+    the serving span ring passes a per-row [B, 1, Tq, Tk]).
     State: running max m [B,H,Tq,1], numerator [B,H,Tq,D], denominator
     [B,H,Tq,1] — all float32 regardless of input dtype.
     """
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
-    s = s * scale + bias[None, None, :, :]
+    s = s * scale + bias
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
     # Renormalize previous accumulators to the new max.
     correction = jnp.exp(m_prev - m_new)
@@ -53,6 +55,17 @@ def _block_attn(q, k, v, bias, m_prev, num_prev, den_prev, scale):
 def _causal_bias(q_start, k_start, tq, tk):
     q_pos = q_start + jnp.arange(tq)[:, None]
     k_pos = k_start + jnp.arange(tk)[None, :]
+    return jnp.where(q_pos >= k_pos, 0.0, _NEG_INF).astype(jnp.float32)
+
+
+def span_bias(pos, q_start, k_start, tq, tk):
+    """Per-row span mask for chunked-prefill ring attention: query token
+    ``i`` of row ``b`` sits at global position ``pos[b] + q_start + i``
+    and attends keys at global positions ``<= `` its own (its just-written
+    K/V included). Returns [B, Tq, Tk] float32 — broadcast to
+    ``[B, 1, Tq, Tk]`` before handing it to :func:`_block_attn`."""
+    q_pos = pos[:, None, None] + q_start + jnp.arange(tq)[None, :, None]
+    k_pos = k_start + jnp.arange(tk)[None, None, :]
     return jnp.where(q_pos >= k_pos, 0.0, _NEG_INF).astype(jnp.float32)
 
 

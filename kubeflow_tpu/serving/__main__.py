@@ -96,6 +96,35 @@ def main(argv=None) -> int:
                         "pool sharded over the KV-head axis; must "
                         "divide the model's kv heads / heads / d_ff "
                         "and the pod needs that many chips")
+    p.add_argument("--prefill-chunk-tokens", type=int, default=0,
+                   help="chunked prefill (continuous mode, paged "
+                        "layout): admit prompts longer than this as a "
+                        "chain of bounded chunk dispatches interleaved "
+                        "with decode rounds, so a long admission never "
+                        "stalls live streams for more than one chunk "
+                        "of prefill compute; 0 disables (monolithic "
+                        "admission). Token streams stay byte-identical")
+    p.add_argument("--max-prompt-len", type=int, default=0,
+                   help="prompt-length ceiling (0 = max-seq-len). "
+                        "Raising it past max-seq-len requires "
+                        "--prefill-chunk-tokens: chunks ride the paged "
+                        "block scatter, so only the KV row bounds the "
+                        "prompt. Longer prompts are rejected with 413, "
+                        "never truncated")
+    p.add_argument("--cp-shards", type=int, default=1,
+                   help="context-parallel shards: >1 runs each prefill "
+                        "chunk's attention ring-style over a sequence "
+                        "mesh axis — long-prompt prefill FLOPs scale "
+                        "with cp while decode stays tp-only; requires "
+                        "--prefill-chunk-tokens and the paged gather "
+                        "path; the pod needs tp*cp*pp chips")
+    p.add_argument("--pp-stages", type=int, default=1,
+                   help="pipeline-parallel decoder stages: >1 shards "
+                        "the layer stack AND the KV pool's layer dim "
+                        "over a pipeline mesh axis (per-chip weight + "
+                        "KV bytes divide by pp); must divide the "
+                        "model's n_layers; the pod needs tp*cp*pp "
+                        "chips")
     p.add_argument("--host-kv-bytes", type=int, default=0,
                    help="host-RAM KV tier budget in bytes (paged "
                         "layout; 0 disables): prefix evictions demote "
@@ -157,6 +186,34 @@ def main(argv=None) -> int:
         p.error("--serving-role requires --kv-layout=paged")
     if args.tp_shards < 1:
         p.error("--tp-shards must be >= 1")
+    if args.cp_shards < 1:
+        p.error("--cp-shards must be >= 1")
+    if args.pp_stages < 1:
+        p.error("--pp-stages must be >= 1")
+    if args.prefill_chunk_tokens < 0:
+        p.error("--prefill-chunk-tokens must be >= 0")
+    if args.max_prompt_len < 0:
+        p.error("--max-prompt-len must be >= 0")
+    if args.prefill_chunk_tokens and args.kv_layout != "paged":
+        # Chunks scatter through the block table; dense rows have no
+        # table to scatter through.
+        p.error("--prefill-chunk-tokens requires --kv-layout=paged")
+    if (args.max_prompt_len > args.max_seq_len
+            and not args.prefill_chunk_tokens):
+        # Monolithic prefill is bounded by the compiled width; silently
+        # accepting the flag would 413 every long prompt anyway.
+        p.error("--max-prompt-len beyond max-seq-len requires "
+                "--prefill-chunk-tokens")
+    if args.cp_shards > 1 and not args.prefill_chunk_tokens:
+        # The sequence axis only carries chunked-prefill attention;
+        # silently ignoring the flag would report tp-only numbers as
+        # context-parallel ones.
+        p.error("--cp-shards requires --prefill-chunk-tokens")
+    if args.cp_shards > 1 and args.kv_fused_attention:
+        p.error("--cp-shards uses the gathered ring read; drop "
+                "--kv-fused-attention")
+    if args.pp_stages > 1 and args.decode_mode != "continuous":
+        p.error("--pp-stages requires --decode-mode=continuous")
     if args.host_kv_bytes < 0:
         p.error("--host-kv-bytes must be >= 0")
     if args.host_kv_bytes and args.kv_layout != "paged":
@@ -188,12 +245,13 @@ def main(argv=None) -> int:
             p.error("--kv-layout=paged requires --decode-mode=continuous")
         if args.kv_block_size <= 0:
             p.error("--kv-block-size must be positive")
-        if (args.max_seq_len + args.max_new_tokens) % args.kv_block_size:
+        total = ((args.max_prompt_len or args.max_seq_len)
+                 + args.max_new_tokens)
+        if total % args.kv_block_size:
             # Fail at flag-parse time, not at the first generation
             # request (the decoder is built lazily).
             p.error(f"--kv-block-size {args.kv_block_size} must divide "
-                    f"max-seq-len + max-new-tokens = "
-                    f"{args.max_seq_len + args.max_new_tokens}")
+                    f"max-prompt-len + max-new-tokens = {total}")
 
     server = ModelServer(
         EngineConfig(
@@ -219,6 +277,10 @@ def main(argv=None) -> int:
             stream_timeout_s=args.stream_timeout_s,
             serving_role=args.serving_role,
             tp_shards=args.tp_shards,
+            prefill_chunk_tokens=args.prefill_chunk_tokens,
+            max_prompt_len=args.max_prompt_len,
+            cp_shards=args.cp_shards,
+            pp_stages=args.pp_stages,
             host_kv_bytes=args.host_kv_bytes,
             qos_tenants=args.qos_tenants,
             qos_aging_s=args.qos_aging_s,

@@ -138,6 +138,38 @@ class EngineConfig:
     # needs tp chips. The `serving_kv_bytes_*` gauges then price the
     # pool PER CHIP.
     tp_shards: int = 1
+    # Long-context serving (continuous mode, paged layout). Chunked
+    # prefill: >0 admits any prompt whose (post-prefix-hit) suffix
+    # exceeds this as a CHAIN of bounded chunk dispatches interleaved
+    # with decode rounds — the chunk width bounds the worst-case gap a
+    # long admission inserts into live streams' inter-token cadence.
+    # Token streams stay byte-identical to monolithic prefill. Must be
+    # <= max_seq_len; 0 disables (monolithic admission, pre-chunking
+    # behavior).
+    prefill_chunk_tokens: int = 0
+    # Prompt-length ceiling. 0 = max_seq_len (the compiled prefill
+    # width). Raising it past max_seq_len requires
+    # prefill_chunk_tokens > 0: chunks ride the paged block scatter, so
+    # only the virtual KV row — not any compiled shape — bounds the
+    # prompt. Prompts beyond the ceiling are rejected with HTTP 413
+    # (never silently truncated). Sizes the KV row: total = this +
+    # max_new_tokens; kv_block_size must divide it.
+    max_prompt_len: int = 0
+    # Context-parallel shards (continuous mode): >1 adds a `sequence`
+    # mesh axis and runs each prefill chunk's attention ring-style
+    # across it (parallel/ring_attention.py collective-permute core
+    # over the gathered paged span) — prefill FLOPs/bandwidth for long
+    # prompts scale with cp while decode stays tp-only. Requires
+    # prefill_chunk_tokens > 0 and the paged gather path (not
+    # kv_fused); pow2; the pod needs tp*cp*pp chips.
+    cp_shards: int = 1
+    # Pipeline-parallel decoder stages: >1 shards the stacked layer
+    # weights AND the KV pool's leading layer dim over the outermost
+    # `pipeline` mesh axis — per-chip weight and KV bytes divide by pp
+    # (long contexts fit where a tp-only replica OOMs) while block ids
+    # stay host-global (allocator/trie/handoff unchanged). Must divide
+    # the model's n_layers; the pod needs tp*cp*pp chips.
+    pp_stages: int = 1
     # Host-RAM KV tier budget in bytes (paged layout; 0 disables).
     # Prefix-trie evictions DEMOTE their blocks here instead of freeing
     # outright, trie misses probe it before cold prefill (second-chance

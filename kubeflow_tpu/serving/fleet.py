@@ -539,7 +539,7 @@ class DecoderFleet:
             per[name] = self._replicas[name].metrics()
         agg_keys = ("tokens_emitted", "requests_admitted", "prefix_hits",
                     "prefix_misses", "kv_blocks_in_use", "in_flight",
-                    "queued")
+                    "queued", "prefill_chunks", "prompt_rejected_too_long")
         agg = {k: sum(m.get(k, 0) for m in per.values()) for k in agg_keys}
         agg.update(replicas=per, live=sorted(per),
                    dead=dead, routed=counters["routed"],

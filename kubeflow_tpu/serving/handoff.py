@@ -100,7 +100,9 @@ def pack(handoff: dict) -> dict:
         # the payload arrives host-gathered across every mesh shape —
         # but a future envelope that ships per-shard payloads would bump
         # the version, and dashboards read it to attribute handoffs.
-        "mesh": {"tpShards": int(handoff.get("tp_shards", 1) or 1)},
+        "mesh": {"tpShards": int(handoff.get("tp_shards", 1) or 1),
+                 "cpShards": int(handoff.get("cp_shards", 1) or 1),
+                 "ppStages": int(handoff.get("pp_stages", 1) or 1)},
         "payload": {side: _enc(payload[side]) for side in ("k", "v")},
     }
 
@@ -134,5 +136,7 @@ def unpack(env: dict) -> dict:
         "block_size": int(env["block_size"]),
         "kv_dtype": str(env.get("kv_dtype", "fp")),
         "tp_shards": int(mesh.get("tpShards", 1) or 1),
+        "cp_shards": int(mesh.get("cpShards", 1) or 1),
+        "pp_stages": int(mesh.get("ppStages", 1) or 1),
         "payload": {side: _dec(payload[side]) for side in ("k", "v")},
     }

@@ -29,6 +29,7 @@ from kubeflow_tpu.observability.tracing import (
     render_debug,
 )
 from kubeflow_tpu.serving.batcher import DynamicBatcher
+from kubeflow_tpu.serving.continuous import PromptTooLong
 from kubeflow_tpu.serving.engine import EngineConfig, InferenceEngine
 from kubeflow_tpu.serving.qos import QosRejected
 
@@ -126,6 +127,11 @@ class ModelServer:
                     tp_shards=self.engine.cfg.tp_shards,
                     qos=qos,
                     host_kv_bytes=self.engine.cfg.host_kv_bytes,
+                    prefill_chunk_tokens=(
+                        self.engine.cfg.prefill_chunk_tokens),
+                    max_prompt_len=self.engine.cfg.max_prompt_len,
+                    cp_shards=self.engine.cfg.cp_shards,
+                    pp_stages=self.engine.cfg.pp_stages,
                 )
             return self._decoder
 
@@ -637,6 +643,14 @@ class ModelServer:
                     error = True
                     self._send(503, {"error": str(e) or "generation "
                                      "timed out"})
+                except PromptTooLong as e:
+                    # Terminal size rejection (prompt beyond the
+                    # replica's ceiling even chunked) — 413 so clients
+                    # can tell "shrink the prompt" from 400's "fix the
+                    # request" and from memory-pressure 503s. Ordered
+                    # before ValueError: PromptTooLong subclasses it.
+                    error = True
+                    self._send(413, {"error": str(e)})
                 except ValueError as e:
                     error = True
                     self._send(400, {"error": str(e)})

@@ -1,0 +1,44 @@
+"""JAX-hygiene BAD fixture: Python branch on a traced operand inside a
+``shard_map`` ring-permute loop — the hygiene class a context-parallel
+prefill kernel is most likely to ship. The ring walk itself (``for
+step in range(shards)`` + ``ppermute``) is host-static and legal; the
+bug is skipping "fully masked" rotations by testing a traced per-shard
+position against the rotation offset in Python. Under tracing that is
+a ``TracerBoolConversionError`` — or, through a caching wrapper, an
+executable with one rotation's schedule silently baked in. Causality
+across ring offsets belongs in an additive ``jnp.where`` bias."""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from kubeflow_tpu.parallel.collectives import shard_map
+
+
+def ring_prefill_attention(mesh, q, k, v, pos):
+    """Rotates K/V spans around the sequence axis, folding each."""
+    shards = mesh.shape["sequence"]  # host-static: legal out here
+
+    def body(q_l, k_l, v_l, pos_l):
+        n = shards
+        span = k_l.shape[1]
+        acc = jnp.zeros_like(q_l)
+        for step in range(n):  # host-static ring walk: fine
+            # BUG: ``pos_l`` is a traced per-shard operand — deciding
+            # in Python whether this rotation's span is still causal
+            # branches on a tracer. The skip must be a jnp.where bias
+            # (or the bound must be host-static).
+            if pos_l >= step * span:
+                acc = acc + jnp.einsum("bsd,btd->bsd", q_l, k_l) \
+                    @ jnp.swapaxes(v_l, 1, 2)
+            k_l, v_l = jax.lax.ppermute(
+                (k_l, v_l), "sequence",
+                [(j, (j - 1) % n) for j in range(n)])
+        return acc
+
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(P(None, "sequence", None), P(None, "sequence", None),
+                  P(None, "sequence", None), P()),
+        out_specs=P(None, "sequence", None),
+    )(q, k, v, pos)

@@ -1,0 +1,42 @@
+"""JAX-hygiene GOOD twin of jax_hygiene_ring_bad.py: the same
+ring-permute fold with causality expressed as an additive ``jnp.where``
+bias (traced-safe — masked rotations contribute zero weight instead of
+being skipped in Python) and the host-static mesh questions (shard
+count, the single-shard short-circuit) resolved OUTSIDE the body."""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from kubeflow_tpu.parallel.collectives import shard_map
+
+
+def ring_prefill_attention(mesh, q, k, v, pos):
+    """Rotates K/V spans around the sequence axis, folding each."""
+    shards = mesh.shape["sequence"]  # host-static: legal out here
+
+    def body(q_l, k_l, v_l, pos_l):
+        n = shards
+        span = k_l.shape[1]
+        acc = jnp.zeros_like(q_l)
+        for step in range(n):  # host-static ring walk
+            s = jnp.einsum("bsd,btd->bst", q_l, k_l)
+            # Causality across ring offsets stays in the traced
+            # domain: a masked rotation folds with -inf scores, not a
+            # Python skip.
+            bias = jnp.where(pos_l >= step * span, 0.0, -1e30)
+            acc = acc + jnp.einsum(
+                "bst,btd->bsd", jax.nn.softmax(s + bias, axis=-1), v_l)
+            k_l, v_l = jax.lax.ppermute(
+                (k_l, v_l), "sequence",
+                [(j, (j - 1) % n) for j in range(n)])
+        return acc
+
+    if shards == 1:
+        return body(q, k, v, pos)
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(P(None, "sequence", None), P(None, "sequence", None),
+                  P(None, "sequence", None), P()),
+        out_specs=P(None, "sequence", None),
+    )(q, k, v, pos)

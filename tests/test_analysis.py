@@ -134,6 +134,18 @@ def test_jax_hygiene_shard_map_branch_detected():
     assert "pos_l" in hits[0].message
 
 
+def test_jax_hygiene_ring_loop_branch_detected():
+    """A Python branch on a traced operand inside a shard_map
+    ring-permute loop — the hygiene class context-parallel prefill
+    kernels are most exposed to (the host-static ring walk makes the
+    traced skip look innocuous)."""
+    found = _findings(FIXTURES / "jax_hygiene_ring_bad.py")
+    hits = [f for f in found if f.rule == "jit-traced-branch"]
+    assert hits, found
+    assert hits[0].symbol == "ring_prefill_attention.body"
+    assert "pos_l" in hits[0].message
+
+
 def test_metrics_exposition_detected():
     found = _findings(FIXTURES / "metrics_exposition_bad.py")
     rules = {f.rule for f in found}
@@ -156,6 +168,7 @@ def test_good_fixtures_are_clean():
                  "thread_lifecycle_good.py",
                  "resource_good.py", "jax_hygiene_good.py",
                  "jax_hygiene_shard_map_good.py",
+                 "jax_hygiene_ring_good.py",
                  "metrics_exposition_good.py"):
         found = _findings(FIXTURES / name)
         assert not found, (name, found)

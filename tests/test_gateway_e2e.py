@@ -1388,3 +1388,155 @@ def test_prefix_affine_routing_through_gateway(api):
                 be.close()
             except Exception:
                 pass
+
+
+class _DigestBackend:
+    """HTTP backend that reads its POST body fully and answers with its
+    own name plus the body's length and sha256 — proof an upstream
+    received a (possibly gateway-streamed) body byte-identically."""
+
+    def __init__(self, name):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        self.name = name
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                data = b""
+                while len(data) < n:
+                    chunk = self.rfile.read(n - len(data))
+                    if not chunk:
+                        break
+                    data += chunk
+                body = json.dumps({
+                    "variant": outer.name,
+                    "len": len(data),
+                    "sha": hashlib.sha256(data).hexdigest(),
+                }).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_long_body_spills_past_affinity_head():
+    """Long-context regression: a prefix-affine route used to buffer
+    (and json-parse) the ENTIRE request body just to compute the
+    affinity key. A multi-megabyte prompt must instead hash a bounded
+    head, land on the SAME affine replica a short prompt with the same
+    leading tokens does, and stream through to the backend intact."""
+    from kubeflow_tpu.gateway import Route
+
+    a, b = _DigestBackend("a"), _DigestBackend("b")
+    table = RouteTable()
+    table.set_routes([Route(
+        name="long", prefix="/long/",
+        service=f"127.0.0.1:{a.port}",
+        backends=((f"127.0.0.1:{a.port}", 1),
+                  (f"127.0.0.1:{b.port}", 1)),
+        strategy="prefix-affine")])
+    gw = Gateway(table, port=0, admin_port=0, probe_interval=0)
+    gw.start()
+    try:
+        port = gw._proxy.server_address[1]
+        toks = [7, 11, 13, 17, 19, 23]
+        # Short prompt: the strict-parse affinity path.
+        status, short_reply, _ = http(
+            "POST", f"http://127.0.0.1:{port}/long/x:predict",
+            {"instances": [{"tokens": toks}]})
+        assert status == 200
+        # Long prompt, same leading tokens: ~1 MiB of payload after the
+        # token array, far past the gateway's affinity head bound.
+        long_body = (
+            b'{"instances": [{"tokens": '
+            + json.dumps(toks).encode()
+            + b', "pad": "' + b"x" * (1 << 20) + b'"}]}')
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/long/x:predict", data=long_body,
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            long_reply = json.loads(resp.read())
+        # Byte-identical arrival despite the spill...
+        assert long_reply["len"] == len(long_body)
+        assert long_reply["sha"] == \
+            hashlib.sha256(long_body).hexdigest()
+        # ...on the SAME affine replica the short prompt routed to (the
+        # truncated-head token extraction must agree with full parsing).
+        assert long_reply["variant"] == short_reply["variant"]
+        # Unparseable long bodies still route deterministically (digest
+        # fallback over the head): same garbage, same backend.
+        junk = b"\x00\x01" * (1 << 19)
+        picks = set()
+        for _ in range(2):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/long/x:predict", data=junk,
+                method="POST")
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                picks.add(json.loads(resp.read())["variant"])
+        assert len(picks) == 1
+    finally:
+        gw.stop()
+        a.close()
+        b.close()
+
+
+def test_max_body_bytes_rejects_oversized_declared_body():
+    """A declared Content-Length beyond ``max_body_bytes`` answers 413
+    BEFORE the gateway reads a single body byte — sent raw so the test
+    controls exactly what goes on the wire (headers only, no body)."""
+    import socket
+
+    from kubeflow_tpu.gateway import Route
+
+    be = _DigestBackend("a")
+    table = RouteTable()
+    table.set_routes([Route(
+        name="cap", prefix="/cap/",
+        service=f"127.0.0.1:{be.port}")])
+    gw = Gateway(table, port=0, admin_port=0, probe_interval=0,
+                 max_body_bytes=1 << 20)
+    gw.start()
+    try:
+        port = gw._proxy.server_address[1]
+        client = socket.create_connection(("127.0.0.1", port),
+                                          timeout=10)
+        # Declare 64 MiB; send NOTHING after the headers. The gateway
+        # must answer from the header alone (buffering first would hang
+        # this test until timeout).
+        client.sendall((
+            f"POST /cap/x HTTP/1.1\r\nHost: 127.0.0.1:{port}\r\n"
+            f"Content-Length: {64 << 20}\r\n\r\n").encode())
+        resp = b""
+        while b"\r\n\r\n" not in resp:
+            chunk = client.recv(4096)
+            if not chunk:
+                break
+            resp += chunk
+        assert b" 413 " in resp.split(b"\r\n", 1)[0] + b" ", resp
+        assert b"max_body_bytes" in resp + client.recv(4096)
+        client.close()
+        assert gw.body_rejected_total == 1
+        # Within the cap still flows end-to-end.
+        status, body, _ = http(
+            "POST", f"http://127.0.0.1:{port}/cap/x", {"a": 1})
+        assert status == 200 and body["len"] > 0
+    finally:
+        gw.stop()
+        be.close()

@@ -344,7 +344,8 @@ def test_handoff_across_mesh_shapes(tiny_tp, tp_export, tp_import):
         assert handoff["tp_shards"] == tp_export
         env = json.loads(json.dumps(handoff_mod.pack(handoff)))
         assert env["version"] == handoff_mod.HANDOFF_VERSION
-        assert env["mesh"] == {"tpShards": tp_export}
+        assert env["mesh"] == {"tpShards": tp_export, "cpShards": 1,
+                               "ppStages": 1}
         unpacked = handoff_mod.unpack(env)
         assert unpacked["tp_shards"] == tp_export
         assert imp.import_prompt(unpacked)
