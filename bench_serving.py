@@ -55,6 +55,19 @@ gate), when prefix-affine routing does not beat random routing's mean
 per-replica prefix-cache hit rate strictly, when greedy tokens differ
 across any run, or when any replica leaks KV blocks.
 
+``--kv-economy-sweep`` benchmarks the fleet KV economy: 3 replicas
+behind the seeded-RANDOM router (the locality-hostile placement where
+every replica eventually sees every prompt group) with a shared
+prefix→holder directory, in-process peer KV pulls over the handoff
+envelope, and a shared content-addressed cold store — against the same
+replicas with private caches only, at EQUAL per-replica warm-tier
+bytes, plus an uncached parity reference. The regression marker fires
+when any leg's greedy tokens differ from the reference, when the
+economy's follower-phase aggregate prefill volume or TTFT p99 is not
+below the private-cache baseline, when no peer/cold import actually
+happened, when a mid-pull weight push is NOT refused as stale, or
+when any leg leaks KV blocks in any tier.
+
 ``--disagg-sweep`` benchmarks disaggregated prefill/decode pools
 against a colocated fleet at EQUAL total pool bytes and engine count
 under mixed long-prefill/long-decode burst traffic. A colocated
@@ -89,8 +102,8 @@ baseline (>=5x rollout throughput required — the reason RLJob exists).
 
 Usage: python bench_serving.py [--quick] [--requests N] [--generate]
        [--prefix-reuse] [--speculative] [--concurrency-sweep]
-       [--kv-dtype-sweep] [--fleet-sweep] [--disagg-sweep] [--tp-sweep]
-       [--weight-push-sweep]
+       [--kv-dtype-sweep] [--fleet-sweep] [--kv-economy-sweep]
+       [--disagg-sweep] [--tp-sweep] [--weight-push-sweep]
 """
 
 from __future__ import annotations
@@ -1055,6 +1068,235 @@ def _bench_fleet_sweep(args, model) -> dict:
         "config": f"{model} groups{groups}x{per_group} prefix{plen} "
                   f"gen{gen} slots{slots} pool{pool_blocks} "
                   f"block{block} replicas1v4",
+    }
+
+
+def _bench_kv_economy_sweep(args, model) -> dict:
+    """Fleet KV economy: distributed prefix cache vs private caches.
+
+    Spill-heavy trace: G prompt groups, each sharing a ``plen``-token
+    leading prefix, scattered over 3 replicas by the seeded RANDOM
+    router — the locality-hostile placement where a group's followers
+    keep landing on replicas that never served its leader, so a
+    private per-replica trie pays a full prefill per (group, replica)
+    first encounter. Three legs, byte-compared request by request:
+
+    - **reference** — one uncached decoder (the parity anchor);
+    - **baseline** — 3 replicas, private tries + host tiers only;
+    - **economy**  — the same replicas (EQUAL warm-tier bytes) plus a
+      shared prefix directory, in-process peer pulls over the handoff
+      envelope, and a shared content-addressed cold store: a first
+      encounter imports the leader's KV from its holder and prefills
+      only the tail.
+
+    Placement, leaders, and compile warmup are identical across legs
+    (same router seed, same phases), so the follower-phase deltas are
+    the economy's doing. Two untimed probes then pin the churn
+    contracts: a weight push landing mid-pull must be REFUSED as stale
+    (never installed), and a dead holder must fall back to the cold
+    tier with exact bytes. The regression marker fires on any parity
+    break, on economy follower prefill volume or TTFT p99 not below
+    baseline, on zero peer/cold hits, on a missing stale refusal, or
+    on leaked blocks in any leg or tier."""
+    from kubeflow_tpu.models.registry import get_model
+    from kubeflow_tpu.serving.cold_store import ColdKvStore
+    from kubeflow_tpu.serving.continuous import ContinuousDecoder
+    from kubeflow_tpu.serving.fleet import DecoderFleet
+    from kubeflow_tpu.serving.kv_directory import KvDirectory
+
+    spec = get_model(model)
+    params = spec.init(jax.random.PRNGKey(0), spec.config)
+    gen = 8
+    prefill_len = 32
+    block = 8
+    slots = 8
+    plen = 24       # group-shared prefix
+    affinity = 16   # directory key window (< plen: groups keep keys)
+    pool_blocks = slots * ((prefill_len + gen) // block)
+    groups = 8
+    per_group = 4 if args.quick else 8
+    n_rep = 3
+    requests = []
+    for g in range(groups):
+        prefix = [(g * 13 + j * 5) % 97 + 3 for j in range(plen)]
+        for r in range(per_group):
+            requests.append(
+                (g, prefix + [210 + g, 150 + r % 40, 9 + r % 7]))
+    # Probe prompt families (never in the main trace).
+    stale_prefix = [171 + j for j in range(plen)]
+    cold_prefix = [131 + j for j in range(plen)]
+    probe_prompts = {"stale": stale_prefix + [6, 7],
+                     "cold": cold_prefix + [6, 7]}
+
+    def mk(**kw):
+        return ContinuousDecoder(
+            params, spec.config, slots=slots, prefill_len=prefill_len,
+            max_new_tokens=gen, prefill_len_buckets=2,
+            kv_layout="paged", kv_block_size=block,
+            kv_pool_blocks=pool_blocks, stream_timeout_s=600.0, **kw)
+
+    def run(economy):
+        directory = KvDirectory() if economy else None
+        cold = ColdKvStore(4 << 20) if economy else None
+        reps = {}
+        for i in range(n_rep):
+            kw = {"prefix_cache_slots": slots,
+                  "prefix_cache_min_len": 16,
+                  "host_kv_bytes": 1 << 20}
+            if economy:
+                kw.update(kv_directory=directory, cold_store=cold,
+                          kv_affinity_tokens=affinity,
+                          replica_name=f"r{i}")
+            reps[f"r{i}"] = mk(**kw)
+        fleet = DecoderFleet(reps, affinity_tokens=affinity,
+                             router="random", seed=11)
+        # Same seed + same call order => identical placement per leg.
+        placement = [fleet.route(toks) for _, toks in requests]
+        tokens_by_idx = {}
+        ttfts = []
+        out = {}
+        try:
+            # Compile warmup (both prefill buckets) + global leaders:
+            # the first request of each group seeds its routed trie.
+            for i, d in enumerate(reps.values()):
+                warm = [(i * 31 + j * 3) % 89 + 101 for j in range(plen)]
+                d.generate(warm + [1], gen, timeout=600)
+                d.generate(warm + [1, 2], gen, timeout=600)
+            seen = set()
+            followers = []
+            for idx, (g, toks) in enumerate(requests):
+                if g in seen:
+                    followers.append(idx)
+                    continue
+                seen.add(g)
+                tokens_by_idx[idx] = reps[placement[idx]].generate(
+                    toks, gen, timeout=600)["tokens"]
+            # Timed follower phase, per replica back to back (shards
+            # never fight for the CI host's single core).
+            pre0 = {nm: d.metrics()["prefill_tokens"]
+                    for nm, d in reps.items()}
+            for nm, d in reps.items():
+                shard = [i for i in followers if placement[i] == nm]
+                if not shard:
+                    continue
+
+                def one(idx):
+                    h = d.submit(requests[idx][1], gen)
+                    return idx, h.result(timeout=600)["tokens"], h.ttft_s
+                with ThreadPoolExecutor(min(len(shard), 8)) as pool:
+                    for idx, toks_out, ttft in pool.map(one, shard):
+                        tokens_by_idx[idx] = toks_out
+                        ttfts.append(ttft * 1e3)
+            out["prefill_tokens"] = sum(
+                d.metrics()["prefill_tokens"] - pre0[nm]
+                for nm, d in reps.items())
+            agg = {k: sum(d.metrics()[k] for d in reps.values())
+                   for k in ("kv_peer_hits", "kv_peer_misses",
+                             "kv_peer_import_bytes", "kv_cold_hits",
+                             "kv_import_stale_refused")} if economy \
+                else {}
+            if economy:
+                # Churn probe 1: weight push lands mid-pull — the
+                # envelope's epoch stamp goes stale between fetch and
+                # install, and the import must be refused.
+                reps["r0"].generate(stale_prefix + [5], gen,
+                                    timeout=600)
+                r1 = reps["r1"]
+                inner = r1._peer_fetch
+
+                def racing(holder, toks, ver):
+                    got = inner(holder, toks, ver)
+                    r1.update_weights(params)
+                    return got
+                r1._peer_fetch = racing
+                out["stale_tokens"] = r1.generate(
+                    probe_prompts["stale"], gen, timeout=600)["tokens"]
+                r1._peer_fetch = inner
+                out["stale_refused"] = \
+                    r1.metrics()["kv_import_stale_refused"]
+                # Churn probe 2: the only warm holder dies; the miss
+                # path falls past the dead peer into the cold tier.
+                reps["r0"].generate(cold_prefix + [5], gen,
+                                    timeout=600)
+                h = reps["r0"].export_prefix(probe_prompts["cold"])
+                cold.put(h, version=h.pop("weights_version"))
+                fleet.mark_dead("r0")
+                out["cold_tokens"] = reps["r2"].generate(
+                    probe_prompts["cold"], gen, timeout=600)["tokens"]
+                out["cold_hits"] = reps["r2"].metrics()["kv_cold_hits"]
+            leaked = sum(len(b) for d in reps.values()
+                         for b in d._slot_blocks)
+            tier_overrun = any(
+                d._host_tier is not None
+                and d._host_tier.bytes_in_use > d._host_tier.capacity_bytes
+                for d in reps.values())
+            if economy:
+                tier_overrun |= cold.bytes_in_use > cold.capacity_bytes
+                out["directory"] = directory.stats()
+                out["cold_store"] = cold.stats()
+        finally:
+            fleet.stop()
+        ttfts.sort()
+        out.update({
+            "tokens": [tokens_by_idx[i] for i in range(len(requests))],
+            "ttft_p50_ms": round(percentile(ttfts, 50), 2),
+            "ttft_p99_ms": round(percentile(ttfts, 99), 2),
+            "leaked_blocks": leaked,
+            "tier_overrun": tier_overrun,
+            **agg,
+        })
+        return out
+
+    ref = mk()
+    try:
+        ref_tokens = [ref.generate(t, gen, timeout=600)["tokens"]
+                      for _, t in requests]
+        ref_probe = {k: ref.generate(p, gen, timeout=600)["tokens"]
+                     for k, p in probe_prompts.items()}
+    finally:
+        ref.stop()
+    base = run(False)
+    econ = run(True)
+
+    identical = (ref_tokens == base["tokens"] == econ["tokens"]
+                 and econ["stale_tokens"] == ref_probe["stale"]
+                 and econ["cold_tokens"] == ref_probe["cold"])
+    prefill_ratio = (base["prefill_tokens"]
+                     / max(econ["prefill_tokens"], 1))
+    leaked = base["leaked_blocks"] + econ["leaked_blocks"]
+    regression = (
+        (not identical)
+        or econ["prefill_tokens"] >= base["prefill_tokens"]
+        or econ["ttft_p99_ms"] >= base["ttft_p99_ms"]
+        or econ["kv_peer_hits"] < 1
+        or econ["cold_hits"] < 1
+        or econ["stale_refused"] < 1
+        or leaked != 0
+        or base["tier_overrun"] or econ["tier_overrun"])
+    return {
+        "metric": "serving_kv_economy_prefill_reduction",
+        "value": round(prefill_ratio, 2),
+        "unit": "x",
+        "vs_baseline": 1.0,
+        "baseline_prefill_tokens": base["prefill_tokens"],
+        "economy_prefill_tokens": econ["prefill_tokens"],
+        "baseline_ttft_p99_ms": base["ttft_p99_ms"],
+        "economy_ttft_p99_ms": econ["ttft_p99_ms"],
+        "baseline_ttft_p50_ms": base["ttft_p50_ms"],
+        "economy_ttft_p50_ms": econ["ttft_p50_ms"],
+        "kv_peer_hits": econ["kv_peer_hits"],
+        "kv_peer_import_bytes": econ["kv_peer_import_bytes"],
+        "kv_cold_hits": econ["cold_hits"],
+        "kv_import_stale_refused": econ["stale_refused"],
+        "directory": econ["directory"],
+        "cold_store": econ["cold_store"],
+        "tokens_identical": identical,
+        "kv_blocks_in_use_after_drain": leaked,
+        "regression": regression,
+        "config": f"{model} groups{groups}x{per_group} prefix{plen} "
+                  f"affinity{affinity} gen{gen} slots{slots} "
+                  f"pool{pool_blocks} block{block} replicas{n_rep} "
+                  f"router=random",
     }
 
 
@@ -2023,6 +2265,15 @@ def main() -> int:
                          "shared-prefix traffic (>=3.4x aggregate "
                          "tokens/s and a strictly higher prefix hit "
                          "rate than random routing required)")
+    ap.add_argument("--kv-economy-sweep", action="store_true",
+                    help="benchmark the fleet KV economy: shared "
+                         "prefix directory + peer pulls + cold "
+                         "content-addressed tier vs private "
+                         "per-replica caches under the seeded-random "
+                         "router (byte-identical streams, follower "
+                         "prefill volume and TTFT p99 below baseline, "
+                         "mid-pull weight push refused as stale, zero "
+                         "leaked blocks in every tier)")
     ap.add_argument("--kv-dtype-sweep", action="store_true",
                     help="benchmark int8 vs fp paged KV at equal pool "
                          "bytes (>=1.8x in-flight peak, fp bitwise "
@@ -2100,6 +2351,9 @@ def main() -> int:
     elif args.fleet_sweep:
         model = "llama-1b" if on_tpu and not args.quick else "lm-test-tiny"
         result = _bench_fleet_sweep(args, model)
+    elif args.kv_economy_sweep:
+        model = "llama-1b" if on_tpu and not args.quick else "lm-test-tiny"
+        result = _bench_kv_economy_sweep(args, model)
     elif args.kv_dtype_sweep:
         model = "llama-1b" if on_tpu and not args.quick else "lm-test-tiny"
         result = _bench_kv_dtype_sweep(args, model)

@@ -101,6 +101,16 @@ check_json "$out"
 # vs the incumbent cold decoder.
 out="$(JAX_PLATFORMS=cpu python bench_serving.py --quick --rollout-sweep)"
 check_json "$out"
+# Fleet KV economy: the marker fires when the distributed prefix cache
+# (shared directory + peer pulls + cold content-addressed tier) fails
+# to cut follower-phase prefill volume AND TTFT p99 below the private-
+# per-replica-cache baseline at equal warm-tier bytes under the
+# spill-heavy seeded-random trace, when any leg's greedy tokens differ
+# from the uncached reference, when no peer/cold import happened, when
+# a weight push landing mid-pull is not refused as stale, or when any
+# leg leaks blocks in any tier.
+out="$(JAX_PLATFORMS=cpu python bench_serving.py --quick --kv-economy-sweep)"
+check_json "$out"
 echo "bench smoke ok"
 # Training input pipeline: prefetch-on must match prefetch-off final
 # loss byte-for-byte (bench.py sets the regression marker otherwise)

@@ -129,6 +129,15 @@ def inference_service_crd() -> dict:
             # operators sizing pod memory see it in the schema — the
             # tier's bytes come out of the pod's RAM, not HBM.
             "hostKvBytes": {"type": "integer", "minimum": 0},
+            # Fleet KV economy: the prefix->holder directory's key
+            # capacity (0 = economy off), the shared cold store ref
+            # ("mem://<name>[?bytes=n]"), and the recompute-vs-import
+            # crossover threshold in prefill tokens. Declared so the
+            # operator can validate them and so colocated replicas of
+            # one service share the same cold store name by default.
+            "kvDirectorySize": {"type": "integer", "minimum": 0},
+            "coldStoreRef": {"type": "string"},
+            "importCrossoverTokens": {"type": "integer", "minimum": 0},
         },
         "x-kubernetes-preserve-unknown-fields": True,
     }

@@ -32,6 +32,7 @@ from kubeflow_tpu.gateway.admin import make_admin_handler
 from kubeflow_tpu.gateway.proxy import make_proxy_handler
 from kubeflow_tpu.observability.metrics import MetricRegistry
 from kubeflow_tpu.observability.tracing import TraceStore
+from kubeflow_tpu.serving.kv_directory import KvDirectory
 from kubeflow_tpu.gateway.resilience import (
     BackendLoad,
     BanditStats,
@@ -152,6 +153,20 @@ class Gateway:
         # gateway-visible depth grows. Folded into the prefix-affine
         # spill decision when the route sets kv_pressure.
         self.kv_fill = KvFillCache()
+        # Fleet KV economy: the gateway-side prefix→holder directory.
+        # Every prefix-affine placement publishes its chosen backend as
+        # a holder for the request's affinity key, and a SPILL consults
+        # the directory first — a spilled request lands on a backend
+        # already advertising its prefix (warm trie, or peer-importable
+        # KV) instead of merely the least-loaded one. Hints, not truth:
+        # the replicas validate on pull, so a stale gateway hint costs
+        # one ordinary prefill.
+        self.kv_directory = KvDirectory(2048)
+        self.directory_hits = 0   # spills steered to an advertised holder
+        # Per-route affinity outcome counters for the /metricsz rollup:
+        # route name → {"affine": n, "spill": n, "directory": n}.
+        self.route_affinity: dict = {}
+        self._affinity_lock = threading.Lock()
         # Disaggregated two-hop relay counters (prefill_backends routes).
         self.handoffs_total = 0
         self.handoff_failures = 0
@@ -197,6 +212,20 @@ class Gateway:
         self._redirect: ThreadingHTTPServer | None = None
         self._ssl_ctx = None
         self._cert_watch_stop = threading.Event()
+
+    def note_affinity(self, route_name: str, kind: str) -> None:
+        """Count one prefix-affine placement outcome on a route:
+        ``affine`` (landed on the rendezvous pick), ``spill`` (pressure
+        pushed it off), or ``directory`` (a spill steered to a backend
+        the prefix directory advertised). The /metricsz rollup reads
+        these per route — spills were previously only visible
+        per-replica."""
+        with self._affinity_lock:
+            per = self.route_affinity.setdefault(
+                route_name, {"affine": 0, "spill": 0, "directory": 0})
+            per[kind] = per.get(kind, 0) + 1
+            if kind == "directory":
+                self.directory_hits += 1
 
     def _retry_allowed(self) -> bool:
         return (self.retries_total + 1) <= self.retry_budget * max(

@@ -52,6 +52,7 @@ def make_admin_handler(gw):
                     "gateway_shadow_requests_total": gw.shadow_total,
                     "gateway_retries_total": gw.retries_total,
                     "gateway_affine_spills_total": gw.affine_spills,
+                    "gateway_directory_hits_total": gw.directory_hits,
                     "gateway_qos_shed_total": gw.qos_shed_total,
                     "gateway_body_rejected_total":
                         gw.body_rejected_total,
@@ -70,6 +71,36 @@ def make_admin_handler(gw):
                         getattr(gw.jwt_verifier, "rejected_total", 0),
                 }) + gw.registry.render()).encode()
                 ctype = "text/plain"
+            elif self.path == "/metricsz":
+                # Fleet rollup (JSON, not prometheus exposition): the
+                # per-route affinity outcome counters — affine hits vs
+                # pressure spills vs directory-steered spills — plus
+                # the prefix-directory stats and the per-backend
+                # depth/KV-fill the spill decisions read. One curl
+                # answers "is locality holding, and when it breaks,
+                # does the directory catch the spill?" — previously
+                # spills were only visible per-replica.
+                with gw._affinity_lock:
+                    routes = {name: dict(per)
+                              for name, per in gw.route_affinity.items()}
+                totals = {"affine": 0, "spill": 0, "directory": 0}
+                for per in routes.values():
+                    for k in totals:
+                        totals[k] += per.get(k, 0)
+                upstreams = {}
+                for svc, depth in gw.load.snapshot().items():
+                    upstreams.setdefault(svc, {})["in_flight"] = depth
+                for svc, fill in gw.kv_fill.snapshot().items():
+                    upstreams.setdefault(svc, {})["kv_fill"] = fill
+                body = json.dumps({
+                    "routes": routes,
+                    "totals": totals,
+                    "affine_spills_total": gw.affine_spills,
+                    "directory_hits_total": gw.directory_hits,
+                    "directory": gw.kv_directory.stats(),
+                    "upstreams": upstreams,
+                }).encode()
+                ctype = "application/json"
             elif self.path.partition("?")[0] == "/debug/requests":
                 body, ctype = render_debug(gw.trace,
                                            self.path.partition("?")[2])

@@ -384,8 +384,26 @@ def make_proxy_handler(gw):
                     fill = gw.kv_fill.fill(picked, gw.resolve)
                 over_kv = (fill is not None
                            and fill >= route.kv_pressure)
+                spill_kind = None
                 if (over_depth or over_kv) and len(order) > 1:
-                    spill = gw.load.least_loaded(order[1:])
+                    # Directory-aware spill (fleet KV economy): prefer a
+                    # backend already advertising this prefix — its trie
+                    # (or peer-importable tier) is warm, so the spilled
+                    # request pays a tail prefill instead of a full one.
+                    # The directory only changes WHICH backend takes the
+                    # spill, never WHETHER it happens: every candidate
+                    # still has to actually relieve the pressure that
+                    # triggered it (guards below), or the key stays home.
+                    spill = None
+                    if key is not None:
+                        spill = next(
+                            (h for h in gw.kv_directory.holders(key)
+                             if h in order[1:]), None)
+                        if spill is not None:
+                            spill_kind = "directory"
+                    if spill is None:
+                        spill = gw.load.least_loaded(order[1:])
+                        spill_kind = "spill"
                     if spill is not None and over_depth and \
                             gw.load.depth(spill) >= gw.load.depth(picked):
                         spill = None  # everyone is at least as deep
@@ -396,6 +414,14 @@ def make_proxy_handler(gw):
                     if spill is not None:
                         picked = spill
                         gw.affine_spills += 1
+                    else:
+                        spill_kind = None
+                if key is not None:
+                    gw.note_affinity(route.name, spill_kind or "affine")
+                    # The picked backend is about to prefill (and pool)
+                    # this prefix — advertise it so the NEXT spill of
+                    # the same key prefers this backend over cold ones.
+                    gw.kv_directory.publish(key, picked, tier="route")
             elif route.strategy == "hash-split":
                 # Progressive delivery: the key's stable hash picks a
                 # VERSION group (so an affine prefix sees exactly one

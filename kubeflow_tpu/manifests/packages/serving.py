@@ -95,6 +95,21 @@ REST_PORT = 8500
                   "misses re-import them, QoS suspensions park live "
                   "streams' KV there — size the pod's memory request "
                   "to cover it"),
+        ParamSpec("kv_directory_size", 0,
+                  "fleet KV economy: affinity keys the prefix->holder "
+                  "directory tracks (0 disables; requires "
+                  "kv_layout=paged). Local misses pull the deepest "
+                  "advertised prefix from the holding peer via :kv"),
+        ParamSpec("cold_store_ref", "",
+                  "shared cold content-addressed KV store "
+                  "('mem://<name>[?bytes=<n>]'; empty disables): "
+                  "host-tier evictions demote payloads there; the "
+                  "weights epoch rides the content key so live pushes "
+                  "invalidate by construction"),
+        ParamSpec("kv_import_crossover_tokens", 0,
+                  "minimum prefill tokens a peer/cold import must save "
+                  "over the best local tier before the pull is worth "
+                  "its fixed cost (0 = any strictly deeper match)"),
         ParamSpec("qos_tenants", "",
                   "multi-tenant QoS: 'name=weight[:rate[:burst"
                   "[:priority]]]' comma-separated (empty disables); "
@@ -133,6 +148,9 @@ def tpu_serving(
     cp_shards: int,
     pp_stages: int,
     host_kv_bytes: int,
+    kv_directory_size: int,
+    cold_store_ref: str,
+    kv_import_crossover_tokens: int,
     qos_tenants: str,
     qos_aging_s: float,
     enable_prometheus: bool,
@@ -174,6 +192,13 @@ def tpu_serving(
         args.insert(-1, f"--pp-stages={pp_stages}")
     if host_kv_bytes:
         args.insert(-1, f"--host-kv-bytes={host_kv_bytes}")
+    if kv_directory_size:
+        args.insert(-1, f"--kv-directory-size={kv_directory_size}")
+    if cold_store_ref:
+        args.insert(-1, f"--cold-store-ref={cold_store_ref}")
+    if kv_import_crossover_tokens:
+        args.insert(-1, "--kv-import-crossover-tokens="
+                    f"{kv_import_crossover_tokens}")
     if qos_tenants:
         args.insert(-1, f"--qos-tenants={qos_tenants}")
         args.insert(-1, f"--qos-aging-s={qos_aging_s}")

@@ -72,7 +72,11 @@ _ENGINE_KEY_ALIASES = {"tpShards": "tp_shards",
                        "ppStages": "pp_stages",
                        "prefillChunkTokens": "prefill_chunk_tokens",
                        "maxPromptLen": "max_prompt_len",
-                       "hostKvBytes": "host_kv_bytes"}
+                       "hostKvBytes": "host_kv_bytes",
+                       "kvDirectorySize": "kv_directory_size",
+                       "coldStoreRef": "cold_store_ref",
+                       "importCrossoverTokens":
+                           "kv_import_crossover_tokens"}
 
 
 def _qos_params(spec: dict) -> dict:

@@ -132,6 +132,26 @@ def main(argv=None) -> int:
                         "misses re-import them (second-chance cache), "
                         "and QoS suspensions park live streams' KV "
                         "there until resume")
+    p.add_argument("--kv-directory-size", type=int, default=0,
+                   help="fleet KV economy: distinct prefix affinity "
+                        "keys the prefix->holder directory tracks "
+                        "(paged layout; 0 disables). Local misses "
+                        "probe directory hints and pull the deepest "
+                        "advertised prefix from the holding peer over "
+                        "the :kv handoff endpoint, prefilling only "
+                        "the tail")
+    p.add_argument("--cold-store-ref", default="",
+                   help="shared cold content-addressed KV store "
+                        "('mem://<name>[?bytes=<n>]'; empty disables): "
+                        "host-tier evictions demote payloads there "
+                        "before dropping bytes; the weights epoch "
+                        "rides the content key, so a live weight push "
+                        "invalidates pre-swap blobs by construction")
+    p.add_argument("--kv-import-crossover-tokens", type=int, default=0,
+                   help="minimum prefill tokens a peer/cold import "
+                        "must save over the best local tier before "
+                        "the pull is worth its fixed cost; 0 imports "
+                        "any strictly deeper match")
     p.add_argument("--qos-tenants", default="",
                    help="multi-tenant QoS spec: 'name=weight[:rate"
                         "[:burst[:priority]]]' comma-separated (empty "
@@ -220,6 +240,25 @@ def main(argv=None) -> int:
         # The tier stores exported BLOCK payloads; dense rows have no
         # blocks to demote or re-import.
         p.error("--host-kv-bytes requires --kv-layout=paged")
+    if args.kv_directory_size < 0:
+        p.error("--kv-directory-size must be >= 0")
+    if args.kv_import_crossover_tokens < 0:
+        p.error("--kv-import-crossover-tokens must be >= 0")
+    if ((args.kv_directory_size or args.cold_store_ref)
+            and args.kv_layout != "paged"):
+        # The economy imports land through the paged scatter; dense
+        # rows have no block pool to install a pulled prefix into.
+        p.error("--kv-directory-size/--cold-store-ref require "
+                "--kv-layout=paged")
+    if args.cold_store_ref:
+        from kubeflow_tpu.serving.cold_store import cold_store_from_ref
+
+        try:
+            cold_store_from_ref(args.cold_store_ref)
+        except ValueError as e:
+            # A typo'd store URL must fail the rollout at flag-parse
+            # time, not serve silently without its cold tier.
+            p.error(f"--cold-store-ref: {e}")
     if args.qos_tenants:
         if args.decode_mode != "continuous":
             # QoS ordering lives in the continuous pop loop; silently
@@ -282,6 +321,9 @@ def main(argv=None) -> int:
             cp_shards=args.cp_shards,
             pp_stages=args.pp_stages,
             host_kv_bytes=args.host_kv_bytes,
+            kv_directory_size=args.kv_directory_size,
+            cold_store_ref=args.cold_store_ref,
+            kv_import_crossover_tokens=args.kv_import_crossover_tokens,
             qos_tenants=args.qos_tenants,
             qos_aging_s=args.qos_aging_s,
             dtype=args.dtype,

@@ -92,12 +92,17 @@ from kubeflow_tpu.models.decode import (
 )
 from kubeflow_tpu.observability.metrics import MetricRegistry
 from kubeflow_tpu.observability.tracing import TraceStore
+from kubeflow_tpu.serving.affinity import (
+    DEFAULT_AFFINITY_TOKENS,
+    prefix_affinity_key,
+)
 from kubeflow_tpu.serving.engine import pow2_bucket
 from kubeflow_tpu.serving.kv_allocator import (
     BlockAllocator,
     kv_bytes_per_token,
 )
-from kubeflow_tpu.serving.kv_tier import HostKvTier
+from kubeflow_tpu.serving.kv_directory import COLD_HOLDER
+from kubeflow_tpu.serving.kv_tier import HostKvTier, payload_nbytes
 from kubeflow_tpu.serving.prefix_cache import PrefixCache
 from kubeflow_tpu.serving.qos import (
     DEFAULT_TENANT,
@@ -284,7 +289,13 @@ class ContinuousDecoder:
                  prefill_chunk_tokens: int = 0,
                  max_prompt_len: int = 0,
                  cp_shards: int = 1,
-                 pp_stages: int = 1):
+                 pp_stages: int = 1,
+                 kv_directory=None,
+                 cold_store=None,
+                 peer_fetch=None,
+                 kv_import_crossover_tokens: int = 0,
+                 kv_affinity_tokens: int = 0,
+                 replica_name: str = ""):
         # Model-parallel serving: tp_shards > 1 runs THIS replica's
         # decode executables over a tp-wide tensor mesh — weights carry
         # the Megatron column/row split from the model's partition
@@ -568,6 +579,34 @@ class ContinuousDecoder:
             kv_bytes_per_token(cfg.n_layers, cfg.n_kv_heads, cfg.head_dim,
                                jnp.dtype(cfg.dtype).itemsize, kv_dtype)
             if self._alloc is not None else 0)
+        # Fleet KV economy (HBM -> host -> PEER -> COLD): the shared
+        # prefix->holder directory (serving/kv_directory.py), the
+        # content-addressed cold store (serving/cold_store.py), and the
+        # peer-pull callable the fleet/server wires in
+        # (``peer_fetch(holder, tokens, version) -> {"envelope": packed,
+        # "weights_version": v} | None``). A local trie+host miss probes
+        # the directory ON THE CALLER THREAD in submit() — never under
+        # a decoder lock — and installs the imported prefix so the
+        # pop-time plan sees an ordinary trie hit.
+        # ``kv_import_crossover_tokens`` is the recompute-vs-import
+        # crossover: the per-pull fixed cost (RTT + envelope
+        # pack/unpack + scatter dispatch) amortizes over matched
+        # tokens, so importing pays only when the remote match beats
+        # the best LOCAL tier by at least this many tokens (0 = any
+        # strictly-deeper match imports).
+        if (kv_directory is not None or cold_store is not None) \
+                and kv_layout != "paged":
+            raise ValueError(
+                "the fleet KV economy (kv_directory/cold_store) "
+                "requires kv_layout='paged'")
+        self.kv_directory = kv_directory
+        self.cold_store = cold_store
+        self._peer_fetch = peer_fetch
+        self.replica_name = str(replica_name or "")
+        self.kv_import_crossover_tokens = max(
+            0, int(kv_import_crossover_tokens))
+        self.kv_affinity_tokens = (int(kv_affinity_tokens)
+                                   or DEFAULT_AFFINITY_TOKENS)
         # Head-of-line bypass: how many memory-blocked candidates a
         # round may skip past looking for a smaller request that fits,
         # and how many blocked rounds age a head into an unskippable
@@ -624,6 +663,17 @@ class ContinuousDecoder:
         self.kv_suspends = 0          # live streams parked to the host tier
         self.kv_resumes = 0           # parked streams re-admitted
         self.kv_host_hits = 0         # trie misses served by the host tier
+        # Fleet KV-economy counters (zero without a directory/cold store).
+        self.kv_peer_hits = 0         # prefixes imported from a peer replica
+        self.kv_peer_misses = 0       # probes that found nothing importable
+        self.kv_peer_import_bytes = 0  # payload bytes pulled from peers
+        self.kv_peer_fetch_failures = 0  # dead holder / refused pull
+        self.kv_cold_hits = 0         # prefixes imported from the cold store
+        self.kv_cold_demotions = 0    # host evictions packed into cold
+        self.kv_cold_import_bytes = 0  # payload bytes promoted from cold
+        self.kv_import_stale_refused = 0  # envelopes refused: stale epoch
+        self.kv_import_skipped_crossover = 0  # gains under the threshold
+        self.kv_directory_publishes = 0  # holder hints this replica wrote
         self.qos_deadline_shed = 0    # requests shed past their deadline
         self.hol_bypasses = 0         # admissions that jumped a blocked head
         # Decode service per tenant (tokens emitted) — the weighted-fair
@@ -720,6 +770,21 @@ class ContinuousDecoder:
             # Trie evictions must return the entry's refcounted blocks
             # to the pool; remove() fires this under the prefix lock.
             self.prefix_cache.on_evict = self._drop_entry_blocks
+        if self._host_tier is not None:
+            # Host-tier observability (the directory publish path must
+            # be visible to size the tier): eviction-age distribution;
+            # occupancy/high-water ride metrics() gauges.
+            self._h_host_evict_age = self.registry.histogram(
+                "serving_kv_host_eviction_age_seconds",
+                "Idle time a demoted payload sat in the host tier "
+                "before LRU pressure evicted it")
+            self._host_tier.eviction_age_observe = \
+                self._h_host_evict_age.observe
+            if self.cold_store is not None:
+                # The economy's demotion chain: host-tier evictions
+                # pack into the cold store (and publish the hint)
+                # BEFORE the bytes drop.
+                self._host_tier.on_evict = self._demote_to_cold
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -773,6 +838,19 @@ class ContinuousDecoder:
         req.timeline.event("submit", prompt_tokens=len(req.tokens),
                            want=req.want, tenant=req.tenant,
                            priority=req.priority)
+        if self.kv_directory is not None or self.cold_store is not None:
+            # Fleet miss-path probe (trie -> host -> peer -> cold) on
+            # the CALLER's thread, before the request enters the queue:
+            # the pop loop plans prefixes under the scheduler condition,
+            # where a blocking peer fetch would stall every submit. A
+            # successful import lands in the trie, so pop-time planning
+            # sees an ordinary local hit. Probes are best-effort — an
+            # import failure must never fail the submit it was trying
+            # to speed up.
+            try:
+                self._maybe_import_remote(req.tokens, req.timeline)
+            except Exception:
+                pass
         with self._cv:
             if self._stopped:
                 req.timeline.close(error=RuntimeError("decoder is stopped"))
@@ -867,7 +945,10 @@ class ContinuousDecoder:
             # path (the crash drain evicts the whole trie): losing the
             # second-chance copy is fine, losing the free() is a leak.
             return
-        self._host_tier.put(key, payload, plen, version=entry.version)
+        if self._host_tier.put(key, payload, plen,
+                               version=entry.version):
+            self._publish_directory(key, plen, entry.version,
+                                    tier="host")
 
     def _set_table_row(self, slot: int, blocks: list[int]) -> None:
         """Point ``slot``'s host block-table row at ``blocks`` (sentinel
@@ -1360,6 +1441,11 @@ class ContinuousDecoder:
                     jnp.int32(slot))
             with self._mlock:
                 self.prefix_inserts += 1
+            plen = len(key)
+            if entry.blocks:
+                plen = min(plen, len(entry.blocks) * self.kv_block_size)
+            self._publish_directory(key, plen, req.weights_version,
+                                    tier="hbm")
 
     def _release_pin(self, req: _Request) -> None:
         if req.pinned_prefix is not None and self.prefix_cache is not None:
@@ -1705,6 +1791,10 @@ class ContinuousDecoder:
                 with self._mlock:
                     self.prefix_inserts += 1
                 imported = True
+        if imported:
+            # tpu-lint: disable=lock-inconsistent-guard -- epoch fence; hints validate on pull
+            ver = self.weights_version if version is None else int(version)
+            self._publish_directory(key, len(key), ver, tier="hbm")
         return imported
 
     def _promote_host_prefix(self, tokens: list[int],
@@ -1749,6 +1839,292 @@ class ContinuousDecoder:
             self.kv_host_hits += 1
         if timeline is not None:
             timeline.event("promote", prefix_len=depth)
+        return True
+
+    # -- fleet KV economy (HBM -> host -> peer -> cold) ----------------
+
+    @staticmethod
+    def _slice_payload(payload: dict, nblk: int) -> dict:
+        """Covering slice of a handoff payload's leading ``nblk``
+        blocks (causality: the leading blocks back any shorter depth,
+        fp arrays and int8 {"q","scale"} dicts alike)."""
+
+        def _s(node):
+            if isinstance(node, dict):
+                return {k: _s(v) for k, v in node.items()}
+            return node[:, :nblk]
+
+        return {side: _s(payload[side]) for side in ("k", "v")}
+
+    def _publish_directory(self, key_tokens, prefix_len: int,
+                           version: int, *, tier: str) -> None:
+        """Advertise a held prefix to the fleet directory (keyed by the
+        same affinity hash the gateway routes on). Cheap enough for the
+        hot publish/demote paths: one leaf-locked dict write, no fleet
+        round-trip — the directory stores hints and the pull validates."""
+        if self.kv_directory is None:
+            return
+        holder = COLD_HOLDER if tier == "cold" else self.replica_name
+        if not holder:
+            return  # anonymous replica: nothing a peer could pull from
+        key = prefix_affinity_key(key_tokens, self.kv_affinity_tokens)
+        self.kv_directory.publish(key, holder,
+                                  prefix_len=int(prefix_len),
+                                  version=int(version), tier=tier)
+        with self._mlock:
+            self.kv_directory_publishes += 1
+
+    def _demote_to_cold(self, entry) -> None:
+        """Host-tier eviction hook (HostKvTier.on_evict, fired under
+        the prefix lock): pack the dying payload into the shared
+        content-addressed cold store and publish the hint BEFORE the
+        bytes drop — the long tail demotes instead of vanishing. The
+        epoch rides the content key, so a pre-swap payload parked here
+        is unreachable to post-swap lookups by construction."""
+        # tpu-lint: disable=lock-inconsistent-guard -- epoch fence; stale payloads just drop
+        if entry.version != self.weights_version:
+            return  # stale epoch: parking it would waste cold bytes
+        if self.cold_store is None or entry.prefix_len < 1:
+            return
+        handoff = {"tokens": list(entry.key[: entry.prefix_len]),
+                   "prefix_len": int(entry.prefix_len),
+                   "block_size": self.kv_block_size,
+                   "kv_dtype": self.kv_dtype,
+                   "tp_shards": self.tp_shards,
+                   "cp_shards": self.cp_shards,
+                   "pp_stages": self.pp_stages,
+                   "payload": entry.payload}
+        if self.cold_store.put(handoff, version=entry.version) is None:
+            return
+        with self._mlock:
+            self.kv_cold_demotions += 1
+        self._publish_directory(entry.key, entry.prefix_len,
+                                entry.version, tier="cold")
+
+    def export_prefix(self, tokens: list[int]) -> dict:
+        """Serve a peer's KV pull: export the deepest cached prefix of
+        ``tokens`` this replica holds — trie (device blocks, one export
+        round-trip) or host tier (already host-side, free) — as a PR-9
+        handoff dict stamped with the live weights epoch
+        (``weights_version`` key; the requester refuses the envelope if
+        its own epoch has moved on, so a mid-pull weight push degrades
+        to a refusal, never to garbage KV).
+
+        Raises ``KeyError`` when nothing matches — the directory hint
+        that sent the requester here was stale; it withdraws the hint
+        and falls through to the cold store or a plain prefill."""
+        if self._alloc is None:
+            raise ValueError("prefix export requires kv_layout='paged'")
+        toks = [int(t) for t in tokens]
+        cache = self.prefix_cache
+        entry, depth, host = None, 0, None
+        with self._prefix_lock:
+            # tpu-lint: disable=lock-inconsistent-guard -- epoch fence; requester re-validates
+            live = self.weights_version
+            if cache is not None:
+                m = cache.match(toks)  # pins against eviction
+                if m is not None:
+                    entry, depth = m
+                    depth = min(depth, len(entry.blocks or ())
+                                * self.kv_block_size)
+                    if depth < cache.min_len or \
+                            getattr(entry, "version", 0) != live:
+                        cache.release(entry)
+                        entry, depth = None, 0
+            if self._host_tier is not None:
+                hm = self._host_tier.match(toks, live)
+                if hm is not None and hm[1] > depth:
+                    host = hm
+        try:
+            if host is not None:
+                hentry, plen = host
+                payload = self._slice_payload(
+                    hentry.payload, self._alloc.blocks_for(plen))
+            elif entry is not None:
+                plen = depth
+                ids = list(entry.blocks[: self._alloc.blocks_for(plen)])
+                payload = self._export_ids(ids)
+            else:
+                raise KeyError("no cached prefix to export")
+        finally:
+            if entry is not None:
+                with self._prefix_lock:
+                    cache.release(entry)
+        with self._mlock:
+            self.kv_handoff_exports += 1
+            self.kv_handoff_tokens += plen
+        return {"tokens": toks[:plen], "prefix_len": plen,
+                "block_size": self.kv_block_size,
+                "kv_dtype": self.kv_dtype, "tp_shards": self.tp_shards,
+                "cp_shards": self.cp_shards, "pp_stages": self.pp_stages,
+                "weights_version": live, "payload": payload}
+
+    def _local_prefix_depth(self, toks: list[int]) -> tuple[int, int]:
+        """(best local tier depth, live epoch) for the crossover check:
+        the deepest of trie and host-tier match at the live weights
+        epoch — anything a remote import must BEAT to be worth its
+        fixed pull cost."""
+        cache = self.prefix_cache
+        with self._prefix_lock:
+            # tpu-lint: disable=lock-inconsistent-guard -- epoch fence; install re-validates
+            live = self.weights_version
+            local = 0
+            m = cache.match(toks)
+            if m is not None:
+                ent, d = m
+                cache.release(ent)
+                if getattr(ent, "version", 0) == live:
+                    local = min(d, len(ent.blocks or ())
+                                * self.kv_block_size)
+            if self._host_tier is not None:
+                hm = self._host_tier.match(toks, live)
+                if hm is not None:
+                    local = max(local, hm[1])
+        return local, live
+
+    def _maybe_import_remote(self, tokens: list[int],
+                             timeline=None) -> bool:
+        """The fleet miss path: trie -> host -> PEER -> COLD ->
+        prefill. Runs on the CALLER thread in :meth:`submit` with no
+        decoder lock held across a fetch (the pop loop plans prefixes
+        under the scheduler condition — blocking I/O there would stall
+        every submit; the tpu-lint lock-blocking-call fixture pair pins
+        the shape). A successful import installs through
+        :meth:`_install_prefix_payload`, so the pop-time plan sees an
+        ordinary trie hit and prefills only the tail."""
+        cache = self.prefix_cache
+        if cache is None or self._alloc is None:
+            return False
+        if self.kv_directory is None and self.cold_store is None:
+            return False
+        toks = [int(t) for t in tokens]
+        cap = min(len(toks) - 1, self.prefill_len)
+        if cap < cache.min_len:
+            return False
+        local, live = self._local_prefix_depth(toks)
+        # Recompute-vs-import crossover: the pull's fixed cost (RTT +
+        # envelope codec + scatter dispatch) only amortizes when the
+        # import saves at least this many prefill tokens over the best
+        # local tier.
+        want = max(cache.min_len,
+                   local + max(1, self.kv_import_crossover_tokens))
+        if want > cap:
+            return False
+        key = prefix_affinity_key(toks, self.kv_affinity_tokens)
+        best_remote = 0
+        if self._import_from_peers(key, toks, cap, want, live,
+                                   timeline):
+            return True
+        if self.kv_directory is not None:
+            for hint in self.kv_directory.lookup(key, version=live):
+                best_remote = max(best_remote, hint.prefix_len)
+        if self._import_from_cold(toks, cap, want, live, timeline):
+            return True
+        if self.cold_store is not None:
+            best_remote = max(best_remote,
+                              self.cold_store.peek_depth(toks, live))
+        with self._mlock:
+            if local < best_remote < want:
+                self.kv_import_skipped_crossover += 1
+            else:
+                self.kv_peer_misses += 1
+        return False
+
+    def _import_from_peers(self, key: str, toks: list[int], cap: int,
+                           want: int, live: int, timeline) -> bool:
+        """Probe directory holders deepest-first; the fetch validates
+        everything the hint merely promised. A dead or evicted holder
+        costs one withdrawn hint, never a hang — the next holder, the
+        cold store, and plain prefill are all still behind it."""
+        if self.kv_directory is None or self._peer_fetch is None:
+            return False
+        hints = [h for h in self.kv_directory.lookup(
+                     key, exclude=(self.replica_name, COLD_HOLDER),
+                     version=live)
+                 if h.prefix_len >= want]
+        for hint in hints:
+            try:
+                got = self._peer_fetch(hint.holder, toks, live)
+            except Exception:
+                got = None
+            if got is None:
+                with self._mlock:
+                    self.kv_peer_fetch_failures += 1
+                self.kv_directory.withdraw(key, hint.holder)
+                continue
+            try:
+                from kubeflow_tpu.serving import handoff as handoff_mod
+
+                h = handoff_mod.unpack(got["envelope"])
+                ver = int(got.get("weights_version", live))
+            except (ValueError, KeyError, TypeError):
+                with self._mlock:
+                    self.kv_peer_fetch_failures += 1
+                self.kv_directory.withdraw(key, hint.holder)
+                continue
+            if self._install_remote(h, ver, toks, cap, want,
+                                    timeline, tier="peer"):
+                return True
+        return False
+
+    def _import_from_cold(self, toks: list[int], cap: int, want: int,
+                          live: int, timeline) -> bool:
+        if self.cold_store is None:
+            return False
+        got = self.cold_store.match(toks, live)
+        if got is None:
+            return False
+        h, depth = got
+        return self._install_remote(h, live, toks, min(cap, depth),
+                                    want, timeline, tier="cold")
+
+    def _install_remote(self, h: dict, ver: int, toks: list[int],
+                        cap: int, want: int, timeline,
+                        tier: str) -> bool:
+        """Validate a fetched envelope against THIS pool and the LIVE
+        weights epoch, then install its covering slice. The epoch
+        re-read is the mid-pull staleness gate: a weight push that
+        landed while the envelope was in flight makes ``ver`` stale
+        and the envelope is refused — counted, never installed."""
+        if int(h["block_size"]) != self.kv_block_size or \
+                str(h.get("kv_dtype", "fp")) != self.kv_dtype:
+            with self._mlock:
+                self.kv_peer_fetch_failures += 1
+            return False
+        with self._state_lock:
+            now_live = self.weights_version
+        if int(ver) != now_live:
+            with self._mlock:
+                self.kv_import_stale_refused += 1
+            if timeline is not None:
+                timeline.event("kv_import_refused", tier=tier,
+                               stale_version=int(ver))
+            return False
+        # Actual matched depth (the hint and even the envelope's own
+        # prefix_len may be optimistic — a different prompt family can
+        # share an affinity key).
+        ht = h["tokens"]
+        lim = min(int(h["prefix_len"]), cap, len(ht))
+        d = 0
+        while d < lim and int(ht[d]) == toks[d]:
+            d += 1
+        if d < want:
+            return False
+        payload = self._slice_payload(h["payload"],
+                                      self._alloc.blocks_for(d))
+        if not self._install_prefix_payload(tuple(toks[:d]), payload,
+                                            version=now_live):
+            return False
+        nbytes = payload_nbytes(payload)
+        with self._mlock:
+            if tier == "cold":
+                self.kv_cold_hits += 1
+                self.kv_cold_import_bytes += nbytes
+            else:
+                self.kv_peer_hits += 1
+                self.kv_peer_import_bytes += nbytes
+        if timeline is not None:
+            timeline.event("kv_import", tier=tier, prefix_len=d)
         return True
 
     # -- live weight streaming -----------------------------------------
@@ -2632,6 +3008,17 @@ class ContinuousDecoder:
                 "kv_suspends": self.kv_suspends,
                 "kv_resumes": self.kv_resumes,
                 "kv_host_hits": self.kv_host_hits,
+                "kv_peer_hits": self.kv_peer_hits,
+                "kv_peer_misses": self.kv_peer_misses,
+                "kv_peer_import_bytes": self.kv_peer_import_bytes,
+                "kv_peer_fetch_failures": self.kv_peer_fetch_failures,
+                "kv_cold_hits": self.kv_cold_hits,
+                "kv_cold_demotions": self.kv_cold_demotions,
+                "kv_cold_import_bytes": self.kv_cold_import_bytes,
+                "kv_import_stale_refused": self.kv_import_stale_refused,
+                "kv_import_skipped_crossover":
+                    self.kv_import_skipped_crossover,
+                "kv_directory_publishes": self.kv_directory_publishes,
                 "qos_deadline_shed": self.qos_deadline_shed,
                 "hol_bypasses": self.hol_bypasses,
                 "qos_enabled": self.qos is not None,
@@ -2686,6 +3073,19 @@ class ContinuousDecoder:
             snap["kv_host_demotions"] = tier.demotions if tier else 0
             snap["kv_host_evictions"] = tier.evictions if tier else 0
             snap["kv_host_promotions"] = tier.promotions if tier else 0
+            snap["kv_host_tier_high_water_bytes"] = (
+                tier.high_water_bytes if tier else 0)
+        # Shared-tier stats carry their own leaf locks (the directory
+        # and cold store are fleet-shared objects — other replicas'
+        # submit probes touch them concurrently with this snapshot).
+        if self.cold_store is not None:
+            cold = self.cold_store.stats()
+            snap["kv_cold_store_bytes"] = cold["bytes_in_use"]
+            snap["kv_cold_store_bytes_total"] = cold["capacity_bytes"]
+            snap["kv_cold_store_entries"] = cold["entries"]
+            snap["kv_cold_store_evictions"] = cold["evictions"]
+        if self.kv_directory is not None:
+            snap["kv_directory_keys"] = self.kv_directory.stats()["keys"]
         # Histogram-backed latency quantiles (ttft_avg_s above stays for
         # backward compatibility — bench_serving.py and dashboards read
         # it — but the distribution is what autoscaling policies need).

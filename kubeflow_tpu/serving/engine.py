@@ -177,6 +177,25 @@ class EngineConfig:
     # bytes), and QoS suspensions park live streams' KV here until
     # resume.
     host_kv_bytes: int = 0
+    # Fleet KV economy: distinct affinity keys the prefix→holder
+    # directory tracks (paged layout; 0 disables the economy — the
+    # tiers above stay replica-private). With a directory, the miss
+    # path runs trie → host → peer → cold → prefill: local misses
+    # probe directory hints, pull the deepest advertised prefix from
+    # the holding peer over the PR-9 handoff envelope (:kv endpoint),
+    # and prefill only the tail.
+    kv_directory_size: int = 0
+    # Shared cold content-addressed store ref ("mem://<name>[?bytes=n]";
+    # empty disables). Host-tier evictions demote their payload here
+    # before dropping bytes; the weights epoch rides the content key,
+    # so a live weight push invalidates every pre-swap blob by
+    # construction.
+    cold_store_ref: str = ""
+    # Recompute-vs-import crossover: minimum prefill tokens a remote
+    # (peer/cold) import must save over the best LOCAL tier before the
+    # pull is worth its fixed cost (RTT + envelope codec + scatter).
+    # 0 = import any strictly deeper match.
+    kv_import_crossover_tokens: int = 0
     # Multi-tenant QoS tenants: "name=weight[:rate[:burst[:priority]]]"
     # comma-separated (serving/qos.py:parse_tenants). Empty disables
     # QoS entirely — FIFO admission, one implicit tenant, exactly the

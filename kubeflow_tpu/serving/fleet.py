@@ -159,6 +159,26 @@ class DecoderFleet:
         self._weights_installed: dict[str, int] = {}
         self.weight_pushes = 0          # broadcast_weights calls
         self.weight_push_failures = 0   # per-replica push failures
+        # Fleet KV economy: adopt the members' shared prefix directory
+        # and cold store (every economy-enabled replica is constructed
+        # with the SAME instances — the directory is only useful
+        # fleet-wide), and close the loop by installing the in-process
+        # peer-fetch path on any replica that has a directory but no
+        # transport yet: a replica's submit-time probe then pulls the
+        # holder's exported prefix through the PR-9 envelope codec,
+        # exactly the bytes the HTTP ``:kv`` endpoint would ship.
+        self.kv_directory = next(
+            (getattr(d, "kv_directory", None)
+             for d in self._replicas.values()
+             if getattr(d, "kv_directory", None) is not None), None)
+        self.cold_store = next(
+            (getattr(d, "cold_store", None)
+             for d in self._replicas.values()
+             if getattr(d, "cold_store", None) is not None), None)
+        for d in self._replicas.values():
+            if (getattr(d, "kv_directory", None) is not None
+                    and getattr(d, "_peer_fetch", None) is None):
+                d._peer_fetch = self._peer_fetch
 
     # -- membership ----------------------------------------------------
 
@@ -201,8 +221,42 @@ class DecoderFleet:
 
     def mark_dead(self, name: str, cause: Exception | None = None) -> None:
         with self._lock:
-            if name in self._replicas:
-                self._dead.add(name)
+            if name not in self._replicas:
+                return
+            self._dead.add(name)
+        # Sweep the dead replica's directory hints OUTSIDE the fleet
+        # lock (the directory carries its own leaf lock): its advertised
+        # KV died with it, and a requester probing a stale hint would
+        # burn a failed fetch per submit until withdrawal. Cold-tier
+        # hints survive — the cold store outlives any one replica.
+        if self.kv_directory is not None:
+            self.kv_directory.drop_holder(name)
+
+    def _peer_fetch(self, holder: str, tokens, version: int):
+        """In-process peer KV pull (the transport the remote fleet
+        replaces with the ``:kv`` HTTP endpoint): export the deepest
+        cached prefix on ``holder`` and ship it as a packed handoff
+        envelope — the requester unpacks, validates, and refuses it
+        exactly as it would a remote one. Returns None on any miss or
+        holder death; the caller withdraws the hint and falls through
+        (cold tier, then prefill) — a dead holder costs one probe,
+        never a hang."""
+        from kubeflow_tpu.serving import handoff as handoff_mod
+
+        with self._lock:
+            d = self._replicas.get(holder)
+            if d is None or holder in self._dead:
+                return None
+        try:
+            h = d.export_prefix(list(tokens))
+        except KeyError:
+            return None  # hint was stale: holder evicted it meanwhile
+        except Exception as e:  # noqa: BLE001 — death check below
+            if self._is_replica_death(e):
+                self.mark_dead(holder, cause=e)
+            return None
+        ver = h.pop("weights_version", 0)
+        return {"envelope": handoff_mod.pack(h), "weights_version": ver}
 
     @staticmethod
     def _is_replica_death(err: Exception) -> bool:
@@ -539,8 +593,16 @@ class DecoderFleet:
             per[name] = self._replicas[name].metrics()
         agg_keys = ("tokens_emitted", "requests_admitted", "prefix_hits",
                     "prefix_misses", "kv_blocks_in_use", "in_flight",
-                    "queued", "prefill_chunks", "prompt_rejected_too_long")
+                    "queued", "prefill_chunks", "prompt_rejected_too_long",
+                    "prefill_tokens", "kv_peer_hits", "kv_peer_misses",
+                    "kv_peer_import_bytes", "kv_peer_fetch_failures",
+                    "kv_cold_hits", "kv_cold_demotions",
+                    "kv_import_stale_refused")
         agg = {k: sum(m.get(k, 0) for m in per.values()) for k in agg_keys}
+        if self.kv_directory is not None:
+            agg["kv_directory"] = self.kv_directory.stats()
+        if self.cold_store is not None:
+            agg["kv_cold_store"] = self.cold_store.stats()
         agg.update(replicas=per, live=sorted(per),
                    dead=dead, routed=counters["routed"],
                    spilled=counters["spilled"],

@@ -28,6 +28,7 @@ Pure host logic — numpy payloads, no jax — callers serialize access
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 
 
@@ -53,6 +54,10 @@ class TierEntry:
     nbytes: int
     pinned: bool = False
     last_used: int = field(default=0)
+    # Wall-clock of the last touch — the eviction-age histogram's input
+    # (how long demoted bytes sat unreferenced before pressure dropped
+    # them: the signal for sizing the tier and the cold store under it).
+    touched_t: float = field(default=0.0)
     # Weights epoch the payload's K/V was computed under (same contract
     # as PrefixEntry.version): a demoted payload from before a live
     # weight swap must never feed a fresh request's promotion. Pinned
@@ -77,11 +82,21 @@ class HostKvTier:
         self.capacity_bytes = int(capacity_bytes)
         self.bytes_in_use = 0
         self.pinned_bytes = 0
+        self.high_water_bytes = 0  # peak bytes_in_use (occupancy gauge)
         self.evictions = 0
         self.demotions = 0   # puts from trie eviction / suspension
         self.promotions = 0  # gets that fed a device re-import
         self._by_key: dict[tuple[int, ...], TierEntry] = {}
         self._clock = 0
+        # Eviction hooks, fired from _evict_lru under the CALLER's
+        # serialization (the decoder's prefix lock) — so they must stay
+        # CPU-only and must not call back into this tier:
+        # ``on_evict(entry)`` is the fleet economy's demote-to-cold
+        # path (pack the payload, publish the directory hint, BEFORE
+        # the bytes drop); ``eviction_age_observe(seconds)`` feeds the
+        # eviction-age histogram.
+        self.on_evict = None
+        self.eviction_age_observe = None
 
     def __len__(self) -> int:
         return len(self._by_key)
@@ -89,6 +104,7 @@ class HostKvTier:
     def _tick(self, entry: TierEntry) -> None:
         self._clock += 1
         entry.last_used = self._clock
+        entry.touched_t = time.monotonic()
 
     def has(self, key: tuple[int, ...]) -> bool:
         return tuple(key) in self._by_key
@@ -121,6 +137,8 @@ class HostKvTier:
         self._tick(entry)
         self._by_key[key] = entry
         self.bytes_in_use += nbytes
+        self.high_water_bytes = max(self.high_water_bytes,
+                                    self.bytes_in_use)
         if pinned:
             self.pinned_bytes += nbytes
         self.demotions += 1
@@ -136,8 +154,24 @@ class HostKvTier:
         victims = [e for e in self._by_key.values() if not e.pinned]
         if not victims:
             return False
-        self._drop(min(victims, key=lambda e: e.last_used))
+        victim = min(victims, key=lambda e: e.last_used)
+        if self.on_evict is not None:
+            # Demote-before-drop: the hook (cold-store pack + directory
+            # publish) sees the payload while the bytes still exist.
+            # Hook failures must not wedge the eviction — losing the
+            # cold copy degrades one future miss to a prefill.
+            try:
+                self.on_evict(victim)
+            except Exception:
+                pass
+        self._drop(victim)
         self.evictions += 1
+        if self.eviction_age_observe is not None and victim.touched_t:
+            try:
+                self.eviction_age_observe(
+                    max(0.0, time.monotonic() - victim.touched_t))
+            except Exception:
+                pass
         return True
 
     def note_promotion(self) -> None:

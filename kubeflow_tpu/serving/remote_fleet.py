@@ -24,17 +24,33 @@ import json
 import threading
 from http.client import HTTPConnection
 
+from kubeflow_tpu.serving.affinity import (
+    DEFAULT_AFFINITY_TOKENS,
+    prefix_affinity_key,
+)
 from kubeflow_tpu.serving.weights import DEFAULT_CHUNK_BYTES, push_weights
 
 
 class RemoteActorFleet:
     """Round-robin rollout client + weight broadcaster over HTTP
-    model-server targets (``host:port`` each)."""
+    model-server targets (``host:port`` each).
+
+    ``kv_directory`` (optional, a
+    :class:`~kubeflow_tpu.serving.kv_directory.KvDirectory`) makes the
+    round-robin KV-economy aware: a rollout whose prompt prefix is
+    advertised by a live target lands there (the holder's trie/host
+    tier already carries the bytes — RL rollouts share the task prompt,
+    so this is the common case), successful rollouts publish their
+    target as a holder, and a target marked dead has its hints swept —
+    the same directory object the in-process fleet and the gateway
+    maintain, so all three planes agree on who holds what."""
 
     def __init__(self, targets: list[str], model: str, *,
                  weights_max_lag: int = 0,
                  chunk_bytes: int = DEFAULT_CHUNK_BYTES,
-                 timeout: float = 600.0):
+                 timeout: float = 600.0,
+                 kv_directory=None,
+                 affinity_tokens: int = DEFAULT_AFFINITY_TOKENS):
         if not targets:
             raise ValueError("RemoteActorFleet needs at least one target")
         self.targets = list(targets)
@@ -42,6 +58,8 @@ class RemoteActorFleet:
         self.weights_max_lag = int(weights_max_lag)
         self.chunk_bytes = int(chunk_bytes)
         self.timeout = float(timeout)
+        self.kv_directory = kv_directory
+        self.affinity_tokens = int(affinity_tokens)
         self._lock = threading.Lock()
         self._rr = 0
         self._dead: set[str] = set()
@@ -50,6 +68,7 @@ class RemoteActorFleet:
         self.weight_pushes = 0
         self.weight_push_failures = 0
         self.rollouts = 0
+        self.directory_routed = 0  # rollouts placed on an advertised holder
 
     # -- routing -------------------------------------------------------
 
@@ -64,13 +83,28 @@ class RemoteActorFleet:
                 live = fresh or live
         return live
 
-    def _pick(self) -> str:
+    def _pick(self, key: str | None = None) -> str:
         live = self._live()
         if not live:
             raise RuntimeError("every actor target is dead")
+        if key is not None and self.kv_directory is not None:
+            holders = [h for h in self.kv_directory.holders(key)
+                       if h in live]
+            if holders:
+                with self._lock:
+                    self.directory_routed += 1
+                    return holders[0]  # deepest advertised prefix
         with self._lock:
             self._rr += 1
             return live[self._rr % len(live)]
+
+    def _mark_dead(self, target: str) -> None:
+        with self._lock:
+            self._dead.add(target)
+        if self.kv_directory is not None:
+            # The target's advertised KV died with its process; stale
+            # hints would keep steering rollouts at a dead pod.
+            self.kv_directory.drop_holder(target)
 
     # -- rollouts ------------------------------------------------------
 
@@ -82,9 +116,11 @@ class RemoteActorFleet:
             "max_new_tokens": int(max_new_tokens),
             "temperature": float(temperature),
         }]}).encode()
+        key = (prefix_affinity_key(tokens, self.affinity_tokens)
+               if self.kv_directory is not None else None)
         last_err: Exception | None = None
         for _ in range(len(self.targets)):
-            target = self._pick()
+            target = self._pick(key)
             host, _, port_s = target.partition(":")
             try:
                 conn = HTTPConnection(host, int(port_s or 80),
@@ -105,14 +141,54 @@ class RemoteActorFleet:
                 pred = payload["predictions"][0]
                 with self._lock:
                     self.rollouts += 1
+                if key is not None:
+                    # The served prompt's prefix now lives in the
+                    # target's trie (its decoder pools finishing
+                    # prompts) — advertise it so the next rollout
+                    # sharing the prompt lands on the warm replica.
+                    self.kv_directory.publish(
+                        key, target,
+                        prefix_len=max(len(list(tokens)) - 1, 0),
+                        tier="route")
                 return {"tokens": pred.get("tokens", []),
                         "finish_reason": pred.get("finish_reason", "")}
             except (OSError, ValueError, KeyError, IndexError) as e:
                 last_err = e
-                with self._lock:
-                    self._dead.add(target)
+                self._mark_dead(target)
         raise RuntimeError(
             f"every actor target failed; last error: {last_err}")
+
+    def fetch_kv(self, target: str, tokens, version: int = 0):
+        """Peer KV pull over HTTP — the cross-pod transport for a
+        decoder's ``peer_fetch`` hook, shaped to its contract: POST the
+        prompt at the holder's ``:kv`` endpoint and return
+        ``{"envelope": <packed handoff>, "weights_version": v}``, or
+        None on any failure (404 = the holder no longer caches the
+        prefix; the requester withdraws the hint and falls through).
+        ``version`` rides along so the holder can refuse the export
+        outright when its own epoch already moved past the
+        requester's."""
+        host, _, port_s = str(target).partition(":")
+        body = json.dumps({"tokens": [int(t) for t in tokens],
+                           "weights_version": int(version)}).encode()
+        try:
+            conn = HTTPConnection(host, int(port_s or 80),
+                                  timeout=self.timeout)
+            try:
+                conn.request("POST", f"/v1/models/{self.model}:kv",
+                             body=body,
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                payload = json.loads(resp.read() or b"{}")
+            finally:
+                conn.close()
+        except (OSError, ValueError):
+            self._mark_dead(target)
+            return None
+        if resp.status != 200 or "envelope" not in payload:
+            return None
+        return {"envelope": payload["envelope"],
+                "weights_version": int(payload.get("weights_version", 0))}
 
     # -- weight streaming ---------------------------------------------
 
@@ -187,6 +263,7 @@ class RemoteActorFleet:
                 "targets": list(self.targets),
                 "dead": sorted(self._dead),
                 "rollouts": self.rollouts,
+                "directory_routed": self.directory_routed,
                 "weight_pushes": self.weight_pushes,
                 "weight_push_failures": self.weight_push_failures,
                 "weights_latest": self._weights_latest,

@@ -97,12 +97,26 @@ class ModelServer:
             return None
         with self._decoder_lock:
             if self._decoder is None:
+                from kubeflow_tpu.serving.cold_store import (
+                    cold_store_from_ref,
+                )
                 from kubeflow_tpu.serving.continuous import ContinuousDecoder
+                from kubeflow_tpu.serving.kv_directory import KvDirectory
                 from kubeflow_tpu.serving.qos import QosPolicy
 
                 qos = (QosPolicy(self.engine.cfg.qos_tenants,
                                  aging_seconds=self.engine.cfg.qos_aging_s)
                        if self.engine.cfg.qos_tenants else None)
+                # Fleet KV economy: a sized directory turns the local
+                # tiers into fleet-visible ones; the cold ref names the
+                # shared content-addressed store (colocated replicas
+                # resolving the same mem:// name share one instance).
+                # The peer-fetch transport is installed by whichever
+                # fleet wraps this server (in-process: DecoderFleet;
+                # cross-pod: RemoteActorFleet.fetch_kv against :kv).
+                kv_dir = (KvDirectory(self.engine.cfg.kv_directory_size)
+                          if self.engine.cfg.kv_directory_size > 0
+                          else None)
                 self._decoder = ContinuousDecoder(
                     self.engine.params, self.engine.model.config,
                     slots=self.engine.cfg.batch_size,
@@ -132,6 +146,13 @@ class ModelServer:
                     max_prompt_len=self.engine.cfg.max_prompt_len,
                     cp_shards=self.engine.cfg.cp_shards,
                     pp_stages=self.engine.cfg.pp_stages,
+                    kv_directory=kv_dir,
+                    cold_store=cold_store_from_ref(
+                        self.engine.cfg.cold_store_ref),
+                    kv_import_crossover_tokens=(
+                        self.engine.cfg.kv_import_crossover_tokens),
+                    replica_name=(
+                        f"{self.engine.cfg.model}:{self.port}"),
                 )
             return self._decoder
 
@@ -300,6 +321,31 @@ class ModelServer:
             raise ValueError("model does not support generation")
         h = handoff_mod.unpack(body)  # ValueError on garbage -> 400
         return {"imported": bool(self.decoder.import_prompt(h))}
+
+    def handle_kv(self, name: str, body: dict) -> dict:
+        """The fleet KV economy's pull endpoint (``:kv``): a peer
+        replica that saw this server advertised in the prefix directory
+        POSTs its prompt here and gets back the deepest cached prefix
+        as a packed handoff envelope plus the weights epoch that
+        computed it — the requester validates both and refuses stale or
+        mismatched envelopes. A prefix this server no longer caches is
+        a KeyError (HTTP 404): the hint was stale, the requester
+        withdraws it and falls through to the cold tier or a plain
+        prefill."""
+        from kubeflow_tpu.serving import handoff as handoff_mod
+
+        if name != self.engine.cfg.model:
+            raise KeyError(f"model {name!r} not served")
+        if self.decoder is None:
+            raise ValueError("model does not support generation")
+        toks = body.get("tokens")
+        if not isinstance(toks, list) or not toks:
+            raise ValueError("kv pull needs non-empty 'tokens'")
+        h = self.decoder.export_prefix(toks)  # KeyError -> 404 on miss
+        ver = h.pop("weights_version", 0)
+        return {"envelope": handoff_mod.pack(h),
+                "weights_version": ver,
+                "prefix_len": h["prefix_len"]}
 
     # -- live weight streaming -----------------------------------------
     #
@@ -470,6 +516,45 @@ class ModelServer:
                                 d["kv_host_promotions"],
                             "serving_kv_host_evictions_total":
                                 d["kv_host_evictions"],
+                            # High-water occupancy (sizing signal for
+                            # the host tier and the cold store under
+                            # it; the eviction-age histogram rides the
+                            # decoder registry above).
+                            "serving_kv_host_tier_high_water_bytes":
+                                d["kv_host_tier_high_water_bytes"],
+                            # Fleet KV economy (peer + cold tiers):
+                            # hit/miss/bytes per remote tier, the
+                            # staleness refusals that prove mid-pull
+                            # weight pushes degrade safely, and the
+                            # crossover skips (remote KV existed but
+                            # the gain was below the import threshold).
+                            "serving_kv_peer_hits_total":
+                                d["kv_peer_hits"],
+                            "serving_kv_peer_misses_total":
+                                d["kv_peer_misses"],
+                            "serving_kv_peer_import_bytes_total":
+                                d["kv_peer_import_bytes"],
+                            "serving_kv_peer_fetch_failures_total":
+                                d["kv_peer_fetch_failures"],
+                            "serving_kv_cold_hits_total":
+                                d["kv_cold_hits"],
+                            "serving_kv_cold_demotions_total":
+                                d["kv_cold_demotions"],
+                            "serving_kv_cold_import_bytes_total":
+                                d["kv_cold_import_bytes"],
+                            "serving_kv_import_stale_refused_total":
+                                d["kv_import_stale_refused"],
+                            "serving_kv_import_skipped_crossover_total":
+                                d["kv_import_skipped_crossover"],
+                            "serving_kv_directory_publishes_total":
+                                d["kv_directory_publishes"],
+                            # Shared-tier gauges, present only when the
+                            # replica carries the economy objects.
+                            **{f"serving_{k}": d[k] for k in (
+                                "kv_cold_store_bytes",
+                                "kv_cold_store_bytes_total",
+                                "kv_cold_store_entries",
+                                "kv_directory_keys") if k in d},
                             "serving_suspends_total": d["kv_suspends"],
                             "serving_resumes_total": d["kv_resumes"],
                             "serving_deadline_shed_total":
@@ -607,6 +692,10 @@ class ModelServer:
                             self.path.endswith(":import"):
                         name = self.path[len("/v1/models/"):-len(":import")]
                         self._send(200, server.handle_import(name, body))
+                    elif self.path.startswith("/v1/models/") and \
+                            self.path.endswith(":kv"):
+                        name = self.path[len("/v1/models/"):-len(":kv")]
+                        self._send(200, server.handle_kv(name, body))
                     elif self.path.startswith("/v1/models/") and \
                             self.path.endswith(":weights"):
                         name = self.path[len("/v1/models/"):

@@ -1,0 +1,41 @@
+"""Minimized fleet-KV-economy hazard: the peer prefix pull — a
+network round-trip to the holding replica's ``:kv`` endpoint —
+issued UNDER the decoder's prefix lock.
+
+The miss-path contract says the directory probe and the fetch run on
+the submitting caller's thread with NO decoder lock held: the pop
+loop plans prefix hits under the same lock, so a blocked fetch parks
+every admission (and every other submit's probe) behind one peer's
+RTT — or forever, if the holder died mid-pull. The lock-discipline
+checker must flag the fetch (``lock-blocking-call``).
+"""
+
+import threading
+from urllib.request import urlopen
+
+
+class BadPeerImporter:
+    """Pulls a peer's KV envelope with the prefix lock held."""
+
+    def __init__(self, directory):
+        self._prefix_lock = threading.Lock()
+        self._directory = directory
+        self._trie = {}
+
+    def plan_prefix(self, tokens):
+        with self._prefix_lock:
+            return self._trie.get(tuple(tokens))
+
+    def import_remote(self, key, tokens):
+        with self._prefix_lock:
+            if tuple(tokens) in self._trie:
+                return True
+            for hint in self._directory.lookup(key):
+                # BUG: the holder round-trip runs under the lock the
+                # pop loop plans every admission with — one slow (or
+                # dead) peer stalls the whole replica's token cadence.
+                envelope = urlopen(hint.url, timeout=5).read()
+                if envelope:
+                    self._trie[tuple(tokens)] = envelope
+                    return True
+        return False

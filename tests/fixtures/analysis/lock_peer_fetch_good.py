@@ -1,0 +1,36 @@
+"""Fleet-KV-economy GOOD twin: snapshot the miss under the prefix
+lock, run the peer round-trip with NO lock held (the pop loop keeps
+planning admissions against the old trie while the envelope is in
+flight), then re-take the lock only to install the validated bytes —
+a dead holder costs the requester one probe, never the replica's
+token cadence."""
+
+import threading
+from urllib.request import urlopen
+
+
+class GoodPeerImporter:
+    """Probe under the lock; fetch outside; install under it again."""
+
+    def __init__(self, directory):
+        self._prefix_lock = threading.Lock()
+        self._directory = directory
+        self._trie = {}
+
+    def plan_prefix(self, tokens):
+        with self._prefix_lock:
+            return self._trie.get(tuple(tokens))
+
+    def import_remote(self, key, tokens):
+        with self._prefix_lock:
+            if tuple(tokens) in self._trie:
+                return True
+            hints = list(self._directory.lookup(key))
+        for hint in hints:
+            envelope = urlopen(hint.url, timeout=5).read()
+            if not envelope:
+                continue
+            with self._prefix_lock:
+                self._trie[tuple(tokens)] = envelope
+            return True
+        return False

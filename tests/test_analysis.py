@@ -97,6 +97,20 @@ def test_weight_swap_device_put_under_lock_detected():
     assert all(h.symbol == "BadWeightSwap.update_weights" for h in hits)
 
 
+def test_peer_fetch_io_under_prefix_lock_detected():
+    """The fleet KV economy's exposed class: the peer ``:kv``
+    round-trip issued while the decoder's prefix lock — the one the
+    pop loop plans every admission with — is held. The network call
+    must be flagged as a blocking call."""
+    found = _findings(FIXTURES / "lock_peer_fetch_bad.py")
+    hits = [f for f in found if f.rule == "lock-blocking-call"]
+    assert hits, found
+    messages = " ".join(h.message for h in hits)
+    assert "_prefix_lock" in messages
+    assert "urlopen" in messages
+    assert all(h.symbol == "BadPeerImporter.import_remote" for h in hits)
+
+
 def test_pr4_torn_metrics_detected():
     found = _findings(FIXTURES / "lock_torn_metrics_bad.py")
     hits = [f for f in found if f.rule == "lock-inconsistent-guard"]
@@ -164,7 +178,7 @@ def test_metrics_exposition_detected():
 
 def test_good_fixtures_are_clean():
     for name in ("lock_good.py", "lock_elastic_drain_good.py",
-                 "lock_weight_swap_good.py",
+                 "lock_weight_swap_good.py", "lock_peer_fetch_good.py",
                  "thread_lifecycle_good.py",
                  "resource_good.py", "jax_hygiene_good.py",
                  "jax_hygiene_shard_map_good.py",
