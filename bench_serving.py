@@ -2223,6 +2223,234 @@ def _bench_long_context_sweep(args, model) -> dict:
     }
 
 
+def _bench_flash_crowd_sweep(args, model) -> dict:
+    """Flash-crowd elasticity: sub-second replica birth + predictive
+    scale-up vs the reactive cold-boot baseline.
+
+    Legs:
+
+    1. **Cold birth** — a baseline replica boots the slow path FIRST in
+       this process (checkpoint restore from disk, then cold-compiling
+       its whole decode dispatch set against an empty compile cache),
+       then a treatment replica is born the flash-crowd way: weights
+       pulled from the live baseline server over the chunked ``:pull``
+       envelope (no checkpoint store on the hot path) and the dispatch
+       set replayed against the now-populated compile cache (the
+       in-process jit cache stands in for the persistent disk cache a
+       fresh pod replays; the CompileCache manifest accounting is the
+       real machinery either way). Gates: treatment cold-to-first-token
+       >= 5x better with the per-phase (weights/compile/first-token)
+       breakdown recorded, the pulled pytree BYTE-identical to the
+       checkpoint-restored one, and a post-rollout pull returning the
+       pushed epoch's exact bytes (fleet-version consistency).
+    2. **Flash crowd** — a 10x-offered admission storm trickled at a
+       1-replica fleet. The reactive arm gains +1 replica after the
+       BASELINE birth latency (what a checkpoint-booted pod delivers);
+       the predictive arm scale-to-N's three replicas at once after the
+       TREATMENT birth latency (the autoscaler acted on the projected
+       breach and the newborns were born the fast way). Newborns join
+       WARMING (spill-only, no affine share) and are marked warm, so
+       the ramped-admission path is exercised. Gates: predictive TTFT
+       p99 at least 1.2x better than reactive, greedy probe tokens
+       byte-identical across arms, zero leaked blocks.
+    """
+    import shutil
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from kubeflow_tpu.models.registry import get_model
+    from kubeflow_tpu.serving.continuous import ContinuousDecoder
+    from kubeflow_tpu.serving.engine import EngineConfig
+    from kubeflow_tpu.serving.fleet import DecoderFleet
+    from kubeflow_tpu.serving.server import ModelServer
+    from kubeflow_tpu.serving.weights import (
+        flatten_namespaced,
+        pull_weights,
+        push_weights,
+        split_namespaces,
+    )
+    from kubeflow_tpu.train.optimizers import OptimizerConfig
+    from kubeflow_tpu.train.trainer import init_state
+    from kubeflow_tpu.train import checkpoint as ckpt_lib
+
+    spec = get_model(model)
+    tmp = tempfile.mkdtemp(prefix="flash_crowd_")
+    ckpt_dir = os.path.join(tmp, "ckpt")
+    cache_dir = os.path.join(tmp, "compile-cache")
+    gen_n, slots, block = 8, 4, 8
+
+    def eng_cfg(**kw):
+        return EngineConfig(
+            model=model, decode_mode="continuous", batch_size=slots,
+            max_seq_len=32, max_new_tokens=gen_n, kv_layout="paged",
+            kv_block_size=block, prefix_cache_slots=4,
+            prefix_cache_min_len=8, compile_cache_dir=cache_dir, **kw)
+
+    # The checkpoint the baseline replica restores — same seed as the
+    # checkpoint-less init path, so every birth flavor carries the SAME
+    # pytree and byte-identity gates are exact, not approximate.
+    state = init_state(jax.random.PRNGKey(0), spec, OptimizerConfig())
+    ckpt_lib.save(ckpt_dir, 1, state)
+
+    # --- leg 1: cold birth, baseline then treatment -------------------
+    base = ModelServer(eng_cfg(checkpoint_dir=ckpt_dir), port=0,
+                       grpc_port=None)
+    base.start()  # blocks until warm: cold_start carries the phases
+    base_phases = dict(base.engine.cold_start)
+    donor = f"127.0.0.1:{base.port}"
+
+    treat = ModelServer(eng_cfg(weight_peers=donor,
+                                weight_pull_timeout_s=60.0),
+                        port=0, grpc_port=None)
+    treat.start()
+    treat_phases = dict(treat.engine.cold_start)
+
+    base_cold = float(base_phases.get("first_token", 0.0))
+    treat_cold = float(treat_phases.get("first_token", 0.0))
+    speedup = base_cold / max(treat_cold, 1e-9)
+
+    base_leaves = jax.tree_util.tree_leaves(base.engine.params)
+    treat_leaves = jax.tree_util.tree_leaves(treat.engine.params)
+    pulled_identical = (
+        treat.engine.weight_pull_source == "peer"
+        and len(base_leaves) == len(treat_leaves)
+        and all(np.array_equal(np.asarray(a), np.asarray(b))
+                for a, b in zip(base_leaves, treat_leaves)))
+
+    # Rollout consistency: push a new epoch at the donor, pull again —
+    # the envelope must hand back the PUSHED epoch's exact bytes (a
+    # newborn born mid-rollout stamps the fleet's current version).
+    p2 = spec.init(jax.random.PRNGKey(1), spec.config)
+    push_weights(donor, model, p2, 1)
+    leaves2, ver2, _ = pull_weights(donor, model, timeout=60.0)
+    model_leaves2, _ = split_namespaces(leaves2)
+    want2 = {p: np.asarray(a) for p, a in flatten_namespaced(p2)}
+    epoch_consistent = (
+        ver2 == 1 and len(model_leaves2) == len(want2)
+        and all(np.array_equal(np.asarray(a), want2[f"m/{p}"])
+                for p, a in model_leaves2.items()))
+
+    cache_stats = {
+        "base_hits": int(getattr(base.decoder, "compile_cache_hits", 0)),
+        "base_misses": int(getattr(base.decoder,
+                                   "compile_cache_misses", 0)),
+        "treat_hits": int(getattr(treat.decoder,
+                                  "compile_cache_hits", 0)),
+        "treat_misses": int(getattr(treat.decoder,
+                                    "compile_cache_misses", 0)),
+    }
+    base.stop()
+    treat.stop()
+
+    # --- leg 2: 10x storm, reactive +1 vs predictive scale-to-N -------
+    params = state.params
+    n_storm = 24 if args.quick else 48
+    # The storm outlasts the slowest birth so late arrivals actually
+    # see the added capacity (routing is decided at submit time).
+    window = max(base_cold, treat_cold, 1.0) * 1.5
+    interarrival = window / n_storm
+
+    def mk():
+        return ContinuousDecoder(
+            params, spec.config, slots=slots, prefill_len=16,
+            max_new_tokens=gen_n, kv_layout="paged",
+            kv_block_size=block, prefix_cache_slots=4,
+            prefix_cache_min_len=8, stream_timeout_s=600.0)
+
+    def prompt(i):
+        return [3 + (j % 29) for j in range(8)] + [5 + (i % 80)] * 4
+
+    def storm(birth_delay, newborns):
+        fleet = DecoderFleet({"r0": mk()}, pressure=slots)
+        t0 = time.perf_counter()
+
+        def births():
+            time.sleep(max(0.0, t0 + birth_delay - time.perf_counter()))
+            fresh = []
+            for k in range(newborns):
+                nm = f"r{k + 1}"
+                fleet.add_replica(nm, mk(), warming=True)
+                fresh.append(nm)
+            time.sleep(0.2)  # spill-only ramp before the affine share
+            for nm in fresh:
+                fleet.mark_warm(nm)
+
+        birth_th = threading.Thread(target=births)
+        birth_th.start()
+        ttfts = [None] * n_storm
+
+        def one(i, due):
+            time.sleep(max(0.0, due - time.perf_counter()))
+            t_sub = time.perf_counter()
+            h = fleet.submit(prompt(i), gen_n)
+            for _ in h.tokens(timeout=600):
+                if ttfts[i] is None:
+                    ttfts[i] = time.perf_counter() - t_sub
+        threads = [threading.Thread(
+            target=one, args=(i, t0 + i * interarrival))
+            for i in range(n_storm)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=600)
+        birth_th.join(timeout=600)
+        probe = fleet.generate(prompt(0), gen_n, timeout=600)["tokens"]
+        leaked = 0
+        for nm in fleet.members():
+            d = fleet._replicas[nm]
+            with d._prefix_lock:
+                while d.prefix_cache.evict_lru():
+                    pass
+            leaked += d.metrics()["kv_blocks_in_use"]
+        spilled = fleet.metrics()["spilled"]
+        fleet.stop()
+        done = [t for t in ttfts if t is not None]
+        done.sort()
+        return {"ttft_p99_s": percentile(done, 99) if done else 1e9,
+                "completed": len(done), "probe": probe,
+                "leaked": int(leaked), "spilled": int(spilled)}
+
+    react = storm(base_cold, 1)
+    pred = storm(treat_cold, 3)
+    ttft_ratio = react["ttft_p99_s"] / max(pred["ttft_p99_s"], 1e-9)
+    leaked = react["leaked"] + pred["leaked"]
+    complete = (react["completed"] == n_storm
+                and pred["completed"] == n_storm)
+    shutil.rmtree(tmp, ignore_errors=True)
+
+    return {
+        "benchmark": "serving_flash_crowd_sweep",
+        "model": model,
+        "cold_start_baseline_s": {
+            k: round(v, 3) for k, v in base_phases.items()},
+        "cold_start_treatment_s": {
+            k: round(v, 3) for k, v in treat_phases.items()},
+        "cold_to_first_token_speedup": round(speedup, 2),
+        "weight_pull_source": treat.engine.weight_pull_source,
+        "pulled_weights_identical": pulled_identical,
+        "post_rollout_pull_epoch_consistent": epoch_consistent,
+        "compile_cache": cache_stats,
+        "storm_requests": n_storm,
+        "storm_window_s": round(window, 2),
+        "reactive_ttft_p99_ms": round(1e3 * react["ttft_p99_s"], 1),
+        "predictive_ttft_p99_ms": round(1e3 * pred["ttft_p99_s"], 1),
+        "ttft_p99_ratio": round(ttft_ratio, 2),
+        "spilled_reactive": react["spilled"],
+        "spilled_predictive": pred["spilled"],
+        "probe_tokens_identical": react["probe"] == pred["probe"],
+        "kv_blocks_in_use_after_drain": int(leaked),
+        "regression": (speedup < 5.0 or not pulled_identical
+                       or not epoch_consistent or not complete
+                       or ttft_ratio < 1.2
+                       or react["probe"] != pred["probe"]
+                       or leaked != 0),
+        "config": f"{model} storm{n_storm} slots{slots} gen{gen_n} "
+                  f"block{block} newborns_react1_pred3",
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
@@ -2311,6 +2539,15 @@ def main() -> int:
                          "max_prompt_len, decode inter-token p99 <= "
                          "1.5x the no-prefill baseline, zero leaked "
                          "blocks)")
+    ap.add_argument("--flash-crowd-sweep", action="store_true",
+                    help="benchmark flash-crowd elasticity: replica "
+                         "birth via peer weight pull + warm compile "
+                         "cache vs checkpoint + cold compile (>=5x "
+                         "cold-to-first-token, byte-identical pytree, "
+                         "epoch-consistent under rollout), and a 10x "
+                         "admission storm under predictive "
+                         "scale-to-N vs the reactive +1 ladder "
+                         "(TTFT p99 bounded, zero leaked blocks)")
     ap.add_argument("--tp-sweep", action="store_true",
                     help="benchmark model-parallel serving: tp=1/2/4 "
                          "mesh shapes at equal total pool bytes "
@@ -2330,7 +2567,10 @@ def main() -> int:
             os.environ.get("XLA_FLAGS", "")
             + " --xla_force_host_platform_device_count=8").strip()
     on_tpu = jax.default_backend() == "tpu"
-    if args.long_context_sweep:
+    if args.flash_crowd_sweep:
+        model = "llama-1b" if on_tpu and not args.quick else "lm-test-tiny"
+        result = _bench_flash_crowd_sweep(args, model)
+    elif args.long_context_sweep:
         model = "llama-1b" if on_tpu and not args.quick else "lm-test-tiny"
         result = _bench_long_context_sweep(args, model)
     elif args.rollout_sweep:

@@ -111,6 +111,16 @@ check_json "$out"
 # leg leaks blocks in any tier.
 out="$(JAX_PLATFORMS=cpu python bench_serving.py --quick --kv-economy-sweep)"
 check_json "$out"
+# Flash-crowd elasticity: the marker fires when peer-weight birth plus
+# a warm compile cache fails to reach >=5x cold-to-first-token vs the
+# checkpoint-restore + cold-compile baseline, when the peer-pulled
+# pytree differs byte-for-byte from the checkpoint restore or a
+# post-rollout pull returns a stale epoch's bytes, when predictive
+# scale-to-N under the storm fails to keep TTFT p99 under the
+# reactive +1-per-period ladder's, when probe tokens diverge between
+# birth paths, or on leaked blocks after drain.
+out="$(JAX_PLATFORMS=cpu python bench_serving.py --quick --flash-crowd-sweep)"
+check_json "$out"
 echo "bench smoke ok"
 # Training input pipeline: prefetch-on must match prefetch-off final
 # loss byte-for-byte (bench.py sets the regression marker otherwise)

@@ -33,7 +33,11 @@ EXEMPT_PATHS = ("observability/metrics.py", "observability/lint.py",
                 "analysis/exposition.py")
 
 SUBSYSTEMS = ("serving", "gateway", "operator", "scheduler", "train",
-              "probe", "kubeflow", "analysis")
+              "probe", "kubeflow", "analysis",
+              # InferenceService autoscaler decisions (operators/
+              # inference.py) — the service-facing counter family the
+              # flash-crowd dashboards join on.
+              "inference")
 
 LABEL_VOCAB = frozenset({
     "kind", "route", "queue", "pool", "reason", "role", "model",
@@ -49,6 +53,12 @@ LABEL_VOCAB = frozenset({
     # two per service (incumbent + candidate, validate_versions), plus
     # the literal "shadow" fallback for an unnamed mirror target.
     "version",
+    # Flash-crowd cold start: values are exactly {"peer", "checkpoint",
+    # "init"} (serving/server.py record_weight_pull).
+    "source",
+    # Birth phase breakdown: values are exactly {"weights", "compile",
+    # "first_token"} (InferenceEngine.cold_start keys).
+    "phase",
 })
 
 _NAME_RE = re.compile(r"^[a-z][a-z0-9]*(_[a-z0-9]+)+$")

@@ -38,6 +38,27 @@ DEFAULT_AUTOSCALE = {
     # gate verdict on substituted data); past it the replica counts as
     # unobservable.
     "signalStalenessSeconds": 30.0,
+    # Predictive scale-up (flash-crowd elasticity): fit the per-pool
+    # queue-wait/TTFT trend over the kept scrape rounds and scale when
+    # the projection at now + horizonSeconds breaches — ahead of the
+    # breach itself — jumping straight to the projected replica count
+    # (capped at maxStepUp added per round) instead of +1-per-period.
+    # Off by default: reactive-only behavior is unchanged.
+    "predictive": False,
+    "horizonSeconds": 30.0,
+    "maxStepUp": 4,
+}
+
+# Newborn warm-up defaults (spec.warmup): peer weight birth off, no
+# shared compile-cache volume, and a zero ramp window — each knob is
+# opt-in so an unconfigured service keeps the checkpoint-boot behavior.
+# rampSeconds additionally bounds how long the autoscaler treats a
+# just-born (possibly unscrapeable) replica as warming: such replicas
+# neither anchor the scale-down cooldown nor count as calm signals.
+DEFAULT_WARMUP = {
+    "peerWeights": False,
+    "compileCacheDir": "",
+    "rampSeconds": 0.0,
 }
 
 # Roles a disaggregated InferenceService splits its replicas into.
@@ -106,6 +127,19 @@ def inference_service_crd() -> dict:
         "cooldownSeconds": {"type": "number", "minimum": 0},
         "scrapePeriodSeconds": {"type": "number", "minimum": 0},
         "signalStalenessSeconds": {"type": "number", "minimum": 0},
+        "predictive": {"type": "boolean"},
+        "horizonSeconds": {"type": "number", "minimum": 0},
+        "maxStepUp": {"type": "integer", "minimum": 1},
+    }
+    # Newborn warm-up: peer weight birth, the shared compile-cache
+    # volume, and the ramp window the autoscaler/gateway honor.
+    warmup_schema = {
+        "type": "object",
+        "properties": {
+            "peerWeights": {"type": "boolean"},
+            "compileCacheDir": {"type": "string"},
+            "rampSeconds": {"type": "number", "minimum": 0},
+        },
     }
     # Engine knobs pass through to the model-server args verbatim, but
     # tpShards is declared explicitly: the operator reads it to size
@@ -213,6 +247,7 @@ def inference_service_crd() -> dict:
                     "qos": qos_schema,
                     "autoscale": {"type": "object",
                                   "properties": autoscale_props},
+                    "warmup": warmup_schema,
                     # Progressive delivery: the declared model versions
                     # (traffic is the steady-state split the rollout
                     # walks toward) and the canary policy knobs.
@@ -306,6 +341,7 @@ def inference_service(
     roles: dict | None = None,
     qos: dict | None = None,
     autoscale: dict | None = None,
+    warmup: dict | None = None,
     versions: list[dict] | None = None,
     rollout: dict | None = None,
 ) -> dict:
@@ -343,6 +379,10 @@ def inference_service(
         bad = set(rollout) - set(DEFAULT_ROLLOUT)
         if bad:
             raise ValueError(f"unknown rollout keys {sorted(bad)}")
+    if warmup is not None:
+        bad = set(warmup) - set(DEFAULT_WARMUP)
+        if bad:
+            raise ValueError(f"unknown warmup keys {sorted(bad)}")
     router: dict = {"affinityTokens": int(affinity_tokens),
                     "pressure": int(pressure)}
     if kv_pressure:
@@ -359,6 +399,10 @@ def inference_service(
         spec["roles"] = {r: dict(v) for r, v in roles.items()}
     if qos:
         spec["qos"] = dict(qos)
+    if warmup is not None:
+        # Present only when asked for: an unconfigured service renders
+        # the exact legacy manifest (no spec.warmup key at all).
+        spec["warmup"] = {**DEFAULT_WARMUP, **warmup}
     if model_path:
         spec["modelPath"] = model_path
     if image:

@@ -152,6 +152,7 @@ class UpstreamHealth:
             "consecutive_failures": 0, "ejected_until": 0.0,
             "ejections": 0, "half_open_inflight": False,
             "trial_started": 0.0, "last_change": self.clock(),
+            "warming": False,
         })
 
     def record_success(self, service: str) -> None:
@@ -196,11 +197,25 @@ class UpstreamHealth:
             return False
         return True  # window elapsed: a trial may begin
 
-    def admits(self, service: str) -> bool:
-        """Side-effect-free eligibility: healthy, or ejection window
-        elapsed with no trial in flight."""
+    def set_warming(self, service: str, warming: bool) -> None:
+        """A newborn upstream answering ``/healthz`` with ``warming`` is
+        alive-but-not-serving: route-excluded like an ejection but with
+        NO failure-counter penalty — it exits the moment its dispatch
+        set finishes compiling, with zero half-open walk to pay."""
         with self._lock:
-            return self._eligible_locked(self._state.get(service))
+            cell = self._cell(service)
+            if cell.get("warming") != bool(warming):
+                cell["warming"] = bool(warming)
+                cell["last_change"] = self.clock()
+
+    def admits(self, service: str) -> bool:
+        """Side-effect-free eligibility: healthy (and not a warming
+        newborn), or ejection window elapsed with no trial in flight."""
+        with self._lock:
+            cell = self._state.get(service)
+            if cell is not None and cell.get("warming"):
+                return False
+            return self._eligible_locked(cell)
 
     def begin_trial(self, service: str) -> None:
         """Mark the half-open trial as in flight for the backend a
@@ -216,26 +231,55 @@ class UpstreamHealth:
                 cell["trial_started"] = self.clock()
 
     def filter_healthy(self, services: list[str]) -> list[str]:
-        """The pick set: ejected backends drop out; if EVERYTHING is
-        ejected, fail open with the full set (a wrong 502 beats
-        blackholing when the health data itself is suspect)."""
+        """The pick set: ejected and warming backends drop out; if
+        EVERYTHING is excluded, fail open with the full set (a wrong
+        502 beats blackholing when the health data itself is suspect —
+        and an all-warming pool serving slowly beats serving nobody)."""
         healthy = [s for s in services if self.admits(s)]
         return healthy or list(services)
 
     def probe(self, services: list[str],
               resolve: Callable[[str], str]) -> None:
-        """Active TCP-connect probe of every service (cheap, protocol-
-        agnostic — the readiness signal is 'something is listening')."""
+        """Active probe of every service: a TCP connect is the
+        liveness signal (protocol-agnostic — 'something is
+        listening'), then a best-effort ``GET /healthz`` on the same
+        socket distinguishes a WARMING newborn (mid weight-install /
+        dispatch-set compile) from a serving one. Anything that
+        connects but doesn't speak the health protocol reads as
+        serving — no worse than the TCP-only probe."""
         for service in services:
             addr = resolve(service)
             host, _, port_s = addr.partition(":")
             try:
                 with socket.create_connection(
-                        (host, int(port_s or 80)), timeout=2.0):
-                    pass
+                        (host, int(port_s or 80)), timeout=2.0) as sock:
+                    warming = self._probe_warming(sock, host)
+                self.set_warming(service, warming)
                 self.record_success(service)
             except OSError:
                 self.record_failure(service)
+
+    @staticmethod
+    def _probe_warming(sock: socket.socket, host: str) -> bool:
+        """Raw-socket health read on the already-connected probe
+        socket. Returns True only on an explicit ``"warming"`` status;
+        a non-HTTP listener, timeout, or parse failure is False —
+        warming must only ever be asserted by the upstream itself."""
+        try:
+            sock.settimeout(2.0)
+            sock.sendall((f"GET /healthz HTTP/1.1\r\nHost: {host}\r\n"
+                          "Connection: close\r\n\r\n").encode())
+            data = b""
+            while len(data) < 65536:
+                chunk = sock.recv(4096)
+                if not chunk:
+                    break
+                data += chunk
+                if b"}" in data:  # the one-line JSON body landed
+                    break
+            return b'"warming"' in data
+        except OSError:
+            return False
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -248,6 +292,7 @@ class UpstreamHealth:
                     "ejected_for_seconds": round(
                         max(0.0, cell["ejected_until"] - now), 2),
                     "ejections": cell["ejections"],
+                    "warming": bool(cell.get("warming")),
                 }
                 for svc, cell in self._state.items()
             }

@@ -553,6 +553,11 @@ def pvc_volume(name: str, claim: str) -> dict:
     return {"name": name, "persistentVolumeClaim": {"claimName": claim}}
 
 
+def host_path_volume(name: str, path: str,
+                     path_type: str = "DirectoryOrCreate") -> dict:
+    return {"name": name, "hostPath": {"path": path, "type": path_type}}
+
+
 def volume_mount(name: str, mount_path: str, read_only: bool | None = None,
                  sub_path: str | None = None) -> dict:
     return _clean(

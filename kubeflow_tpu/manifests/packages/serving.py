@@ -117,6 +117,15 @@ REST_PORT = 8500
         ParamSpec("qos_aging_s", 30.0,
                   "seconds of queue wait worth one priority point "
                   "(starvation aging)"),
+        ParamSpec("compile_cache_dir", "",
+                  "persistent compile-cache directory (empty disables): "
+                  "mounted as a node-shared hostPath so a newborn "
+                  "replica replays the fleet's serialized executables "
+                  "instead of cold-compiling its dispatch set"),
+        ParamSpec("weight_peers", "",
+                  "comma-separated host:port donors a newborn pulls its "
+                  "weights from over :pull before falling back to the "
+                  "checkpoint (empty = checkpoint boot)"),
         ParamSpec("enable_prometheus", True),
         ParamSpec("dtype", "bfloat16"),
     ],
@@ -153,6 +162,8 @@ def tpu_serving(
     kv_import_crossover_tokens: int,
     qos_tenants: str,
     qos_aging_s: float,
+    compile_cache_dir: str,
+    weight_peers: str,
     enable_prometheus: bool,
     dtype: str,
 ) -> list[dict]:
@@ -202,8 +213,19 @@ def tpu_serving(
     if qos_tenants:
         args.insert(-1, f"--qos-tenants={qos_tenants}")
         args.insert(-1, f"--qos-aging-s={qos_aging_s}")
+    if compile_cache_dir:
+        args.insert(-1, f"--compile-cache-dir={compile_cache_dir}")
+    if weight_peers:
+        args.insert(-1, f"--weight-peers={weight_peers}")
     if enable_prometheus:
         args.append("--enable-prometheus")
+    # The compile cache is node-shared state, not pod state: every
+    # replica scheduled on the node mounts the same hostPath, so the
+    # first compile on the node is the LAST one any sibling pays.
+    volumes = mounts = None
+    if compile_cache_dir:
+        volumes = [k8s.host_path_volume("compile-cache", compile_cache_dir)]
+        mounts = [k8s.volume_mount("compile-cache", compile_cache_dir)]
     pod_annotations = (
         {
             "prometheus.io/scrape": "true",
@@ -229,11 +251,13 @@ def tpu_serving(
                     readiness_probe=k8s.http_probe(
                         f"/v1/models/{model_name}", REST_PORT, initial_delay=20
                     ),
+                    volume_mounts=mounts,
                 )
             ],
             replicas=replicas,
             labels=labels,
             pod_annotations=pod_annotations,
+            volumes=volumes,
         ),
         k8s.service(
             name,

@@ -34,6 +34,13 @@ SNAPSHOT_CASES: dict[str, tuple[str, dict]] = {
         "tpu-serving",
         {"name": "bert", "model_path": "gs://models/bert", "num_tpu_chips": 4},
     ),
+    "tpu-serving-warm": (
+        "tpu-serving",
+        {"name": "bert", "model_path": "gs://models/bert",
+         "num_tpu_chips": 4,
+         "compile_cache_dir": "/var/cache/kubeflow-tpu/compile",
+         "weight_peers": "bert-r0.kubeflow:8500,bert-r1.kubeflow:8500"},
+    ),
     "pipeline-operator": ("pipeline-operator", {}),
     "scheduled-workflow": (
         "scheduled-workflow",

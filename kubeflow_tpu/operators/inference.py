@@ -30,20 +30,42 @@ from __future__ import annotations
 
 import copy
 import logging
+import math
 import time
 import urllib.request
 
 from kubeflow_tpu.apis.inference import (
     DEFAULT_AUTOSCALE,
+    DEFAULT_WARMUP,
     INFERENCE_API_VERSION,
     INFERENCE_KIND,
     INFERENCE_ROLES,
 )
 from kubeflow_tpu.k8s import objects as k8s
 from kubeflow_tpu.manifests.core import gateway_route, generate
-from kubeflow_tpu.operators.base import Controller
+from kubeflow_tpu.operators.base import OPERATOR_METRICS, Controller
 
 log = logging.getLogger(__name__)
+
+_M_PREDICTIVE = OPERATOR_METRICS.counter(
+    "inference_predictive_scaleups_total",
+    "Scale-ups taken on a projected (not yet observed) SLO breach",
+    labels=("service",))
+
+# Scrape rounds of pool-max signals kept per pool for the trend fit.
+# The slope only needs enough points to reject one-round noise; a flash
+# crowd shows a clean ramp within 3-4 rounds, so a dozen is plenty and
+# keeps the per-pool state O(1).
+HISTORY_ROUNDS = 12
+
+# Signal-dict field and scoped-name pairs shared by the breach test,
+# the trend fit and the capacity ratio, with the per-unit target key.
+_SIGNAL_FIELDS = (
+    ("queue_wait_p99", "queue_wait_p99_s", "queueWaitP99Ms", 1e3),
+    ("ttft_p99", "ttft_p99_s", "ttftP99Ms", 1e3),
+    ("inter_token_p99", "inter_token_p99_s", "interTokenP99Ms", 1e3),
+    ("kv_bytes", "kv_utilization", "kvBytesUtilization", 1.0),
+)
 
 REST_PORT = 8500
 REPLICA_LABEL = "kubeflow-tpu.org/inference-replica"
@@ -333,6 +355,8 @@ class InferenceServiceController(Controller):
         ns = svc["metadata"]["namespace"]
         spec = svc.get("spec", {})
         cfg = {**DEFAULT_AUTOSCALE, **(spec.get("autoscale") or {})}
+        warm = {**DEFAULT_WARMUP, **(spec.get("warmup") or {})}
+        ramp_s = float(warm.get("rampSeconds") or 0.0)
         status = svc.get("status") or {}
         desired_by: dict[str, int] = {}
         signals_by: dict[str, list[dict]] = {}
@@ -348,23 +372,42 @@ class InferenceServiceController(Controller):
                 current = int(pool["replicas"] or lo)
             current = min(max(current, lo), hi)
 
+            # Replicas younger than warmup.rampSeconds are RAMPING: a
+            # newborn is compiling/pulling weights and either cannot be
+            # scraped at all or reports cold-start latencies that look
+            # like a breach. Its samples must neither anchor the stale-
+            # HOLD, vote "calm" for scale-down, nor trigger a reactive
+            # cascade — only SEASONED replicas drive the decision.
+            young = set()
+            if ramp_s > 0:
+                born = (self._scale_state.get((ns, name, role))
+                        or {}).get("born") or {}
+                now = self.clock()
+                young = {j for j, t in born.items() if now - t < ramp_s}
+
             signals = []
+            seasoned = []
             stale = False
             for i in range(current):
                 sig, fresh = self.signal_cache.scrape(
                     self.replica_addr(name, ns, i, role),
                     float(cfg["signalStalenessSeconds"]))
-                if sig is not None:
-                    signals.append(sig)
-                    stale = stale or not fresh
+                if sig is None:
+                    continue
+                signals.append(sig)
+                if i in young:
+                    continue
+                seasoned.append(sig)
+                stale = stale or not fresh
             if stale:
                 # A substituted (last-good) sample in the vector: HOLD.
                 # Scaling on held data acts on the past — a transient
                 # scrape timeout must never move the pool.
                 desired, reason = current, "hold: stale scrape signals"
             else:
-                desired, reason = self._decide((ns, name, role), current,
-                                               lo, hi, signals, cfg, role)
+                desired, reason = self._decide(
+                    (ns, name, role), current, lo, hi, seasoned, cfg,
+                    role, ramp_s=ramp_s, ramping=bool(young))
             self._ensure_replicas(svc, desired, role, pool["engine"])
             self._prune_replicas(svc, desired, role)
             desired_by[role] = desired
@@ -400,34 +443,133 @@ class InferenceServiceController(Controller):
         scoped = ROLE_SIGNALS[role]
         return [b for b in over if b in scoped]
 
+    @staticmethod
+    def _pool_max(signals: list[dict]) -> dict:
+        """Pool-worst sample per signal field — the vector the trend
+        fit and the capacity ratio both run on (scaling serves the
+        worst replica, not the average one)."""
+        return {f: max(s.get(f, 0.0) for s in signals)
+                for _, f, _, _ in _SIGNAL_FIELDS}
+
+    @staticmethod
+    def _trend_projection(history: list[tuple[float, dict]],
+                          at: float) -> dict:
+        """Least-squares projection of each pool-max signal at time
+        ``at``. Clamped below at the latest observation: a projection
+        is only allowed to warn EARLIER than reality, never to erase a
+        breach that is already visible."""
+        ts = [t for t, _ in history]
+        t_mean = sum(ts) / len(ts)
+        var = sum((t - t_mean) ** 2 for t in ts)
+        out = {}
+        for _, field, _, _ in _SIGNAL_FIELDS:
+            vs = [s.get(field, 0.0) for _, s in history]
+            v_mean = sum(vs) / len(vs)
+            slope = (sum((t - t_mean) * (v - v_mean)
+                         for t, v in zip(ts, vs)) / var) if var > 0 else 0.0
+            out[field] = max(vs[-1], v_mean + slope * (at - t_mean))
+        return out
+
+    @staticmethod
+    def _worst_ratio(sig: dict, cfg: dict, role: str = "") -> float:
+        """How far over capacity the pool runs, as max(signal/target)
+        over the signals that bind ``role``. Queue wait and latency
+        tails grow roughly linearly with per-replica load near
+        saturation and KV fill is exactly linear in resident bytes, so
+        this ratio IS the throughput profile's per-replica capacity
+        estimate read off the signal plane: a pool at ratio r needs
+        ~ceil(current * r) replicas to sit back at target."""
+        scoped = ROLE_SIGNALS[role]
+        ratios = [1.0]
+        for name, field, target_key, unit in _SIGNAL_FIELDS:
+            target = float(cfg[target_key])
+            if name in scoped and target > 0:
+                ratios.append(sig.get(field, 0.0) * unit / target)
+        return max(ratios)
+
+    @staticmethod
+    def _scale_step(current: int, ratio: float, max_step: int) -> int:
+        """Replicas to ADD this round: scale-to-N from the capacity
+        ratio, clamped to ``maxStepUp`` — one round closes the whole
+        projected gap when it is large instead of walking +1 per scrape
+        period behind a flash crowd."""
+        need = int(math.ceil(current * ratio)) - current
+        return max(1, min(max(1, int(max_step)), need))
+
     def _decide(self, key: tuple[str, str, str], current: int, lo: int,
                 hi: int, signals: list[dict], cfg: dict,
-                role: str = "") -> tuple[int, str]:
+                role: str = "", *, ramp_s: float = 0.0,
+                ramping: bool = False) -> tuple[int, str]:
         """One pool's scaling decision. Up is immediate (a breach is
         user-visible latency, the urgent direction); down needs the
         whole pool inside the hysteresis band AND the cooldown elapsed,
         so a breach → scale-up → relief sequence cannot flap back within
         the window. Cooldown state is PER POOL: scaling prefill never
-        resets decode's clock."""
+        resets decode's clock.
+
+        With ``autoscale.predictive`` the pool also keeps the last
+        HISTORY_ROUNDS pool-max samples, fits a slope, and scales when
+        the projection at ``now + horizonSeconds`` breaches — the
+        replicas are BORN before the SLO is, so their ramp (weight pull
+        + compile-cache warm) overlaps the load climb instead of
+        following the breach. ``ramping`` (a replica younger than
+        ``warmup.rampSeconds`` exists) vetoes scale-down outright: a
+        newborn that cannot be scraped yet must never read as calm."""
         now = self.clock()
         # First sight anchors the cooldown: a freshly declared pool gets
         # a full cooldown of observation before any scale-down (spec
         # .replicas is the operator's intent, not a transient to erase).
         state = self._scale_state.setdefault(key, {"last_scale": now})
+        born = state.setdefault("born", {})
+        for j in [j for j, t in born.items()
+                  if ramp_s <= 0 or now - t >= ramp_s]:
+            born.pop(j)  # seasoned: out of every future young set
+        predictive = bool(cfg.get("predictive"))
+        max_step = int(cfg.get("maxStepUp", 1) or 1)
+        agg = self._pool_max(signals) if signals else None
+        hist = state.setdefault("history", [])
+        if agg is not None:
+            hist.append((now, agg))
+            del hist[:-HISTORY_ROUNDS]
+
+        def scale_to(new: int) -> int:
+            state["last_scale"] = now
+            for j in range(current, new):
+                born[j] = now
+            return new
+
         breached = sorted({b for s in signals
                            for b in self._breaches(s, cfg, role=role)})
         if breached and current < hi:
-            state["last_scale"] = now
-            return current + 1, f"scale-up: {','.join(breached)} over target"
+            step = (self._scale_step(current,
+                                     self._worst_ratio(agg, cfg, role),
+                                     max_step) if predictive else 1)
+            return (scale_to(min(hi, current + step)),
+                    f"scale-up: {','.join(breached)} over target")
+        if predictive and current < hi and len(hist) >= 3:
+            horizon = float(cfg.get("horizonSeconds", 0.0))
+            proj = self._trend_projection(hist, now + horizon)
+            ahead = self._breaches(proj, cfg, role=role)
+            if ahead:
+                step = self._scale_step(
+                    current, self._worst_ratio(proj, cfg, role), max_step)
+                new = scale_to(min(hi, current + step))
+                _M_PREDICTIVE.labels(key[1]).inc()
+                return new, (f"predictive scale-up: {','.join(ahead)} "
+                             f"projected over target within "
+                             f"{horizon:g}s")
         low = bool(signals) and not any(
             self._breaches(s, cfg, float(cfg["scaleDownRatio"]), role)
             for s in signals)
         last = state["last_scale"]
         cooled = last is None or (now - last) >= float(
             cfg["cooldownSeconds"])
-        if low and current > lo and cooled:
+        if low and not ramping and current > lo and cooled:
             state["last_scale"] = now
+            born.pop(current - 1, None)  # its stamp leaves with it
             return current - 1, "scale-down: all signals under low water"
+        if low and ramping:
+            return current, "hold: newborn replica still ramping"
         return current, ""
 
     # -- children -----------------------------------------------------
@@ -466,6 +608,19 @@ class InferenceServiceController(Controller):
         }
         if spec.get("image"):
             params["image"] = spec["image"]
+        # spec.warmup → the flash-crowd birth path: every replica in
+        # every pool shares one persistent compile cache dir, and a
+        # scaled-up replica (i > 0) lists its lower-indexed siblings as
+        # weight donors — replica 0 is the pool's checkpoint-booted
+        # root, so the donor chain always terminates. setdefault keeps
+        # an explicit engine-level override authoritative.
+        warm = {**DEFAULT_WARMUP, **(spec.get("warmup") or {})}
+        if warm.get("compileCacheDir"):
+            params.setdefault("compile_cache_dir",
+                              str(warm["compileCacheDir"]))
+        if warm.get("peerWeights") and i > 0:
+            params.setdefault("weight_peers", ",".join(
+                self.replica_addr(name, ns, j, role) for j in range(i)))
         objs = generate("tpu-serving", params)
         ref = k8s.object_ref(svc)
         for o in objs:

@@ -163,6 +163,21 @@ def main(argv=None) -> int:
     p.add_argument("--qos-aging-s", type=float, default=30.0,
                    help="seconds of queue wait worth one priority "
                         "point (starvation aging; <=0 disables)")
+    p.add_argument("--compile-cache-dir", default="",
+                   help="persistent compile-cache directory (empty "
+                        "disables): a newborn replica replays the "
+                        "fingerprint-matched serialized executables "
+                        "for its whole decode dispatch set instead of "
+                        "cold-compiling it, and records its own "
+                        "compiles for the next birth")
+    p.add_argument("--weight-peers", default="",
+                   help="comma-separated host:port donors to pull the "
+                        "boot weights from over :pull (tried in "
+                        "order, checkpoint fallback; empty boots from "
+                        "the checkpoint)")
+    p.add_argument("--weight-pull-timeout-s", type=float, default=30.0,
+                   help="per-donor budget for the boot-time weight "
+                        "pull before trying the next donor")
     p.add_argument("--stream-timeout-s", type=float, default=60.0,
                    help="default wait for generation results/streams; "
                         "raise under heavy load so memory-deferred "
@@ -326,6 +341,9 @@ def main(argv=None) -> int:
             kv_import_crossover_tokens=args.kv_import_crossover_tokens,
             qos_tenants=args.qos_tenants,
             qos_aging_s=args.qos_aging_s,
+            weight_peers=args.weight_peers,
+            weight_pull_timeout_s=args.weight_pull_timeout_s,
+            compile_cache_dir=args.compile_cache_dir,
             dtype=args.dtype,
         ),
         port=args.rest_port,

@@ -295,7 +295,9 @@ class ContinuousDecoder:
                  peer_fetch=None,
                  kv_import_crossover_tokens: int = 0,
                  kv_affinity_tokens: int = 0,
-                 replica_name: str = ""):
+                 replica_name: str = "",
+                 boot_weights_version: int = 0,
+                 compile_cache_dir: str = ""):
         # Model-parallel serving: tp_shards > 1 runs THIS replica's
         # decode executables over a tp-wide tensor mesh — weights carry
         # the Megatron column/row split from the model's partition
@@ -748,13 +750,18 @@ class ContinuousDecoder:
         # Live weight streaming (update_weights): monotonically
         # increasing weights epoch, push counter, and the end-to-end
         # push duration (device placement + atomic swap + stale flush).
-        self.weights_version = 0
+        # A peer-born replica stamps its donor's epoch at construction
+        # (boot_weights_version) so the rollout machinery and the
+        # stale-KV fences see a version-consistent fleet from birth.
+        self.weights_version = max(0, int(boot_weights_version))
         self.weight_pushes = 0
         self.weight_stale_refused = 0  # stale trie/tier hits refused
         self.last_swap_seconds = 0.0   # last push's in-lock swap stall
         self._g_weights_version = self.registry.gauge(
             "serving_weights_version",
             "Weights epoch installed by live pushes (0 = boot weights)")
+        if self.weights_version:
+            self._g_weights_version.set(self.weights_version)
         self._c_weight_pushes = self.registry.counter(
             "serving_weight_pushes_total",
             "Live weight swaps installed by update_weights")
@@ -785,10 +792,124 @@ class ContinuousDecoder:
                 # pack into the cold store (and publish the hint)
                 # BEFORE the bytes drop.
                 self._host_tier.on_evict = self._demote_to_cold
+        # Newborn ramp state: a birth path (model server boot, fleet
+        # add_replica) sets `warming` True before calling warm(); the
+        # fleet admits a warming member via least-loaded spill only —
+        # no affine share — and /healthz reports "warming" so the
+        # gateway excludes it without penalty. Defaults False: a
+        # decoder constructed outside a birth path serves immediately.
+        self.warming = False
+        self.compile_cache_hits = 0
+        self.compile_cache_misses = 0
+        self.warm_seconds = 0.0
+        self.compile_cache = None
+        if compile_cache_dir:
+            from kubeflow_tpu.serving.compile_cache import CompileCache
+            self.compile_cache = CompileCache(compile_cache_dir)
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
     # ------------------------------------------------------------------
+
+    def engine_fingerprint(self) -> str:
+        """Digest keying this decoder's compiled dispatch set in the
+        persistent compile cache (see serving/compile_cache.py)."""
+        from kubeflow_tpu.serving.compile_cache import engine_fingerprint
+        return engine_fingerprint(
+            self.cfg, tp_shards=self.tp_shards, cp_shards=self.cp_shards,
+            pp_stages=self.pp_stages, kv_layout=self.kv_layout,
+            kv_dtype=self.kv_dtype, kv_fused=self.kv_fused,
+            kv_block_size=getattr(self, "kv_block_size", 0),
+            slots=self.slots, prefill_len=self.prefill_len,
+            prefill_len_buckets=self.prefill_len_buckets,
+            chunk_size=self.chunk_size,
+            speculative_k=self.speculative_k,
+            prefill_chunk_tokens=self.prefill_chunk_tokens,
+            max_prompt_len=self.max_prompt_len, top_k=self.top_k)
+
+    def dispatch_keys(self) -> list[str]:
+        from kubeflow_tpu.serving.compile_cache import dispatch_keys
+        return dispatch_keys(
+            slots=self.slots, prefill_len=self.prefill_len,
+            prefill_len_buckets=self.prefill_len_buckets,
+            chunk_size=self.chunk_size,
+            speculative_k=self.speculative_k,
+            prefill_chunk_tokens=self.prefill_chunk_tokens)
+
+    def warm(self, compile_cache=None) -> dict:
+        """Pre-compile the full dispatch set by running dummy
+        generations through the real submit path — one admission per
+        prefill bucket, decode steps at the chunk width, the verify
+        shape under speculation, and the chunked-prefill interior shape
+        for long prompts. Populates the in-process jit cache and (when
+        wired) XLA's persistent store; the manifest accounting splits
+        the set into hits (a prior same-fingerprint replica already
+        compiled them — this birth deserializes) vs misses (compiled
+        here, recorded for the next birth). Flips ``warming`` off at
+        the end — the fleet/gateway ramp gate.
+
+        Never raises: a newborn that cannot warm one shape (QoS rate
+        limit on the dummy tenant, a bucket wider than max_prompt_len)
+        still comes up and compiles that shape on first real traffic.
+        """
+        t0 = time.perf_counter()
+        cache = compile_cache if compile_cache is not None \
+            else self.compile_cache
+        floor = (self.prefill_len >> self.prefill_len_buckets
+                 if self.prefill_len_buckets else self.prefill_len)
+        widths, w = [], max(1, floor)
+        while True:
+            widths.append(w)
+            if w >= self.prefill_len:
+                break
+            w *= 2
+        # Distinctive token pattern: repeated so the ngram proposer
+        # drafts (driving the verify executable), and unlikely to alias
+        # real prompts in the prefix trie.
+        handles = []
+        steps = max(1, min(self.max_new_tokens, self.chunk_size))
+        for w in widths:
+            n = max(1, min(w, self.max_prompt_len))
+            prompt = ([7, 11, 13] * (n // 3 + 1))[:n]
+            try:
+                handles.append(self.submit(prompt, steps))
+            except Exception:
+                continue
+        if self.prefill_chunk_tokens and self.max_prompt_len \
+                > self.prefill_len:
+            n = min(self.max_prompt_len,
+                    self.prefill_len + self.prefill_chunk_tokens)
+            prompt = ([7, 11, 13] * (n // 3 + 1))[:n]
+            try:
+                handles.append(self.submit(prompt, steps))
+            except Exception:
+                pass
+        for h in handles:
+            try:
+                h.result()
+            except Exception:
+                continue
+        hits = misses = 0
+        if cache is not None:
+            hits, misses = cache.account(self.engine_fingerprint(),
+                                         self.dispatch_keys())
+        secs = time.perf_counter() - t0
+        with self._mlock:
+            self.compile_cache_hits += hits
+            self.compile_cache_misses += misses
+            self.warm_seconds = secs
+        self.warming = False
+        return {"seconds": secs, "hits": hits, "misses": misses,
+                "keys": len(self.dispatch_keys())}
+
+    def weights_snapshot(self):
+        """Consistent (params, weights_version) pair for a donor-side
+        peer pull: pointer reads under the state lock (no copies, no
+        blocking work) — the same discipline update_weights' swap uses,
+        so a puller never sees epoch N's version with epoch N+1's
+        pytree."""
+        with self._state_lock:
+            return self.params, self.weights_version
 
     def submit(self, tokens: list[int], max_new_tokens: int,
                temperature: float = 0.0, *,
@@ -3030,6 +3151,10 @@ class ContinuousDecoder:
                 "weight_pushes": self.weight_pushes,
                 "weights_stale_refused": self.weight_stale_refused,
                 "weight_swap_seconds_last": self.last_swap_seconds,
+                "compile_cache_hits": self.compile_cache_hits,
+                "compile_cache_misses": self.compile_cache_misses,
+                "warm_seconds": self.warm_seconds,
+                "warming": self.warming,
             }
         # The weights epoch swaps under the state lock; its own scope
         # (never nested with the other snapshot locks) keeps the read

@@ -207,9 +207,64 @@ class EngineConfig:
     # Seconds of queue wait worth one priority point (starvation
     # aging); <= 0 disables aging.
     qos_aging_s: float = 30.0
+    # Flash-crowd elasticity: peer weight birth. Comma-separated donor
+    # addresses ("host:port,host:port" — serving peers of the same
+    # model). When set, boot pulls the param pytree from the first
+    # answering donor over the chunked :pull envelope instead of
+    # touching the checkpoint store — the weights arrive already at the
+    # fleet's live epoch, so a newborn joining mid-rollout is
+    # version-consistent by construction. Donors are tried in order; a
+    # donor dying mid-stream falls through to the next, and an empty
+    # chain falls back to checkpoint_dir (a newborn always comes up).
+    weight_peers: str = ""
+    # Per-donor transport timeout for the birth pull.
+    weight_pull_timeout_s: float = 30.0
+    # Persistent compile cache directory (shared volume across a pool's
+    # replicas; empty disables). The server pre-warms the decode
+    # dispatch set at start, pointed at this directory — see
+    # serving/compile_cache.py for the fingerprint/invalidation scheme.
+    compile_cache_dir: str = ""
     # Compute dtype override ("bfloat16"/"float32"); empty keeps the
     # model preset's dtype. The tpu-serving manifest's --dtype arg.
     dtype: str = ""
+
+
+def _predict_impl(model: ModelSpec, params, inputs):
+    cfg = model.config
+    if model.family == "transformer":
+        logits = model.apply(params, inputs["tokens"], cfg)
+        # Causality makes position len-1 exact regardless of padding
+        # after it — gather each request's last real position.
+        last = jnp.take_along_axis(
+            logits, inputs["last_index"][:, None, None], axis=1
+        )[:, 0]
+        return {
+            "logits": last.astype(jnp.float32),
+            "next_token": jnp.argmax(last, axis=-1),
+        }
+    if model.family == "bert":
+        seq, pooled = model.apply(
+            params, inputs["tokens"], cfg,
+            pad_mask=inputs.get("pad_mask"),
+        )
+        return {"pooled": pooled.astype(jnp.float32)}
+    if model.family == "resnet":
+        logits = model.apply(params, inputs["images"], cfg)
+        return {
+            "probabilities": jax.nn.softmax(logits, axis=-1),
+            "classes": jnp.argmax(logits, axis=-1),
+        }
+    raise ValueError(model.family)
+
+
+# One jitted predict wrapper per (model, dtype): jax.jit over a bound
+# method is a fresh wrapper — and a fresh executable — per engine
+# instance, so a flash-crowd newborn in the same process would re-pay
+# the lockstep predict compile its donor already paid. Sharing the
+# wrapper makes the whole dispatch surface executable-cached the way
+# the decoder's module-level jits already are; across processes the
+# persistent XLA cache (compile_cache.configure_jax_cache) covers it.
+_PREDICT_JIT: dict[tuple[str, str], object] = {}
 
 
 class InferenceEngine:
@@ -220,12 +275,78 @@ class InferenceEngine:
         overrides = {"dtype": jnp.dtype(cfg.dtype)} if cfg.dtype else {}
         self.model: ModelSpec = get_model(cfg.model, **overrides)
         self._lock = threading.Lock()
+        # Replica-birth accounting: where the boot weights came from
+        # ("peer" / "checkpoint" / "init"), the donor's weights epoch
+        # (0 = boot weights, checkpoint semantics), and the per-phase
+        # cold-start seconds the server publishes as
+        # serving_cold_start_seconds{phase}.
+        self.weight_pull_source = "init"
+        self.boot_weights_version = 0
+        self.cold_start: dict[str, float] = {}
+        import time as _time
+
+        t0 = _time.perf_counter()
         self.params = self._load_params()
-        self._predict = jax.jit(self._predict_fn)
+        self.cold_start["weights"] = _time.perf_counter() - t0
+        jit_key = (cfg.model, cfg.dtype or "")
+        if jit_key not in _PREDICT_JIT:
+            import functools
+
+            _PREDICT_JIT[jit_key] = jax.jit(
+                functools.partial(_predict_impl, self.model))
+        self._predict = _PREDICT_JIT[jit_key]
         self._seed = 0
         self._warm = False
 
+    def _pull_params_from_peers(self):
+        """Peer weight birth: try each configured donor in order over
+        the chunked ``:pull`` envelope. Returns the assembled params
+        (stamping source/epoch) or None when every donor is dead — the
+        caller then falls back to the checkpoint path, so a newborn
+        always comes up."""
+        from kubeflow_tpu.serving import weights as weights_mod
+
+        reference = self.model.init(jax.random.PRNGKey(0),
+                                    self.model.config)
+        for donor in [p.strip() for p in self.cfg.weight_peers.split(",")
+                      if p.strip()]:
+            try:
+                leaves, version, _has_draft = weights_mod.pull_weights(
+                    donor, self.cfg.model,
+                    timeout=self.cfg.weight_pull_timeout_s)
+                model_leaves, _ = weights_mod.split_namespaces(leaves)
+                params = weights_mod.unflatten_params(model_leaves,
+                                                      reference)
+            except (OSError, ValueError) as e:
+                # Dead / mid-stream-dying / misbehaving donor: the
+                # assembler guarantees nothing partial survived; move
+                # to the next donor.
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "weight pull from donor %s failed: %s", donor, e)
+                continue
+            self.weight_pull_source = "peer"
+            self.boot_weights_version = int(version)
+            return params
+        return None
+
+    @staticmethod
+    def _normalize_placement(params):
+        """Land the boot weights as uncommitted default-device arrays —
+        the same flavor ``update_weights`` installs — regardless of
+        birth path. The jit executable cache keys on array sharding as
+        well as avals: a checkpoint restore hands back COMMITTED
+        arrays while a peer pull hands back host numpy, and without
+        this normalization a newborn recompiles executables its donor
+        (or the persistent compile cache) already holds."""
+        return jax.device_put(jax.tree.map(np.asarray, params))
+
     def _load_params(self):
+        if self.cfg.weight_peers:
+            params = self._pull_params_from_peers()
+            if params is not None:
+                return self._normalize_placement(params)
         params = self.model.init(jax.random.PRNGKey(0), self.model.config)
         if self.cfg.checkpoint_dir:
             from kubeflow_tpu.train import checkpoint as ckpt_lib
@@ -243,36 +364,13 @@ class InferenceEngine:
                     f"no checkpoint under {self.cfg.checkpoint_dir}"
                 )
             params = restored[0].params
-        return params
+            self.weight_pull_source = "checkpoint"
+        return self._normalize_placement(params)
 
     # ------------------------------------------------------------------
 
     def _predict_fn(self, params, inputs):
-        cfg = self.model.config
-        if self.model.family == "transformer":
-            logits = self.model.apply(params, inputs["tokens"], cfg)
-            # Causality makes position len-1 exact regardless of padding
-            # after it — gather each request's last real position.
-            last = jnp.take_along_axis(
-                logits, inputs["last_index"][:, None, None], axis=1
-            )[:, 0]
-            return {
-                "logits": last.astype(jnp.float32),
-                "next_token": jnp.argmax(last, axis=-1),
-            }
-        if self.model.family == "bert":
-            seq, pooled = self.model.apply(
-                params, inputs["tokens"], cfg,
-                pad_mask=inputs.get("pad_mask"),
-            )
-            return {"pooled": pooled.astype(jnp.float32)}
-        if self.model.family == "resnet":
-            logits = self.model.apply(params, inputs["images"], cfg)
-            return {
-                "probabilities": jax.nn.softmax(logits, axis=-1),
-                "classes": jnp.argmax(logits, axis=-1),
-            }
-        raise ValueError(self.model.family)
+        return _predict_impl(self.model, params, inputs)
 
     def warmup(self) -> None:
         self.predict_batch(self._example_instances(1))

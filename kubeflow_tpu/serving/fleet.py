@@ -145,6 +145,7 @@ class DecoderFleet:
         self.routed = 0
         self.spilled = 0
         self.remapped = 0  # submits re-routed off a just-dead replica
+        self.replicas_added = 0  # newborns joined via add_replica
         self.handoffs = 0           # prefill→decode KV relays completed
         self.handoff_fallbacks = 0  # degraded to a plain decode submit
         self.handoff_skipped = 0    # prompts too short to register
@@ -191,6 +192,71 @@ class DecoderFleet:
 
     def role_of(self, name: str) -> str:
         return self._roles.get(name, "")
+
+    def _warming(self, name: str) -> bool:
+        return bool(getattr(self._replicas.get(name), "warming", False))
+
+    def add_replica(self, name: str, decoder, *,
+                    warming: bool = True) -> None:
+        """Join a newborn replica to a RUNNING fleet (the flash-crowd
+        scale-up path; construction-time membership stays the common
+        case). The newborn is wired into the fleet's KV economy (shared
+        directory adopted, the in-process peer-fetch transport
+        installed) and its installed weights epoch is recorded from the
+        decoder's own ``weights_version`` — a peer-born decoder stamped
+        its donor's epoch at construction, so a concurrent rollout's
+        lag accounting sees it as current, not lagging from epoch 0.
+
+        ``warming=True`` (default) admits it via least-loaded spill
+        only — no affine key share — until :meth:`mark_warm`; pass
+        False for a replica already warmed (e.g. compile-cache birth
+        where the dispatch set deserialized).
+
+        Membership mutation is CONTROL-PLANE and single-writer (the
+        operator's reconcile loop, a test, or the bench harness) —
+        hot-path readers stay lock-free because the membership dicts
+        are never mutated in place: a join builds fresh dicts and
+        publishes them by atomic reference swap, so a concurrent
+        route sees either the old complete snapshot or the new one,
+        never a dict growing under iteration."""
+        if name in self._replicas:
+            raise ValueError(f"replica {name!r} already in the fleet")
+        decoder.warming = bool(warming)
+        role = getattr(decoder, "role", "") or ""
+        with self._lock:
+            self.replicas_added += 1
+            ver = int(getattr(decoder, "weights_version", 0) or 0)
+            if ver:
+                self._weights_installed[name] = ver
+        self._replicas = {**self._replicas, name: decoder}
+        self._roles = {**self._roles, name: role}
+        if self.kv_directory is None:
+            self.kv_directory = getattr(decoder, "kv_directory", None)
+        if self.cold_store is None:
+            self.cold_store = getattr(decoder, "cold_store", None)
+        if (getattr(decoder, "kv_directory", None) is not None
+                and getattr(decoder, "_peer_fetch", None) is None):
+            decoder._peer_fetch = self._peer_fetch
+
+    def mark_warm(self, name: str) -> None:
+        """Flip a newborn into full affine membership: the next route
+        recomputes rendezvous order with it eligible, so exactly the
+        keys that hash to it move — every other key stays put."""
+        d = self._replicas.get(name)
+        if d is not None:
+            d.warming = False
+
+    def donor_for(self, name: str = "") -> str | None:
+        """A live, warm, non-lagging replica to pull birth weights from
+        (the in-process analogue of the operator rendering lower-
+        indexed siblings into ``--weight-peers``). ``name`` excludes
+        the newborn itself. None when no viable donor exists — the
+        caller falls back to checkpoint birth."""
+        live = self._fresh(self.live_members())
+        for m in live:
+            if m != name and not self._warming(m):
+                return m
+        return None
 
     @property
     def disaggregated(self) -> bool:
@@ -311,12 +377,19 @@ class DecoderFleet:
                 return self._rng.choice(live)
         key = prefix_affinity_key(tokens, self.affinity_tokens)
         order = rendezvous_order(key, live)
-        primary = order[0]
+        # Ramped admission: a WARMING newborn takes no affine share —
+        # its keys stay on the established replicas until it reports
+        # warm (then they rebalance by plain rendezvous order on the
+        # next route) — but it stays in the spill pool below, so a
+        # genuine hotspot can overflow onto it immediately. All-warming
+        # degenerates to plain rendezvous: availability beats ramp.
+        primary = next((m for m in order if not self._warming(m)),
+                       order[0])
         if len(order) > 1 and self._over_pressure(primary):
             # Spill: least-loaded live replica; rendezvous order breaks
             # depth ties so the choice is deterministic for a given
             # (key, membership, load) snapshot.
-            spill = min(order[1:],
+            spill = min((m for m in order if m != primary),
                         key=lambda m: (self._depth(m), order.index(m)))
             if self._depth(spill) < self._depth(primary):
                 with self._lock:
@@ -578,6 +651,7 @@ class DecoderFleet:
             dead = sorted(self._dead)
             counters = {
                 "routed": self.routed, "spilled": self.spilled,
+                "replicas_added": self.replicas_added,
                 "remapped": self.remapped, "handoffs": self.handoffs,
                 "handoff_fallbacks": self.handoff_fallbacks,
                 "handoff_skipped": self.handoff_skipped,
@@ -604,6 +678,8 @@ class DecoderFleet:
         if self.cold_store is not None:
             agg["kv_cold_store"] = self.cold_store.stats()
         agg.update(replicas=per, live=sorted(per),
+                   warming=sorted(m for m in per if self._warming(m)),
+                   replicas_added=counters["replicas_added"],
                    dead=dead, routed=counters["routed"],
                    spilled=counters["spilled"],
                    remapped=counters["remapped"],

@@ -98,6 +98,28 @@ class RemoteActorFleet:
             self._rr += 1
             return live[self._rr % len(live)]
 
+    def add_target(self, target: str) -> None:
+        """Join a newborn actor address to the rotation (the
+        flash-crowd scale-up path — the operator scaled the pool and
+        the new pod's DNS just resolved). Its installed weights epoch
+        converges on the next broadcast; until then the max-lag
+        exclusion treats it exactly like any straggler.
+
+        Control-plane, single-writer: the rotation list is published
+        by atomic reference swap (never mutated in place), so the
+        lock-free pick path sees a complete snapshot either way."""
+        with self._lock:
+            self._dead.discard(target)
+        if target not in self.targets:
+            self.targets = [*self.targets, target]
+
+    def donors(self, exclude: str = "") -> list[str]:
+        """Live, non-lagging targets ordered for a newborn's
+        ``--weight-peers`` fallback chain (each is a valid source for
+        :func:`kubeflow_tpu.serving.weights.pull_weights`); ``exclude``
+        drops the newborn's own address."""
+        return [t for t in self._live() if t != exclude]
+
     def _mark_dead(self, target: str) -> None:
         with self._lock:
             self._dead.add(target)

@@ -48,12 +48,32 @@ class _Metrics:
             "serving_errors_total", "HTTP requests that failed")
         self._latency = self.registry.histogram(
             "serving_latency_seconds", "End-to-end request latency")
+        # Cold-start surface (flash-crowd elasticity): where this
+        # replica's boot weights came from, and the per-phase birth
+        # timing the ≥5x cold-to-first-token gate reads.
+        self._weight_pulls = self.registry.counter(
+            "serving_weight_pulls_total",
+            "Boot weight installs by source (peer = pulled from a "
+            "serving donor over :pull; checkpoint = restored from the "
+            "store; init = fresh random init)", labels=("source",))
+        self._cold_start = self.registry.gauge(
+            "serving_cold_start_seconds",
+            "Birth phase durations: weights (install), compile "
+            "(dispatch-set warm), first_token (boot to serving-ready)",
+            labels=("phase",))
 
     def observe(self, seconds: float, error: bool) -> None:
         self._requests.inc()
         if error:
             self._errors.inc()
         self._latency.observe(seconds)
+
+    def record_weight_pull(self, source: str) -> None:
+        self._weight_pulls.labels(source or "init").inc()
+
+    def record_cold_start(self, phases: dict) -> None:
+        for phase, seconds in phases.items():
+            self._cold_start.labels(phase).set(float(seconds))
 
     def render(self) -> str:
         return self.registry.render()
@@ -70,6 +90,7 @@ class ModelServer:
     def __init__(self, engine_cfg: EngineConfig, *, port: int = 8500,
                  grpc_port: int | None = None,
                  batch_timeout_ms: float = 5.0):
+        self._t_boot = time.perf_counter()
         self.engine = InferenceEngine(engine_cfg)
         self.batcher = DynamicBatcher(
             self.engine.predict_batch, engine_cfg.batch_size, batch_timeout_ms
@@ -88,6 +109,18 @@ class ModelServer:
         # serialized so concurrent learner chunks interleave safely.
         self._weights_assembler = None
         self._weights_lock = threading.Lock()
+        # Donor-side pull export (:pull endpoint): the flattened host
+        # copy of the current epoch's tree, chunk-planned once and
+        # re-served to every concurrent newborn; invalidated by version
+        # compare when a live push swaps epochs. Leaf lock guarding only
+        # the cached tuple (the flatten/pack work runs outside it).
+        self._export_cache = None
+        self._export_lock = threading.Lock()
+        # Readiness ramp: True from construction until warm() covers
+        # the boot path — /healthz answers {"status": "warming"} so the
+        # gateway route-excludes this replica without failure-counter
+        # penalty while it compiles.
+        self.warming = True
 
     @property
     def decoder(self):
@@ -153,6 +186,8 @@ class ModelServer:
                         self.engine.cfg.kv_import_crossover_tokens),
                     replica_name=(
                         f"{self.engine.cfg.model}:{self.port}"),
+                    boot_weights_version=self.engine.boot_weights_version,
+                    compile_cache_dir=self.engine.cfg.compile_cache_dir,
                 )
             return self._decoder
 
@@ -390,6 +425,54 @@ class ModelServer:
             params, version=chunk["weights_version"], draft_params=draft)
         return {"installed": True, "weights_version": installed}
 
+    def handle_weights_pull(self, name: str, body: dict) -> dict:
+        """Donor side of peer weight birth (``:pull``): a NEWBORN
+        replica POSTs ``{"seq": k}`` and gets back chunk ``k`` of this
+        server's CURRENT weights epoch as a standard push envelope —
+        the PR-15 transport's reverse direction, so the newborn's
+        weights arrive already at the fleet's version and no checkpoint
+        store sits on the scale-up hot path.
+
+        The flattened host tree is chunk-planned once per epoch and
+        cached (``_export_cache``); a live push swapping epochs
+        mid-pull changes the version the next chunk carries, which the
+        puller's assembler treats exactly like a superseded push —
+        restart, never a mixed-epoch install. A ``seq`` beyond the
+        chunk count is a KeyError (404): the puller overshot a
+        shrinking plan after an epoch swap and will restart."""
+        from kubeflow_tpu.serving import weights as weights_mod
+
+        if name != self.engine.cfg.model:
+            raise KeyError(f"model {name!r} not served")
+        seq = int(body.get("seq", 0))
+        # A decoder (live-pushable) serves its epoch-consistent
+        # snapshot; a plain predict server donates the engine's boot
+        # tree at the epoch it booted with.
+        with self._decoder_lock:
+            decoder = self._decoder
+        if decoder is not None:
+            params, version = decoder.weights_snapshot()
+        else:
+            params = self.engine.params
+            version = self.engine.boot_weights_version
+        with self._export_lock:
+            cache = self._export_cache
+        if cache is None or cache[0] != version:
+            # Flatten + plan OUTSIDE the lock (device fetches and a
+            # full host copy must not serialize concurrent pulls; a
+            # losing racer just rebuilds the same plan).
+            items = weights_mod.flatten_namespaced(params)
+            groups = weights_mod.plan_chunks(items)
+            cache = (version, groups)
+            with self._export_lock:
+                self._export_cache = cache
+        version, groups = cache
+        if not 0 <= seq < len(groups):
+            raise KeyError(f"weights chunk {seq} beyond plan "
+                           f"({len(groups)} chunks at epoch {version})")
+        return weights_mod.pack_chunk(groups[seq], version, seq,
+                                      len(groups), False)
+
     def handle_metadata(self, name: str) -> dict:
         if name != self.engine.cfg.model:
             raise KeyError(f"model {name!r} not served")
@@ -421,7 +504,11 @@ class ModelServer:
 
             def do_GET(self):
                 if self.path in ("/healthz", "/livez"):
-                    self._send(200, {"status": "ok"})
+                    # "warming" is alive-but-not-serving: the gateway
+                    # route-excludes without a failure-counter penalty
+                    # (a newborn mid-compile is not a dead upstream).
+                    status = "warming" if server.warming else "ok"
+                    self._send(200, {"status": status})
                 elif self.path == "/readyz":
                     code = 200 if server.engine.ready else 503
                     self._send(code, {"ready": server.engine.ready})
@@ -569,6 +656,15 @@ class ModelServer:
                             # stale-hit refusals land here.
                             "serving_weights_stale_refused_total":
                                 d["weights_stale_refused"],
+                            # Flash-crowd birth surface: persistent
+                            # compile-cache coverage of the dispatch
+                            # set, and the ramp gate (1 while this
+                            # replica is spill-only).
+                            "serving_compile_cache_hits_total":
+                                d["compile_cache_hits"],
+                            "serving_compile_cache_misses_total":
+                                d["compile_cache_misses"],
+                            "serving_warming": int(d["warming"]),
                             "serving_in_flight": d["in_flight"],
                             "serving_queued": d["queued"],
                             # serving_tp_shards rides the decoder
@@ -701,6 +797,11 @@ class ModelServer:
                         name = self.path[len("/v1/models/"):
                                          -len(":weights")]
                         self._send(200, server.handle_weights(name, body))
+                    elif self.path.startswith("/v1/models/") and \
+                            self.path.endswith(":pull"):
+                        name = self.path[len("/v1/models/"):-len(":pull")]
+                        self._send(200,
+                                   server.handle_weights_pull(name, body))
                     else:
                         error = True
                         self._send(404, {"error": f"no route {self.path}"})
@@ -760,8 +861,30 @@ class ModelServer:
         self.grpc_port = self._grpc.bound_port  # resolve port 0 → real port
         self._grpc.start()
 
-    def start(self) -> None:
+    def warm(self) -> None:
+        """Boot warm path, run AFTER the HTTP port binds so ``/healthz``
+        answers ``warming`` (route-excluded, not dead) for the whole
+        birth instead of connection-refusing: engine warmup (compiles
+        the predict executable), then — when the flash-crowd surface is
+        configured (``compile_cache_dir``/``weight_peers``) — an eager
+        decoder build + dispatch-set warm so the replica joins the
+        fleet with nothing left to compile. Publishes the per-phase
+        cold-start breakdown and flips ``warming`` off."""
+        t0 = time.perf_counter()
         self.engine.warmup()
+        if self.engine.cfg.compile_cache_dir or self.engine.cfg.weight_peers:
+            decoder = self.decoder
+            if decoder is not None:
+                decoder.warming = True
+                decoder.warm()
+        self.engine.cold_start["compile"] = time.perf_counter() - t0
+        self.engine.cold_start["first_token"] = (time.perf_counter()
+                                                 - self._t_boot)
+        self.metrics.record_cold_start(self.engine.cold_start)
+        self.metrics.record_weight_pull(self.engine.weight_pull_source)
+        self.warming = False
+
+    def start(self) -> None:
         self._start_grpc()
         self._httpd = ThreadingHTTPServer(
             ("0.0.0.0", self.port), self._make_handler()
@@ -770,13 +893,16 @@ class ModelServer:
         thread = threading.Thread(target=self._httpd.serve_forever,
                                   daemon=True)
         thread.start()
+        self.warm()
 
     def serve_forever(self) -> None:
-        self.engine.warmup()
         self._start_grpc()
         self._httpd = ThreadingHTTPServer(
             ("0.0.0.0", self.port), self._make_handler()
         )
+        # Warm on a side thread: the accept loop must answer health
+        # probes (as "warming") while the dispatch set compiles.
+        threading.Thread(target=self.warm, daemon=True).start()
         self._httpd.serve_forever()
 
     def stop(self) -> None:

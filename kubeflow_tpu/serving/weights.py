@@ -109,6 +109,49 @@ def unflatten_params(leaves: dict[str, np.ndarray], reference):
         treedef, [leaves[p] for p in want])
 
 
+def plan_chunks(items: list[tuple[str, np.ndarray]],
+                chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+                ) -> list[list[tuple[str, np.ndarray]]]:
+    """Split flattened ``(path, array)`` items into chunk groups on
+    leaf boundaries by cumulative payload size. Shared by the push
+    packer and the donor-side pull export, so both directions of the
+    transport agree on chunk count for a given tree."""
+    groups: list[list[tuple[str, np.ndarray]]] = [[]]
+    size = 0
+    for path, arr in items:
+        nbytes = int(arr.nbytes)
+        if groups[-1] and size + nbytes > max(1, int(chunk_bytes)):
+            groups.append([])
+            size = 0
+        groups[-1].append((path, arr))
+        size += nbytes
+    return groups
+
+
+def flatten_namespaced(params, draft_params=None,
+                       ) -> list[tuple[str, np.ndarray]]:
+    """Flatten a (params, draft) pair into the namespaced ``m/``/``d/``
+    item list every envelope carries."""
+    items = [("m/" + p, a) for p, a in flatten_params(params).items()]
+    if draft_params is not None:
+        items += [("d/" + p, a)
+                  for p, a in flatten_params(draft_params).items()]
+    return items
+
+
+def pack_chunk(group: list[tuple[str, np.ndarray]], weights_version: int,
+               seq: int, total: int, has_draft: bool) -> dict:
+    """One chunk group → its self-describing envelope."""
+    return {
+        "version": WEIGHTS_ENVELOPE_VERSION,
+        "weights_version": int(weights_version),
+        "seq": int(seq),
+        "chunks": int(total),
+        "has_draft": bool(has_draft),
+        "leaves": {p: _pack_array(a) for p, a in group},
+    }
+
+
 def pack_weights(params, weights_version: int, *,
                  chunk_bytes: int = DEFAULT_CHUNK_BYTES,
                  draft_params=None) -> list[dict]:
@@ -120,30 +163,11 @@ def pack_weights(params, weights_version: int, *,
     partial push. ``draft_params`` (a paired draft model's tree) rides
     the same envelopes under a separate namespace, so target and draft
     install in the same epoch."""
-    items = [("m/" + p, a) for p, a in flatten_params(params).items()]
-    if draft_params is not None:
-        items += [("d/" + p, a)
-                  for p, a in flatten_params(draft_params).items()]
-    groups: list[list[tuple[str, np.ndarray]]] = [[]]
-    size = 0
-    for path, arr in items:
-        nbytes = int(arr.nbytes)
-        if groups[-1] and size + nbytes > max(1, int(chunk_bytes)):
-            groups.append([])
-            size = 0
-        groups[-1].append((path, arr))
-        size += nbytes
-    chunks = []
-    for seq, group in enumerate(groups):
-        chunks.append({
-            "version": WEIGHTS_ENVELOPE_VERSION,
-            "weights_version": int(weights_version),
-            "seq": seq,
-            "chunks": len(groups),
-            "has_draft": draft_params is not None,
-            "leaves": {p: _pack_array(a) for p, a in group},
-        })
-    return chunks
+    items = flatten_namespaced(params, draft_params)
+    groups = plan_chunks(items, chunk_bytes)
+    return [pack_chunk(group, weights_version, seq, len(groups),
+                       draft_params is not None)
+            for seq, group in enumerate(groups)]
 
 
 def unpack_chunk(env: dict) -> dict:
@@ -264,3 +288,73 @@ def push_weights(target: str, model: str, params, weights_version: int,
         finally:
             conn.close()
     return out
+
+
+def pull_weights(source: str, model: str, *,
+                 timeout: float = 60.0) -> tuple[dict, int, bool]:
+    """Pull a donor replica's param pytree over the chunked envelope —
+    the PR-15 transport's new direction (replica birth): a NEWBORN asks
+    a serving peer for its weights instead of touching the checkpoint
+    store on the hot path.
+
+    POSTs ``{"seq": k}`` at ``source``'s ``:pull`` endpoint chunk by
+    chunk and assembles through :class:`WeightChunkAssembler`, so the
+    epoch-consistency rules are the push path's exactly: every chunk
+    carries the donor's weights epoch, a push landing ON THE DONOR
+    mid-pull bumps the epoch and the assembler discards the partial
+    older tree (the pull restarts at the new epoch — a mixed-epoch
+    install is impossible by construction), and the assembled tree is
+    complete or nothing.
+
+    Returns ``(leaves, weights_version, has_draft)`` — namespaced
+    leaves ready for :func:`split_namespaces`. Raises ``OSError`` /
+    ``ValueError`` on a dead or misbehaving donor; the caller
+    (engine birth) owns the donor-list fallback."""
+    host, _, port_s = source.partition(":")
+    seq = 0
+    version: int | None = None
+    asm = WeightChunkAssembler()
+    # 2 epoch restarts tolerated: a rollout storm pushing faster than a
+    # pull can drain is a donor to give up on, not to chase forever.
+    restarts = 0
+    while True:
+        data = json.dumps({"seq": seq}).encode()
+        conn = HTTPConnection(host, int(port_s or 80), timeout=timeout)
+        try:
+            conn.request("POST", f"/v1/models/{model}:pull", body=data,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            body = resp.read()
+            if resp.status != 200:
+                raise ValueError(
+                    f"weights pull chunk {seq} refused: "
+                    f"HTTP {resp.status} {body[:200]!r}")
+            env = json.loads(body)
+        finally:
+            conn.close()
+        chunk = unpack_chunk(env)
+        if version is not None and chunk["weights_version"] != version:
+            # The donor swapped epochs mid-pull: restart at chunk 0 of
+            # the new epoch (the assembler already dropped the partial).
+            restarts += 1
+            if restarts > 2:
+                raise ValueError(
+                    f"donor {source} kept swapping weights epochs "
+                    f"mid-pull ({version} -> {chunk['weights_version']})")
+            version = chunk["weights_version"]
+            done = asm.add(chunk) if chunk["seq"] == 0 else None
+            seq = 1 if chunk["seq"] == 0 else 0
+            if done is not None:
+                leaves, has_draft = done
+                return leaves, version, has_draft
+            continue
+        version = chunk["weights_version"]
+        done = asm.add(chunk)
+        if done is not None:
+            leaves, has_draft = done
+            return leaves, version, has_draft
+        seq += 1
+        if seq >= chunk["chunks"]:
+            raise ValueError(
+                f"donor {source} never completed epoch {version}: "
+                f"{asm.pending} chunks still missing after a full sweep")

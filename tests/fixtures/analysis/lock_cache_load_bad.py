@@ -1,0 +1,41 @@
+"""Minimized flash-crowd hazard: the persistent compile-cache replay
+— deserialize a cached executable, run the probe batch and sync it
+hot — issued UNDER the decoder's dispatch lock.
+
+The warm() contract says cache replay runs on the booting thread with
+NO dispatch lock held: the decode loop takes the same lock for every
+token step, so a replay sync parks the whole replica's token cadence
+behind one executable's warm-up — on a cold node, behind a full XLA
+compile. The lock-discipline checker must flag the device sync
+(``lock-blocking-call``).
+"""
+
+import threading
+
+import jax
+
+
+class BadCacheLoader:
+    """Replays a cached executable with the dispatch lock held."""
+
+    def __init__(self, cache):
+        self._dispatch_lock = threading.Lock()
+        self._cache = cache
+        self._executables = {}
+
+    def dispatch(self, key, batch):
+        with self._dispatch_lock:
+            return self._executables[key](batch)
+
+    def ensure_compiled(self, key, fn, probe):
+        with self._dispatch_lock:
+            if key in self._executables:
+                return self._executables[key]
+            entry = self._cache.load(key)
+            compiled = fn if entry is None else entry.bind(fn)
+            # BUG: the probe run + device sync (a full compile on a
+            # cache miss) happens under the lock every decode step
+            # takes — one replay stalls the replica's token cadence.
+            jax.block_until_ready(compiled(probe))
+            self._executables[key] = compiled
+            return compiled

@@ -1,0 +1,33 @@
+"""Flash-crowd GOOD twin: probe the executable table under the
+dispatch lock, run the cache replay — deserialize, probe batch,
+device sync — with NO lock held (the decode loop keeps stepping
+tokens against the executables it already has), then re-take the
+lock only to publish the warmed executable."""
+
+import threading
+
+import jax
+
+
+class GoodCacheLoader:
+    """Probe under the lock; replay outside; publish under it again."""
+
+    def __init__(self, cache):
+        self._dispatch_lock = threading.Lock()
+        self._cache = cache
+        self._executables = {}
+
+    def dispatch(self, key, batch):
+        with self._dispatch_lock:
+            return self._executables[key](batch)
+
+    def ensure_compiled(self, key, fn, probe):
+        with self._dispatch_lock:
+            cached = self._executables.get(key)
+        if cached is not None:
+            return cached
+        entry = self._cache.load(key)
+        compiled = fn if entry is None else entry.bind(fn)
+        jax.block_until_ready(compiled(probe))
+        with self._dispatch_lock:
+            return self._executables.setdefault(key, compiled)

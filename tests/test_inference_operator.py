@@ -480,3 +480,118 @@ def test_http_scrape_against_real_model_server():
     finally:
         server.stop()
     assert _http_fetch_signals("127.0.0.1:1") is None  # dead replica
+
+
+# ---------------------------------------------------------------------------
+# Flash-crowd elasticity: predictive scale-up + newborn ramp guard
+# ---------------------------------------------------------------------------
+
+
+def test_predictive_scale_up_fires_before_any_observed_breach(env):
+    """With autoscale.predictive the pool keeps a scrape history, fits
+    the trend, and scales TO the projected need while every observed
+    sample is still under target — the replicas are born before the
+    SLO is breached, not after."""
+    api, ctrl, clock, signals, _ = env
+    api.create(_cr(autoscale={"cooldownSeconds": 30,
+                              "scrapePeriodSeconds": 5,
+                              "predictive": True,
+                              "horizonSeconds": 30,
+                              "maxStepUp": 4}))
+    # Queue wait climbing 20ms/s but still under the 500ms target at
+    # every observed point: 200ms -> 300ms -> 400ms over two periods.
+    for wait_s in (0.2, 0.3, 0.4):
+        signals["value"] = {**CALM, "queue_wait_p99_s": wait_s}
+        ctrl.reconcile_all()
+        clock["t"] += 5
+    st = _status(api)
+    # Projection at +30s is 1.0s = 2x target -> scale-to-N jumps the
+    # pool straight from 2 to 4 (ceil(2 * 2.0)), not +1.
+    assert st["replicas"] == 4
+    assert "predictive scale-up" in st["lastScaleReason"]
+    assert "queue_wait_p99" in st["lastScaleReason"]
+    from kubeflow_tpu.operators.base import OPERATOR_METRICS
+    assert "inference_predictive_scaleups_total" in \
+        OPERATOR_METRICS.render()
+
+
+def test_reactive_only_pool_never_scales_predictively(env):
+    """The same climbing-but-under-target trace with predictive off
+    (the default) holds steady: reactive behavior is unchanged."""
+    api, ctrl, clock, signals, _ = env
+    api.create(_cr())
+    for wait_s in (0.2, 0.3, 0.4):
+        signals["value"] = {**CALM, "queue_wait_p99_s": wait_s}
+        ctrl.reconcile_all()
+        clock["t"] += 5
+    assert _status(api)["replicas"] == 2
+
+
+def test_newborn_mid_cooldown_never_triggers_blind_scale_down(env):
+    """Satellite regression: a replica born mid-cooldown that cannot
+    be scraped yet must neither count as a calm vote nor let the
+    seasoned replicas' calm shrink the pool out from under it — the
+    scale-down that would kill the newborn the breach just paid for."""
+    api, ctrl, clock, signals, _ = env
+    young = {"unscrapeable": True}
+
+    def fetch(addr):
+        if "-r2." in addr and young["unscrapeable"]:
+            return None  # newborn: weights pulling, no exposition yet
+        return dict(signals["value"])
+
+    ctrl.fetch_metrics = fetch
+    api.create(_cr(autoscale={"cooldownSeconds": 30,
+                              "scrapePeriodSeconds": 5},
+                   warmup={"rampSeconds": 60}))
+    ctrl.reconcile_all()
+    signals["value"] = dict(BREACH)
+    clock["t"] += 5
+    ctrl.reconcile_all()  # birth of llm-r2 at t=5
+    assert _status(api)["replicas"] == 3
+
+    # Relief lands; the established replicas read LOW; the cooldown
+    # (30s) elapses at t=40 — but the newborn is still ramping (<60s)
+    # and unscrapeable. Without the ramp guard this reconcile would
+    # scale down on two calm votes and kill the newborn blind.
+    signals["value"] = dict(LOW)
+    clock["t"] += 35
+    ctrl.reconcile_all()
+    st = _status(api)
+    assert st["replicas"] == 3
+    assert "still ramping" in st["lastScaleReason"]
+
+    # Ramp over (t=70 > birth+60), the newborn scrapes calm like its
+    # siblings: the normal cooled scale-down proceeds.
+    young["unscrapeable"] = False
+    clock["t"] += 30
+    ctrl.reconcile_all()
+    assert _status(api)["replicas"] == 2
+
+
+def test_warmup_spec_renders_cache_volume_and_peer_chain(env):
+    """spec.warmup flows into every replica: the shared compile-cache
+    hostPath volume on all, --weight-peers only on replicas with a
+    lower-indexed sibling to pull from (r0 must boot from the
+    checkpoint — someone has to be first)."""
+    api, ctrl, *_ = env
+    api.create(_cr(warmup={
+        "compileCacheDir": "/var/cache/kubeflow-tpu/compile",
+        "peerWeights": True}))
+    ctrl.reconcile_all()
+
+    def replica(i):
+        dep = api.get("apps/v1", "Deployment", f"llm-r{i}", NS)
+        pod = dep["spec"]["template"]["spec"]
+        return pod, pod["containers"][0]["args"]
+
+    pod0, args0 = replica(0)
+    pod1, args1 = replica(1)
+    cache_flag = "--compile-cache-dir=/var/cache/kubeflow-tpu/compile"
+    assert cache_flag in args0 and cache_flag in args1
+    assert not any(a.startswith("--weight-peers") for a in args0)
+    assert "--weight-peers=llm-r0.kubeflow:8500" in args1
+    for pod in (pod0, pod1):
+        vols = {v["name"]: v for v in pod.get("volumes", [])}
+        assert vols["compile-cache"]["hostPath"]["path"] == \
+            "/var/cache/kubeflow-tpu/compile"
