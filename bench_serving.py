@@ -110,21 +110,26 @@ from __future__ import annotations
 
 import argparse
 import json
-import math
 import os
 import time
 from concurrent.futures import ThreadPoolExecutor
 
 import jax
 
-
-def percentile(sorted_vals, p):
-    """Nearest-rank percentile over an ascending list: the value at rank
-    ``ceil(p/100 * n)`` (1-based). The previous ``int(n*p/100)`` index
-    read one element high on exact-rank hits — p50 of an even-length
-    list returned the upper middle element."""
-    rank = math.ceil(len(sorted_vals) * p / 100)
-    return sorted_vals[max(rank, 1) - 1]
+# The scenario registry (kubeflow_tpu/serving/scenarios.py) is the single
+# implementation shared by this CLI, the CI smoke scripts, and the
+# ExperimentController's tuning trials. The moved scenarios keep their
+# historical underscore aliases so every existing caller still resolves.
+from kubeflow_tpu.serving.scenarios import (  # noqa: F401
+    all_scenarios,
+    bench_concurrency_sweep as _bench_concurrency_sweep,
+    bench_prefix_reuse as _bench_prefix_reuse,
+    bench_speculative as _bench_speculative,
+    decode_burst_tps as _decode_burst_tps,
+    get_scenario,
+    percentile,
+    run_trial,
+)
 
 
 def _bench_predict(args, model) -> dict:
@@ -282,280 +287,6 @@ def _bench_generate(args, model) -> dict:
                   f"chunk{args.decode_chunk}",
     })
     return out
-
-
-def _bench_prefix_reuse(args, model) -> dict:
-    """Prefix-reuse scenario: N concurrent requests sharing an S-token
-    system prompt, decoded greedily through the continuous decoder with
-    the prefix cache ON vs OFF. Reports TTFT, prefill dispatch/token
-    volume, and the cache counters; emitted tokens must be identical
-    both ways (``regression`` flags a mismatch or a <2x volume win)."""
-    from kubeflow_tpu.models.registry import get_model
-    from kubeflow_tpu.serving.continuous import ContinuousDecoder
-
-    spec = get_model(model)
-    params = spec.init(jax.random.PRNGKey(0), spec.config)
-    n = 16 if args.quick else max(16, args.requests // 8)
-    gen = min(args.max_new_tokens, 8)
-    system = list(range(3, 3 + args.prefix_len))  # the shared prefix
-    prompts = [system + [200 + i, 17, 11 + (i % 5)] for i in range(n)]
-    prefill_len = max(args.seq_len, args.prefix_len + 8)
-
-    runs = {}
-    for label, cache_slots in (("off", 0), ("on", 8)):
-        d = ContinuousDecoder(
-            params, spec.config, slots=8, prefill_len=prefill_len,
-            max_new_tokens=gen, prefix_cache_slots=cache_slots,
-            prefix_cache_min_len=16, prefill_len_buckets=3)
-        try:
-            if cache_slots:
-                # Preload the shared system prompt (what a production
-                # deployment does at startup) so every request hits.
-                d.prime_prefix(system)
-            # Warm the compiled admission shapes outside the timed burst.
-            d.generate(prompts[0][:4], 1)
-
-            def one(p):
-                h = d.submit(p, gen)
-                res = h.result(timeout=300)
-                return res["tokens"], h.ttft_s * 1e3
-            with ThreadPoolExecutor(args.concurrency) as pool:
-                results = list(pool.map(one, prompts))
-            m = d.metrics()
-        finally:
-            d.stop()
-        runs[label] = {
-            "tokens": [t for t, _ in results],
-            "ttft_p50_ms": round(percentile(
-                sorted(ms for _, ms in results), 50), 2),
-            "prefill_dispatches": m["prefill_dispatches"],
-            "prefill_tokens": m["prefill_tokens"],
-            "prefix_hits": m["prefix_hits"],
-            "prefix_tokens_reused": m["prefix_tokens_reused"],
-        }
-
-    identical = runs["on"]["tokens"] == runs["off"]["tokens"]
-    ratio = runs["off"]["prefill_tokens"] / max(
-        runs["on"]["prefill_tokens"], 1)
-    return {
-        "metric": "serving_prefix_reuse_ttft_p50_ms",
-        "value": runs["on"]["ttft_p50_ms"],
-        "unit": "ms",
-        "vs_baseline": 1.0,
-        "ttft_off_p50_ms": runs["off"]["ttft_p50_ms"],
-        "prefill_tokens_off": runs["off"]["prefill_tokens"],
-        "prefill_tokens_on": runs["on"]["prefill_tokens"],
-        "prefill_volume_ratio": round(ratio, 2),
-        "prefill_dispatches_off": runs["off"]["prefill_dispatches"],
-        "prefill_dispatches": runs["on"]["prefill_dispatches"],
-        "prefix_hits": runs["on"]["prefix_hits"],
-        "prefix_tokens_reused": runs["on"]["prefix_tokens_reused"],
-        "tokens_identical": identical,
-        "regression": (not identical) or ratio < 2.0,
-        "config": f"{model} prefix{args.prefix_len} n{n} gen{gen} "
-                  f"prefill{prefill_len} c{args.concurrency}",
-    }
-
-
-def _bench_speculative(args, model) -> dict:
-    """Speculative-decoding scenario: N concurrent greedy requests through
-    the continuous decoder with speculation off / n-gram / draft-model.
-    Tokens must be byte-identical in every mode (speculation may only
-    change cost); the draft-model run (same weights, so acceptance is
-    structural, not luck) must clear >1.5 accepted tokens per verify
-    dispatch — the dispatch economy that motivates the feature."""
-    from kubeflow_tpu.models.registry import get_model
-    from kubeflow_tpu.serving.continuous import ContinuousDecoder
-
-    spec = get_model(model)
-    params = spec.init(jax.random.PRNGKey(0), spec.config)
-    n = 8 if args.quick else max(8, args.requests // 16)
-    gen = min(args.max_new_tokens, 16)
-    k = args.speculative_k
-    # Mildly repetitive prompts: gives the n-gram proposer something to
-    # find without rigging the model's own continuations.
-    prompts = [([3 + i, 17, 29, 3 + i, 17] * 3)[:12] for i in range(n)]
-
-    runs = {}
-    modes = (("off", {}),
-             ("ngram", {"speculative_k": k, "draft_mode": "ngram"}),
-             ("draft_model", {"speculative_k": k,
-                              "draft_mode": f"model:{model}"}))
-    for label, kw in modes:
-        d = ContinuousDecoder(params, spec.config, slots=8, prefill_len=32,
-                              max_new_tokens=gen, **kw)
-        try:
-            d.generate(prompts[0][:4], 1)  # warm the compiled shapes
-
-            def one(p):
-                h = d.submit(p, gen)
-                return h.result(timeout=300)["tokens"]
-            t0 = time.perf_counter()
-            with ThreadPoolExecutor(args.concurrency) as pool:
-                tokens = list(pool.map(one, prompts))
-            wall = time.perf_counter() - t0
-            m = d.metrics()
-        finally:
-            d.stop()
-        runs[label] = {
-            "tokens": tokens,
-            "wall_s": wall,
-            "decode_dispatches": m["decode_dispatches"],
-            "spec_drafted_tokens": m["spec_drafted_tokens"],
-            "spec_accepted_tokens": m["spec_accepted_tokens"],
-            "spec_verify_dispatches": m["spec_verify_dispatches"],
-            "spec_draft_dispatches": m["spec_draft_dispatches"],
-            "spec_acceptance_rate": round(m["spec_acceptance_rate"], 3),
-        }
-
-    identical = (runs["ngram"]["tokens"] == runs["off"]["tokens"]
-                 and runs["draft_model"]["tokens"] == runs["off"]["tokens"])
-    dm = runs["draft_model"]
-    accepted_per_dispatch = (dm["spec_accepted_tokens"]
-                             / max(dm["spec_verify_dispatches"], 1))
-    return {
-        "metric": "serving_spec_accepted_tokens_per_dispatch",
-        "value": round(accepted_per_dispatch, 2),
-        "unit": "tokens/dispatch",
-        "vs_baseline": 1.0,
-        "acceptance_rate": dm["spec_acceptance_rate"],
-        "ngram_acceptance_rate": runs["ngram"]["spec_acceptance_rate"],
-        "ngram_accepted_tokens": runs["ngram"]["spec_accepted_tokens"],
-        "drafted_tokens": dm["spec_drafted_tokens"],
-        "accepted_tokens": dm["spec_accepted_tokens"],
-        "verify_dispatches": dm["spec_verify_dispatches"],
-        "draft_dispatches": dm["spec_draft_dispatches"],
-        "decode_dispatches_off": runs["off"]["decode_dispatches"],
-        "decode_dispatches_on": dm["decode_dispatches"],
-        "tokens_identical": identical,
-        "regression": (not identical) or accepted_per_dispatch <= 1.5,
-        "config": f"{model} k{k} n{n} gen{gen} c{args.concurrency}",
-    }
-
-
-def _bench_concurrency_sweep(args, model) -> dict:
-    """Dense vs paged KV at EQUAL total pool bytes under an offered-
-    concurrency ladder of mixed-length greedy requests.
-
-    The dense decoder reserves ``slots * total_len`` positions, so its
-    in-flight ceiling is ``slots`` no matter how short the requests are.
-    The paged decoder gets the SAME pool bytes (``slots * total_len /
-    block_size`` blocks) but 4x the slots: admission is bounded by
-    tokens resident, so the mixed-length load packs more concurrent
-    requests into the identical HBM budget. A sequential probe pins
-    byte-identical greedy outputs between layouts; the regression marker
-    fires on divergence, on a paged in-flight peak below 2x dense, or on
-    leaked blocks after drain."""
-    from kubeflow_tpu.models.registry import get_model
-    from kubeflow_tpu.serving.continuous import ContinuousDecoder
-
-    spec = get_model(model)
-    params = spec.init(jax.random.PRNGKey(0), spec.config)
-    gen = min(args.max_new_tokens, 16)
-    prefill_len = 32
-    block = 8
-    total = prefill_len + gen
-    dense_slots = 4
-    pool_blocks = dense_slots * (total // block)  # equal KV bytes
-    cfg = spec.config
-    bytes_per_token = (2 * cfg.n_layers * cfg.n_kv_heads * cfg.head_dim
-                       * jax.numpy.dtype(cfg.dtype).itemsize)
-    ladder = [4, 16] if args.quick else [4, 16, 64]
-
-    def request(i):
-        plen = (4, 6, 8, 10)[i % 4]
-        want = (2, 3, 4, gen // 2)[i % 4]
-        return [3 + (i % 7)] * plen, want
-
-    probes = [[1, 2, 3], [7, 5], [9, 9, 9, 9, 2]]
-    runs = {}
-    for layout in ("dense", "paged"):
-        kw = (dict(kv_layout="paged", kv_block_size=block,
-                   kv_pool_blocks=pool_blocks)
-              if layout == "paged" else {})
-        slots = dense_slots * 4 if layout == "paged" else dense_slots
-        d = ContinuousDecoder(params, spec.config, slots=slots,
-                              prefill_len=prefill_len, max_new_tokens=gen,
-                              prefill_len_buckets=2,
-                              stream_timeout_s=300.0, **kw)
-        try:
-            # Sequential parity probe (also warms compiled shapes):
-            # layout must never change tokens.
-            probe_out = [d.generate(p, 4) ["tokens"] for p in probes]
-            levels = {}
-            for n in ladder:
-                t0 = time.perf_counter()
-
-                def one(i):
-                    toks, want = request(i)
-                    return len(d.submit(toks, want).result()["tokens"])
-                with ThreadPoolExecutor(n) as pool:
-                    emitted = sum(pool.map(one, range(n)))
-                wall = time.perf_counter() - t0
-                levels[n] = round(emitted / wall, 1)
-            m = d.metrics()
-        finally:
-            d.stop()
-        runs[layout] = {
-            "tokens": probe_out,
-            "levels": levels,
-            "peak_in_flight": m["peak_in_flight"],
-            "kv_blocks_peak": m["kv_blocks_peak"],
-            "kv_blocks_in_use": m["kv_blocks_in_use"],
-            "defer_admissions": m["kv_defer_admissions"],
-            "kv_peak_bytes": (
-                m["kv_blocks_peak"] * block * bytes_per_token
-                if layout == "paged"
-                else slots * total * bytes_per_token),
-        }
-
-    identical = runs["paged"]["tokens"] == runs["dense"]["tokens"]
-    leak = runs["paged"]["kv_blocks_in_use"]
-    dense_peak = runs["dense"]["peak_in_flight"]
-    paged_peak = runs["paged"]["peak_in_flight"]
-    top = ladder[-1]
-    return {
-        "metric": "serving_paged_peak_in_flight",
-        "value": paged_peak,
-        "unit": "requests",
-        "vs_baseline": 1.0,
-        "dense_peak_in_flight": dense_peak,
-        "concurrency_ratio": round(paged_peak / max(dense_peak, 1), 2),
-        "tokens_per_sec_dense": runs["dense"]["levels"],
-        "tokens_per_sec_paged": runs["paged"]["levels"],
-        "pool_bytes": pool_blocks * block * bytes_per_token,
-        "kv_peak_bytes_dense": runs["dense"]["kv_peak_bytes"],
-        "kv_peak_bytes_paged": runs["paged"]["kv_peak_bytes"],
-        "defer_admissions": runs["paged"]["defer_admissions"],
-        "kv_blocks_in_use_after_drain": leak,
-        "tokens_identical": identical,
-        "regression": ((not identical) or leak != 0
-                       or paged_peak < 2 * dense_peak),
-        "config": f"{model} ladder{ladder} gen{gen} "
-                  f"prefill{prefill_len} block{block} "
-                  f"pool{pool_blocks} slots{dense_slots}v"
-                  f"{dense_slots * 4} top{top}",
-    }
-
-
-def _decode_burst_tps(d, gen, n_thr=8, rounds=3) -> float:
-    """Decode-heavy tokens/s of ``n_thr`` concurrent full-length
-    generations, best of ``rounds`` after an untimed warm burst. Which
-    admission batch buckets the warm burst compiles depends on thread
-    arrival races, so early timed rounds can still eat a stray compile;
-    the best round is the steady state both paths are compared at."""
-    def one(i):
-        return len(d.submit([3 + (i % 7)] * 8, gen).result()["tokens"])
-
-    with ThreadPoolExecutor(n_thr) as pool:
-        list(pool.map(one, range(n_thr)))  # warm the common buckets
-    best = 0.0
-    for _ in range(rounds):
-        t0 = time.perf_counter()
-        with ThreadPoolExecutor(n_thr) as pool:
-            emitted = sum(pool.map(one, range(n_thr)))
-        best = max(best, emitted / (time.perf_counter() - t0))
-    return best
 
 
 def _bench_kv_dtype_sweep(args, model) -> dict:
@@ -2554,6 +2285,19 @@ def main() -> int:
                          "(byte-identical greedy incl. prefix sharing "
                          "+ CoW + int8 + cross-mesh handoff, per-chip "
                          "tokens/s gate, zero leaked blocks)")
+    ap.add_argument("--scenario", default="",
+                    help="run a named scenario from the shared registry "
+                         "(kubeflow_tpu/serving/scenarios.py) — the same "
+                         "implementation ExperimentController trials "
+                         "drive; empty knobs = the checked-in defaults")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="trial seed for --scenario (threads through "
+                         "scenario traffic generation, so a re-run "
+                         "observes the same trace)")
+    ap.add_argument("--assignments", default="",
+                    help="JSON knob assignments for --scenario (what a "
+                         "job-mode experiment trial passes); empty = "
+                         "the checked-in defaults")
     args = ap.parse_args()
 
     if (args.tp_sweep or args.weight_push_sweep) and \
@@ -2567,7 +2311,17 @@ def main() -> int:
             os.environ.get("XLA_FLAGS", "")
             + " --xla_force_host_platform_device_count=8").strip()
     on_tpu = jax.default_backend() == "tpu"
-    if args.flash_crowd_sweep:
+    if args.scenario:
+        model = "llama-1b" if on_tpu and not args.quick else "lm-test-tiny"
+        sc = get_scenario(args.scenario)
+        assignments = json.loads(args.assignments) if args.assignments \
+            else {}
+        if sc.bench is not None and not assignments:
+            result = sc.bench(args, model)
+        else:
+            result = run_trial(args.scenario, assignments, seed=args.seed,
+                               model=model, quick=args.quick)
+    elif args.flash_crowd_sweep:
         model = "llama-1b" if on_tpu and not args.quick else "lm-test-tiny"
         result = _bench_flash_crowd_sweep(args, model)
     elif args.long_context_sweep:

@@ -37,7 +37,10 @@ SUBSYSTEMS = ("serving", "gateway", "operator", "scheduler", "train",
               # InferenceService autoscaler decisions (operators/
               # inference.py) — the service-facing counter family the
               # flash-crowd dashboards join on.
-              "inference")
+              "inference",
+              # Self-tuning engine (operators/experiment.py): experiment
+              # trial accounting and suggestion-policy counters.
+              "experiment", "tuning")
 
 LABEL_VOCAB = frozenset({
     "kind", "route", "queue", "pool", "reason", "role", "model",
@@ -59,6 +62,11 @@ LABEL_VOCAB = frozenset({
     # Birth phase breakdown: values are exactly {"weights", "compile",
     # "first_token"} (InferenceEngine.cold_start keys).
     "phase",
+    # Self-tuning engine: trial terminal states are a closed enum
+    # (succeeded/failed/preempted/early_stopped), policies are the
+    # tuning/suggestions.py _ALGORITHMS registry, and scenario values
+    # come from the fixed serving/scenarios.py registry.
+    "state", "policy", "scenario",
 })
 
 _NAME_RE = re.compile(r"^[a-z][a-z0-9]*(_[a-z0-9]+)+$")

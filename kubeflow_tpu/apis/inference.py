@@ -108,8 +108,17 @@ def validate_versions(versions: list[dict]) -> list[dict]:
             raise ValueError(
                 f"version {name!r} traffic {traffic} outside [0, 100]")
         total += traffic
-        out.append({"name": name, "weightsRef": str(v["weightsRef"]),
-                    "traffic": traffic})
+        entry = {"name": name, "weightsRef": str(v["weightsRef"]),
+                 "traffic": traffic}
+        # Optional per-version engine knob overrides (an Experiment's
+        # winning config rides its candidate version through the walk).
+        engine = v.get("engine")
+        if engine is not None:
+            if not isinstance(engine, dict):
+                raise ValueError(
+                    f"version {name!r} engine must be an object")
+            entry["engine"] = dict(engine)
+        out.append(entry)
     if abs(total - 100.0) > 1e-6:
         raise ValueError(
             f"spec.versions traffic weights sum to {total}, want 100")
@@ -262,6 +271,13 @@ def inference_service_crd() -> dict:
                                 "traffic": {"type": "number",
                                             "minimum": 0,
                                             "maximum": 100},
+                                # Engine knob overrides the candidate
+                                # carries (Experiment promotion).
+                                "engine": {
+                                    "type": "object",
+                                    "x-kubernetes-preserve-unknown-fields":
+                                        True,
+                                },
                             },
                         },
                     },

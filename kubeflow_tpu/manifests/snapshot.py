@@ -99,6 +99,12 @@ SNAPSHOT_CASES: dict[str, tuple[str, dict]] = {
          "args": ["-logtostderr", "-enable-metrics=true"],
          "metrics_port": 10254},
     ),
+    "experiment": (
+        "experiment",
+        {"name": "decode-knobs", "scenario": "decode-tps",
+         "algorithm": "tpe", "max_trials": 12, "seed": 7,
+         "target": "llama"},
+    ),
     "cert-manager": ("cert-manager", {}),
     "gatekeeper": ("gatekeeper", {"password_hash": "0" * 64}),
     "admission-webhook": ("admission-webhook", {}),

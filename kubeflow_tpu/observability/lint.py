@@ -305,6 +305,22 @@ def _self_check() -> int:
                     {"name": "main", "image": "i"}]}}}}},
         })
         SchedulerController(fake).reconcile_all()
+        # One REAL experiment round (synthetic closed-form scenario, two
+        # trials) so the experiment/tuning families carry samples, not
+        # just TYPE lines.
+        from kubeflow_tpu.apis.experiment import (
+            experiment as experiment_cr,
+            experiment_crd,
+        )
+        from kubeflow_tpu.operators.experiment import ExperimentController
+
+        fake.apply(experiment_crd())
+        fake.create(experiment_cr(
+            "lint-exp", "kubeflow", "synthetic-knobs",
+            algorithm="random", max_trials=2, parallel_trials=2))
+        exp_ctrl = ExperimentController(fake)
+        exp_ctrl.reconcile_all()
+        exp_ctrl.reconcile_all()
         # The elastic-training reshard families live in the same shared
         # registry (train/elastic.py registers them at import) — pull
         # them in before the scrape so their TYPE lines are asserted.
@@ -335,7 +351,10 @@ def _self_check() -> int:
                 ("scheduler_grows_total", "counter"),
                 ("train_reshards_total", "counter"),
                 ("train_reshard_seconds", "histogram"),
-                ("scheduler_unschedulable_jobs", "gauge")):
+                ("scheduler_unschedulable_jobs", "gauge"),
+                ("experiment_trials_total", "counter"),
+                ("experiment_best_objective", "gauge"),
+                ("tuning_suggestions_total", "counter")):
             if type_line(family, kind) not in operator_body:
                 failures.append(
                     f"{operator_url}: scheduler family {family} missing")

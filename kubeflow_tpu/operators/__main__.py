@@ -19,6 +19,7 @@ def make_all_controllers(client):
         EndpointController,
         IssuerController,
     )
+    from kubeflow_tpu.operators.experiment import ExperimentController
     from kubeflow_tpu.operators.inference import InferenceServiceController
     from kubeflow_tpu.operators.jobs import make_job_controllers
     from kubeflow_tpu.operators.notebooks import NotebookController
@@ -42,6 +43,7 @@ def make_all_controllers(client):
         NotebookController(client),
         ProfileController(client),
         StudyJobController(client),
+        ExperimentController(client),
         BenchmarkJobController(client),
         WorkflowController(client),
         ScheduledWorkflowController(client),

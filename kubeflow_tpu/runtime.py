@@ -83,6 +83,7 @@ def platform_registry() -> KindRegistry:
     """KindRegistry pre-loaded with every platform CRD kind, so entrypoints
     can resolve REST paths without a discovery round-trip."""
     from kubeflow_tpu.apis.benchmark import benchmark_job_crd
+    from kubeflow_tpu.apis.experiment import experiment_crd
     from kubeflow_tpu.apis.jobs import all_job_crds
     from kubeflow_tpu.apis.notebooks import notebook_crd
     from kubeflow_tpu.apis.profiles import profile_crd
@@ -90,7 +91,7 @@ def platform_registry() -> KindRegistry:
 
     registry = KindRegistry()
     for crd in [*all_job_crds(), notebook_crd(), profile_crd(),
-                study_job_crd(), benchmark_job_crd()]:
+                study_job_crd(), benchmark_job_crd(), experiment_crd()]:
         registry.register_crd(crd)
     return registry
 

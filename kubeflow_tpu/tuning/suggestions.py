@@ -234,11 +234,102 @@ def _npdf(z):
     return np.exp(-0.5 * z**2) / math.sqrt(2 * math.pi)
 
 
+class TpeSuggestion(Suggestion):
+    """Tree-structured Parzen estimator over the unit hypercube.
+
+    Observations are split at the ``gamma`` quantile into good/bad sets;
+    each set is modelled as a per-dimension Gaussian mixture (one kernel
+    per observation, fixed bandwidth). Candidates are drawn from the good
+    mixture and ranked by the density ratio l(x)/g(x) — cheaper than the
+    GP (no Cholesky) and robust to non-smooth objectives.
+    """
+
+    n_init = 3
+    n_candidates = 64
+    gamma = 0.25
+    bandwidth = 0.15
+
+    def next(self, observations):
+        if len(observations) < self.n_init:
+            return {d.name: d.sample(self.rng) for d in self.domains}
+        x = np.array([
+            [d.to_unit(o.assignments[d.name]) for d in self.domains]
+            for o in observations
+        ])
+        y = np.array([o.objective for o in observations], np.float64)
+        n_good = max(1, int(math.ceil(self.gamma * len(y))))
+        order = np.argsort(-y)
+        good, bad = x[order[:n_good]], x[order[n_good:]]
+        if not len(bad):
+            bad = x
+
+        def mix_logpdf(pts, centers):
+            # Independent per-dim Gaussian KDE, mean over kernels.
+            d2 = (pts[:, None, :] - centers[None, :, :]) ** 2
+            logk = -0.5 * d2 / self.bandwidth**2 - math.log(
+                self.bandwidth * math.sqrt(2 * math.pi))
+            per_dim = _logmeanexp(logk, axis=1)  # (n_pts, n_dims)
+            return per_dim.sum(-1)
+
+        # Sample candidates from the good mixture: pick a kernel, jitter.
+        idx = self.rng.integers(len(good), size=self.n_candidates)
+        cand = np.clip(
+            good[idx] + self.rng.normal(
+                0, self.bandwidth, size=(self.n_candidates, x.shape[1])),
+            0.0, 1.0)
+        score = mix_logpdf(cand, good) - mix_logpdf(cand, bad)
+        u = cand[int(np.argmax(score))]
+        return {
+            d.name: d.from_unit(u[i]) for i, d in enumerate(self.domains)
+        }
+
+
+def _logmeanexp(a, axis):
+    m = a.max(axis=axis, keepdims=True)
+    return (m + np.log(np.mean(np.exp(a - m), axis=axis, keepdims=True))
+            ).squeeze(axis)
+
+
+class MedianEarlyStop:
+    """Early-stop policy in the spirit of Google Vizier's median rule:
+    a running trial is stopped when its latest intermediate objective is
+    strictly below the median of completed trials' objectives at the same
+    (or nearest earlier) step. Maximization convention, like Suggestion.
+    """
+
+    def __init__(self, min_trials: int = 3, start_step: int = 1):
+        self.min_trials = min_trials
+        self.start_step = start_step
+
+    @staticmethod
+    def _value_at(curve: list[tuple[int, float]], step: int):
+        best = None
+        for s, v in curve:
+            if s <= step and (best is None or s > best[0]):
+                best = (s, v)
+        return None if best is None else best[1]
+
+    def should_stop(self, curve: list[tuple[int, float]],
+                    completed: list[list[tuple[int, float]]]) -> bool:
+        """``curve``/``completed`` are (step, objective) series."""
+        if len(completed) < self.min_trials or not curve:
+            return False
+        step, value = max(curve, key=lambda sv: sv[0])
+        if step < self.start_step:
+            return False
+        peers = [self._value_at(c, step) for c in completed]
+        peers = [p for p in peers if p is not None]
+        if len(peers) < self.min_trials:
+            return False
+        return value < float(np.median(peers))
+
+
 _ALGORITHMS = {
     "random": RandomSuggestion,
     "grid": GridSuggestion,
     "hyperband": HyperbandSuggestion,
     "bayesianoptimization": BayesianSuggestion,
+    "tpe": TpeSuggestion,
 }
 
 

@@ -1,0 +1,37 @@
+"""Minimized self-tuning hazard: the experiment controller's objective
+scrape — an HTTP round-trip to the trial replica's exposition endpoint —
+issued UNDER the controller's trial-table lock.
+
+The trial table is what reconcile reads to spawn the next suggestion and
+what the status writer serializes; scraping under it parks every other
+trial's bookkeeping (and the reconcile loop itself) behind one slow or
+dead trial replica. The lock-discipline checker must flag the scrape
+(``lock-blocking-call``).
+"""
+
+import threading
+from urllib.request import urlopen
+
+
+class BadTrialScraper:
+    """Scrapes a trial's objective with the trial-table lock held."""
+
+    def __init__(self, parse_signals):
+        self._trials_lock = threading.Lock()
+        self._parse = parse_signals
+        self._objectives = {}
+
+    def objective(self, index):
+        with self._trials_lock:
+            return self._objectives.get(index)
+
+    def collect(self, index, addr):
+        with self._trials_lock:
+            if index in self._objectives:
+                return self._objectives[index]
+            # BUG: the exposition round-trip runs under the lock every
+            # reconcile pass takes to read the trial table — one hung
+            # trial replica stalls the whole experiment's loop.
+            body = urlopen(f"http://{addr}/metrics", timeout=5).read()
+            self._objectives[index] = self._parse(body.decode())
+            return self._objectives[index]

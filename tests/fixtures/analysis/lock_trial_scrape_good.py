@@ -1,0 +1,32 @@
+"""Self-tuning GOOD twin: check the cache under the trial-table lock,
+run the exposition round-trip with NO lock held (reconcile keeps
+reading the table while the scrape is in flight), then re-take the
+lock only to install the parsed objective — a hung trial replica
+costs its own scrape, never the experiment loop."""
+
+import threading
+from urllib.request import urlopen
+
+
+class GoodTrialScraper:
+    """Check under the lock; scrape outside; install under it again."""
+
+    def __init__(self, parse_signals):
+        self._trials_lock = threading.Lock()
+        self._parse = parse_signals
+        self._objectives = {}
+
+    def objective(self, index):
+        with self._trials_lock:
+            return self._objectives.get(index)
+
+    def collect(self, index, addr):
+        with self._trials_lock:
+            cached = self._objectives.get(index)
+        if cached is not None:
+            return cached
+        body = urlopen(f"http://{addr}/metrics", timeout=5).read()
+        value = self._parse(body.decode())
+        with self._trials_lock:
+            self._objectives[index] = value
+        return value

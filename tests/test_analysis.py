@@ -111,6 +111,20 @@ def test_peer_fetch_io_under_prefix_lock_detected():
     assert all(h.symbol == "BadPeerImporter.import_remote" for h in hits)
 
 
+def test_trial_scrape_under_trials_lock_detected():
+    """The self-tuning engine's exposed class: the objective scrape (an
+    HTTP exposition round-trip) issued while the experiment controller's
+    trial-table lock — the one every reconcile pass reads under — is
+    held. The scrape must be flagged as a blocking call."""
+    found = _findings(FIXTURES / "lock_trial_scrape_bad.py")
+    hits = [f for f in found if f.rule == "lock-blocking-call"]
+    assert hits, found
+    messages = " ".join(h.message for h in hits)
+    assert "_trials_lock" in messages
+    assert "urlopen" in messages
+    assert all(h.symbol == "BadTrialScraper.collect" for h in hits)
+
+
 def test_cache_load_sync_under_dispatch_lock_detected():
     """The flash-crowd birth's exposed class: the compile-cache
     replay's probe-run device sync (a full XLA compile on a miss)
@@ -195,7 +209,7 @@ def test_metrics_exposition_detected():
 def test_good_fixtures_are_clean():
     for name in ("lock_good.py", "lock_elastic_drain_good.py",
                  "lock_weight_swap_good.py", "lock_peer_fetch_good.py",
-                 "lock_cache_load_good.py",
+                 "lock_cache_load_good.py", "lock_trial_scrape_good.py",
                  "thread_lifecycle_good.py",
                  "resource_good.py", "jax_hygiene_good.py",
                  "jax_hygiene_shard_map_good.py",

@@ -34,6 +34,7 @@ def test_all_prototypes_registered():
         "centraldashboard",
         "tpu-serving",
         "inference-service",
+        "experiment",
     ]:
         assert expected in protos, f"missing prototype {expected}"
 

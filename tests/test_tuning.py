@@ -1,6 +1,8 @@
 """Suggestion algorithms + study/benchmark controller tests (the
 katib_studyjob_test.py analogue, driven on the fake apiserver)."""
 
+import pytest
+
 from kubeflow_tpu.apis import jobs as jobs_api
 from kubeflow_tpu.apis.benchmark import benchmark_job, benchmark_job_crd
 from kubeflow_tpu.apis.tuning import (
@@ -14,7 +16,9 @@ from kubeflow_tpu.benchmark import BenchmarkJobController
 from kubeflow_tpu.tuning import StudyJobController
 from kubeflow_tpu.tuning.controller import substitute_parameters
 from kubeflow_tpu.tuning.suggestions import (
+    MedianEarlyStop,
     Observation,
+    ParamDomain,
     domains_from_spec,
     get_algorithm,
 )
@@ -25,6 +29,123 @@ PARAMS = [
     categorical_param("opt", ["adam", "sgd"]),
 ]
 DOMAINS = domains_from_spec(PARAMS)
+
+
+# ---------------------------------------------------------------------------
+# ParamDomain unit-cube mapping: property tests (the GP/TPE proposers
+# live entirely on [0,1]^d — a broken round-trip silently corrupts every
+# observation they condition on)
+# ---------------------------------------------------------------------------
+
+UNIT_GRID = [i / 16 for i in range(17)]  # includes both boundaries
+
+
+def _double(lo, hi, log=False):
+    space = {"min": lo, "max": hi}
+    if log:
+        space["logScale"] = True
+    return ParamDomain("x", "double", space)
+
+
+@pytest.mark.parametrize("dom", [
+    _double(0.0, 1.0),
+    _double(-3.5, 7.25),
+    _double(1e-5, 1e-1, log=True),
+    _double(2.0, 4096.0, log=True),
+], ids=["unit", "shifted", "log-small", "log-wide"])
+def test_double_unit_round_trip(dom):
+    lo, hi = float(dom.space["min"]), float(dom.space["max"])
+    for u in UNIT_GRID:
+        v = dom.from_unit(u)
+        assert lo - abs(lo) * 1e-9 <= v <= hi + abs(hi) * 1e-9
+        # from_unit/to_unit is a bijection on doubles (linear AND log).
+        assert dom.to_unit(v) == pytest.approx(u, abs=1e-9)
+    # Boundaries land exactly on the range ends.
+    assert dom.from_unit(0.0) == pytest.approx(lo)
+    assert dom.from_unit(1.0) == pytest.approx(hi)
+    assert dom.to_unit(lo) == pytest.approx(0.0, abs=1e-9)
+    assert dom.to_unit(hi) == pytest.approx(1.0, abs=1e-9)
+    # Out-of-cube proposals clip instead of extrapolating.
+    assert dom.from_unit(-0.5) == pytest.approx(lo)
+    assert dom.from_unit(1.5) == pytest.approx(hi)
+
+
+@pytest.mark.parametrize("lo,hi", [(0, 1), (1, 64), (-4, 4), (3, 3)])
+def test_int_unit_round_trip(lo, hi):
+    dom = ParamDomain("n", "int", {"min": lo, "max": hi})
+    for v in range(lo, hi + 1):
+        # Integers survive the full round trip exactly: to the cube and
+        # back is the identity on every feasible value.
+        assert dom.from_unit(dom.to_unit(v)) == v
+    for u in UNIT_GRID:
+        v = dom.from_unit(u)
+        assert isinstance(v, int) and lo <= v <= hi
+    assert dom.from_unit(0.0) == lo and dom.from_unit(1.0) == hi
+
+
+def test_categorical_unit_round_trip():
+    dom = ParamDomain("c", "categorical", {"list": ["a", "b", "c"]})
+    for v in ("a", "b", "c"):
+        assert dom.from_unit(dom.to_unit(v)) == v
+
+
+@pytest.mark.parametrize("policy",
+                         ["random", "bayesianoptimization", "tpe"])
+def test_suggestion_next_deterministic_under_seed(policy):
+    """The reproducibility contract the controller builds on: one seed
+    replays the exact proposal stream for the same observation history."""
+    obs = []
+    rng_algo = get_algorithm("random", DOMAINS, seed=99)
+    for i in range(6):
+        a = rng_algo.next(obs)
+        obs.append(Observation(a, float(i % 3)))
+
+    def stream(seed):
+        algo = get_algorithm(policy, DOMAINS, seed=seed)
+        out = []
+        history = list(obs)
+        for i in range(5):
+            a = algo.next(history)
+            out.append(a)
+            history.append(Observation(a, 0.1 * i))
+        return out
+
+    assert stream(7) == stream(7)
+    assert stream(7) != stream(8)
+
+
+def test_tpe_concentrates_near_optimum():
+    # Maximize -(x-0.7)^2: after warm-up TPE's proposals should cluster
+    # around the good region rather than staying uniform.
+    dom = domains_from_spec([double_param("x", 0.0, 1.0)])
+    algo = get_algorithm("tpe", dom, seed=3)
+    obs = []
+    for _ in range(25):
+        a = algo.next(obs)
+        assert 0.0 <= a["x"] <= 1.0
+        obs.append(Observation(a, -(a["x"] - 0.7) ** 2))
+    best = max(obs, key=lambda o: o.objective)
+    assert abs(best.assignments["x"] - 0.7) < 0.15
+    late = [o.assignments["x"] for o in obs[15:]]
+    assert sum(abs(x - 0.7) < 0.25 for x in late) >= len(late) // 2
+
+
+def test_median_early_stop_rule():
+    stop = MedianEarlyStop(min_trials=3)
+    completed = [[(1, 40.0), (2, 80.0)],
+                 [(1, 45.0), (2, 90.0)],
+                 [(1, 50.0), (2, 100.0)]]
+    # Below the median of peers at the same step: stop.
+    assert stop.should_stop([(1, 5.0), (2, 10.0)], completed)
+    # At/above the median: keep running.
+    assert not stop.should_stop([(1, 48.0), (2, 95.0)], completed)
+    # Not enough completed trials to trust the median: never stop.
+    assert not stop.should_stop([(1, 5.0)], completed[:2])
+    # No intermediate measurements yet: nothing to judge.
+    assert not stop.should_stop([], completed)
+    # Peers are compared at the nearest earlier step when the running
+    # trial is ahead of them.
+    assert stop.should_stop([(3, 10.0)], completed)
 
 
 def test_random_suggestion_in_bounds():
